@@ -1,0 +1,1 @@
+"""Numerical ops: aggregation, pipelined collectives, attention kernels."""

@@ -1,0 +1,108 @@
+"""Weighted FedAvg over parameter pytrees.
+
+Behavioral parity with the reference aggregator
+(``/root/reference/src/Utils.py:35-66``), re-expressed over JAX pytrees:
+
+* weighted average with weights normalized by the *total* weight (absent
+  contributors still dilute — the reference divides by ``sum(weights)`` even
+  for keys only some clients have);
+* union of keys across contributors (a key missing from a client simply
+  contributes nothing);
+* NaNs zero-filled before averaging;
+* integer/bool leaves are averaged in float then rounded back to the original
+  dtype.
+
+Two forms: a host-side tree fold (used at round barriers by the orchestrator,
+mirrors the server's UPDATE handling) and an in-mesh form
+(:func:`fedavg_psum`) that runs the same weighted mean as a ``psum`` over a
+mesh axis inside a jitted step — the TPU-native path where all clients of a
+stage live on devices of one slice.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Sequence
+
+import jax
+import jax.numpy as jnp
+
+
+def _is_int_dtype(dtype) -> bool:
+    return (jnp.issubdtype(dtype, jnp.integer)
+            or jnp.issubdtype(dtype, jnp.bool_))
+
+
+def _avg_leaves(leaves: Sequence[jnp.ndarray], weights: Sequence[float],
+                total_w: float) -> jnp.ndarray:
+    orig_dtype = leaves[0].dtype
+    acc = None
+    for leaf, w in zip(leaves, weights):
+        t = jnp.nan_to_num(jnp.asarray(leaf, dtype=jnp.float32)) * w
+        acc = t if acc is None else acc + t
+    avg = acc / total_w
+    if _is_int_dtype(orig_dtype):
+        return jnp.round(avg).astype(orig_dtype)
+    return avg.astype(orig_dtype)
+
+
+def fedavg_trees(trees: Sequence[Any],
+                 weights: Sequence[float] | None = None) -> Any:
+    """Weighted FedAvg over a list of pytrees (flat or nested dicts).
+
+    Dict nodes are merged by key union; non-dict leaves are averaged.  Shapes
+    of shared leaves must match (the reference has the same constraint — it
+    adds tensors elementwise).
+    """
+    if not trees:
+        raise ValueError("fedavg_trees: empty input")
+    if weights is None:
+        weights = [1.0] * len(trees)
+    total_w = float(sum(weights))
+
+    def merge(nodes_weights):
+        nodes = [n for n, _ in nodes_weights]
+        if isinstance(nodes[0], dict):
+            keys = set().union(*(n.keys() for n in nodes))
+            return {
+                k: merge([(n[k], w) for n, w in nodes_weights if k in n])
+                for k in sorted(keys)
+            }
+        ws = [w for _, w in nodes_weights]
+        return _avg_leaves(nodes, ws, total_w)
+
+    return merge(list(zip(trees, weights)))
+
+
+def fedavg_psum(params: Any, weight: jnp.ndarray, axis_name: str) -> Any:
+    """In-mesh weighted FedAvg: each mesh index along ``axis_name`` holds one
+    client's params and a scalar sample weight; returns the weighted mean,
+    replicated along the axis.
+
+    Preserves the reference's NaN-zeroing and integer-rounding semantics so a
+    client whose shard diverged (NaN weights) contributes zeros, diluted by
+    its weight, exactly as the host-side fold does.
+    """
+    total_w = jax.lax.psum(weight, axis_name)
+
+    def avg(leaf):
+        orig_dtype = leaf.dtype
+        t = jnp.nan_to_num(leaf.astype(jnp.float32)) * weight
+        s = jax.lax.psum(t, axis_name) / total_w
+        if _is_int_dtype(orig_dtype):
+            return jnp.round(s).astype(orig_dtype)
+        return s.astype(orig_dtype)
+
+    return jax.tree_util.tree_map(avg, params)
+
+
+def concatenate_shards(shard_trees: Sequence[dict]) -> dict:
+    """Reassemble a full-model param dict from per-stage shard dicts.
+
+    Mirrors the server's cluster concatenation
+    (``src/Server.py:410-434``): later shards' keys overwrite earlier ones on
+    collision (there should be none for a clean split).
+    """
+    full: dict = {}
+    for sd in shard_trees:
+        full.update(sd)
+    return full

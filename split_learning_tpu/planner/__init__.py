@@ -1,0 +1,24 @@
+"""Resource-aware planning: who runs which stage, where to cut, who is too slow.
+
+This package is the TPU-native counterpart of the reference's server-side
+"brains" (``src/Partition.py``, ``src/Selection.py``, ``src/Cluster.py`` and
+the label-distribution synthesis in ``src/Server.py:87-101``).  All functions
+are pure and CPU-cheap; their output feeds the mesh planner
+(:mod:`split_learning_tpu.planner.mesh`) which maps (cluster, client, stage)
+onto a ``jax.sharding.Mesh``.
+"""
+
+from split_learning_tpu.planner.partition import partition, partition_multiway
+from split_learning_tpu.planner.selection import auto_threshold, select_devices
+from split_learning_tpu.planner.cluster import kmeans_cluster, clustering_algorithm
+from split_learning_tpu.planner.distribution import synthesize_label_counts
+
+__all__ = [
+    "partition",
+    "partition_multiway",
+    "auto_threshold",
+    "select_devices",
+    "kmeans_cluster",
+    "clustering_algorithm",
+    "synthesize_label_counts",
+]

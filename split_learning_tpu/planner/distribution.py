@@ -1,0 +1,33 @@
+"""Per-client label-count synthesis (IID / Dirichlet non-IID).
+
+Parity with the reference server's ``distribution()``
+(``/root/reference/src/Server.py:87-101``): stage-1 clients each get a
+per-label sample-count vector — IID mode splits ``num_sample`` evenly over
+labels for every client; non-IID mode draws each client's label distribution
+from ``Dirichlet(alpha * 1)`` and scales to ``num_sample``.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def synthesize_label_counts(num_clients: int, num_labels: int,
+                            num_samples: int, non_iid: bool = False,
+                            alpha: float = 1.0,
+                            seed: int | None = None) -> np.ndarray:
+    """(num_clients, num_labels) int array of per-label sample counts."""
+    if num_clients <= 0:
+        return np.zeros((0, num_labels), dtype=int)
+    if non_iid:
+        rng = np.random.default_rng(seed)
+        probs = rng.dirichlet([alpha] * num_labels, size=num_clients)
+        return (probs * num_samples).astype(int)
+    return np.full((num_clients, num_labels), num_samples // num_labels,
+                   dtype=int)
+
+
+def fixed_matrix_label_counts(matrix) -> np.ndarray:
+    """Pass-through for the FLEX variant's hardcoded non-IID matrix
+    (``other/FLEX/src/Server.py:80-93``) expressed as a config value."""
+    return np.asarray(matrix, dtype=int)

@@ -1,0 +1,30 @@
+"""Test harness: force JAX onto 8 virtual CPU devices before jax imports.
+
+Multi-chip hardware is unavailable in CI; every mesh/pipeline test runs on a
+virtual 8-device CPU topology (SURVEY.md §4 test plan item (c)).
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"  # force: the shell may pin a TPU platform
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+# A sitecustomize may have pre-imported jax and pinned a TPU platform before
+# this file runs; the config update wins over the env var in that case.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def eight_devices():
+    import jax
+    devs = jax.devices()
+    assert len(devs) >= 8, f"expected 8 virtual devices, got {len(devs)}"
+    return devs

@@ -1,0 +1,85 @@
+"""FedAvg parity tests: hand values, key union, NaN zeroing, int rounding,
+and host-fold ≡ in-mesh psum equivalence."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from split_learning_tpu.ops.fedavg import (
+    fedavg_trees, fedavg_psum, concatenate_shards,
+)
+
+
+def test_weighted_mean_hand_value():
+    a = {"w": jnp.array([1.0, 2.0])}
+    b = {"w": jnp.array([3.0, 4.0])}
+    out = fedavg_trees([a, b], weights=[1.0, 3.0])
+    np.testing.assert_allclose(out["w"], [(1 + 9) / 4, (2 + 12) / 4])
+
+
+def test_key_union_dilutes_by_total_weight():
+    # key only in one tree still divides by total weight (reference semantics)
+    a = {"w": jnp.array([4.0]), "only_a": jnp.array([8.0])}
+    b = {"w": jnp.array([0.0])}
+    out = fedavg_trees([a, b])
+    np.testing.assert_allclose(out["w"], [2.0])
+    np.testing.assert_allclose(out["only_a"], [4.0])  # 8*1/2
+
+
+def test_nan_zero_filled():
+    a = {"w": jnp.array([jnp.nan, 2.0])}
+    b = {"w": jnp.array([4.0, 4.0])}
+    out = fedavg_trees([a, b])
+    np.testing.assert_allclose(out["w"], [2.0, 3.0])
+
+
+def test_int_dtype_rounded_back():
+    a = {"step": jnp.array([3], dtype=jnp.int32)}
+    b = {"step": jnp.array([4], dtype=jnp.int32)}
+    out = fedavg_trees([a, b])
+    assert out["step"].dtype == jnp.int32
+    assert int(out["step"][0]) == 4  # 3.5 rounds to 4 (round-half-even)
+
+
+def test_nested_trees():
+    a = {"block": {"w": jnp.ones((2, 2)), "b": jnp.zeros(2)}}
+    b = {"block": {"w": 3 * jnp.ones((2, 2)), "b": 2 * jnp.ones(2)}}
+    out = fedavg_trees([a, b])
+    np.testing.assert_allclose(out["block"]["w"], 2 * np.ones((2, 2)))
+    np.testing.assert_allclose(out["block"]["b"], np.ones(2))
+
+
+def test_empty_raises():
+    with pytest.raises(ValueError):
+        fedavg_trees([])
+
+
+def test_psum_matches_host_fold(eight_devices):
+    from jax.sharding import Mesh, PartitionSpec as P
+    from jax.experimental.shard_map import shard_map
+
+    n = 4
+    mesh = Mesh(np.array(eight_devices[:n]), ("client",))
+    rng = np.random.default_rng(0)
+    params = np.stack([rng.normal(size=(3, 5)) for _ in range(n)]).astype(np.float32)
+    weights = np.array([1.0, 2.0, 3.0, 4.0], dtype=np.float32)
+    params[1, 0, 0] = np.nan  # diverged client contributes zeros there
+
+    @jax.jit
+    def run(p, w):
+        def body(p, w):
+            return fedavg_psum(p[0], w[0], "client")[None]
+        return shard_map(body, mesh=mesh, in_specs=(P("client"), P("client")),
+                         out_specs=P("client"))(p, w)
+
+    out = np.asarray(run(params, weights))
+    host = fedavg_trees([params[i] for i in range(n)],
+                        weights=[float(w) for w in weights])
+    for i in range(n):  # replicated along axis
+        np.testing.assert_allclose(out[i], np.asarray(host), rtol=1e-6)
+
+
+def test_concatenate_shards():
+    full = concatenate_shards([{"l1": 1, "l2": 2}, {"l3": 3}])
+    assert full == {"l1": 1, "l2": 2, "l3": 3}

@@ -1,0 +1,125 @@
+"""Unit tests for the planner math against hand-computed values
+(SURVEY.md §4 plan item (a))."""
+
+import numpy as np
+import pytest
+
+from split_learning_tpu.planner import (
+    partition, partition_multiway, auto_threshold, select_devices,
+    kmeans_cluster, clustering_algorithm, synthesize_label_counts,
+)
+from split_learning_tpu.planner.cluster import affinity_propagation
+
+
+class TestPartition:
+    def test_hand_computed_two_layer(self):
+        # 3 layers, 1 device per group. exe times [1,1,1] both sides,
+        # bandwidth 1 byte/sec, activation sizes [1, 10, 1].
+        # cut=0: min(1/(1+1), 1/(2+1)) = 1/3
+        # cut=1: min(1/(2+10), 1/(1+10)) = 1/12
+        # cut=2: min(1/(3+1), 1/(0+1)) = 1/4  <-- best is cut index 0? no:
+        # 1/3 > 1/4 > 1/12 -> best cut index 0 -> returns [1]
+        cuts = partition([[1, 1, 1]], [1.0], [[1, 1, 1]], [1.0], [1, 10, 1])
+        assert cuts == [1]
+
+    def test_prefers_balanced_cut_with_uniform_sizes(self):
+        # uniform activation sizes & bandwidth: balance compute.
+        exe = [[1.0, 1.0, 1.0, 1.0]]
+        cuts = partition(exe, [1e9], exe, [1e9], [4, 4, 4, 4])
+        assert cuts == [2]  # 2 layers each side
+
+    def test_many_clients_aggregate_rate(self):
+        # group 1 has 10 slow devices, group 2 one fast: rates add, so the
+        # cut shifts work onto the populous group.
+        exe1 = [[1.0, 1.0, 1.0, 1.0]] * 10
+        exe2 = [[0.1, 0.1, 0.1, 0.1]]
+        cuts = partition(exe1, [1e9] * 10, exe2, [1e9], [1, 1, 1, 1])
+        assert cuts[0] <= 2
+
+    def test_multiway_balances_three_groups(self):
+        exe = [[1.0] * 6]
+        cuts = partition_multiway([exe, exe, exe], [[1e9], [1e9], [1e9]],
+                                  [1, 1, 1, 1, 1, 1])
+        assert cuts == [2, 4]  # 2 layers per stage
+
+
+class TestSelection:
+    def test_bimodal_speeds_split(self):
+        slow = [1.0, 1.1, 0.9, 1.05]
+        fast = [100.0, 110.0, 95.0, 105.0]
+        thr = auto_threshold(slow + fast)
+        assert max(slow) < thr < min(fast)
+
+    def test_mask_keeps_fast(self):
+        speeds = [1.0, 1.1, 100.0, 110.0, 95.0]
+        mask, thr = select_devices(speeds, enabled=True)
+        assert mask.tolist() == [False, False, True, True, True]
+
+    def test_disabled_keeps_all(self):
+        mask, thr = select_devices([1, 100, 1000], enabled=False)
+        assert mask.all() and thr == 0.0
+
+    def test_single_device(self):
+        assert auto_threshold([5.0]) == 0.0
+
+
+class TestCluster:
+    def test_two_obvious_clusters(self):
+        a = [[100, 0, 0], [90, 5, 0], [95, 0, 5]]
+        b = [[0, 0, 100], [0, 10, 90], [5, 0, 95]]
+        labels, info = kmeans_cluster(a + b, 2)
+        assert len(set(labels[:3])) == 1 and len(set(labels[3:])) == 1
+        assert labels[0] != labels[3]
+        assert sorted(x[0] for x in info) == [3, 3]
+
+    def test_l1_normalization_makes_scale_irrelevant(self):
+        # same distribution at different scales must co-cluster
+        x = [[10, 0], [1000, 0], [0, 10], [0, 1000]]
+        labels, _ = kmeans_cluster(x, 2)
+        assert labels[0] == labels[1] and labels[2] == labels[3]
+        assert labels[0] != labels[2]
+
+    def test_dispatcher(self):
+        x = [[1, 0], [0, 1], [1, 0], [0, 1]]
+        labels, info = clustering_algorithm(x, 2, algorithm="KMeans")
+        assert len(labels) == 4
+        with pytest.raises(ValueError):
+            clustering_algorithm(x, 2, algorithm="DBSCAN")
+
+    def test_affinity_propagation_groups(self):
+        x = np.array([[1.0, 0, 0]] * 4 + [[0, 0, 1.0]] * 4)
+        labels = affinity_propagation(x)
+        assert len(set(labels[:4])) == 1 and len(set(labels[4:])) == 1
+        assert labels[0] != labels[7]
+
+
+class TestDistribution:
+    def test_iid(self):
+        counts = synthesize_label_counts(3, 10, 5000, non_iid=False)
+        assert counts.shape == (3, 10)
+        assert (counts == 500).all()
+
+    def test_dirichlet_sums(self):
+        counts = synthesize_label_counts(8, 10, 5000, non_iid=True,
+                                         alpha=0.3, seed=1)
+        assert counts.shape == (8, 10)
+        # int truncation loses at most num_labels samples per client
+        assert ((counts.sum(axis=1) <= 5000)
+                & (counts.sum(axis=1) > 5000 - 10)).all()
+
+    def test_dirichlet_alpha_skew(self):
+        # small alpha -> concentrated; large alpha -> near-uniform
+        skew = synthesize_label_counts(50, 10, 1000, True, alpha=0.05, seed=0)
+        flat = synthesize_label_counts(50, 10, 1000, True, alpha=100.0, seed=0)
+        assert skew.max(axis=1).mean() > flat.max(axis=1).mean()
+
+
+class TestSelectionRobustness:
+    def test_zero_speed_device_rejected_not_crash(self):
+        mask, thr = select_devices([0.0, 1.0, 1.1, 100.0, 110.0])
+        assert thr > 0
+        assert not mask[0]
+
+    def test_two_device_cluster_rejects_straggler(self):
+        mask, thr = select_devices([1.0, 100.0])
+        assert mask.tolist() == [False, True]
