@@ -1,0 +1,23 @@
+"""Split-aware model zoo.
+
+Every model is expressed once as an ordered list of indexed
+:class:`~split_learning_tpu.models.split.LayerSpec` entries; the generic
+:class:`~split_learning_tpu.models.split.SplitModel` materializes any
+contiguous slice of it — the TPU-native counterpart of the reference's
+per-model ``Klass(start_layer, end_layer)`` pattern
+(``/root/reference/src/model/VGG16_CIFAR10.py:4-9``) without one class per
+model/shard combination.
+"""
+
+from split_learning_tpu.models.split import (
+    LayerSpec, SplitModel, build_model, model_registry, register_model,
+    shard_params, merge_shard_params, num_layers,
+)
+import split_learning_tpu.models.vgg  # noqa: F401  (registers VGG16_*)
+import split_learning_tpu.models.bert  # noqa: F401  (registers BERT_*)
+import split_learning_tpu.models.kwt  # noqa: F401  (registers KWT_*)
+
+__all__ = [
+    "LayerSpec", "SplitModel", "build_model", "model_registry",
+    "register_model", "shard_params", "merge_shard_params", "num_layers",
+]

@@ -1,0 +1,66 @@
+"""BERT-base as 15 macro-layers for AG-News (and the 6-label emotion
+variant).
+
+Indexing parity with the reference (``/root/reference/src/model/
+BERT_AGNEWS.py:185-200``): layer 1 = embeddings, layers 2-13 = encoder
+blocks, 14 = CLS pooler, 15 = classifier.  ``BERT_EMOTION`` mirrors the
+Vanilla_SL variant's 6-label model at the same macro granularity.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax.numpy as jnp
+
+from split_learning_tpu.models.split import (
+    LayerSpec, register_model,
+    module_train_fn as _train_fn, module_plain_fn as _plain_fn,
+)
+from split_learning_tpu.models.transformer import (
+    BertBlock, BertEmbeddings, Pooler, ClassifierHead,
+)
+
+
+def _bert_specs(num_labels: int, vocab_size: int = 28996,
+                hidden_size: int = 768, num_heads: int = 12,
+                intermediate_size: int = 3072,
+                max_position_embeddings: int = 512, n_block: int = 12,
+                dropout_rate: float = 0.1, dtype=jnp.float32) -> tuple:
+    specs = [LayerSpec(
+        name="layer1",
+        make=functools.partial(
+            BertEmbeddings, vocab_size=vocab_size, hidden_size=hidden_size,
+            max_position_embeddings=max_position_embeddings,
+            dropout_rate=dropout_rate, dtype=dtype),
+        fn=_train_fn)]
+    for i in range(n_block):
+        specs.append(LayerSpec(
+            name=f"layer{2 + i}",
+            make=functools.partial(
+                BertBlock, hidden_size=hidden_size, num_heads=num_heads,
+                intermediate_size=intermediate_size,
+                dropout_rate=dropout_rate, dtype=dtype),
+            fn=_train_fn))
+    specs.append(LayerSpec(
+        name=f"layer{2 + n_block}",
+        make=functools.partial(Pooler, hidden_size=hidden_size, dtype=dtype),
+        fn=_plain_fn))
+    specs.append(LayerSpec(
+        name=f"layer{3 + n_block}",
+        make=functools.partial(ClassifierHead, num_labels=num_labels,
+                               dropout_rate=dropout_rate, dtype=dtype),
+        fn=_train_fn))
+    return tuple(specs)
+
+
+@register_model("BERT_AGNEWS")
+def bert_agnews(dtype=jnp.float32, **kw) -> tuple:
+    """AG-News: 4 classes, input (B, 128) int token ids."""
+    return _bert_specs(4, dtype=dtype, **kw)
+
+
+@register_model("BERT_EMOTION")
+def bert_emotion(dtype=jnp.float32, **kw) -> tuple:
+    """Emotion: 6 classes (Vanilla_SL variant parity at macro granularity)."""
+    return _bert_specs(6, dtype=dtype, **kw)

@@ -1,0 +1,104 @@
+"""Keyword-Transformer over MFCC features as 17 indexed layers.
+
+Indexing parity with the reference (``/root/reference/src/model/
+KWT_SPEECHCOMMANDS.py:28-67``): 1 = linear patch embed (with the
+time-major transpose), 2 = CLS token, 3 = positional embedding + dropout,
+4-15 = pre-LN encoder blocks, 16 = LayerNorm on the CLS position,
+17 = classification head.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from split_learning_tpu.models.split import (
+    LayerSpec, register_model,
+    module_train_fn as _train_fn, module_plain_fn as _plain_fn,
+)
+from split_learning_tpu.models.transformer import PreLNBlock
+
+
+class _TimeMajorEmbed(nn.Module):
+    """(B, n_mfcc, T) -> (B, T, embed_dim) linear embedding."""
+    embed_dim: int
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        x = jnp.swapaxes(x, 1, 2)
+        return nn.Dense(self.embed_dim, dtype=self.dtype, name="embed")(x)
+
+
+class _ClsToken(nn.Module):
+    embed_dim: int
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        cls = self.param("cls_token",
+                         nn.initializers.truncated_normal(0.02),
+                         (1, 1, self.embed_dim))
+        cls = jnp.broadcast_to(cls, (x.shape[0], 1, self.embed_dim))
+        return jnp.concatenate([cls.astype(x.dtype), x], axis=1)
+
+
+class _PosEmbed(nn.Module):
+    seq_len: int
+    embed_dim: int
+    dropout_rate: float = 0.1
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        pos = self.param("pos_embed",
+                         nn.initializers.truncated_normal(0.02),
+                         (1, self.seq_len, self.embed_dim))
+        x = x + pos.astype(x.dtype)
+        return nn.Dropout(self.dropout_rate)(x, deterministic=not train)
+
+
+def _cls_norm_fn(mod, x, train):
+    return mod(x[:, 0])
+
+
+@register_model("KWT_SPEECHCOMMANDS")
+def kwt_speechcommands(n_mfcc: int = 40, time_steps: int = 98,
+                       embed_dim: int = 64, num_heads: int = 1,
+                       mlp_dim: int = 256, num_classes: int = 10,
+                       dropout_rate: float = 0.1,
+                       dtype=jnp.float32) -> tuple:
+    specs = [
+        LayerSpec("layer1",
+                  make=functools.partial(_TimeMajorEmbed,
+                                         embed_dim=embed_dim, dtype=dtype),
+                  fn=_plain_fn),
+        LayerSpec("layer2",
+                  make=functools.partial(_ClsToken, embed_dim=embed_dim,
+                                         dtype=dtype),
+                  fn=_plain_fn),
+        LayerSpec("layer3",
+                  make=functools.partial(_PosEmbed, seq_len=time_steps + 1,
+                                         embed_dim=embed_dim,
+                                         dropout_rate=dropout_rate,
+                                         dtype=dtype),
+                  fn=_train_fn),
+    ]
+    for i in range(12):
+        specs.append(LayerSpec(
+            f"layer{4 + i}",
+            make=functools.partial(PreLNBlock, embed_dim=embed_dim,
+                                   num_heads=num_heads, mlp_dim=mlp_dim,
+                                   dtype=dtype),
+            fn=_train_fn))
+    specs.append(LayerSpec(
+        "layer16", make=functools.partial(nn.LayerNorm, dtype=dtype),
+        fn=_cls_norm_fn))
+    specs.append(LayerSpec(
+        "layer17", make=functools.partial(nn.Dense, features=num_classes,
+                                          dtype=dtype),
+        fn=_plain_fn))
+    assert len(specs) == 17
+    return tuple(specs)

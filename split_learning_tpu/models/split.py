@@ -1,0 +1,204 @@
+"""Generic layer-indexed model splitting.
+
+The reference materializes a model shard with per-layer ``if start < i <=
+end`` guards duplicated across every model file
+(``/root/reference/src/model/VGG16_CIFAR10.py:9-117``).  Here the same
+semantics — 1-based layer indices, a shard owns layers ``start+1..end``,
+``end == -1`` means "to the end" — live once in :class:`SplitModel`, and a
+model is just a tuple of :class:`LayerSpec`.
+
+Shard parameters are keyed by **absolute** layer name (``layer7`` is
+``layer7`` in every shard and in the full model), so shard state transfer,
+FedAvg across shards, and full-model reassembly are plain dict slicing —
+the pytree analog of the reference's state_dict key matching
+(``src/Server.py:230-256``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class LayerSpec:
+    """One indexed layer of a splittable model.
+
+    ``make`` builds a flax module given a ``name`` (parametric layers) or
+    returns ``None`` with ``fn`` set instead (param-free ops: activation,
+    pooling, reshape).  ``fn`` signature: ``fn(module_or_none, x, train)``.
+    """
+    name: str
+    make: Callable[..., nn.Module] | None = None
+    fn: Callable[..., Any] | None = None
+
+    def __post_init__(self):
+        if self.make is None and self.fn is None:
+            raise ValueError(
+                f"LayerSpec {self.name}: at least one of make/fn required")
+
+
+class SplitModel(nn.Module):
+    """A contiguous slice ``start_layer+1 .. end_layer`` of a layer list.
+
+    ``start_layer=0, end_layer=-1`` (or ``len(specs)``) is the full model.
+    Layer indices are 1-based to match the reference's protocol surface
+    (cut layers, ``layers`` ranges in START messages).
+    """
+    specs: tuple  # tuple[LayerSpec, ...] — static, hashable for jit
+    start_layer: int = 0
+    end_layer: int = -1
+
+    @property
+    def resolved_end(self) -> int:
+        return len(self.specs) if self.end_layer == -1 else self.end_layer
+
+    def setup(self):
+        owned = {}
+        for i, spec in enumerate(self.specs, start=1):
+            if self.start_layer < i <= self.resolved_end and spec.make:
+                owned[spec.name] = spec.make(name=spec.name)
+        self._owned = owned
+
+    def __call__(self, x, train: bool = False):
+        for i, spec in enumerate(self.specs, start=1):
+            if not (self.start_layer < i <= self.resolved_end):
+                continue
+            if spec.make:
+                mod = self._owned[spec.name]
+                x = spec.fn(mod, x, train) if spec.fn else mod(x)
+            else:
+                x = spec.fn(None, x, train)
+        return x
+
+
+# --------------------------------------------------------------------------
+# registry
+# --------------------------------------------------------------------------
+
+_REGISTRY: dict[str, Callable[..., tuple]] = {}
+
+
+def register_model(name: str):
+    """Decorator: register a ``(**kw) -> tuple[LayerSpec, ...]`` spec builder."""
+    def deco(fn):
+        _REGISTRY[name] = fn
+        return fn
+    return deco
+
+
+def model_registry() -> dict[str, Callable[..., tuple]]:
+    return dict(_REGISTRY)
+
+
+def build_model(name: str, start_layer: int = 0, end_layer: int = -1,
+                **kwargs) -> SplitModel:
+    """Instantiate a shard of a registered model.
+
+    ``name`` follows the reference's ``{MODEL}_{DATASET}`` convention
+    (e.g. ``VGG16_CIFAR10``).
+    """
+    if name not in _REGISTRY:
+        raise KeyError(
+            f"unknown model {name!r}; known: {sorted(_REGISTRY)}")
+    specs = _REGISTRY[name](**kwargs)
+    return SplitModel(specs=specs, start_layer=start_layer,
+                      end_layer=end_layer)
+
+
+def num_layers(name: str, **kwargs) -> int:
+    return len(_REGISTRY[name](**kwargs))
+
+
+# --------------------------------------------------------------------------
+# shard pytree slicing
+# --------------------------------------------------------------------------
+
+def _layer_index(specs: Sequence[LayerSpec], layer_name: str) -> int:
+    for i, s in enumerate(specs, start=1):
+        if s.name == layer_name:
+            return i
+    raise KeyError(layer_name)
+
+
+def shard_params(full_tree: dict, specs: Sequence[LayerSpec],
+                 start_layer: int, end_layer: int) -> dict:
+    """Slice a full-model variable collection down to one shard's layers.
+
+    Works on any collection dict keyed by layer name at the top level
+    (``params``, ``batch_stats``).  ``end_layer == -1`` means to-the-end.
+    """
+    end = len(specs) if end_layer == -1 else end_layer
+    return {
+        k: v for k, v in full_tree.items()
+        if start_layer < _layer_index(specs, k) <= end
+    }
+
+
+def merge_shard_params(full_tree: dict, *shard_trees: dict) -> dict:
+    """Overlay shard collections onto a full-model collection (reassembly)."""
+    out = dict(full_tree)
+    for sd in shard_trees:
+        out.update(sd)
+    return out
+
+
+# --------------------------------------------------------------------------
+# param-free op helpers for LayerSpec.fn
+# --------------------------------------------------------------------------
+
+def relu_fn(_, x, train):
+    return nn.relu(x)
+
+
+def gelu_fn(_, x, train):
+    return nn.gelu(x)
+
+
+def maxpool2_fn(_, x, train):
+    return nn.max_pool(x, window_shape=(2, 2), strides=(2, 2))
+
+
+def flatten_fn(_, x, train):
+    return x.reshape((x.shape[0], -1))
+
+
+def dropout_layer(rate: float):
+    """Dropout as a parametric-less module layer (needs an rng when train)."""
+    def make(name=None):
+        return nn.Dropout(rate=rate, name=name)
+
+    def fn(mod, x, train):
+        return mod(x, deterministic=not train)
+    return make, fn
+
+
+def conv_fn(mod, x, train):
+    return mod(x)
+
+
+def module_train_fn(mod, x, train):
+    """Module whose __call__ takes a ``train`` kwarg (dropout inside)."""
+    return mod(x, train=train)
+
+
+def module_plain_fn(mod, x, train):
+    """Module whose __call__ ignores train mode."""
+    return mod(x)
+
+
+def batchnorm_fn(mod, x, train):
+    return mod(x, use_running_average=not train)
+
+
+def identity_fn(_, x, train):
+    return x
+
+
+def astype_fn(dtype):
+    def fn(_, x, train):
+        return jnp.asarray(x, dtype)
+    return fn

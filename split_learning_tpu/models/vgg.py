@@ -1,0 +1,87 @@
+"""VGG16 (+BatchNorm) as 52 individually indexed layers.
+
+Layer-for-layer the same indexing contract as the reference
+(``/root/reference/src/model/VGG16_CIFAR10.py:4-117``: conv/bn/relu/pool/
+flatten/dropout/linear each occupy one index, 52 total), expressed as one
+declarative spec list.  NHWC layout and optional bfloat16 compute dtype —
+the MXU-friendly choices — instead of the reference's NCHW float32.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from split_learning_tpu.models.split import (
+    LayerSpec, register_model, relu_fn, maxpool2_fn, flatten_fn,
+    dropout_layer, batchnorm_fn,
+)
+
+# (out_channels, convs per block, pool after block?) — CIFAR: 5 pools
+# (52 layers, 32px -> 1px); MNIST: 4 pools (51 layers, 28px -> 1px),
+# matching other/Vanilla_SL/src/model/VGG16_MNIST.py's layer indices.
+_VGG16_CIFAR_CFG = [(64, 2, True), (128, 2, True), (256, 3, True),
+                    (512, 3, True), (512, 3, True)]
+_VGG16_MNIST_CFG = [(64, 2, True), (128, 2, True), (256, 3, True),
+                    (512, 3, True), (512, 3, False)]
+
+
+def _vgg_specs(num_classes: int, cfg=None, dtype=jnp.float32) -> tuple:
+    conv = functools.partial(nn.Conv, kernel_size=(3, 3), strides=(1, 1),
+                             padding=1, dtype=dtype)
+    bn = functools.partial(nn.BatchNorm, momentum=0.9, epsilon=1e-5,
+                           dtype=dtype)
+    dense = functools.partial(nn.Dense, dtype=dtype)
+    cfg = cfg or _VGG16_CIFAR_CFG
+
+    specs: list[LayerSpec] = []
+    idx = 0
+
+    def add(make=None, fn=None):
+        nonlocal idx
+        idx += 1
+        specs.append(LayerSpec(name=f"layer{idx}", make=make, fn=fn))
+
+    for out_ch, n_convs, pool in cfg:
+        for _ in range(n_convs):
+            add(make=functools.partial(conv, features=out_ch))
+            add(make=bn, fn=batchnorm_fn)
+            add(fn=relu_fn)
+        if pool:
+            add(fn=maxpool2_fn)
+
+    add(fn=flatten_fn)
+    dmake, dfn = dropout_layer(0.5)
+    add(make=dmake, fn=dfn)
+    add(make=functools.partial(dense, features=4096))
+    add(fn=relu_fn)
+    dmake, dfn = dropout_layer(0.5)
+    add(make=dmake, fn=dfn)
+    add(make=functools.partial(dense, features=4096))
+    add(fn=relu_fn)
+    add(make=functools.partial(dense, features=num_classes))
+    return tuple(specs)
+
+
+@register_model("VGG16_CIFAR10")
+def vgg16_cifar10(dtype=jnp.float32) -> tuple:
+    """CIFAR-10 VGG16: input (B, 32, 32, 3) NHWC, 10 classes, 52 layers."""
+    specs = _vgg_specs(10, dtype=dtype)
+    assert len(specs) == 52
+    return specs
+
+
+@register_model("VGG16_MNIST")
+def vgg16_mnist(dtype=jnp.float32) -> tuple:
+    """MNIST VGG16: input (B, 28, 28, 1) NHWC, 10 classes, 51 layers
+    (4 pools; 28 -> 14 -> 7 -> 3 -> 1)."""
+    specs = _vgg_specs(10, cfg=_VGG16_MNIST_CFG, dtype=dtype)
+    assert len(specs) == 51
+    return specs
+
+
+@register_model("VGG16_CIFAR100")
+def vgg16_cifar100(dtype=jnp.float32) -> tuple:
+    return _vgg_specs(100, dtype=dtype)

@@ -93,16 +93,3 @@ def fedavg_psum(params: Any, weight: jnp.ndarray, axis_name: str) -> Any:
         return s.astype(orig_dtype)
 
     return jax.tree_util.tree_map(avg, params)
-
-
-def concatenate_shards(shard_trees: Sequence[dict]) -> dict:
-    """Reassemble a full-model param dict from per-stage shard dicts.
-
-    Mirrors the server's cluster concatenation
-    (``src/Server.py:410-434``): later shards' keys overwrite earlier ones on
-    collision (there should be none for a clean split).
-    """
-    full: dict = {}
-    for sd in shard_trees:
-        full.update(sd)
-    return full

@@ -49,7 +49,11 @@ def kmeans(x: np.ndarray, k: int, n_init: int = 10, n_iter: int = 300,
                 centers = new_centers
                 break
             centers = new_centers
-        inertia = float(((x - centers[labels]) ** 2).sum())
+        # final assignment against the *final* centers, so the returned
+        # (labels, centers) pair is consistent and restarts rank correctly
+        d2 = ((x[:, None, :] - centers[None, :, :]) ** 2).sum(axis=2)
+        labels = d2.argmin(axis=1)
+        inertia = float(d2[np.arange(x.shape[0]), labels].sum())
         if inertia < best_inertia:
             best_inertia = inertia
             best = (labels.copy(), centers.copy())

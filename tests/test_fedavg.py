@@ -6,9 +6,8 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
-from split_learning_tpu.ops.fedavg import (
-    fedavg_trees, fedavg_psum, concatenate_shards,
-)
+from split_learning_tpu.models import merge_shard_params
+from split_learning_tpu.ops.fedavg import fedavg_trees, fedavg_psum
 
 
 def test_weighted_mean_hand_value():
@@ -57,7 +56,7 @@ def test_empty_raises():
 
 def test_psum_matches_host_fold(eight_devices):
     from jax.sharding import Mesh, PartitionSpec as P
-    from jax.experimental.shard_map import shard_map
+    from jax import shard_map
 
     n = 4
     mesh = Mesh(np.array(eight_devices[:n]), ("client",))
@@ -80,6 +79,6 @@ def test_psum_matches_host_fold(eight_devices):
         np.testing.assert_allclose(out[i], np.asarray(host), rtol=1e-6)
 
 
-def test_concatenate_shards():
-    full = concatenate_shards([{"l1": 1, "l2": 2}, {"l3": 3}])
+def test_merge_shard_params_reassembles():
+    full = merge_shard_params({}, {"l1": 1, "l2": 2}, {"l3": 3})
     assert full == {"l1": 1, "l2": 2, "l3": 3}

@@ -1,0 +1,164 @@
+"""Golden split tests: for every tested cut, shard composition must equal
+the unsplit model exactly — forward AND parameter gradients
+(SURVEY.md §4 plan item (b))."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from split_learning_tpu.models import (
+    build_model, shard_params, merge_shard_params, num_layers,
+)
+
+
+def _init_full(name, x, **kw):
+    model = build_model(name, **kw)
+    variables = model.init(jax.random.key(0), x, train=False)
+    return model, variables
+
+
+def _split_apply(name, variables, x, cut, total, train=False, **kw):
+    """Apply stage1 (1..cut) then stage2 (cut+1..end) with sliced params."""
+    m1 = build_model(name, start_layer=0, end_layer=cut, **kw)
+    m2 = build_model(name, start_layer=cut, end_layer=-1, **kw)
+    specs = m1.specs
+
+    def slice_vars(start, end):
+        return {
+            col: shard_params(tree, specs, start, end)
+            for col, tree in variables.items()
+        }
+    v1, v2 = slice_vars(0, cut), slice_vars(cut, total)
+    h = m1.apply(v1, x, train=train)
+    out = m2.apply(v2, h, train=train)
+    return out
+
+
+CASES = [
+    ("VGG16_CIFAR10", (2, 32, 32, 3), "float32", [1, 7, 14, 24, 45, 51]),
+    ("KWT_SPEECHCOMMANDS", (2, 40, 98), "float32", [1, 2, 3, 9, 16]),
+]
+
+
+@pytest.mark.parametrize("name,shape,dtype,cuts", CASES)
+def test_split_forward_matches_unsplit(name, shape, dtype, cuts):
+    x = jax.random.normal(jax.random.key(1), shape, dtype=dtype)
+    model, variables = _init_full(name, x)
+    total = num_layers(name)
+    ref = model.apply(variables, x, train=False)
+    for cut in cuts:
+        out = _split_apply(name, variables, x, cut, total)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=1e-5, atol=1e-5,
+                                   err_msg=f"{name} cut={cut}")
+
+
+def test_bert_split_forward_matches_unsplit():
+    kw = dict(vocab_size=100, hidden_size=32, num_heads=2,
+              intermediate_size=64, max_position_embeddings=64)
+    x = jax.random.randint(jax.random.key(1), (2, 16), 0, 100)
+    model, variables = _init_full("BERT_AGNEWS", x, **kw)
+    ref = model.apply(variables, x, train=False)
+    assert ref.shape == (2, 4)
+    for cut in [1, 7, 13, 14]:
+        out = _split_apply("BERT_AGNEWS", variables, x, cut, 15, **kw)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=1e-5, atol=1e-5,
+                                   err_msg=f"cut={cut}")
+
+
+def test_split_backward_matches_unsplit():
+    """Param grads through the split composition == full-model grads.
+
+    This is the property the reference guarantees by construction and the
+    streaming loop depends on (stage-1 backward from received activation
+    grads, src/train/VGG16.py:89-92)."""
+    name, cut = "KWT_SPEECHCOMMANDS", 9
+    x = jax.random.normal(jax.random.key(2), (2, 40, 98))
+    model, variables = _init_full(name, x)
+    specs = model.specs
+
+    def loss_full(params):
+        out = model.apply({"params": params}, x, train=False)
+        return jnp.sum(out ** 2)
+
+    g_full = jax.grad(loss_full)(variables["params"])
+
+    m1 = build_model(name, start_layer=0, end_layer=cut)
+    m2 = build_model(name, start_layer=cut, end_layer=-1)
+    p1 = shard_params(variables["params"], specs, 0, cut)
+    p2 = shard_params(variables["params"], specs, cut, 17)
+
+    def loss_split(p1, p2):
+        h = m1.apply({"params": p1}, x, train=False)
+        out = m2.apply({"params": p2}, h, train=False)
+        return jnp.sum(out ** 2)
+
+    g1, g2 = jax.grad(loss_split, argnums=(0, 1))(p1, p2)
+    g_merged = merge_shard_params({}, g1, g2)
+    flat_full = jax.tree_util.tree_leaves_with_path(g_full)
+    flat_merged = dict(jax.tree_util.tree_leaves_with_path(g_merged))
+    assert len(flat_full) == len(flat_merged)
+    for path, leaf in flat_full:
+        np.testing.assert_allclose(np.asarray(flat_merged[path]),
+                                   np.asarray(leaf), rtol=1e-5, atol=1e-6,
+                                   err_msg=str(path))
+
+
+def test_vgg_batchnorm_train_mode_split():
+    """Train-mode equivalence incl. batch_stats mutation and dropout rngs."""
+    name, cut = "VGG16_CIFAR10", 7
+    x = jax.random.normal(jax.random.key(3), (2, 32, 32, 3))
+    model, variables = _init_full(name, x)
+    rngs = {"dropout": jax.random.key(9)}
+    ref, ref_mut = model.apply(variables, x, train=True,
+                               mutable=["batch_stats"], rngs=rngs)
+    specs = model.specs
+    m1 = build_model(name, start_layer=0, end_layer=cut)
+    m2 = build_model(name, start_layer=cut, end_layer=-1)
+    v1 = {c: shard_params(t, specs, 0, cut) for c, t in variables.items()}
+    v2 = {c: shard_params(t, specs, cut, 52) for c, t in variables.items()}
+    h, mut1 = m1.apply(v1, x, train=True, mutable=["batch_stats"], rngs=rngs)
+    out, mut2 = m2.apply(v2, h, train=True, mutable=["batch_stats"],
+                         rngs=rngs)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+    merged_stats = merge_shard_params({}, mut1["batch_stats"],
+                                      mut2["batch_stats"])
+    ref_leaves = dict(jax.tree_util.tree_leaves_with_path(
+        ref_mut["batch_stats"]))
+    for path, leaf in jax.tree_util.tree_leaves_with_path(merged_stats):
+        np.testing.assert_allclose(np.asarray(leaf),
+                                   np.asarray(ref_leaves[path]),
+                                   rtol=1e-5, atol=1e-5)
+
+
+def test_end_layer_minus_one_means_full():
+    m = build_model("KWT_SPEECHCOMMANDS", start_layer=0, end_layer=-1)
+    assert m.resolved_end == 17
+
+
+def test_registry_unknown_model():
+    with pytest.raises(KeyError):
+        build_model("RESNET_IMAGENET_NOPE")
+
+
+def test_shard_param_keys_are_absolute():
+    x = jax.random.normal(jax.random.key(0), (1, 40, 98))
+    model, variables = _init_full("KWT_SPEECHCOMMANDS", x)
+    sliced = shard_params(variables["params"], model.specs, 9, 17)
+    assert "layer10" in sliced and "layer9" not in sliced
+    assert "layer17" in sliced
+
+
+def test_vgg_mnist_51_layers_shapes():
+    import jax
+    x = jax.random.normal(jax.random.key(0), (2, 28, 28, 1))
+    m = build_model("VGG16_MNIST")
+    assert num_layers("VGG16_MNIST") == 51
+    v = m.init(jax.random.key(1), x, train=False)
+    out = m.apply(v, x, train=False)
+    assert out.shape == (2, 10)
+    # flatten at 44 sees a 1x1x512 map: dense kernel is (512, 4096)
+    assert v["params"]["layer46"]["kernel"].shape == (512, 4096)
