@@ -1,0 +1,11 @@
+"""Mesh planning and the compiled split-learning pipeline runtime."""
+
+from split_learning_tpu.parallel.mesh import make_mesh, stage_ranges
+from split_learning_tpu.parallel.pipeline import (
+    PipelineModel, make_train_step, make_fedavg_step,
+)
+
+__all__ = [
+    "make_mesh", "stage_ranges", "PipelineModel", "make_train_step",
+    "make_fedavg_step",
+]

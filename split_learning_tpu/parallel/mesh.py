@@ -1,0 +1,46 @@
+"""Device-mesh construction from a cluster plan.
+
+The reference maps (cluster, client, stage) onto RabbitMQ queue names
+(``src/train/VGG16.py:21-22``, ``43-44``); here the same coordinates become
+axes of a ``jax.sharding.Mesh``.  One cluster = one mesh of shape
+(client, stage); clusters with different cut points compile different
+pipeline programs and run on disjoint device sub-slices.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+
+def make_mesh(n_clients: int, n_stages: int,
+              devices: Sequence | None = None) -> Mesh:
+    """Mesh of shape (client, stage) over the first n_clients*n_stages
+    devices."""
+    devs = list(devices if devices is not None else jax.devices())
+    need = n_clients * n_stages
+    if len(devs) < need:
+        raise ValueError(
+            f"need {need} devices for mesh (client={n_clients}, "
+            f"stage={n_stages}), have {len(devs)}")
+    grid = np.array(devs[:need]).reshape(n_clients, n_stages)
+    return Mesh(grid, ("client", "stage"))
+
+
+def stage_ranges(n_layers: int, cuts: Sequence[int]) -> list[tuple[int, int]]:
+    """Turn 1-based cut layers into per-stage (start, end) layer ranges.
+
+    ``cuts=[7]`` over 52 layers -> ``[(0, 7), (7, 52)]`` — stage k owns
+    layers ``start+1..end``, the same contract as the reference's START
+    message ``layers`` ranges (``src/Server.py:221-228``).
+    """
+    if any(not (1 <= c < n_layers) for c in cuts):
+        raise ValueError(
+            f"cuts {cuts!r} out of range [1, {n_layers - 1}]")
+    bounds = [0] + sorted(cuts) + [n_layers]
+    if len(set(bounds)) != len(bounds):
+        raise ValueError(f"degenerate cuts {cuts!r} for {n_layers} layers")
+    return [(bounds[i], bounds[i + 1]) for i in range(len(bounds) - 1)]
