@@ -1,0 +1,148 @@
+"""Benchmark: VGG16/CIFAR10 split-learning training throughput.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+The reference publishes no numbers (BASELINE.md), so the baseline is
+self-measured: a PyTorch-CPU VGG16-BN training step — the compute the
+reference's clients run per batch (``/root/reference/src/train/VGG16.py``
+drives ``model(x)``/``backward`` through stock torch layers on CPU/CUDA;
+no GPU in this image).  The torch measurement is cached in
+``.baseline_cache.json`` so repeat bench runs only time the JAX path.
+
+Ours: the compiled split-learning train step (PipelineModel) on whatever
+accelerator JAX exposes — bfloat16 compute, synthetic CIFAR-shaped data,
+samples/sec normalized per chip.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import pathlib
+import time
+
+CACHE = pathlib.Path(__file__).parent / ".baseline_cache.json"
+
+
+def measure_torch_baseline(batch_size: int = 32, steps: int = 3) -> float:
+    """samples/sec of a torch-CPU VGG16-BN train step (reference compute)."""
+    import torch
+    import torch.nn as nn
+
+    torch.manual_seed(0)
+    torch.set_num_threads(os.cpu_count() or 1)
+
+    cfg = [(64, 2), (128, 2), (256, 3), (512, 3), (512, 3)]
+    layers: list[nn.Module] = []
+    in_ch = 3
+    for out_ch, n_convs in cfg:
+        for _ in range(n_convs):
+            layers += [nn.Conv2d(in_ch, out_ch, 3, padding=1),
+                       nn.BatchNorm2d(out_ch), nn.ReLU(inplace=True)]
+            in_ch = out_ch
+        layers.append(nn.MaxPool2d(2))
+    layers += [nn.Flatten(), nn.Dropout(0.5), nn.Linear(512, 4096),
+               nn.ReLU(inplace=True), nn.Dropout(0.5), nn.Linear(4096, 4096),
+               nn.ReLU(inplace=True), nn.Linear(4096, 10)]
+    model = nn.Sequential(*layers)
+    opt = torch.optim.SGD(model.parameters(), lr=5e-4, momentum=0.9)
+    loss_fn = nn.CrossEntropyLoss()
+    x = torch.randn(batch_size, 3, 32, 32)
+    y = torch.randint(0, 10, (batch_size,))
+
+    # one warmup step, then timed
+    for _ in range(1):
+        opt.zero_grad(); loss_fn(model(x), y).backward(); opt.step()
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        opt.zero_grad(); loss_fn(model(x), y).backward(); opt.step()
+    dt = time.perf_counter() - t0
+    return batch_size * steps / dt
+
+
+def get_baseline() -> float:
+    if CACHE.exists():
+        try:
+            return float(json.loads(CACHE.read_text())["torch_cpu_sps"])
+        except Exception:
+            pass
+    sps = measure_torch_baseline()
+    try:
+        CACHE.write_text(json.dumps({"torch_cpu_sps": sps}))
+    except OSError:
+        pass
+    return sps
+
+
+def measure_ours() -> tuple[float, int]:
+    """(samples/sec, n_chips) of the compiled split-learning train step."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+    from jax.sharding import Mesh
+
+    from split_learning_tpu.parallel.pipeline import (
+        PipelineModel, init_pipeline_variables, make_train_step,
+        stack_for_clients, shard_to_mesh,
+    )
+
+    on_cpu = jax.default_backend() == "cpu"
+    devs = jax.devices()
+    # one chip = (client=1, stage=1); the driver benches single-chip.
+    mesh = Mesh(np.array(devs[:1]).reshape(1, 1), ("client", "stage"))
+    n_chips = 1
+
+    mb = 32 if on_cpu else 256
+    n_micro = 1
+    steps = 3 if on_cpu else 20
+    dtype = jnp.float32 if on_cpu else jnp.bfloat16
+
+    pipe = PipelineModel(
+        "VGG16_CIFAR10", cuts=[],
+        example_input=jax.ShapeDtypeStruct((mb, 32, 32, 3), jnp.float32),
+        num_microbatches=n_micro, model_kwargs={"dtype": dtype})
+    variables = init_pipeline_variables(
+        pipe, jax.random.key(0),
+        jax.ShapeDtypeStruct((mb, 32, 32, 3), jnp.float32))
+    params, stats = variables["params"], variables.get("batch_stats", {})
+    optimizer = optax.sgd(5e-4, momentum=0.9)
+    opt_state = optimizer.init(params)
+
+    params_c = shard_to_mesh(stack_for_clients(params, 1), mesh)
+    opt_c = shard_to_mesh(stack_for_clients(opt_state, 1), mesh)
+    stats_c = shard_to_mesh(stack_for_clients(stats, 1), mesh)
+    rng = jax.random.split(jax.random.key(1), 1)
+    kx = jax.random.key(2)
+    x = jax.random.normal(kx, (1, n_micro, mb, 32, 32, 3), jnp.float32)
+    labels = jnp.zeros((1, n_micro, mb), jnp.int32)
+
+    step = make_train_step(pipe, optimizer, mesh)
+    # warmup/compile
+    params_c, opt_c, stats_c, loss = step(params_c, opt_c, stats_c, x,
+                                          labels, rng)
+    jax.block_until_ready(loss)
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        params_c, opt_c, stats_c, loss = step(params_c, opt_c, stats_c, x,
+                                              labels, rng)
+    jax.block_until_ready(loss)
+    dt = time.perf_counter() - t0
+    return mb * n_micro * steps / dt, n_chips
+
+
+def main():
+    baseline = get_baseline()
+    sps, n_chips = measure_ours()
+    value = sps / n_chips
+    print(json.dumps({
+        "metric": "vgg16_cifar10_train_samples_per_sec_per_chip",
+        "value": round(value, 2),
+        "unit": "samples/sec/chip",
+        "vs_baseline": round(value / baseline, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,247 @@
+"""Validated configuration schema.
+
+The reference parses a schemaless ``config.yaml`` with ``yaml.safe_load``
+at every entry point (``/root/reference/server.py:12-17``,
+``client.py:20-21``) and its five variants drift keys freely
+(``manual`` vs ``no-cluster`` vs ``manual-cluster`` blocks).  Here the
+union of all of those surfaces lives in one typed, validated schema:
+
+* rounds {global, local}, wall-clock limit, per-stage client counts;
+* model / dataset selection;
+* cut topology {manual list, per-cluster lists, auto planner};
+* data distribution {iid, dirichlet(alpha), fixed matrix};
+* aggregation strategy {fedavg, periodic(t_c, t_g), fedasync(alpha),
+  sequential relay, cluster relay, sda(size)};
+* device selection on/off, cluster algorithm, cluster count;
+* learning hyperparams incl. the in-flight cap (``control-count`` →
+  microbatch count of the compiled schedule);
+* checkpoint save/load/validate flags and paths;
+* transport choice for the control plane.
+
+Unknown keys are rejected (the reference silently ignores typos).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import pathlib
+from typing import Any, Sequence
+
+import yaml
+
+
+class ConfigError(ValueError):
+    pass
+
+
+def _check(cond: bool, msg: str):
+    if not cond:
+        raise ConfigError(msg)
+
+
+@dataclasses.dataclass(frozen=True)
+class LearningConfig:
+    """Optimizer + loop hyperparameters (reference ``config.yaml:50-55``)."""
+    learning_rate: float = 5e-4
+    momentum: float = 0.9
+    weight_decay: float = 0.0
+    batch_size: int = 32
+    optimizer: str = "sgd"          # sgd | adamw
+    control_count: int = 4          # in-flight cap -> num_microbatches
+    clip_grad_norm: float | None = None  # Vanilla_SL Scheduler.py:204-205
+    lr_decay: float = 1.0           # DCSL Server.py:38-39
+    lr_decay_every: int = 0         # rounds; 0 = off
+
+    def validate(self):
+        _check(self.learning_rate > 0, "learning-rate must be > 0")
+        _check(self.batch_size > 0, "batch-size must be > 0")
+        _check(self.optimizer in ("sgd", "adamw"),
+               f"optimizer must be sgd|adamw, got {self.optimizer!r}")
+        _check(self.control_count > 0, "control-count must be > 0")
+
+
+@dataclasses.dataclass(frozen=True)
+class DistributionConfig:
+    """Per-client label distribution synthesis (``src/Server.py:87-101``)."""
+    mode: str = "iid"               # iid | dirichlet | fixed
+    alpha: float = 1.0              # dirichlet concentration
+    num_samples: int = 2500         # samples per stage-1 client
+    matrix: tuple | None = None     # fixed per-client label counts (FLEX)
+    seed: int | None = None
+
+    def validate(self):
+        _check(self.mode in ("iid", "dirichlet", "fixed"),
+               f"distribution mode must be iid|dirichlet|fixed, "
+               f"got {self.mode!r}")
+        if self.mode == "dirichlet":
+            _check(self.alpha > 0, "dirichlet alpha must be > 0")
+        if self.mode == "fixed":
+            _check(self.matrix is not None,
+                   "fixed distribution requires a matrix")
+
+
+@dataclasses.dataclass(frozen=True)
+class TopologyConfig:
+    """Cut points + clustering (``config.yaml:25-34`` union)."""
+    mode: str = "manual"            # manual | auto
+    cut_layers: tuple = (7,)        # manual: one cut list for all clusters
+    cluster_cut_layers: tuple | None = None  # per-cluster cut lists (FLEX)
+    num_clusters: int = 1
+    cluster_algorithm: str = "kmeans"  # kmeans | affinity
+    selection: bool = False         # GMM straggler rejection on/off
+
+    def validate(self):
+        _check(self.mode in ("manual", "auto"),
+               f"topology mode must be manual|auto, got {self.mode!r}")
+        _check(self.num_clusters >= 1, "num-clusters must be >= 1")
+        _check(self.cluster_algorithm in ("kmeans", "affinity"),
+               f"cluster-algorithm must be kmeans|affinity, "
+               f"got {self.cluster_algorithm!r}")
+        if self.cluster_cut_layers is not None:
+            _check(len(self.cluster_cut_layers) == self.num_clusters,
+                   "cluster-cut-layers must have one entry per cluster")
+
+
+@dataclasses.dataclass(frozen=True)
+class AggregationConfig:
+    """Round strategy knobs — the algorithm surface of the five variants
+    (SURVEY.md §2.3) as configuration instead of code forks."""
+    strategy: str = "fedavg"
+    # fedavg | relay | cluster_relay | periodic | fedasync | sda
+    t_client: int = 1               # FLEX t-c: client FedAvg interval
+    t_global: int = 1               # FLEX t-g: global concat+validate interval
+    fedasync_alpha: float | None = None  # 2LS: None -> 1/(1+rank)
+    sda_size: int = 2               # DCSL server-side data-aggregation width
+    local_rounds: int = 1           # DCSL epochs per round
+
+    def validate(self):
+        _check(self.strategy in ("fedavg", "relay", "cluster_relay",
+                                 "periodic", "fedasync", "sda"),
+               f"unknown aggregation strategy {self.strategy!r}")
+        _check(self.t_client >= 1 and self.t_global >= 1,
+               "t-client/t-global must be >= 1")
+        _check(self.sda_size >= 1, "sda-size must be >= 1")
+        _check(self.local_rounds >= 1, "local-rounds must be >= 1")
+
+
+@dataclasses.dataclass(frozen=True)
+class CheckpointConfig:
+    """Save/load/validate flags (``config.yaml:11-13``)."""
+    save: bool = True
+    load: bool = False
+    validate: bool = True
+    directory: str = "checkpoints"
+
+
+@dataclasses.dataclass(frozen=True)
+class TransportConfig:
+    """Control-plane transport. ``inproc`` runs the whole cell in one
+    process (TPU-native mode); ``tcp`` is the multi-process protocol mode
+    replacing the reference's RabbitMQ creds (``config.yaml:36-43``)."""
+    kind: str = "inproc"            # inproc | tcp
+    host: str = "127.0.0.1"
+    port: int = 5672
+
+    def validate(self):
+        _check(self.kind in ("inproc", "tcp"),
+               f"transport must be inproc|tcp, got {self.kind!r}")
+
+
+@dataclasses.dataclass(frozen=True)
+class Config:
+    model: str = "VGG16"
+    dataset: str = "CIFAR10"
+    clients: tuple = (1, 1)         # per-stage client counts
+    global_rounds: int = 1
+    limited_time: float | None = None   # Vanilla_SL wall-clock budget (s)
+    seed: int = 0
+    debug: bool = False
+    log_path: str = "."
+    compute_dtype: str = "bfloat16"     # bfloat16 | float32
+    learning: LearningConfig = LearningConfig()
+    distribution: DistributionConfig = DistributionConfig()
+    topology: TopologyConfig = TopologyConfig()
+    aggregation: AggregationConfig = AggregationConfig()
+    checkpoint: CheckpointConfig = CheckpointConfig()
+    transport: TransportConfig = TransportConfig()
+
+    @property
+    def model_key(self) -> str:
+        """Registry key, reference naming: ``{MODEL}_{DATASET}``."""
+        return f"{self.model}_{self.dataset}"
+
+    @property
+    def num_stages(self) -> int:
+        return len(self.clients)
+
+    def validate(self) -> "Config":
+        _check(self.global_rounds >= 1, "global-rounds must be >= 1")
+        _check(len(self.clients) >= 1 and all(c >= 1 for c in self.clients),
+               "clients must be a non-empty list of positive counts")
+        _check(self.compute_dtype in ("bfloat16", "float32"),
+               f"compute-dtype must be bfloat16|float32, "
+               f"got {self.compute_dtype!r}")
+        for sub in (self.learning, self.distribution, self.topology,
+                    self.aggregation, self.transport):
+            sub.validate()
+        if self.topology.mode == "manual":
+            cuts = self.topology.cluster_cut_layers or (
+                self.topology.cut_layers,)
+            for cl in cuts:
+                _check(len(cl) == len(self.clients) - 1 or
+                       len(self.clients) == 1,
+                       f"manual cut list {cl!r} must have "
+                       f"num_stages-1 = {len(self.clients) - 1} entries")
+        return self
+
+
+_SECTION_TYPES = {
+    "learning": LearningConfig,
+    "distribution": DistributionConfig,
+    "topology": TopologyConfig,
+    "aggregation": AggregationConfig,
+    "checkpoint": CheckpointConfig,
+    "transport": TransportConfig,
+}
+
+
+def _freeze(v):
+    if isinstance(v, list):
+        return tuple(_freeze(x) for x in v)
+    return v
+
+
+def _build(cls, d: dict, path: str):
+    fields = {f.name: f for f in dataclasses.fields(cls)}
+    kwargs = {}
+    for k, v in d.items():
+        key = k.replace("-", "_")
+        _check(key in fields, f"unknown config key {path}{k!r}")
+        kwargs[key] = _freeze(v)
+    return cls(**kwargs)
+
+
+def from_dict(d: dict[str, Any]) -> Config:
+    top: dict[str, Any] = {}
+    for k, v in d.items():
+        key = k.replace("-", "_")
+        if key in _SECTION_TYPES:
+            _check(isinstance(v, dict),
+                   f"section {k!r} must be a mapping")
+            top[key] = _build(_SECTION_TYPES[key], v, f"{k}.")
+        else:
+            fields = {f.name for f in dataclasses.fields(Config)}
+            _check(key in fields, f"unknown config key {k!r}")
+            top[key] = _freeze(v)
+    return Config(**top).validate()
+
+
+def from_yaml(path: str | pathlib.Path) -> Config:
+    with open(path) as f:
+        data = yaml.safe_load(f) or {}
+    _check(isinstance(data, dict), "config file must be a mapping")
+    return from_dict(data)
+
+
+def to_dict(cfg: Config) -> dict:
+    return dataclasses.asdict(cfg)

@@ -1,0 +1,19 @@
+"""Datasets, loaders, and feature pipelines.
+
+Reference parity: ``/root/reference/src/dataset/`` (dataloader dispatch,
+AGNEWS tokenization, SpeechCommands MFCC) with on-disk loading plus
+deterministic synthetic fallbacks for the zero-egress environment.
+"""
+
+from split_learning_tpu.data.loader import (
+    ArrayDataset, DataLoader, cifar_augment, label_count_subset,
+)
+from split_learning_tpu.data.datasets import (
+    get_dataset, make_data_loader, register_dataset, dataset_registry,
+)
+
+__all__ = [
+    "ArrayDataset", "DataLoader", "cifar_augment", "label_count_subset",
+    "get_dataset", "make_data_loader", "register_dataset",
+    "dataset_registry",
+]

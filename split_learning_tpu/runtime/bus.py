@@ -1,0 +1,232 @@
+"""Message transports for the control/data plane.
+
+The reference's transport is a RabbitMQ broker spoken via pika
+(``/root/reference/src/Server.py:57-61``); clients *poll* with
+``basic_get`` + 0.5 s sleeps (``src/RpcClient.py:37-41``).  Here the same
+named-queue semantics live behind one small interface with two backends:
+
+* :class:`InProcTransport` — thread-safe in-process queues.  The whole
+  training cell (server + N clients) runs in one process; this is the
+  TPU-native default (the data plane then usually bypasses the bus
+  entirely via the compiled mesh pipeline).
+* :class:`TcpTransport` + :class:`Broker` — a ~150-line length-prefixed
+  TCP broker giving true multi-process / multi-host parity with the
+  reference's deployment shape, without an external Erlang dependency.
+
+Blocking ``get`` uses real waits (condition variables / socket blocking),
+not the reference's sleep-polling.
+"""
+
+from __future__ import annotations
+
+import collections
+import socket
+import struct
+import threading
+from typing import Iterable
+
+
+class QueueClosed(Exception):
+    pass
+
+
+class Transport:
+    """Named-queue message transport (byte payloads)."""
+
+    def publish(self, queue: str, payload: bytes) -> None:
+        raise NotImplementedError
+
+    def get(self, queue: str, timeout: float | None = None) -> bytes | None:
+        """Pop one message; block up to ``timeout`` (None = forever).
+        Returns None on timeout."""
+        raise NotImplementedError
+
+    def purge(self, queues: Iterable[str] | None = None) -> None:
+        """Drop pending messages (all queues if None) — the reference's
+        ``delete_old_queues`` hygiene (``src/Utils.py:8-32``)."""
+        raise NotImplementedError
+
+    def close(self) -> None:
+        pass
+
+
+class InProcTransport(Transport):
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._queues: dict[str, collections.deque] = \
+            collections.defaultdict(collections.deque)
+        self._closed = False
+
+    def publish(self, queue: str, payload: bytes) -> None:
+        with self._cond:
+            if self._closed:
+                raise QueueClosed(queue)
+            self._queues[queue].append(payload)
+            self._cond.notify_all()
+
+    def get(self, queue: str, timeout: float | None = None) -> bytes | None:
+        with self._cond:
+            ok = self._cond.wait_for(
+                lambda: self._closed or self._queues[queue], timeout)
+            if self._closed:
+                raise QueueClosed(queue)
+            if not ok:
+                return None
+            return self._queues[queue].popleft()
+
+    def qsize(self, queue: str) -> int:
+        with self._lock:
+            return len(self._queues[queue])
+
+    def purge(self, queues: Iterable[str] | None = None) -> None:
+        with self._cond:
+            if queues is None:
+                self._queues.clear()
+            else:
+                for q in queues:
+                    self._queues.pop(q, None)
+
+    def close(self) -> None:
+        with self._cond:
+            self._closed = True
+            self._cond.notify_all()
+
+
+# --------------------------------------------------------------------------
+# TCP broker
+# --------------------------------------------------------------------------
+# Frame: 1-byte op | 4-byte BE queue-name len | name | 8-byte BE payload len
+# | payload.  Ops: P=publish, G=get(blocking; payload = 8-byte BE timeout in
+# ms, 0 = forever), X=purge, R=reply (broker->client; zero payload len and
+# flag 0xFF means timeout).
+
+_OP_PUB, _OP_GET, _OP_PURGE, _OP_REPLY = b"P", b"G", b"X", b"R"
+_TIMEOUT_SENTINEL = 0xFFFFFFFFFFFFFFFF
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("socket closed")
+        buf += chunk
+    return buf
+
+
+def _send_frame(sock: socket.socket, op: bytes, name: bytes,
+                payload: bytes) -> None:
+    sock.sendall(op + struct.pack(">I", len(name)) + name
+                 + struct.pack(">Q", len(payload)) + payload)
+
+
+def _recv_frame(sock: socket.socket) -> tuple[bytes, bytes, bytes]:
+    op = _recv_exact(sock, 1)
+    (nlen,) = struct.unpack(">I", _recv_exact(sock, 4))
+    name = _recv_exact(sock, nlen)
+    (plen,) = struct.unpack(">Q", _recv_exact(sock, 8))
+    if plen == _TIMEOUT_SENTINEL:
+        return op, name, None  # type: ignore[return-value]
+    return op, name, _recv_exact(sock, plen)
+
+
+class Broker:
+    """Threaded TCP message broker (one thread per connection)."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self._store = InProcTransport()
+        self._sock = socket.create_server((host, port))
+        self.host, self.port = self._sock.getsockname()[:2]
+        self._threads: list[threading.Thread] = []
+        self._running = True
+        self._accept_thread = threading.Thread(target=self._accept_loop,
+                                               daemon=True)
+        self._accept_thread.start()
+
+    def _accept_loop(self):
+        while self._running:
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                return
+            t = threading.Thread(target=self._serve, args=(conn,),
+                                 daemon=True)
+            t.start()
+            self._threads.append(t)
+
+    def _serve(self, conn: socket.socket):
+        try:
+            while True:
+                op, name, payload = _recv_frame(conn)
+                queue = name.decode()
+                if op == _OP_PUB:
+                    self._store.publish(queue, payload)
+                elif op == _OP_GET:
+                    (ms,) = struct.unpack(">Q", payload)
+                    timeout = None if ms == 0 else ms / 1000.0
+                    try:
+                        msg = self._store.get(queue, timeout)
+                    except QueueClosed:
+                        return
+                    if msg is None:
+                        conn.sendall(_OP_REPLY + struct.pack(">I", 0)
+                                     + struct.pack(">Q", _TIMEOUT_SENTINEL))
+                    else:
+                        _send_frame(conn, _OP_REPLY, b"", msg)
+                elif op == _OP_PURGE:
+                    self._store.purge(None if not payload
+                                      else payload.decode().split(","))
+        except (ConnectionError, OSError):
+            return
+
+    def close(self):
+        self._running = False
+        self._store.close()
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+class TcpTransport(Transport):
+    """Client of a :class:`Broker`. One socket per transport instance;
+    safe for one thread (create one per worker thread)."""
+
+    def __init__(self, host: str, port: int):
+        self._sock = socket.create_connection((host, port))
+        self._lock = threading.Lock()
+
+    def publish(self, queue: str, payload: bytes) -> None:
+        with self._lock:
+            _send_frame(self._sock, _OP_PUB, queue.encode(), payload)
+
+    def get(self, queue: str, timeout: float | None = None) -> bytes | None:
+        ms = 0 if timeout is None else max(1, int(timeout * 1000))
+        with self._lock:
+            _send_frame(self._sock, _OP_GET, queue.encode(),
+                        struct.pack(">Q", ms))
+            op, _, payload = _recv_frame(self._sock)
+            if op != _OP_REPLY:
+                raise ConnectionError(f"unexpected broker reply op {op!r}")
+            return payload  # None on timeout
+
+    def purge(self, queues: Iterable[str] | None = None) -> None:
+        payload = b"" if queues is None else ",".join(queues).encode()
+        with self._lock:
+            _send_frame(self._sock, _OP_PURGE, b"", payload)
+
+    def close(self) -> None:
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+def make_transport(kind: str, host: str = "127.0.0.1",
+                   port: int = 5672) -> Transport:
+    if kind == "inproc":
+        return InProcTransport()
+    if kind == "tcp":
+        return TcpTransport(host, port)
+    raise ValueError(f"unknown transport kind {kind!r}")

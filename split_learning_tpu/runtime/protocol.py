@@ -1,0 +1,185 @@
+"""Typed control-plane protocol.
+
+The reference's wire vocabulary is untyped dicts with an ``action`` key
+pushed through RabbitMQ (client→server REGISTER ``client.py:57``, NOTIFY
+``src/train/VGG16.py:121-126``, UPDATE ``src/RpcClient.py:128-132``;
+server→client START ``src/Server.py:262-272``, SYN ``:293-296``, PAUSE
+``:140-153``, STOP ``:276-287``).  Here every message is a dataclass; a
+READY ack is added so the server's 25-second settle sleep
+(``src/Server.py:289`` — a time-based barrier papering over a race,
+SURVEY.md §5.2) becomes an explicit barrier.
+
+Queue naming keeps the reference topology so the protocol surface maps
+1:1 (SURVEY.md §1 L0 table):
+
+* ``rpc_queue``                              any client → server
+* ``reply_{client_id}``                      server → one client
+* ``intermediate_queue_{stage}_{cluster}``   stage k → k+1 activations
+  (shared per cluster — natural load balance across same-stage clients)
+* ``gradient_queue_{stage}_{client_id}``     stage k+1 → one stage-k client
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import io
+import pickle
+from typing import Any
+
+import numpy as np
+
+RPC_QUEUE = "rpc_queue"
+
+
+def reply_queue(client_id: str) -> str:
+    return f"reply_{client_id}"
+
+
+def intermediate_queue(stage: int, cluster: int) -> str:
+    return f"intermediate_queue_{stage}_{cluster}"
+
+
+def gradient_queue(stage: int, client_id: str) -> str:
+    return f"gradient_queue_{stage}_{client_id}"
+
+
+# --------------------------------------------------------------------------
+# control messages
+# --------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class Register:
+    """client → server: join the round (with the offline profile)."""
+    client_id: str
+    stage: int                      # 1-based stage index ("layer_id")
+    cluster: int | None = None      # manual cluster assignment, or None
+    profile: dict | None = None     # {exe_time, size_data, speed, network}
+
+
+@dataclasses.dataclass
+class Ready:
+    """client → server: shard built, data loaded — replaces sleep(25)."""
+    client_id: str
+
+
+@dataclasses.dataclass
+class Notify:
+    """stage-1 client → server: local data exhausted this round."""
+    client_id: str
+    cluster: int
+
+
+@dataclasses.dataclass
+class Update:
+    """client → server: round's trained shard parameters."""
+    client_id: str
+    stage: int
+    cluster: int
+    params: Any                     # pytree of np arrays (host-side)
+    num_samples: int                # FedAvg weight (data_count semantics)
+    ok: bool = True                 # False -> NaN seen, skip aggregation
+
+
+@dataclasses.dataclass
+class Start:
+    """server → client: round config + shard weights."""
+    start_layer: int
+    end_layer: int                  # -1 = to the end
+    cluster: int
+    params: Any                     # shard pytree (np arrays)
+    batch_stats: Any | None = None
+    learning: dict | None = None    # lr/momentum/... overrides
+    label_counts: Any | None = None  # stage-1: per-label sample counts
+    round_idx: int = 0
+    extra: dict | None = None       # strategy-specific knobs (sda_size, ...)
+
+
+@dataclasses.dataclass
+class Syn:
+    """server → client: begin training."""
+    round_idx: int = 0
+
+
+@dataclasses.dataclass
+class Pause:
+    """server → client: stop the hot loop, upload weights.
+
+    ``send_weights=False`` is FLEX's non-aggregation-round PAUSE
+    (``other/FLEX/src/Server.py:140-143``)."""
+    send_weights: bool = True
+
+
+@dataclasses.dataclass
+class Stop:
+    """server → client: terminate."""
+    reason: str = ""
+
+
+# --------------------------------------------------------------------------
+# data-plane messages
+# --------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class Activation:
+    """stage k → stage k+1. ``trace`` is the routing stack of client_ids,
+    appended per forward hop, popped per backward hop
+    (``src/train/VGG16.py:24-31``, ``:41-43``)."""
+    data_id: str
+    data: np.ndarray
+    labels: np.ndarray
+    trace: list
+    cluster: int
+
+
+@dataclasses.dataclass
+class Gradient:
+    """stage k+1 → the originating stage-k client."""
+    data_id: str
+    data: np.ndarray
+    trace: list
+
+
+CONTROL_TYPES = (Register, Ready, Notify, Update, Start, Syn, Pause, Stop)
+DATA_TYPES = (Activation, Gradient)
+_TYPE_BY_NAME = {t.__name__: t for t in CONTROL_TYPES + DATA_TYPES}
+
+
+# --------------------------------------------------------------------------
+# serialization
+# --------------------------------------------------------------------------
+# Arrays are framed out-of-band (np.save) and the remainder pickled; a
+# restricted unpickler only admits protocol dataclasses + builtins, unlike
+# the reference's bare pickle.loads of broker bytes (SURVEY.md §1 L0).
+
+class _SafeUnpickler(pickle.Unpickler):
+    _ALLOWED = {
+        ("builtins", "dict"), ("builtins", "list"), ("builtins", "tuple"),
+        ("builtins", "set"), ("builtins", "frozenset"),
+        ("builtins", "complex"), ("builtins", "bytearray"),
+        ("numpy", "dtype"), ("numpy", "ndarray"),
+        ("numpy._core.multiarray", "_reconstruct"),
+        ("numpy.core.multiarray", "_reconstruct"),
+        ("numpy._core.multiarray", "scalar"),
+        ("numpy.core.multiarray", "scalar"),
+        ("numpy._core.numeric", "_frombuffer"),
+        ("numpy.core.numeric", "_frombuffer"),
+    }
+
+    def find_class(self, module, name):
+        if module == "split_learning_tpu.runtime.protocol" \
+                and name in _TYPE_BY_NAME:
+            return _TYPE_BY_NAME[name]
+        if (module, name) in self._ALLOWED:
+            return super().find_class(module, name)
+        raise pickle.UnpicklingError(
+            f"disallowed class in protocol message: {module}.{name}")
+
+
+def encode(msg) -> bytes:
+    if type(msg).__name__ not in _TYPE_BY_NAME:
+        raise TypeError(f"not a protocol message: {type(msg)!r}")
+    return pickle.dumps(msg, protocol=pickle.HIGHEST_PROTOCOL)
+
+
+def decode(raw: bytes):
+    return _SafeUnpickler(io.BytesIO(raw)).load()

@@ -1,0 +1,161 @@
+"""Config schema validation + protocol serialization + transports."""
+
+import threading
+
+import numpy as np
+import pytest
+
+from split_learning_tpu.config import Config, ConfigError, from_dict
+from split_learning_tpu.runtime import bus, protocol
+
+
+class TestConfig:
+    def test_defaults_valid(self):
+        cfg = from_dict({})
+        assert cfg.model_key == "VGG16_CIFAR10"
+        assert cfg.num_stages == 2
+        assert cfg.learning.batch_size == 32
+
+    def test_reference_default_surface(self):
+        # the reference's default config.yaml:3-28 expressed in our schema
+        cfg = from_dict({
+            "model": "VGG16", "dataset": "CIFAR10",
+            "clients": [1, 1], "global-rounds": 1,
+            "topology": {"mode": "manual", "cut-layers": [7]},
+            "learning": {"learning-rate": 5e-4, "batch-size": 32,
+                         "momentum": 0.9, "control-count": 4},
+        })
+        assert cfg.topology.cut_layers == (7,)
+        assert cfg.learning.learning_rate == 5e-4
+
+    def test_unknown_key_rejected(self):
+        with pytest.raises(ConfigError, match="unknown config key"):
+            from_dict({"modle": "VGG16"})
+        with pytest.raises(ConfigError, match="unknown config key"):
+            from_dict({"learning": {"learning-rte": 1e-3}})
+
+    def test_bad_values_rejected(self):
+        with pytest.raises(ConfigError):
+            from_dict({"global-rounds": 0})
+        with pytest.raises(ConfigError):
+            from_dict({"learning": {"optimizer": "rmsprop"}})
+        with pytest.raises(ConfigError):
+            from_dict({"aggregation": {"strategy": "nope"}})
+
+    def test_manual_cuts_arity_checked(self):
+        with pytest.raises(ConfigError, match="cut list"):
+            from_dict({"clients": [1, 1, 1],
+                       "topology": {"mode": "manual", "cut-layers": [7]}})
+
+    def test_variant_surfaces(self):
+        # FLEX periodic + per-cluster cuts; 2LS fedasync; DCSL sda
+        cfg = from_dict({
+            "clients": [9, 3],
+            "topology": {"mode": "manual", "num-clusters": 3,
+                         "cluster-cut-layers": [[7], [7], [4]]},
+            "aggregation": {"strategy": "periodic", "t-client": 2,
+                            "t-global": 6},
+        })
+        assert cfg.aggregation.t_global == 6
+        cfg = from_dict({"aggregation": {"strategy": "fedasync"}})
+        assert cfg.aggregation.fedasync_alpha is None
+        cfg = from_dict({"aggregation": {"strategy": "sda", "sda-size": 3,
+                                         "local-rounds": 2}})
+        assert cfg.aggregation.sda_size == 3
+
+
+class TestProtocol:
+    def test_roundtrip_control(self):
+        msg = protocol.Start(start_layer=0, end_layer=7, cluster=0,
+                             params={"layer1": {"kernel":
+                                               np.ones((3, 3))}},
+                             learning={"learning_rate": 1e-3})
+        out = protocol.decode(protocol.encode(msg))
+        assert isinstance(out, protocol.Start)
+        np.testing.assert_array_equal(out.params["layer1"]["kernel"],
+                                      np.ones((3, 3)))
+
+    def test_roundtrip_data_plane(self):
+        act = protocol.Activation(
+            data_id="abc", data=np.arange(12, dtype=np.float32),
+            labels=np.array([1, 2]), trace=["c1"], cluster=0)
+        out = protocol.decode(protocol.encode(act))
+        assert out.trace == ["c1"]
+        np.testing.assert_array_equal(out.data,
+                                      np.arange(12, dtype=np.float32))
+
+    def test_rejects_non_protocol_payloads(self):
+        import pickle
+        evil = pickle.dumps(ValueError("boom"))
+        with pytest.raises(pickle.UnpicklingError, match="disallowed"):
+            protocol.decode(evil)
+
+    def test_queue_names_match_reference_topology(self):
+        assert protocol.intermediate_queue(1, 0) == "intermediate_queue_1_0"
+        assert protocol.gradient_queue(1, "c9") == "gradient_queue_1_c9"
+        assert protocol.reply_queue("c1") == "reply_c1"
+
+
+class TestInProcTransport:
+    def test_fifo_and_timeout(self):
+        t = bus.InProcTransport()
+        t.publish("q", b"1")
+        t.publish("q", b"2")
+        assert t.get("q") == b"1"
+        assert t.get("q") == b"2"
+        assert t.get("q", timeout=0.01) is None
+
+    def test_blocking_get_wakes_on_publish(self):
+        t = bus.InProcTransport()
+        got = []
+
+        def consumer():
+            got.append(t.get("q", timeout=5))
+
+        th = threading.Thread(target=consumer)
+        th.start()
+        t.publish("q", b"x")
+        th.join(timeout=5)
+        assert got == [b"x"]
+
+    def test_purge(self):
+        t = bus.InProcTransport()
+        t.publish("a", b"1")
+        t.publish("b", b"2")
+        t.purge(["a"])
+        assert t.get("a", timeout=0.01) is None
+        assert t.get("b", timeout=0.01) == b"2"
+
+
+class TestTcpTransport:
+    def test_pub_get_over_socket(self):
+        broker = bus.Broker(port=0)
+        try:
+            c1 = bus.TcpTransport(broker.host, broker.port)
+            c2 = bus.TcpTransport(broker.host, broker.port)
+            big = b"\x00" * (1 << 20)  # 1 MiB payload crosses frames fine
+            c1.publish("act", big)
+            c1.publish("act", b"tail")
+            assert c2.get("act", timeout=5) == big
+            assert c2.get("act", timeout=5) == b"tail"
+            assert c2.get("act", timeout=0.05) is None
+            c1.close(); c2.close()
+        finally:
+            broker.close()
+
+    def test_blocking_get_across_processes_shape(self):
+        broker = bus.Broker(port=0)
+        try:
+            pub = bus.TcpTransport(broker.host, broker.port)
+            sub = bus.TcpTransport(broker.host, broker.port)
+            got = []
+            th = threading.Thread(
+                target=lambda: got.append(sub.get("q", timeout=5)))
+            th.start()
+            pub.publish("q", protocol.encode(protocol.Syn(round_idx=3)))
+            th.join(timeout=5)
+            msg = protocol.decode(got[0])
+            assert isinstance(msg, protocol.Syn) and msg.round_idx == 3
+            pub.close(); sub.close()
+        finally:
+            broker.close()

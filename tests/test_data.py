@@ -1,0 +1,108 @@
+"""Data subsystem: subsetting, static-shape batching, MFCC, providers."""
+
+import numpy as np
+import pytest
+
+from split_learning_tpu.data import (
+    ArrayDataset, DataLoader, get_dataset, label_count_subset,
+    make_data_loader,
+)
+from split_learning_tpu.data.mfcc import compute_mfcc, mel_filterbank
+
+
+class TestLabelCountSubset:
+    def test_exact_counts(self):
+        labels = np.repeat(np.arange(4), 50)
+        rng = np.random.default_rng(0)
+        idx = label_count_subset(labels, [10, 0, 5, 50], rng)
+        got = labels[idx]
+        assert (got == 0).sum() == 10
+        assert (got == 1).sum() == 0
+        assert (got == 2).sum() == 5
+        assert (got == 3).sum() == 50
+
+    def test_wraps_when_scarce(self):
+        labels = np.array([0, 0, 1])
+        idx = label_count_subset(labels, [5, 2], np.random.default_rng(0))
+        assert (labels[idx] == 0).sum() == 5
+
+    def test_deterministic_given_seed(self):
+        labels = np.repeat(np.arange(3), 100)
+        a = label_count_subset(labels, [7, 7, 7], np.random.default_rng(3))
+        b = label_count_subset(labels, [7, 7, 7], np.random.default_rng(3))
+        np.testing.assert_array_equal(a, b)
+
+
+class TestDataLoader:
+    def test_static_batch_shapes(self):
+        ds = ArrayDataset(np.zeros((105, 4), np.float32),
+                          np.zeros(105, np.int32))
+        dl = DataLoader(ds, batch_size=32, seed=0)
+        shapes = [x.shape for x, _ in dl]
+        assert shapes == [(32, 4)] * 3  # 105 // 32, no ragged tail
+
+    def test_wraps_small_dataset_to_one_batch(self):
+        ds = ArrayDataset(np.arange(10, dtype=np.float32)[:, None],
+                          np.zeros(10, np.int32))
+        dl = DataLoader(ds, batch_size=32, seed=0)
+        (x, y), = list(dl)
+        assert x.shape == (32, 1) and y.shape == (32,)
+
+    def test_dict_inputs(self):
+        ins = {"ids": np.zeros((64, 8), np.int32),
+               "mask": np.ones((64, 8), np.int32)}
+        dl = DataLoader(ArrayDataset(ins, np.zeros(64, np.int32)),
+                        batch_size=16, seed=0)
+        x, _ = next(iter(dl))
+        assert set(x) == {"ids", "mask"} and x["ids"].shape == (16, 8)
+
+
+class TestMFCC:
+    def test_shape_parity_one_second_clip(self):
+        # 1 s @ 16 kHz, 25 ms / 10 ms frames -> 98 frames, 40 coeffs —
+        # the reference's (40, 98) KWT input (KWT_SPEECHCOMMANDS.py:34-35)
+        sig = np.sin(2 * np.pi * 440 * np.arange(16000) / 16000)
+        m = compute_mfcc(sig)
+        assert m.shape == (40, 98)
+        assert np.all(np.isfinite(m))
+
+    def test_filterbank_partition(self):
+        fb = mel_filterbank(64, 512, 16000)
+        assert fb.shape == (64, 257)
+        assert fb.min() >= 0 and fb.max() <= 1.0
+
+    def test_distinguishes_frequencies(self):
+        t = np.arange(16000) / 16000
+        lo = compute_mfcc(np.sin(2 * np.pi * 200 * t))
+        hi = compute_mfcc(np.sin(2 * np.pi * 4000 * t))
+        assert np.abs(lo - hi).mean() > 0.1
+
+
+class TestProviders:
+    @pytest.mark.parametrize("name,shape,n_classes", [
+        ("CIFAR10", (32, 32, 3), 10),
+        ("MNIST", (28, 28, 1), 10),
+        ("SPEECHCOMMANDS", (40, 98), 10),
+    ])
+    def test_image_like_shapes(self, name, shape, n_classes):
+        ds = get_dataset(name, train=True, synthetic_size=64)
+        assert ds.inputs.shape[1:] == shape
+        assert ds.labels.max() < n_classes
+
+    def test_agnews_token_shape(self):
+        ds = get_dataset("AGNEWS", train=True, synthetic_size=32)
+        assert ds.inputs.shape == (32, 128)
+        assert ds.inputs.dtype == np.int32
+        assert ds.labels.max() < 4
+
+    def test_make_data_loader_with_distribution(self):
+        counts = np.array([8, 0, 8, 0, 0, 0, 0, 0, 0, 0])
+        dl = make_data_loader("CIFAR10", batch_size=8, distribution=counts,
+                              synthetic_size=256, seed=1)
+        assert dl.dataset.labels.tolist().count(1) == 0
+        assert len(dl.dataset) == 16
+
+    def test_synthetic_train_test_disjoint_seeds(self):
+        tr = get_dataset("CIFAR10", train=True, synthetic_size=32)
+        te = get_dataset("CIFAR10", train=False, synthetic_size=32)
+        assert not np.array_equal(tr.inputs[:8], te.inputs[:8])
