@@ -158,6 +158,10 @@ class Config:
     debug: bool = False
     log_path: str = "."
     compute_dtype: str = "bfloat16"     # bfloat16 | float32
+    model_kwargs: Any = None            # overrides for the model builder
+    synthetic_size: int | None = None   # force synthetic datasets (tests)
+    val_batch_size: int = 200
+    val_max_batches: int | None = None
     learning: LearningConfig = LearningConfig()
     distribution: DistributionConfig = DistributionConfig()
     topology: TopologyConfig = TopologyConfig()
@@ -211,13 +215,29 @@ def _freeze(v):
     return v
 
 
+def _coerce(v, annotation: str):
+    """YAML 1.1 parses ``5e-4`` (no dot) as a string; coerce strings into
+    the field's declared numeric type so reference-style configs load."""
+    if not isinstance(v, str):
+        return v
+    ann = annotation.replace(" ", "")
+    try:
+        if ann.startswith("float"):
+            return float(v)
+        if ann.startswith("int"):
+            return int(v)
+    except ValueError:
+        pass
+    return v
+
+
 def _build(cls, d: dict, path: str):
     fields = {f.name: f for f in dataclasses.fields(cls)}
     kwargs = {}
     for k, v in d.items():
         key = k.replace("-", "_")
         _check(key in fields, f"unknown config key {path}{k!r}")
-        kwargs[key] = _freeze(v)
+        kwargs[key] = _coerce(_freeze(v), str(fields[key].type))
     return cls(**kwargs)
 
 
@@ -230,9 +250,9 @@ def from_dict(d: dict[str, Any]) -> Config:
                    f"section {k!r} must be a mapping")
             top[key] = _build(_SECTION_TYPES[key], v, f"{k}.")
         else:
-            fields = {f.name for f in dataclasses.fields(Config)}
+            fields = {f.name: f for f in dataclasses.fields(Config)}
             _check(key in fields, f"unknown config key {k!r}")
-            top[key] = _freeze(v)
+            top[key] = _coerce(_freeze(v), str(fields[key].type))
     return Config(**top).validate()
 
 

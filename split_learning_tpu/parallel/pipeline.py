@@ -251,7 +251,8 @@ def _restore(tree):
 
 def make_train_step(pipe: PipelineModel, optimizer: optax.GradientTransformation,
                     mesh: Mesh, train: bool = True,
-                    donate: bool = True) -> Callable:
+                    donate: bool = True,
+                    client_sync: dict | None = None) -> Callable:
     """Jitted multi-client pipelined train step.
 
     Inputs are stacked along a leading ``client`` axis and sharded over the
@@ -263,8 +264,28 @@ def make_train_step(pipe: PipelineModel, optimizer: optax.GradientTransformation
     * ``x``: (C, M, mb, ...), ``labels``: (C, M, mb);
     * ``rngs``: jax typed key array of shape (C,).
 
+    ``client_sync`` maps a top-level param key (layer name) to
+    ``axis_index_groups`` partitioning the client axis: gradients for that
+    layer are mean-synced within each group every step.  This expresses
+    the reference's shared later-stage clients — N stage-1 clients feeding
+    one stage-2 client through a shared queue (``src/train/VGG16.py:154``)
+    train that stage-2 shard on ALL their activations, which in the
+    synchronous mesh regime is exactly a grouped gradient mean.  DCSL's
+    server-side data aggregation (``other/DCSL/src/Scheduler.py:152-191``,
+    one fwd/bwd over ``sda_size`` concatenated client batches) is the same
+    mechanism with a full-axis group.
+
     Returns (params, opt_state, stats, loss[C]).
     """
+    group_denom = {}
+    if client_sync:
+        n_client = mesh.shape["client"]
+        for name, groups in client_sync.items():
+            sizes = np.ones(n_client, np.float32)
+            for g in groups:
+                for col in g:
+                    sizes[col] = len(g)
+            group_denom[name] = sizes
 
     def body(params, opt_state, stats, x, labels, rngs):
         params, opt_state, stats = map(_strip, (params, opt_state, stats))
@@ -280,6 +301,18 @@ def make_train_step(pipe: PipelineModel, optimizer: optax.GradientTransformation
         # each device produced grads for its own stage only; sync replicas
         grads = jax.tree_util.tree_map(
             lambda g: jax.lax.psum(g, "stage"), grads)
+        if client_sync:
+            c_idx = jax.lax.axis_index("client")
+            synced = dict(grads)
+            for name, groups in client_sync.items():
+                if name not in grads:
+                    continue
+                denom = jnp.asarray(group_denom[name])[c_idx]
+                synced[name] = jax.tree_util.tree_map(
+                    lambda g: jax.lax.psum(
+                        g, "client", axis_index_groups=groups) / denom,
+                    grads[name])
+            grads = synced
         updates, new_opt = optimizer.update(grads, opt_state, params)
         new_params = optax.apply_updates(params, updates)
         return (*map(_restore, (new_params, new_opt, new_stats)),

@@ -1,0 +1,340 @@
+"""Execution contexts: how a round strategy actually trains a cluster.
+
+A :class:`TrainContext` exposes two operations to the round strategies
+(:mod:`split_learning_tpu.runtime.strategies`):
+
+* ``train_cluster(plan, params, stats, ...) -> list[Update]`` — run one
+  round (or ``epochs`` epochs) of split training for one cluster and
+  return per-(logical client, stage) shard updates — the same artifact
+  the reference server collects from UPDATE messages
+  (``/root/reference/src/Server.py:155-170``);
+* ``validate(params, stats) -> ValResult`` — full-model test pass
+  (``src/val/get_val.py``).
+
+:class:`MeshContext` is the TPU-native backend: the whole cluster is ONE
+jitted SPMD program on a (client, stage) mesh (see
+:mod:`split_learning_tpu.parallel.pipeline`).  Logical clients beyond the
+physical device budget are processed in column chunks; a cluster whose
+stage count exceeds the device budget runs stage-fused (cuts still define
+shard extraction, so the aggregation surface is unchanged — split fwd/bwd
+is numerically the unsplit one).
+
+The multi-process protocol backend (real clients over a transport) lives
+in :mod:`split_learning_tpu.runtime.server` and satisfies the same
+interface.
+"""
+
+from __future__ import annotations
+
+import math
+import zlib
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from split_learning_tpu.config import Config
+from split_learning_tpu.data import make_data_loader
+from split_learning_tpu.models import build_model, shard_params
+from split_learning_tpu.models.split import SplitModel
+from split_learning_tpu.parallel.mesh import make_mesh, stage_ranges
+from split_learning_tpu.parallel.pipeline import (
+    PipelineModel, make_train_step, shard_to_mesh, stack_for_clients,
+)
+from split_learning_tpu.runtime.plan import ClusterPlan
+from split_learning_tpu.runtime.protocol import Update
+from split_learning_tpu.runtime.validation import (
+    ValResult, dataset_for_model,
+)
+
+
+def make_optimizer(learning, lr: float | None = None):
+    """Optimizer from a LearningConfig (reference: SGD+momentum for VGG
+    ``src/train/VGG16.py:62``, AdamW for BERT/KWT ``src/train/BERT.py:69``)."""
+    rate = lr if lr is not None else learning.learning_rate
+    if learning.optimizer == "adamw":
+        opt = optax.adamw(rate, weight_decay=learning.weight_decay)
+    else:
+        opt = optax.sgd(rate, momentum=learning.momentum)
+    if learning.clip_grad_norm:
+        opt = optax.chain(
+            optax.clip_by_global_norm(learning.clip_grad_norm), opt)
+    return opt
+
+
+def client_groups(n_columns: int, n_logical: int) -> list[list[int]]:
+    """Partition mesh client columns into n_logical contiguous groups."""
+    n_logical = max(1, min(n_logical, n_columns))
+    bounds = [round(i * n_columns / n_logical)
+              for i in range(n_logical + 1)]
+    return [list(range(bounds[i], bounds[i + 1]))
+            for i in range(n_logical)]
+
+
+class TrainContext:
+    def init_variables(self) -> dict:
+        raise NotImplementedError
+
+    def train_cluster(self, plan: ClusterPlan, params, stats, *,
+                      round_idx: int = 0, epochs: int = 1,
+                      client_subset: list | None = None,
+                      per_client_params: dict | None = None,
+                      lr: float | None = None,
+                      sync_all_later_stages: bool = False) -> list[Update]:
+        raise NotImplementedError
+
+    def validate(self, params, stats) -> ValResult:
+        raise NotImplementedError
+
+    def shutdown(self) -> None:
+        pass
+
+
+class MeshContext(TrainContext):
+    """In-process compiled-mesh backend."""
+
+    def __init__(self, cfg: Config, devices=None):
+        self.cfg = cfg
+        self.devices = list(devices if devices is not None
+                            else jax.devices())
+        self.model_kwargs = dict(cfg.model_kwargs or {})
+        if cfg.compute_dtype == "bfloat16":
+            self.model_kwargs.setdefault("dtype", jnp.bfloat16)
+        self.full_model: SplitModel = build_model(
+            cfg.model_key, **self.model_kwargs)
+        self.specs = self.full_model.specs
+        self.dataset = dataset_for_model(cfg.model_key)
+        self._step_cache: dict = {}
+        self._loader_cache: dict = {}
+        self._example = self._example_struct()
+
+    # -- model/data geometry ------------------------------------------------
+
+    def _example_struct(self) -> jax.ShapeDtypeStruct:
+        mb = self.cfg.learning.batch_size
+        ds = make_data_loader(self.dataset, 1, train=False,
+                              synthetic_size=self.cfg.synthetic_size or 64)
+        x, _ = next(iter(ds))
+        arr = np.asarray(x)
+        return jax.ShapeDtypeStruct((mb,) + arr.shape[1:], arr.dtype)
+
+    def init_variables(self, rng=None) -> dict:
+        rng = rng if rng is not None else jax.random.key(self.cfg.seed)
+        x = jnp.zeros(self._example.shape, self._example.dtype)
+        return self.full_model.init(rng, x, train=False)
+
+    def _loader(self, client_key: str, label_counts: np.ndarray):
+        key = (client_key, tuple(np.asarray(label_counts).tolist()))
+        if key not in self._loader_cache:
+            # stable per-client seed (hash() is salted per process)
+            seed = (zlib.crc32(client_key.encode()) ^ self.cfg.seed) \
+                % (2 ** 31)
+            self._loader_cache[key] = make_data_loader(
+                self.dataset, self.cfg.learning.batch_size,
+                distribution=np.asarray(label_counts), train=True,
+                seed=seed, synthetic_size=self.cfg.synthetic_size)
+        return self._loader_cache[key]
+
+    def _geometry(self, plan: ClusterPlan, n_active: int):
+        """(C_phys, S_phys, physical cuts) fitted to the device budget."""
+        S = len(plan.cuts) + 1
+        D = len(self.devices)
+        if D >= S and plan.cuts:
+            s_phys, cuts_phys = S, list(plan.cuts)
+        else:
+            s_phys, cuts_phys = 1, []
+        c_phys = max(1, min(n_active, D // s_phys))
+        return c_phys, s_phys, cuts_phys
+
+    def _compiled(self, plan: ClusterPlan, c_phys: int, s_phys: int,
+                  cuts_phys: list, lr: float | None,
+                  sync_map_key: tuple, client_sync: dict | None):
+        key = (plan.cluster_id, c_phys, s_phys, tuple(cuts_phys), lr,
+               sync_map_key)
+        if key in self._step_cache:
+            return self._step_cache[key]
+        mesh = make_mesh(c_phys, s_phys, self.devices)
+        pipe = PipelineModel(
+            self.cfg.model_key, cuts=cuts_phys,
+            example_input=self._example,
+            num_microbatches=self.cfg.learning.control_count,
+            model_kwargs=self.model_kwargs)
+        optimizer = make_optimizer(self.cfg.learning, lr)
+        step = make_train_step(pipe, optimizer, mesh,
+                               client_sync=client_sync)
+        self._step_cache[key] = (mesh, pipe, optimizer, step)
+        return self._step_cache[key]
+
+    def _sync_map(self, plan: ClusterPlan, c_phys: int, n_real: int,
+                  sync_all: bool) -> tuple[dict | None, tuple]:
+        """Per-layer client-axis sync groups for shared later stages.
+
+        Only the first ``n_real`` columns are grouped; padded duplicate
+        columns (short tail chunks) get singleton groups so their
+        gradients never enter a shared-stage mean."""
+        if n_real == 1 and c_phys == 1:
+            return None, ()
+        ranges = stage_ranges(len(self.specs), plan.cuts)
+        sync: dict = {}
+        items = []
+        for s in range(2, len(ranges) + 1):
+            n_logical = 1 if sync_all else max(1, len(plan.clients[s - 1]))
+            if n_logical >= n_real:
+                continue  # every column its own logical client: no sync
+            groups = client_groups(n_real, n_logical) + [
+                [i] for i in range(n_real, c_phys)]
+            a, b = ranges[s - 1]
+            for spec in self.specs[a:b]:
+                if spec.make is None:
+                    continue
+                sync[spec.name] = groups
+                items.append((spec.name, tuple(map(tuple, groups))))
+        return (sync or None), tuple(items)
+
+    # -- the round ----------------------------------------------------------
+
+    def train_cluster(self, plan: ClusterPlan, params, stats, *,
+                      round_idx: int = 0, epochs: int = 1,
+                      client_subset: list | None = None,
+                      per_client_params: dict | None = None,
+                      lr: float | None = None,
+                      sync_all_later_stages: bool = False) -> list[Update]:
+        stage1 = [c for c in plan.stage1_clients
+                  if client_subset is None or c in client_subset]
+        if not stage1:
+            return []
+        counts = {c: plan.label_counts[plan.stage1_clients.index(c)]
+                  for c in stage1}
+        c_phys, s_phys, cuts_phys = self._geometry(plan, len(stage1))
+        updates: list[Update] = []
+        n_chunks = math.ceil(len(stage1) / c_phys)
+        for chunk_i in range(n_chunks):
+            chunk = stage1[chunk_i * c_phys:(chunk_i + 1) * c_phys]
+            pad = c_phys - len(chunk)
+            client_sync, sync_key = self._sync_map(
+                plan, c_phys, len(chunk), sync_all_later_stages)
+            mesh, pipe, optimizer, step = self._compiled(
+                plan, c_phys, s_phys, cuts_phys, lr, sync_key, client_sync)
+            M, mb = pipe.num_microbatches, pipe.mb_size
+            cols = chunk + [chunk[-1]] * pad  # padded columns ignored below
+            trees = [
+                (per_client_params or {}).get(c, params) for c in cols
+            ]
+            params_c = jax.tree_util.tree_map(
+                lambda *xs: jnp.stack([jnp.asarray(x) for x in xs]), *trees)
+            opt0 = optimizer.init(
+                jax.tree_util.tree_map(lambda a: a[0], params_c))
+            opt_c = stack_for_clients(opt0, c_phys)
+            stats_c = stack_for_clients(stats, c_phys)
+            params_c, opt_c, stats_c = (
+                shard_to_mesh(t, mesh) for t in (params_c, opt_c, stats_c))
+
+            loaders = [self._loader(c, counts[c]) for c in cols]
+            steps_per_epoch = max(
+                1, min(len(ld) for ld in loaders) // M)
+            rngs = jax.vmap(jax.random.key)(jnp.arange(c_phys)
+                                            + round_idx * 1000)
+            loss = None
+            consumed = np.zeros(c_phys, dtype=np.int64)
+            for _ in range(epochs):
+                iters = [iter(ld) for ld in loaders]
+                for _ in range(steps_per_epoch):
+                    xs, ys = [], []
+                    for it_i, it in enumerate(iters):
+                        bx, by = [], []
+                        for _ in range(M):
+                            try:
+                                b = next(it)
+                            except StopIteration:
+                                it = iters[it_i] = iter(loaders[it_i])
+                                b = next(it)
+                            bx.append(np.asarray(b[0]))
+                            by.append(np.asarray(b[1]))
+                        xs.append(np.stack(bx))
+                        ys.append(np.stack(by))
+                    x = jnp.asarray(np.stack(xs))
+                    labels = jnp.asarray(np.stack(ys).astype(np.int32))
+                    params_c, opt_c, stats_c, loss = step(
+                        params_c, opt_c, stats_c, x, labels, rngs)
+                    consumed += M * mb
+            loss_h = (np.asarray(loss) if loss is not None
+                      else np.zeros(c_phys))
+            params_h = jax.tree_util.tree_map(np.asarray, params_c)
+            stats_h = jax.tree_util.tree_map(np.asarray, stats_c)
+            updates.extend(self._extract_updates(
+                plan, chunk, cols, params_h, stats_h, loss_h, consumed,
+                client_sync))
+        return updates
+
+    def _extract_updates(self, plan: ClusterPlan, chunk, cols, params_h,
+                         stats_h, loss_h, consumed, client_sync):
+        """Per-(logical client, stage) shard updates from trained columns."""
+        ranges = stage_ranges(len(self.specs), plan.cuts)
+        col_tree = lambda tree, i: jax.tree_util.tree_map(  # noqa: E731
+            lambda a: a[i], tree)
+        out: list[Update] = []
+        # stage 1: one update per real (non-padded) column
+        a, b = ranges[0]
+        for i, cid in enumerate(chunk):
+            ok = bool(np.isfinite(loss_h[i]))
+            out.append(Update(
+                client_id=cid, stage=1, cluster=plan.cluster_id,
+                params=shard_params(col_tree(params_h, i), self.specs, a, b),
+                batch_stats=shard_params(col_tree(stats_h, i), self.specs,
+                                         a, b),
+                num_samples=int(consumed[i]), ok=ok))
+        # later stages: one update per sync group (columns in a group hold
+        # identical shard params by construction)
+        for s in range(2, len(ranges) + 1):
+            a, b = ranges[s - 1]
+            layer_names = [sp.name for sp in self.specs[a:b] if sp.make]
+            groups = None
+            if client_sync and layer_names:
+                groups = client_sync.get(layer_names[0])
+            if groups is None:
+                groups = [[i] for i in range(len(cols))]
+            logical = plan.clients[s - 1] or [f"_stage{s}"]
+            for gi, grp in enumerate(groups):
+                real = [i for i in grp if i < len(chunk)]
+                if not real:
+                    continue
+                rep = real[0]
+                cid = logical[min(gi, len(logical) - 1)]
+                ok = bool(np.all(np.isfinite(loss_h[real])))
+                out.append(Update(
+                    client_id=cid, stage=s, cluster=plan.cluster_id,
+                    params=shard_params(col_tree(params_h, rep),
+                                        self.specs, a, b),
+                    batch_stats=shard_params(col_tree(stats_h, rep),
+                                             self.specs, a, b),
+                    num_samples=int(consumed[real].sum()), ok=ok))
+        return out
+
+    def validate(self, params, stats) -> ValResult:
+        variables = {"params": params}
+        if stats:
+            variables["batch_stats"] = stats
+        # loader + jitted eval step are cached on the context: validation
+        # runs every round and must not re-load data or re-trace
+        if not hasattr(self, "_val_cache"):
+            from split_learning_tpu.data import make_data_loader
+            from split_learning_tpu.runtime.validation import make_eval_step
+            model = build_model(self.cfg.model_key, **self.model_kwargs)
+            loader = make_data_loader(
+                self.dataset, self.cfg.val_batch_size, train=False,
+                synthetic_size=self.cfg.synthetic_size)
+            self._val_cache = (loader, make_eval_step(model, bool(stats)))
+        loader, step = self._val_cache
+        total_loss, total_correct, n = 0.0, 0, 0
+        for i, (x, labels) in enumerate(loader):
+            if (self.cfg.val_max_batches is not None
+                    and i >= self.cfg.val_max_batches):
+                break
+            loss, correct = step(variables, jnp.asarray(x),
+                                 jnp.asarray(labels))
+            total_loss += float(loss)
+            total_correct += int(correct)
+            n += len(labels)
+        return ValResult(loss=total_loss / max(n, 1),
+                         accuracy=total_correct / max(n, 1), num_samples=n)

@@ -1,0 +1,92 @@
+"""Structured logging with console mirroring.
+
+Parity with the reference logger (``/root/reference/src/Log.py``): a file
+logger writing timestamped records to ``{log_path}/app.log``, mirrored to
+the console with ANSI colors, with ``[>>>]``/``[<<<]`` direction markers
+for protocol messages and a ``debug_mode`` gate.  Additions: per-round
+structured metrics records (JSON lines in ``metrics.jsonl``) so runs are
+machine-readable, which the reference lacks (SURVEY.md §5.5).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import pathlib
+import sys
+import time
+
+_COLORS = {
+    "red": "\033[91m", "green": "\033[92m", "yellow": "\033[93m",
+    "blue": "\033[94m", "magenta": "\033[95m", "cyan": "\033[96m",
+    "white": "\033[97m", "reset": "\033[0m",
+}
+
+
+def print_with_color(text: str, color: str = "white") -> None:
+    sys.stdout.write(f"{_COLORS.get(color, '')}{text}{_COLORS['reset']}\n")
+
+
+class Logger:
+    """File + console logger with structured metrics sidecar."""
+
+    def __init__(self, log_path: str | pathlib.Path = ".",
+                 debug: bool = False, console: bool = True,
+                 name: str = "split_learning_tpu"):
+        self.debug_mode = debug
+        self.console = console
+        root = pathlib.Path(log_path)
+        root.mkdir(parents=True, exist_ok=True)
+        self._metrics_path = root / "metrics.jsonl"
+        self._log = logging.getLogger(f"{name}.{id(self):x}")
+        self._log.setLevel(logging.DEBUG)
+        self._log.propagate = False
+        # id() values recycle: a reused registry entry may still carry a
+        # previous Logger's handler — drop any stale ones
+        for h in list(self._log.handlers):
+            self._log.removeHandler(h)
+            h.close()
+        handler = logging.FileHandler(root / "app.log")
+        handler.setFormatter(logging.Formatter(
+            "%(asctime)s - %(levelname)s - %(message)s"))
+        self._log.addHandler(handler)
+        self._handler = handler
+
+    def info(self, msg: str, color: str = "white") -> None:
+        self._log.info(msg)
+        if self.console:
+            print_with_color(msg, color)
+
+    def warning(self, msg: str) -> None:
+        self._log.warning(msg)
+        if self.console:
+            print_with_color(msg, "yellow")
+
+    def error(self, msg: str) -> None:
+        self._log.error(msg)
+        if self.console:
+            print_with_color(msg, "red")
+
+    def debug(self, msg: str) -> None:
+        if self.debug_mode:
+            self._log.debug(msg)
+            if self.console:
+                print_with_color(msg, "cyan")
+
+    def sent(self, msg: str) -> None:
+        """Outbound protocol message (reference's red ``[>>>]`` marker)."""
+        self.info(f"[>>>] {msg}", "red")
+
+    def received(self, msg: str) -> None:
+        """Inbound protocol message (reference's blue ``[<<<]`` marker)."""
+        self.info(f"[<<<] {msg}", "blue")
+
+    def metric(self, **fields) -> None:
+        """Append one structured metrics record (JSON line)."""
+        rec = {"ts": time.time(), **fields}
+        with open(self._metrics_path, "a") as f:
+            f.write(json.dumps(rec) + "\n")
+
+    def close(self) -> None:
+        self._handler.close()
+        self._log.removeHandler(self._handler)

@@ -1,0 +1,118 @@
+"""The global round loop: train → aggregate → validate → checkpoint.
+
+Parity with the reference server's round handling
+(``/root/reference/src/Server.py:155-210``): after each round's updates
+are aggregated the full model is validated on the test set; a NaN/exploded
+round logs "Training failed!" and is not checkpointed
+(``:184-196``); otherwise the checkpoint is (over)written and the next
+round begins; resume loads the checkpoint and continues
+(``:230-256``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Any
+
+from split_learning_tpu.config import Config
+from split_learning_tpu.runtime.checkpoint import (
+    load_checkpoint, save_checkpoint,
+)
+from split_learning_tpu.runtime.context import TrainContext
+from split_learning_tpu.runtime.log import Logger
+from split_learning_tpu.runtime.plan import ClusterPlan
+from split_learning_tpu.runtime.strategies import make_strategy
+
+
+@dataclasses.dataclass
+class RoundRecord:
+    round_idx: int
+    ok: bool
+    num_samples: int
+    wall_s: float
+    val_loss: float | None = None
+    val_accuracy: float | None = None
+
+
+@dataclasses.dataclass
+class TrainResult:
+    params: Any
+    stats: Any
+    history: list
+
+
+def run_training(cfg: Config, ctx: TrainContext,
+                 plans: list[ClusterPlan],
+                 logger: Logger | None = None,
+                 init_params: Any | None = None,
+                 init_stats: Any | None = None) -> TrainResult:
+    logger = logger or Logger(cfg.log_path, debug=cfg.debug, console=False)
+    strategy = make_strategy(cfg)
+
+    start_round = 0
+    params, stats = init_params, init_stats
+    if cfg.checkpoint.load:
+        ck = load_checkpoint(cfg.checkpoint.directory, cfg.model_key)
+        if ck is not None:
+            params, stats = ck["params"], ck["batch_stats"]
+            start_round = ck["round_idx"]
+            logger.info(f"Loaded checkpoint at round {start_round}.",
+                        "green")
+    if params is None:
+        variables = ctx.init_variables()
+        params = variables["params"]
+        stats = variables.get("batch_stats", {})
+    stats = stats or {}
+
+    for plan in plans:
+        logger.info(
+            f"Cluster {plan.cluster_id}: cuts={plan.cuts} "
+            f"clients={[len(ids) for ids in plan.clients]} "
+            f"rejected={plan.rejected}", "cyan")
+
+    history: list[RoundRecord] = []
+    t_start = time.perf_counter()
+    for r in range(start_round, cfg.global_rounds):
+        t0 = time.perf_counter()
+        outcome = strategy.run_round(ctx, plans, r, params, stats)
+        wall = time.perf_counter() - t0
+        rec = RoundRecord(round_idx=r, ok=outcome.ok,
+                          num_samples=outcome.num_samples, wall_s=wall)
+        if not outcome.ok:
+            logger.error(f"Round {r}: Training failed! "
+                         f"(NaN detected; aggregation skipped)")
+            history.append(rec)
+            logger.metric(**dataclasses.asdict(rec))
+            continue
+        prev_params, prev_stats = params, stats
+        params, stats = outcome.params, outcome.stats
+        if outcome.validate and cfg.checkpoint.validate:
+            val = ctx.validate(params, stats)
+            rec.val_loss, rec.val_accuracy = val.loss, val.accuracy
+            rec.ok = val.ok
+            logger.info(
+                f"Round {r}: samples={outcome.num_samples} "
+                f"val_loss={val.loss:.4f} val_acc={val.accuracy:.4f} "
+                f"({wall:.1f}s)", "green" if val.ok else "red")
+            if not val.ok:
+                # reference aborts on an exploded round
+                # (src/Server.py:185-187); keep the last good weights
+                # rather than training on from garbage
+                logger.error(f"Round {r}: Training failed! "
+                             f"(validation loss exploded)")
+                params, stats = prev_params, prev_stats
+        else:
+            logger.info(f"Round {r}: samples={outcome.num_samples} "
+                        f"({wall:.1f}s)", "green")
+        if rec.ok and cfg.checkpoint.save:
+            save_checkpoint(cfg.checkpoint.directory, cfg.model_key,
+                            params, stats, round_idx=r + 1)
+        history.append(rec)
+        logger.metric(**dataclasses.asdict(rec))
+        if cfg.limited_time and (time.perf_counter() - t_start
+                                 > cfg.limited_time):
+            logger.warning(f"Wall-clock budget {cfg.limited_time}s "
+                           f"exhausted at round {r}.")
+            break
+    return TrainResult(params=params, stats=stats, history=history)

@@ -78,6 +78,7 @@ class Update:
     params: Any                     # pytree of np arrays (host-side)
     num_samples: int                # FedAvg weight (data_count semantics)
     ok: bool = True                 # False -> NaN seen, skip aggregation
+    batch_stats: Any | None = None  # shard's running stats (BN models)
 
 
 @dataclasses.dataclass

@@ -1,0 +1,316 @@
+"""Round strategies: the reference's six server forks as plug-ins.
+
+The reference implements each scheduling/aggregation algorithm as a full
+copy of the server (SURVEY.md §2.3): the main concurrent FedAvg server
+(``/root/reference/src/Server.py``), Vanilla_SL's sequential relay,
+Cluster_FSL's cluster relay, FLEX's periodic aggregation, 2LS's two-level
+FedAsync, and DCSL's round-robin SDA.  Here each is a
+:class:`RoundStrategy` driving the same :class:`TrainContext` — host
+Python decides *who trains when* and *how weights merge*; the compiled
+mesh step never changes.
+
+Aggregation math is shared: per-cluster per-stage weighted FedAvg
+(``src/Server.py:398-408`` → ``src/Utils.py:35-66``), stage concatenation
+(disjoint absolute layer keys), unweighted cross-cluster average
+(``:410-434``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Sequence
+
+import jax
+import numpy as np
+
+from split_learning_tpu.config import Config
+from split_learning_tpu.ops.fedavg import fedavg_trees
+from split_learning_tpu.runtime.context import TrainContext
+from split_learning_tpu.runtime.plan import ClusterPlan
+from split_learning_tpu.runtime.protocol import Update
+
+
+@dataclasses.dataclass
+class RoundOutcome:
+    params: Any
+    stats: Any
+    ok: bool = True
+    num_samples: int = 0
+    validate: bool = True           # run full-model validation this round?
+    metrics: dict = dataclasses.field(default_factory=dict)
+
+
+# --------------------------------------------------------------------------
+# shared aggregation math
+# --------------------------------------------------------------------------
+
+def aggregate_cluster(updates: Sequence[Update]) -> tuple[Any, Any, int]:
+    """Per-stage weighted FedAvg then stage concat for ONE cluster.
+
+    Returns (params_tree, stats_tree, total_stage1_samples)."""
+    by_stage: dict[int, list[Update]] = {}
+    for u in updates:
+        by_stage.setdefault(u.stage, []).append(u)
+    params: dict = {}
+    stats: dict = {}
+    n_samples = 0
+    for stage, ups in sorted(by_stage.items()):
+        weights = [max(1, u.num_samples) for u in ups]
+        params.update(fedavg_trees([u.params for u in ups], weights))
+        st = [u.batch_stats for u in ups if u.batch_stats]
+        if st:
+            stats.update(fedavg_trees(
+                st, [max(1, u.num_samples) for u in ups
+                     if u.batch_stats]))
+        if stage == 1:
+            n_samples += sum(u.num_samples for u in ups)
+    return params, stats, n_samples
+
+
+def merge_clusters(cluster_trees: Sequence[Any]) -> Any:
+    """Unweighted cross-cluster average (``src/Server.py:410-434``)."""
+    return fedavg_trees(list(cluster_trees))
+
+
+def _lerp(a: Any, b: Any, alpha: float) -> Any:
+    """(1-alpha)*a + alpha*b elementwise over matching pytrees."""
+    return jax.tree_util.tree_map(
+        lambda x, y: np.asarray((1.0 - alpha) * np.asarray(x, np.float32)
+                                + alpha * np.asarray(y, np.float32),
+                                dtype=np.asarray(x).dtype), a, b)
+
+
+def _fill(full: Any, partial: Any) -> Any:
+    """Overlay aggregated layers onto the previous full tree (clusters with
+    fewer stages than layers exist only in degenerate configs; missing keys
+    keep their previous values — the reference's checkpoint-merge
+    semantics, ``src/Server.py:230-256``)."""
+    out = dict(full)
+    out.update(partial)
+    return out
+
+
+# --------------------------------------------------------------------------
+# strategies
+# --------------------------------------------------------------------------
+
+class RoundStrategy:
+    name = "base"
+
+    def __init__(self, cfg: Config):
+        self.cfg = cfg
+
+    def run_round(self, ctx: TrainContext, plans: list[ClusterPlan],
+                  round_idx: int, params: Any, stats: Any) -> RoundOutcome:
+        raise NotImplementedError
+
+    def _lr(self, round_idx: int) -> float | None:
+        """DCSL-style lr decay (``other/DCSL/src/Server.py:38-39``)."""
+        lrn = self.cfg.learning
+        if lrn.lr_decay_every and lrn.lr_decay != 1.0:
+            return lrn.learning_rate * (
+                lrn.lr_decay ** (round_idx // lrn.lr_decay_every))
+        return None
+
+
+class FedAvgStrategy(RoundStrategy):
+    """Main-server behavior: all clusters train concurrently, per-cluster
+    FedAvg per stage, cross-cluster average, validate every round
+    (``src/Server.py:155-210``)."""
+    name = "fedavg"
+    sync_all_later_stages = False   # SDA override
+
+    def _epochs(self) -> int:
+        return 1
+
+    def run_round(self, ctx, plans, round_idx, params, stats):
+        cluster_params, cluster_stats = [], []
+        total, ok = 0, True
+        for plan in plans:
+            ups = ctx.train_cluster(
+                plan, params, stats, round_idx=round_idx,
+                epochs=self._epochs(), lr=self._lr(round_idx),
+                sync_all_later_stages=self.sync_all_later_stages)
+            ok &= all(u.ok for u in ups)
+            p, s, n = aggregate_cluster(ups)
+            cluster_params.append(_fill(params, p))
+            cluster_stats.append(_fill(stats, s))
+            total += n
+        if not ok:
+            # reference: round_result False -> skip aggregation entirely
+            # (src/Server.py:162-166, :195-196)
+            return RoundOutcome(params, stats, ok=False, validate=False)
+        return RoundOutcome(merge_clusters(cluster_params),
+                            merge_clusters(cluster_stats),
+                            num_samples=total)
+
+
+class SDAStrategy(FedAvgStrategy):
+    """DCSL: later stages train on concatenated client batches (full
+    client-axis gradient sync) for ``local_rounds`` epochs per round
+    (``other/DCSL/src/Scheduler.py:152-191``, ``:83``)."""
+    name = "sda"
+    sync_all_later_stages = True
+
+    def _epochs(self) -> int:
+        return self.cfg.aggregation.local_rounds
+
+
+class RelayStrategy(RoundStrategy):
+    """Vanilla_SL: stage-1 clients train ONE AT A TIME; each finisher's
+    stage-1 weights seed the next client; later stages train continuously
+    (``other/Vanilla_SL/src/Server.py:130-146``, ``:248-268``)."""
+    name = "relay"
+
+    def run_round(self, ctx, plans, round_idx, params, stats):
+        total, ok = 0, True
+        cluster_params, cluster_stats = [], []
+        for plan in plans:
+            cur_p, cur_s = params, stats
+            last_stage_updates: list[Update] = []
+            for cid in plan.stage1_clients:
+                ups = ctx.train_cluster(plan, cur_p, cur_s,
+                                        round_idx=round_idx,
+                                        client_subset=[cid],
+                                        lr=self._lr(round_idx))
+                ok &= all(u.ok for u in ups)
+                for u in ups:
+                    cur_p = _fill(cur_p, u.params)
+                    if u.batch_stats:
+                        cur_s = _fill(cur_s, u.batch_stats)
+                    if u.stage == 1:
+                        total += u.num_samples
+                    else:
+                        last_stage_updates.append(u)
+            # final FedAvg across the relay's later-stage snapshots
+            # (other/Vanilla_SL/src/Server.py: stage-2 devices averaged at
+            # round end)
+            if last_stage_updates:
+                p, s, _ = aggregate_cluster(last_stage_updates)
+                cur_p = _fill(cur_p, p)
+                if s:
+                    cur_s = _fill(cur_s, s)
+            cluster_params.append(cur_p)
+            cluster_stats.append(cur_s)
+        if not ok:
+            return RoundOutcome(params, stats, ok=False, validate=False)
+        return RoundOutcome(merge_clusters(cluster_params),
+                            merge_clusters(cluster_stats),
+                            num_samples=total)
+
+
+class ClusterRelayStrategy(RoundStrategy):
+    """Cluster_FSL: clusters run sequentially; cluster i's aggregated
+    stage-1 weights initialize cluster i+1; later stages carry over
+    continuously (``other/Cluster_FSL/src/Server.py:151-167``,
+    ``:267-288``)."""
+    name = "cluster_relay"
+
+    def run_round(self, ctx, plans, round_idx, params, stats):
+        cur_p, cur_s = params, stats
+        total, ok = 0, True
+        for plan in plans:
+            ups = ctx.train_cluster(plan, cur_p, cur_s,
+                                    round_idx=round_idx,
+                                    lr=self._lr(round_idx))
+            ok &= all(u.ok for u in ups)
+            p, s, n = aggregate_cluster(ups)
+            cur_p = _fill(cur_p, p)
+            cur_s = _fill(cur_s, s)
+            total += n
+        if not ok:
+            return RoundOutcome(params, stats, ok=False, validate=False)
+        return RoundOutcome(cur_p, cur_s, num_samples=total)
+
+
+class PeriodicStrategy(RoundStrategy):
+    """FLEX: per-client weights PERSIST across rounds; client-level FedAvg
+    every ``t_client`` rounds, global merge + validation every ``t_global``
+    rounds (``other/FLEX/src/Server.py:169-183``, ``:200-208``)."""
+    name = "periodic"
+
+    def __init__(self, cfg):
+        super().__init__(cfg)
+        self._client_params: dict = {}   # client_id -> full tree
+
+    def run_round(self, ctx, plans, round_idx, params, stats):
+        agg = self.cfg.aggregation
+        total, ok = 0, True
+        cluster_params, cluster_stats = [], []
+        cur_stats = stats
+        for plan in plans:
+            ups = ctx.train_cluster(
+                plan, params, stats, round_idx=round_idx,
+                per_client_params=dict(self._client_params),
+                lr=self._lr(round_idx))
+            ok &= all(u.ok for u in ups)
+            # persist each logical client's full tree (its shard overlaid
+            # on the round's base)
+            for u in ups:
+                base = self._client_params.get(u.client_id, params)
+                self._client_params[u.client_id] = _fill(base, u.params)
+                if u.stage == 1:
+                    total += u.num_samples
+            p, s, _ = aggregate_cluster(ups)
+            cluster_params.append(_fill(params, p))
+            cluster_stats.append(_fill(stats, s))
+            if (round_idx + 1) % agg.t_client == 0:
+                # client-level FedAvg: reset the cluster's clients to the
+                # cluster average (other/FLEX/src/Server.py:169-183)
+                for ids in plan.clients:
+                    for cid in ids:
+                        self._client_params[cid] = cluster_params[-1]
+        if not ok:
+            return RoundOutcome(params, stats, ok=False, validate=False)
+        if (round_idx + 1) % agg.t_global == 0:
+            merged = merge_clusters(cluster_params)
+            merged_stats = merge_clusters(cluster_stats)
+            self._client_params.clear()  # re-seed everyone from global
+            return RoundOutcome(merged, merged_stats, num_samples=total,
+                                validate=True)
+        return RoundOutcome(params, cur_stats, num_samples=total,
+                            validate=False)
+
+
+class FedAsyncStrategy(RoundStrategy):
+    """2LS: clusters execute sequentially in shuffled order; each cluster's
+    aggregate merges into the global model with ``alpha = 1/(1+rank)`` (or
+    a fixed config alpha): ``g = (1-a) g + a c``
+    (``other/2LS/src/Server.py:201-233``)."""
+    name = "fedasync"
+
+    def run_round(self, ctx, plans, round_idx, params, stats):
+        rng = np.random.default_rng(self.cfg.seed + round_idx)
+        order = rng.permutation(len(plans))
+        g_p, g_s = params, stats
+        total, ok = 0, True
+        for rank, pi in enumerate(order):
+            plan = plans[pi]
+            ups = ctx.train_cluster(plan, g_p, g_s, round_idx=round_idx,
+                                    lr=self._lr(round_idx))
+            ok &= all(u.ok for u in ups)
+            p, s, n = aggregate_cluster(ups)
+            alpha = (self.cfg.aggregation.fedasync_alpha
+                     if self.cfg.aggregation.fedasync_alpha is not None
+                     else 1.0 / (1.0 + rank))
+            g_p = _lerp(g_p, _fill(g_p, p), alpha)
+            g_s = _fill(g_s, s)
+            total += n
+        if not ok:
+            return RoundOutcome(params, stats, ok=False, validate=False)
+        return RoundOutcome(g_p, g_s, num_samples=total)
+
+
+_STRATEGIES = {
+    cls.name: cls for cls in (
+        FedAvgStrategy, SDAStrategy, RelayStrategy, ClusterRelayStrategy,
+        PeriodicStrategy, FedAsyncStrategy)
+}
+
+
+def make_strategy(cfg: Config) -> RoundStrategy:
+    name = cfg.aggregation.strategy
+    if name not in _STRATEGIES:
+        raise ValueError(f"unknown strategy {name!r}; "
+                         f"known: {sorted(_STRATEGIES)}")
+    return _STRATEGIES[name](cfg)

@@ -1,0 +1,170 @@
+"""End-to-end orchestration tests: plan → strategy → mesh backend → loop.
+
+Runs every round strategy through the real compiled pipeline on the
+virtual 8-device CPU mesh with a tiny KWT model + synthetic data
+(SURVEY.md §4 plan item (c): full-protocol runs in one pytest process).
+"""
+
+import numpy as np
+import pytest
+
+from split_learning_tpu.config import from_dict
+from split_learning_tpu.runtime.checkpoint import (
+    delete_checkpoint, load_checkpoint,
+)
+from split_learning_tpu.runtime.context import MeshContext, client_groups
+from split_learning_tpu.runtime.loop import run_training
+from split_learning_tpu.runtime.plan import (
+    Registration, plan_clusters,
+)
+from split_learning_tpu.runtime.strategies import (
+    aggregate_cluster, make_strategy,
+)
+from split_learning_tpu.run import run_local, synthesize_registrations
+
+TINY_KWT = {"embed_dim": 16, "num_heads": 2, "mlp_dim": 32}
+
+
+def tiny_cfg(tmp_path, **over):
+    base = dict(
+        model="KWT", dataset="SPEECHCOMMANDS", clients=[2, 1],
+        global_rounds=2, synthetic_size=96, val_max_batches=1,
+        val_batch_size=16, compute_dtype="float32",
+        model_kwargs=TINY_KWT, log_path=str(tmp_path),
+        learning={"batch_size": 4, "control_count": 2,
+                  "optimizer": "adamw", "learning_rate": 1e-3},
+        distribution={"num_samples": 40},
+        topology={"cut_layers": [2]},
+        checkpoint={"directory": str(tmp_path / "ckpt")},
+    )
+    for k, v in over.items():
+        if isinstance(v, dict) and isinstance(base.get(k), dict):
+            base[k].update(v)
+        else:
+            base[k] = v
+    return from_dict(base)
+
+
+def test_plan_clusters_basic(tmp_path):
+    cfg = tiny_cfg(tmp_path, clients=[4, 2],
+                   topology={"num_clusters": 2, "cut_layers": [2]})
+    plans = plan_clusters(cfg, synthesize_registrations(cfg))
+    assert len(plans) == 2
+    all_stage1 = [c for p in plans for c in p.stage1_clients]
+    assert sorted(all_stage1) == [f"client_1_{i}" for i in range(4)]
+    for p in plans:
+        assert p.cuts == [2]
+        assert len(p.clients) == 2
+        assert p.label_counts.shape[0] == len(p.stage1_clients)
+
+
+def test_plan_auto_cuts_from_profiles(tmp_path):
+    cfg = tiny_cfg(tmp_path, topology={"mode": "auto", "cut_layers": [2]})
+    n_layer = 17  # KWT layer count
+    profile = {"exe_time": [1.0] * n_layer, "size_data": [100.0] * n_layer,
+               "speed": 1.0, "network": 1e6}
+    regs = synthesize_registrations(
+        cfg, profiles={"client_1_0": profile, "client_1_1": profile})
+    plans = plan_clusters(cfg, regs)
+    assert len(plans[0].cuts) == 1
+    assert 1 <= plans[0].cuts[0] < n_layer
+
+
+def test_plan_selection_rejects_straggler(tmp_path):
+    cfg = tiny_cfg(tmp_path, clients=[4, 1],
+                   topology={"selection": True, "cut_layers": [2]})
+    profs = {}
+    for i in range(4):
+        speed = 0.001 if i == 3 else 10.0
+        profs[f"client_1_{i}"] = {"speed": speed}
+    plans = plan_clusters(cfg, synthesize_registrations(cfg, profs))
+    rejected = [c for p in plans for c in p.rejected]
+    assert rejected == ["client_1_3"]
+    kept = [c for p in plans for c in p.stage1_clients]
+    assert "client_1_3" not in kept
+
+
+def test_client_groups():
+    assert client_groups(4, 2) == [[0, 1], [2, 3]]
+    assert client_groups(3, 1) == [[0, 1, 2]]
+    assert client_groups(2, 5) == [[0], [1]]
+
+
+def test_mesh_context_updates_shape(tmp_path):
+    cfg = tiny_cfg(tmp_path)
+    plans = plan_clusters(cfg, synthesize_registrations(cfg))
+    ctx = MeshContext(cfg)
+    variables = ctx.init_variables()
+    ups = ctx.train_cluster(plans[0], variables["params"],
+                            variables.get("batch_stats", {}), round_idx=0)
+    stages = sorted({u.stage for u in ups})
+    assert stages == [1, 2]
+    stage1 = [u for u in ups if u.stage == 1]
+    assert len(stage1) == 2
+    assert all(u.num_samples > 0 for u in stage1)
+    # shards are disjoint and cover the model
+    p, _, n = aggregate_cluster(ups)
+    assert set(p) == set(variables["params"])
+    assert n == sum(u.num_samples for u in stage1)
+
+
+@pytest.mark.parametrize("strategy", ["fedavg", "sda", "relay",
+                                      "cluster_relay", "periodic",
+                                      "fedasync"])
+def test_strategy_end_to_end(tmp_path, strategy):
+    over = {"aggregation": {"strategy": strategy}}
+    if strategy == "periodic":
+        over["aggregation"].update({"t_client": 1, "t_global": 2})
+    if strategy in ("cluster_relay", "fedasync"):
+        over["clients"] = [2, 1]
+        over["topology"] = {"num_clusters": 2, "cut_layers": [2]}
+    cfg = tiny_cfg(tmp_path, **over)
+    result = run_local(cfg)
+    assert len(result.history) == 2
+    assert all(rec.ok for rec in result.history)
+    assert result.history[-1].num_samples > 0
+    # strategies that validate every round report accuracy
+    validated = [r for r in result.history if r.val_accuracy is not None]
+    assert validated, "no round was validated"
+
+
+def test_checkpoint_resume(tmp_path):
+    cfg = tiny_cfg(tmp_path, global_rounds=1)
+    result = run_local(cfg)
+    ck = load_checkpoint(cfg.checkpoint.directory, cfg.model_key)
+    assert ck is not None and ck["round_idx"] == 1
+    import jax
+    saved = jax.tree_util.tree_leaves(ck["params"])
+    live = jax.tree_util.tree_leaves(
+        jax.tree_util.tree_map(np.asarray, result.params))
+    assert len(saved) == len(live)
+    for a, b in zip(saved, live):
+        np.testing.assert_allclose(np.asarray(a, np.float32),
+                                   np.asarray(b, np.float32), rtol=1e-5)
+    # resume: 2 rounds total, starts from round 1
+    cfg2 = tiny_cfg(tmp_path, global_rounds=2,
+                    checkpoint={"directory": str(tmp_path / "ckpt"),
+                                "load": True})
+    result2 = run_local(cfg2)
+    assert [r.round_idx for r in result2.history] == [1]
+    delete_checkpoint(cfg.checkpoint.directory, cfg.model_key)
+    assert load_checkpoint(cfg.checkpoint.directory, cfg.model_key) is None
+
+
+def test_nan_round_skips_aggregation(tmp_path):
+    cfg = tiny_cfg(tmp_path, global_rounds=1)
+    plans = plan_clusters(cfg, synthesize_registrations(cfg))
+    ctx = MeshContext(cfg)
+    variables = ctx.init_variables()
+    params = variables["params"]
+    # poison one layer -> NaN loss -> round marked failed, params unchanged
+    import jax
+    name = sorted(params)[0]
+    poisoned = dict(params)
+    poisoned[name] = jax.tree_util.tree_map(
+        lambda v: np.full_like(np.asarray(v), np.nan), params[name])
+    strategy = make_strategy(cfg)
+    outcome = strategy.run_round(ctx, plans, 0, poisoned,
+                                 variables.get("batch_stats", {}))
+    assert not outcome.ok
+    assert outcome.params is poisoned  # untouched
