@@ -1,0 +1,43 @@
+"""``python -m split_learning_tpu.broker`` — standalone message broker.
+
+The reference requires an external RabbitMQ (Erlang) broker
+(``/root/reference/README.md:43-69``); this hosts the framework's own
+TCP broker instead.  Prefers the native C++ broker when it can be built
+(``split_learning_tpu/native``), falling back to the threaded Python one.
+"""
+
+from __future__ import annotations
+
+import argparse
+import time
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description="Split-learning TCP broker.")
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, default=5672)
+    ap.add_argument("--python", action="store_true",
+                    help="force the pure-Python broker")
+    args = ap.parse_args(argv)
+
+    broker = None
+    if not args.python:
+        try:
+            from split_learning_tpu.native import NativeBroker
+            broker = NativeBroker(args.host, args.port)
+            print(f"native broker on {args.host}:{broker.port}")
+        except Exception as e:  # noqa: BLE001 — any build/load failure
+            print(f"native broker unavailable ({e}); using Python broker")
+    if broker is None:
+        from split_learning_tpu.runtime.bus import Broker
+        broker = Broker(args.host, args.port)
+        print(f"python broker on {args.host}:{broker.port}")
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        broker.close()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,7 @@
+"""``python -m split_learning_tpu.client`` — protocol client entry
+(reference ``client.py`` parity)."""
+
+from split_learning_tpu.runtime.client import main
+
+if __name__ == "__main__":
+    main()

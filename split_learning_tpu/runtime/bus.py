@@ -23,6 +23,7 @@ import collections
 import socket
 import struct
 import threading
+import time
 from typing import Iterable
 
 
@@ -193,8 +194,22 @@ class TcpTransport(Transport):
     """Client of a :class:`Broker`. One socket per transport instance;
     safe for one thread (create one per worker thread)."""
 
-    def __init__(self, host: str, port: int):
-        self._sock = socket.create_connection((host, port))
+    def __init__(self, host: str, port: int, connect_timeout: float = 30.0):
+        # the broker may still be coming up (simultaneous launch): retry
+        # with backoff instead of failing the whole client process
+        deadline = time.monotonic() + connect_timeout
+        while True:
+            try:
+                self._sock = socket.create_connection((host, port),
+                                                      timeout=10.0)
+                self._sock.settimeout(None)
+                break
+            except (ConnectionRefusedError, ConnectionResetError,
+                    TimeoutError):
+                # only not-up-yet errors; bad hostnames etc. fail fast
+                if time.monotonic() >= deadline:
+                    raise
+                time.sleep(0.5)
         self._lock = threading.Lock()
 
     def publish(self, queue: str, payload: bytes) -> None:

@@ -1,0 +1,496 @@
+"""Multi-process protocol client: the reference's ``client.py`` +
+``RpcClient`` + ``Train_*`` stack as one generic runner.
+
+Lifecycle parity (``/root/reference/src/RpcClient.py:33-135``): REGISTER →
+wait on ``reply_{id}`` → START builds the shard model and (stage 1) the
+data loader → READY ack (replacing the reference's 25 s settle sleep,
+``src/Server.py:289``) → SYN runs the streaming hot loop → NOTIFY/PAUSE →
+UPDATE with the trained shard → STOP exits.
+
+The hot loops reproduce the reference's three roles with ONE generic
+:class:`ShardRunner` instead of three per-model ``Train_{VGG16,BERT,KWT}``
+classes (``src/train/*.py``):
+
+* stage 1 (``train_on_first_layer``, ``src/train/VGG16.py:61-136``):
+  event-driven 1F1B with a bounded in-flight window (``control-count``)
+  and backward-time activation **recomputation** — here the recompute is a
+  jitted VJP that re-runs the forward inside the gradient computation,
+  with the SAME dropout rng as the original forward (the reference
+  redraws masks on recompute; re-using the rng makes the gradient exact);
+* middle stages: trace-routed forward/backward relay
+  (``src/train/VGG16.py:40-53``);
+* last stage (``train_on_last_layer``, ``:138-191``): loss + backward,
+  input-gradient returned along the popped trace; NaN flags the round
+  (``:169-171``).  DCSL's server-side data aggregation — concatenate
+  ``sda_size`` client batches into one fwd/bwd and split the input
+  gradient back per client (``other/DCSL/src/Scheduler.py:152-191``) —
+  is the same loop with a collect window.
+
+Unlike the reference there is no 0.5 s sleep-polling: transport ``get``
+blocks on a condition variable / socket (``runtime/bus.py``).
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import uuid
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from split_learning_tpu.config import Config, LearningConfig, from_yaml
+from split_learning_tpu.data import make_data_loader
+from split_learning_tpu.models import build_model
+from split_learning_tpu.runtime.bus import Transport, make_transport
+from split_learning_tpu.runtime.log import Logger
+from split_learning_tpu.runtime.protocol import (
+    Activation, Gradient, Notify, Pause, Ready, Register, Start, Stop, Syn,
+    Update, decode, encode, gradient_queue, intermediate_queue, reply_queue,
+    RPC_QUEUE,
+)
+from split_learning_tpu.runtime.validation import dataset_for_model
+
+
+def make_optimizer_from_dict(learning: dict | None) -> tuple[
+        optax.GradientTransformation, LearningConfig]:
+    d = dict(learning or {})
+    known = {f.name for f in dataclasses.fields(LearningConfig)}
+    cfg = LearningConfig(**{k: v for k, v in d.items() if k in known})
+    from split_learning_tpu.runtime.context import make_optimizer
+    return make_optimizer(cfg), cfg
+
+
+class ShardRunner:
+    """Jitted forward / recompute-backward / optimizer ops for one shard."""
+
+    def __init__(self, model_key: str, start_layer: int, end_layer: int,
+                 learning: dict | None, model_kwargs: dict | None = None,
+                 seed: int = 0):
+        self.model = build_model(model_key, start_layer=start_layer,
+                                 end_layer=end_layer,
+                                 **(model_kwargs or {}))
+        self.start_layer = start_layer
+        self.optimizer, self.learning = make_optimizer_from_dict(learning)
+        self.rng = jax.random.key(seed)
+        self._counter = 0
+
+        def _variables(params, stats):
+            v = {"params": params}
+            if stats:
+                v["batch_stats"] = stats
+            return v
+
+        @jax.jit
+        def fwd(params, stats, x, rng):
+            """Forward in train mode; batch_stats update deferred to the
+            backward recompute (single update per consumed batch)."""
+            out, _ = self.model.apply(
+                _variables(params, stats), x, train=True,
+                mutable=["batch_stats"], rngs={"dropout": rng})
+            return out
+
+        @jax.jit
+        def bwd(params, stats, x, ct, rng):
+            """Recompute forward, backprop the received cotangent.
+
+            Returns (param_grads, input_grad, new_stats)."""
+            def f(p, xx):
+                out, mut = self.model.apply(
+                    _variables(p, stats), xx, train=True,
+                    mutable=["batch_stats"], rngs={"dropout": rng})
+                return jnp.vdot(out.astype(jnp.float32),
+                                ct.astype(jnp.float32)), mut
+            grad_fn = jax.grad(f, argnums=(0, 1), has_aux=True)
+            (gp, gx), mut = grad_fn(params, x)
+            new_stats = dict(stats)
+            new_stats.update(mut.get("batch_stats", {}))
+            return gp, gx, new_stats
+
+        @jax.jit
+        def last_step(params, stats, x, labels, rng):
+            """Last stage: CE loss, grads wrt params AND input activation.
+
+            Returns (loss, param_grads, input_grad, new_stats)."""
+            def f(p, xx):
+                out, mut = self.model.apply(
+                    _variables(p, stats), xx, train=True,
+                    mutable=["batch_stats"], rngs={"dropout": rng})
+                loss = optax.softmax_cross_entropy_with_integer_labels(
+                    out.astype(jnp.float32), labels).mean()
+                return loss, mut
+            (loss, mut), (gp, gx) = jax.value_and_grad(
+                f, argnums=(0, 1), has_aux=True)(params, x)
+            new_stats = dict(stats)
+            new_stats.update(mut.get("batch_stats", {}))
+            return loss, gp, gx, new_stats
+
+        @jax.jit
+        def whole_step(params, stats, x, labels, rng):
+            """Degenerate whole-model client (``layers == [0, 0]``,
+            ``src/Server.py:241-243``): plain local train step."""
+            def f(p):
+                out, mut = self.model.apply(
+                    _variables(p, stats), x, train=True,
+                    mutable=["batch_stats"], rngs={"dropout": rng})
+                loss = optax.softmax_cross_entropy_with_integer_labels(
+                    out.astype(jnp.float32), labels).mean()
+                return loss, mut
+            (loss, mut), gp = jax.value_and_grad(f, has_aux=True)(params)
+            new_stats = dict(stats)
+            new_stats.update(mut.get("batch_stats", {}))
+            return loss, gp, new_stats
+
+        @jax.jit
+        def apply_update(params, opt_state, grads):
+            updates, new_opt = self.optimizer.update(grads, opt_state,
+                                                     params)
+            return optax.apply_updates(params, updates), new_opt
+
+        self.fwd, self.bwd = fwd, bwd
+        self.last_step, self.whole_step = last_step, whole_step
+        self.apply_update = apply_update
+
+    def next_rng(self):
+        self._counter += 1
+        return jax.random.fold_in(self.rng, self._counter)
+
+
+@dataclasses.dataclass
+class _Inflight:
+    x: Any
+    rng: Any
+    trace: list
+    labels: Any = None
+
+
+class ProtocolClient:
+    """One split-learning client process (reference ``client.py`` +
+    ``src/RpcClient.py``)."""
+
+    def __init__(self, cfg: Config, client_id: str, stage: int,
+                 transport: Transport | None = None,
+                 cluster: int | None = None, profile: dict | None = None,
+                 logger: Logger | None = None):
+        self.cfg = cfg
+        self.client_id = client_id
+        self.stage = stage
+        self.cluster = cluster
+        self.profile = profile
+        self.bus = transport or make_transport(
+            cfg.transport.kind, cfg.transport.host, cfg.transport.port)
+        self.log = logger or Logger(cfg.log_path, debug=cfg.debug,
+                                    console=False, name=client_id)
+        self.runner: ShardRunner | None = None
+        self.params = None
+        self.stats: dict = {}
+        self.opt_state = None
+        self.loader = None
+        self.epochs = 1
+        self.sda_size = 1
+        self.round_ok = True
+        self.num_samples = 0
+
+    # -- control plane -----------------------------------------------------
+
+    def register(self):
+        self.bus.publish(RPC_QUEUE, encode(Register(
+            client_id=self.client_id, stage=self.stage,
+            cluster=self.cluster, profile=self.profile)))
+        self.log.info(f"[>>>] REGISTER stage={self.stage}")
+
+    def run(self):
+        """Blocking lifecycle loop; returns on STOP.
+
+        Until the first START arrives, REGISTER is re-sent every few
+        seconds: a client that comes up before the server would otherwise
+        lose its registration to the server's startup queue purge
+        (``src/Utils.py:8-32`` hygiene — the reference simply requires
+        clients to start after the server, README.md:144-171)."""
+        self.register()
+        q = reply_queue(self.client_id)
+        started = False
+        while True:
+            raw = self.bus.get(q, timeout=None if started else 3.0)
+            if raw is None:
+                if not started:
+                    self.register()
+                continue
+            msg = decode(raw)
+            if isinstance(msg, Start):
+                started = True
+                self._on_start(msg)
+                self.bus.publish(RPC_QUEUE,
+                                 encode(Ready(client_id=self.client_id)))
+                self.log.info("[>>>] READY")
+            elif isinstance(msg, Syn):
+                self._on_syn(msg)
+            elif isinstance(msg, Stop):
+                self.log.info(f"[<<<] STOP {msg.reason}")
+                return
+            else:
+                self.log.warning(f"unexpected control message {msg}")
+
+    def _on_start(self, msg: Start):
+        self.log.info(f"[<<<] START layers=[{msg.start_layer}, "
+                      f"{msg.end_layer}] cluster={msg.cluster}")
+        self.cluster = msg.cluster
+        extra = msg.extra or {}
+        self.epochs = int(extra.get("epochs", 1))
+        self.sda_size = int(extra.get("sda_size", 1))
+        self.round_idx = msg.round_idx
+        model_kwargs = dict(self.cfg.model_kwargs or {})
+        self.runner = ShardRunner(
+            self.cfg.model_key, msg.start_layer, msg.end_layer,
+            msg.learning, model_kwargs=model_kwargs,
+            seed=self.cfg.seed + hash(self.client_id) % 100000)
+        self.params = jax.tree_util.tree_map(jnp.asarray, msg.params)
+        self.stats = jax.tree_util.tree_map(
+            jnp.asarray, msg.batch_stats or {})
+        self.opt_state = self.runner.optimizer.init(self.params)
+        self.n_stages = int(extra.get("n_stages", self.cfg.num_stages))
+        if self.stage == 1 and msg.label_counts is not None:
+            self.loader = make_data_loader(
+                dataset_for_model(self.cfg.model_key),
+                self.runner.learning.batch_size,
+                distribution=np.asarray(msg.label_counts), train=True,
+                seed=self.cfg.seed, synthetic_size=self.cfg.synthetic_size)
+
+    def _on_syn(self, msg: Syn):
+        self.log.info(f"[<<<] SYN round={msg.round_idx}")
+        self.round_ok = True
+        self.num_samples = 0
+        whole = (self.runner.start_layer == 0
+                 and self.runner.model.resolved_end
+                 == len(self.runner.model.specs))
+        if self.stage == 1 and whole:
+            pause = self._train_whole()
+        elif self.stage == 1:
+            pause = self._train_first()
+        elif self.stage == self.n_stages:
+            pause = self._train_last()
+        else:
+            pause = self._train_middle()
+        if pause is None or pause.send_weights:
+            self._send_update()
+
+    def _send_update(self):
+        params_h = jax.tree_util.tree_map(np.asarray, self.params)
+        stats_h = jax.tree_util.tree_map(np.asarray, self.stats)
+        self.bus.publish(RPC_QUEUE, encode(Update(
+            client_id=self.client_id, stage=self.stage,
+            cluster=self.cluster, params=params_h,
+            batch_stats=stats_h, num_samples=self.num_samples,
+            ok=self.round_ok)))
+        self.log.info(f"[>>>] UPDATE samples={self.num_samples} "
+                      f"ok={self.round_ok}")
+
+    def _wait_pause(self) -> Pause:
+        q = reply_queue(self.client_id)
+        while True:
+            raw = self.bus.get(q)
+            if raw is None:
+                continue
+            msg = decode(raw)
+            if isinstance(msg, Pause):
+                self.log.info("[<<<] PAUSE")
+                return msg
+            self.log.warning(f"ignoring {type(msg).__name__} while "
+                             f"awaiting PAUSE")
+
+    def _check_pause(self) -> Pause | None:
+        raw = self.bus.get(reply_queue(self.client_id), timeout=0.001)
+        if raw is None:
+            return None
+        msg = decode(raw)
+        return msg if isinstance(msg, Pause) else None
+
+    # -- hot loops -----------------------------------------------------------
+
+    def _train_whole(self) -> Pause:
+        r = self.runner
+        for _ in range(self.epochs):
+            for x, labels in self.loader:
+                loss, grads, self.stats = r.whole_step(
+                    self.params, self.stats, jnp.asarray(x),
+                    jnp.asarray(labels.astype(np.int32)), r.next_rng())
+                if not bool(jnp.isfinite(loss)):
+                    self.round_ok = False
+                self.params, self.opt_state = r.apply_update(
+                    self.params, self.opt_state, grads)
+                self.num_samples += len(labels)
+        self.bus.publish(RPC_QUEUE, encode(Notify(
+            client_id=self.client_id, cluster=self.cluster)))
+        return self._wait_pause()
+
+    def _train_first(self) -> Pause:
+        """Bounded-in-flight 1F1B streaming (``src/train/VGG16.py:61-136``)."""
+        r = self.runner
+        inflight: dict[str, _Inflight] = {}
+        grad_q = gradient_queue(self.stage, self.client_id)
+        out_q = intermediate_queue(self.stage, self.cluster)
+        cap = max(1, r.learning.control_count)
+        n_fwd = n_bwd = 0
+
+        for _ in range(self.epochs):
+            data_iter = iter(self.loader)
+            exhausted = False
+            while not (exhausted and n_fwd == n_bwd):
+                raw = self.bus.get(grad_q, timeout=0.0005)
+                if raw is not None:
+                    g = decode(raw)
+                    ent = inflight.pop(g.data_id)
+                    gp, _, self.stats = r.bwd(
+                        self.params, self.stats, ent.x,
+                        jnp.asarray(g.data), ent.rng)
+                    self.params, self.opt_state = r.apply_update(
+                        self.params, self.opt_state, gp)
+                    n_bwd += 1
+                    continue
+                if exhausted or len(inflight) >= cap:
+                    continue
+                try:
+                    x, labels = next(data_iter)
+                except StopIteration:
+                    exhausted = True
+                    continue
+                x = jnp.asarray(x)
+                rng = r.next_rng()
+                out = r.fwd(self.params, self.stats, x, rng)
+                data_id = uuid.uuid4().hex
+                inflight[data_id] = _Inflight(x=x, rng=rng,
+                                              trace=[self.client_id])
+                self.bus.publish(out_q, encode(Activation(
+                    data_id=data_id, data=np.asarray(out, np.float32),
+                    labels=np.asarray(labels, np.int32),
+                    trace=[self.client_id], cluster=self.cluster)))
+                n_fwd += 1
+                self.num_samples += len(labels)
+        self.bus.publish(RPC_QUEUE, encode(Notify(
+            client_id=self.client_id, cluster=self.cluster)))
+        self.log.info(f"[>>>] NOTIFY fwd={n_fwd} bwd={n_bwd}")
+        return self._wait_pause()
+
+    def _train_middle(self) -> Pause:
+        r = self.runner
+        in_q = intermediate_queue(self.stage - 1, self.cluster)
+        out_q = intermediate_queue(self.stage, self.cluster)
+        grad_q = gradient_queue(self.stage, self.client_id)
+        inflight: dict[str, _Inflight] = {}
+        while True:
+            pause = self._check_pause()
+            if pause is not None:
+                self.log.info("[<<<] PAUSE")
+                return pause
+            raw = self.bus.get(grad_q, timeout=0.0005)
+            if raw is not None:
+                g = decode(raw)
+                ent = inflight.pop(g.data_id)
+                gp, gx, self.stats = r.bwd(
+                    self.params, self.stats, ent.x, jnp.asarray(g.data),
+                    ent.rng)
+                self.params, self.opt_state = r.apply_update(
+                    self.params, self.opt_state, gp)
+                origin = ent.trace[-1]
+                self.bus.publish(
+                    gradient_queue(self.stage - 1, origin),
+                    encode(Gradient(data_id=g.data_id,
+                                    data=np.asarray(gx, np.float32),
+                                    trace=ent.trace[:-1])))
+                continue
+            raw = self.bus.get(in_q, timeout=0.0005)
+            if raw is None:
+                continue
+            act = decode(raw)
+            x = jnp.asarray(act.data)
+            rng = r.next_rng()
+            out = r.fwd(self.params, self.stats, x, rng)
+            inflight[act.data_id] = _Inflight(x=x, rng=rng,
+                                              trace=list(act.trace))
+            self.num_samples += len(act.labels)
+            self.bus.publish(out_q, encode(Activation(
+                data_id=act.data_id, data=np.asarray(out, np.float32),
+                labels=act.labels, trace=list(act.trace) + [self.client_id],
+                cluster=self.cluster)))
+
+    def _train_last(self) -> Pause:
+        """Loss + backward + routed input-gradient return
+        (``src/train/VGG16.py:138-191``); with ``sda_size > 1`` collects a
+        window of client batches and runs them as ONE concatenated fwd/bwd
+        (DCSL SDA, ``other/DCSL/src/Scheduler.py:152-191``)."""
+        r = self.runner
+        in_q = intermediate_queue(self.stage - 1, self.cluster)
+        window: list[Activation] = []
+        while True:
+            pause = self._check_pause()
+            if pause is not None:
+                if window:
+                    self._sda_step(window)
+                    window = []
+                self.log.info("[<<<] PAUSE")
+                return pause
+            raw = self.bus.get(in_q, timeout=0.001)
+            if raw is None:
+                if window:  # partial window: flush rather than starve
+                    self._sda_step(window)
+                    window = []
+                continue
+            window.append(decode(raw))
+            if len(window) >= self.sda_size:
+                self._sda_step(window)
+                window = []
+
+    def _sda_step(self, window: list[Activation]):
+        r = self.runner
+        sizes = [len(a.labels) for a in window]
+        x = jnp.concatenate([jnp.asarray(a.data) for a in window])
+        labels = jnp.concatenate(
+            [jnp.asarray(a.labels, jnp.int32) for a in window])
+        loss, gp, gx, self.stats = r.last_step(
+            self.params, self.stats, x, labels, r.next_rng())
+        if not bool(jnp.isfinite(loss)):
+            self.round_ok = False   # NaN sentinel (src/train/VGG16.py:169)
+        self.params, self.opt_state = r.apply_update(
+            self.params, self.opt_state, gp)
+        self.num_samples += int(sum(sizes))
+        gx = np.asarray(gx, np.float32)
+        off = 0
+        for act, n in zip(window, sizes):
+            part = gx[off:off + n]
+            off += n
+            origin = act.trace[-1]
+            self.bus.publish(
+                gradient_queue(self.stage - 1, origin),
+                encode(Gradient(data_id=act.data_id, data=part,
+                                trace=list(act.trace)[:-1])))
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="Split-learning protocol client (reference client.py "
+                    "parity).")
+    ap.add_argument("--config", default="config.yaml")
+    ap.add_argument("--layer_id", type=int, required=True,
+                    help="1-based stage index")
+    ap.add_argument("--client_id", default=None)
+    ap.add_argument("--cluster", type=int, default=None)
+    ap.add_argument("--profile", default=None,
+                    help="path to profiling.json (optional)")
+    args = ap.parse_args(argv)
+    cfg = from_yaml(args.config)
+    profile = None
+    if args.profile:
+        import json
+        with open(args.profile) as f:
+            profile = json.load(f)
+    client_id = args.client_id or f"client_{args.layer_id}_{uuid.uuid4().hex[:6]}"
+    client = ProtocolClient(cfg, client_id, args.layer_id,
+                            cluster=args.cluster, profile=profile)
+    client.run()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,271 @@
+"""Multi-process protocol server: the reference's ``server.py`` +
+``src/Server.py`` FSM over real transports.
+
+The server is a :class:`ProtocolContext` — a
+:class:`~split_learning_tpu.runtime.context.TrainContext` whose
+``train_cluster`` drives REMOTE clients through the control protocol
+instead of running the compiled mesh step locally.  Because it satisfies
+the same interface, all six round strategies
+(:mod:`split_learning_tpu.runtime.strategies`) work unchanged over a
+live deployment — the reference needed a full server fork per algorithm
+(SURVEY.md §2.3).
+
+Round choreography parity (``/root/reference/src/Server.py``):
+registration barrier (``:111-135``) → planning (``:300-382``) → per-round
+START with shard weights (``:214-298``) → READY barrier (replacing the
+25 s sleep at ``:289``) → SYN (``:290-296``) → NOTIFY collection → PAUSE
+fan-out (``:137-153``) → UPDATE collection (``:155-170``) → strategy
+aggregation → validation + checkpoint (``:182-196``, via the shared round
+loop in :mod:`split_learning_tpu.runtime.loop`).
+
+Failure-detection improvement over the reference (SURVEY.md §5.3: a
+crashed client hangs the round forever): every barrier carries a
+deadline; clients that miss it are dropped from the round with a logged
+warning instead of wedging the server.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import time
+from typing import Any, Callable
+
+import numpy as np
+
+from split_learning_tpu.config import Config, from_yaml
+from split_learning_tpu.models import shard_params
+from split_learning_tpu.parallel.mesh import stage_ranges
+from split_learning_tpu.runtime.bus import (
+    Broker, Transport, make_transport,
+)
+from split_learning_tpu.runtime.context import MeshContext
+from split_learning_tpu.runtime.log import Logger
+from split_learning_tpu.runtime.loop import TrainResult, run_training
+from split_learning_tpu.runtime.plan import (
+    ClusterPlan, Registration, plan_clusters,
+)
+from split_learning_tpu.runtime.protocol import (
+    Notify, Pause, Ready, Register, Start, Stop, Syn, Update,
+    decode, encode, reply_queue, RPC_QUEUE,
+)
+
+
+class RoundTimeout(RuntimeError):
+    pass
+
+
+class ProtocolContext(MeshContext):
+    """Server-side TrainContext that trains via remote protocol clients.
+
+    Validation / init reuse the in-process implementations (the server
+    holds the full model for reassembly + test passes, exactly like the
+    reference's ``src/val/get_val.py``).
+    """
+
+    def __init__(self, cfg: Config, transport: Transport,
+                 logger: Logger | None = None,
+                 client_timeout: float = 600.0):
+        super().__init__(cfg)
+        self.bus = transport
+        self.log = logger or Logger(cfg.log_path, debug=cfg.debug,
+                                    console=False, name="server")
+        self.client_timeout = client_timeout
+        self._registrations: dict[str, Registration] = {}
+        self._ready: set = set()
+        self._notified: set = set()
+        self._updates: list[Update] = []
+
+    # -- rpc pump ------------------------------------------------------------
+
+    def _pump_one(self, timeout: float) -> bool:
+        raw = self.bus.get(RPC_QUEUE, timeout=timeout)
+        if raw is None:
+            return False
+        msg = decode(raw)
+        if isinstance(msg, Register):
+            # keyed by client_id: clients re-REGISTER until STARTed (the
+            # server's startup purge may race a fast client's first one)
+            if msg.client_id not in self._registrations:
+                self.log.received(f"REGISTER {msg.client_id} "
+                                  f"stage={msg.stage}")
+            self._registrations[msg.client_id] = Registration(
+                client_id=msg.client_id, stage=msg.stage,
+                cluster=msg.cluster, profile=msg.profile)
+        elif isinstance(msg, Ready):
+            self._ready.add(msg.client_id)
+        elif isinstance(msg, Notify):
+            self._notified.add(msg.client_id)
+            self.log.received(f"NOTIFY {msg.client_id}")
+        elif isinstance(msg, Update):
+            self._updates.append(msg)
+            self.log.received(f"UPDATE {msg.client_id} "
+                              f"samples={msg.num_samples} ok={msg.ok}")
+        return True
+
+    def _pump_until(self, pred: Callable[[], bool],
+                    what: str, deadline: float | None = None) -> bool:
+        """Drain rpc_queue until ``pred()``; False if the deadline passes."""
+        deadline = (time.monotonic() + self.client_timeout
+                    if deadline is None else deadline)
+        while not pred():
+            remain = deadline - time.monotonic()
+            if remain <= 0:
+                self.log.warning(f"timeout waiting for {what}")
+                return False
+            self._pump_one(timeout=min(remain, 0.25))
+        return True
+
+    # -- registration barrier ------------------------------------------------
+
+    @property
+    def registrations(self) -> list[Registration]:
+        return list(self._registrations.values())
+
+    def wait_for_registrations(self) -> list[Registration]:
+        """Block until every configured client has registered
+        (``src/Server.py:111-135``)."""
+        total = sum(self.cfg.clients)
+        self._pump_until(lambda: len(self._registrations) >= total,
+                         f"{total} registrations",
+                         deadline=time.monotonic() + self.client_timeout)
+        if len(self._registrations) < total:
+            raise RoundTimeout(
+                f"only {len(self._registrations)}/{total} clients "
+                f"registered within {self.client_timeout}s")
+        return self.registrations
+
+    # -- the remote round ----------------------------------------------------
+
+    def train_cluster(self, plan: ClusterPlan, params, stats, *,
+                      round_idx: int = 0, epochs: int = 1,
+                      client_subset: list | None = None,
+                      per_client_params: dict | None = None,
+                      lr: float | None = None,
+                      sync_all_later_stages: bool = False) -> list[Update]:
+        stage1 = [c for c in plan.stage1_clients
+                  if client_subset is None or c in client_subset]
+        if not stage1:
+            return []
+        active = [(cid, 1) for cid in stage1]
+        for s in range(2, plan.n_stages + 1):
+            active += [(cid, s) for cid in plan.clients[s - 1]]
+
+        ranges = stage_ranges(len(self.specs), plan.cuts)
+        learning = dataclasses.asdict(self.cfg.learning)
+        if lr is not None:
+            learning["learning_rate"] = lr
+        sda = (self.cfg.aggregation.sda_size
+               if sync_all_later_stages else 1)
+
+        self._ready.clear()
+        self._notified.clear()
+        self._updates = []
+
+        for cid, s in active:
+            a, b = ranges[s - 1]
+            base = (per_client_params or {}).get(cid, params)
+            shard_p = _np_tree(shard_params(base, self.specs, a, b))
+            shard_s = _np_tree(shard_params(stats or {}, self.specs, a, b))
+            label_counts = None
+            if s == 1:
+                label_counts = np.asarray(
+                    plan.label_counts[plan.stage1_clients.index(cid)])
+            end_layer = -1 if s == plan.n_stages else b
+            self.bus.publish(reply_queue(cid), encode(Start(
+                start_layer=a, end_layer=end_layer,
+                cluster=plan.cluster_id, params=shard_p,
+                batch_stats=shard_s, learning=learning,
+                label_counts=label_counts, round_idx=round_idx,
+                extra={"epochs": epochs, "sda_size": sda,
+                       "n_stages": plan.n_stages})))
+            self.log.sent(f"START -> {cid} layers=[{a}, {end_layer}]")
+
+        ids = {cid for cid, _ in active}
+        if not self._pump_until(lambda: ids <= self._ready,
+                                f"READY from {ids - self._ready}"):
+            ids &= self._ready  # drop unresponsive clients mid-round
+        for cid in ids:
+            self.bus.publish(reply_queue(cid), encode(Syn(round_idx)))
+        self.log.sent(f"SYN -> {sorted(ids)}")
+
+        s1_ids = set(stage1) & ids
+        deadline = time.monotonic() + self.client_timeout
+        self._pump_until(lambda: s1_ids <= self._notified,
+                         "NOTIFY from stage-1 clients", deadline=deadline)
+        for cid in ids:
+            self.bus.publish(reply_queue(cid), encode(Pause()))
+        self.log.sent(f"PAUSE -> {sorted(ids)}")
+
+        got = lambda: {u.client_id for u in self._updates} >= ids  # noqa
+        self._pump_until(got, "UPDATE from cluster clients",
+                         deadline=time.monotonic() + self.client_timeout)
+        updates = list(self._updates)
+        self._updates = []
+        return updates
+
+    def stop_all(self, reason: str = "training complete"):
+        for reg in self.registrations:
+            self.bus.publish(reply_queue(reg.client_id),
+                             encode(Stop(reason=reason)))
+        self.log.sent(f"STOP -> all ({reason})")
+
+
+def _np_tree(tree: Any) -> Any:
+    import jax
+    return jax.tree_util.tree_map(np.asarray, tree)
+
+
+class ProtocolServer:
+    """Top-level server process (reference ``server.py:20-30``)."""
+
+    def __init__(self, cfg: Config, transport: Transport | None = None,
+                 logger: Logger | None = None,
+                 client_timeout: float = 600.0):
+        self.cfg = cfg
+        self.log = logger or Logger(cfg.log_path, debug=cfg.debug,
+                                    name="server")
+        bus = transport or make_transport(
+            cfg.transport.kind, cfg.transport.host, cfg.transport.port)
+        bus.purge()   # queue hygiene at startup (src/Utils.py:8-32)
+        self.ctx = ProtocolContext(cfg, bus, logger=self.log,
+                                   client_timeout=client_timeout)
+
+    def serve(self) -> TrainResult:
+        regs = self.ctx.wait_for_registrations()
+        plans = plan_clusters(self.cfg, regs)
+        try:
+            result = run_training(self.cfg, self.ctx, plans, self.log)
+        finally:
+            self.ctx.stop_all()
+        return result
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="Split-learning protocol server (reference server.py "
+                    "parity).")
+    ap.add_argument("--config", default="config.yaml")
+    ap.add_argument("--broker", action="store_true",
+                    help="also host the TCP broker in this process")
+    ap.add_argument("--client_timeout", type=float, default=600.0)
+    args = ap.parse_args(argv)
+    cfg = from_yaml(args.config)
+    broker = None
+    if args.broker and cfg.transport.kind == "tcp":
+        broker = Broker(cfg.transport.host, cfg.transport.port)
+    try:
+        server = ProtocolServer(cfg, client_timeout=args.client_timeout)
+        result = server.serve()
+        for rec in result.history:
+            acc = (f" val_acc={rec.val_accuracy:.4f}"
+                   if rec.val_accuracy is not None else "")
+            print(f"round {rec.round_idx}: ok={rec.ok} "
+                  f"samples={rec.num_samples}{acc}")
+    finally:
+        if broker is not None:
+            broker.close()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,7 @@
+"""``python -m split_learning_tpu.server`` — protocol server entry
+(reference ``server.py`` parity)."""
+
+from split_learning_tpu.runtime.server import main
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,115 @@
+"""Multi-process protocol integration: server + N clients in one pytest
+process (SURVEY.md §4 plan item (c)) over both transports.
+
+The reference can only exercise this path with a live RabbitMQ broker and
+real OS processes (README.md:144-171); here the same control protocol +
+streaming data plane runs with in-process threads, and over a real TCP
+broker socket."""
+
+import threading
+
+import numpy as np
+import pytest
+
+from split_learning_tpu.config import from_dict
+from split_learning_tpu.runtime.bus import Broker, InProcTransport
+from split_learning_tpu.runtime.client import ProtocolClient
+from split_learning_tpu.runtime.server import ProtocolServer
+
+TINY_KWT = {"embed_dim": 16, "num_heads": 2, "mlp_dim": 32}
+
+
+def proto_cfg(tmp_path, **over):
+    base = dict(
+        model="KWT", dataset="SPEECHCOMMANDS", clients=[2, 1],
+        global_rounds=1, synthetic_size=48, val_max_batches=1,
+        val_batch_size=16, compute_dtype="float32",
+        model_kwargs=TINY_KWT, log_path=str(tmp_path),
+        learning={"batch_size": 4, "control_count": 2,
+                  "optimizer": "adamw", "learning_rate": 1e-3},
+        distribution={"num_samples": 24},
+        topology={"cut_layers": [2]},
+        checkpoint={"directory": str(tmp_path / "ckpt"), "save": False},
+    )
+    for k, v in over.items():
+        if isinstance(v, dict) and isinstance(base.get(k), dict):
+            base[k].update(v)
+        else:
+            base[k] = v
+    return from_dict(base)
+
+
+def run_deployment(cfg, make_client_transport, server_transport,
+                   timeout=300.0):
+    """Launch client threads + serve() in the main thread."""
+    server = ProtocolServer(cfg, transport=server_transport,
+                            client_timeout=timeout)
+    threads = []
+    for stage, count in enumerate(cfg.clients, start=1):
+        for i in range(count):
+            cid = f"client_{stage}_{i}"
+            client = ProtocolClient(cfg, cid, stage,
+                                    transport=make_client_transport())
+            t = threading.Thread(target=client.run, daemon=True)
+            t.start()
+            threads.append(t)
+    result = server.serve()
+    for t in threads:
+        t.join(timeout=30)
+        assert not t.is_alive(), "client thread failed to stop"
+    return result
+
+
+def test_inproc_full_round(tmp_path):
+    bus = InProcTransport()
+    cfg = proto_cfg(tmp_path)
+    result = run_deployment(cfg, lambda: bus, bus)
+    assert len(result.history) == 1
+    rec = result.history[0]
+    assert rec.ok
+    assert rec.num_samples > 0
+    assert rec.val_accuracy is not None
+    # trained params returned (finite, right layer surface)
+    assert "layer1" in result.params
+    for leaf in np.asarray(
+            result.params["layer1"]["embed"]["kernel"]).flat[:4]:
+        assert np.isfinite(leaf)
+
+
+def test_inproc_three_stage_middle_client(tmp_path):
+    """Exercises the middle-stage relay loop (trace routing both ways)."""
+    bus = InProcTransport()
+    cfg = proto_cfg(tmp_path, clients=[1, 1, 1],
+                    topology={"cut_layers": [2, 4]})
+    result = run_deployment(cfg, lambda: bus, bus)
+    assert result.history[0].ok
+    assert result.history[0].num_samples > 0
+
+
+def test_tcp_full_round(tmp_path):
+    broker = Broker("127.0.0.1", 0)
+    try:
+        from split_learning_tpu.runtime.bus import TcpTransport
+        cfg = proto_cfg(
+            tmp_path, clients=[1, 1],
+            transport={"kind": "tcp", "host": "127.0.0.1",
+                       "port": broker.port})
+        result = run_deployment(
+            cfg, lambda: TcpTransport("127.0.0.1", broker.port),
+            TcpTransport("127.0.0.1", broker.port))
+        assert result.history[0].ok
+        assert result.history[0].num_samples > 0
+    finally:
+        broker.close()
+
+
+def test_sda_strategy_over_protocol(tmp_path):
+    """DCSL server-side data aggregation: last stage concatenates client
+    batches (window=2) — over the protocol data plane."""
+    bus = InProcTransport()
+    cfg = proto_cfg(tmp_path, clients=[2, 1],
+                    aggregation={"strategy": "sda", "sda_size": 2,
+                                 "local_rounds": 1})
+    result = run_deployment(cfg, lambda: bus, bus)
+    assert result.history[0].ok
+    assert result.history[0].num_samples > 0
