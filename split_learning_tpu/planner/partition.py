@@ -28,7 +28,8 @@ def _group_rate(exe_times: Sequence[Sequence[float]],
     rate = 0.0
     for exe, bw in zip(exe_times, bandwidths):
         t = float(np.sum(np.asarray(exe, dtype=float)[compute_slice]))
-        t += transfer_bytes / bw
+        if bw > 0:       # unmeasured bandwidth (0) -> free transfer
+            t += transfer_bytes / bw
         if t > 0:
             rate += 1.0 / t
     return rate
@@ -48,9 +49,11 @@ def partition(exe_time_group_1: Sequence[Sequence[float]],
     straight into the per-cluster ``layers`` ranges).
     """
     best_rate = 0.0
-    best_cut = 0
+    best_cut = 1
     n_layers = len(size_data)
-    for cut in range(n_layers):
+    # proper cuts only: cutting after the last layer would leave group 2
+    # with no compute (cheap-transfer profiles would otherwise pick it)
+    for cut in range(n_layers - 1):
         size = float(size_data[cut])
         r1 = _group_rate(exe_time_group_1, net_group_1, slice(0, cut + 1), size)
         r2 = _group_rate(exe_time_group_2, net_group_2, slice(cut + 1, None), size)

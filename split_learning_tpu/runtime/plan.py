@@ -183,7 +183,8 @@ def _cluster_cuts(cfg: Config, cluster_id: int, stage1_regs: list,
         return [max(1, (i + 1) * n_layer // (n_cuts + 1))
                 for i in range(n_cuts)]
     exe1 = [p["exe_time"] for p in profs]
-    net1 = [float(p.get("network", 1e9)) for p in profs]
+    # `or`: an unprobed profile carries network=0.0 — treat as unconstrained
+    net1 = [float(p.get("network") or 1e9) for p in profs]
     size_data = profs[0]["size_data"]
     # later-stage devices are unprofiled at the server (the reference also
     # only keeps stage-1 size_data — src/Server.py:115-117); mirror group 1

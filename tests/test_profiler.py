@@ -1,0 +1,65 @@
+"""Profiler: per-layer costs/sizes feed the auto-partition planner
+(reference profiling.py → REGISTER → src/Partition.py pipeline)."""
+
+import numpy as np
+
+from split_learning_tpu.profiler import (
+    profile_model, profile_network, write_profile,
+)
+from split_learning_tpu.runtime.bus import InProcTransport
+
+TINY_KWT = {"embed_dim": 16, "num_heads": 2, "mlp_dim": 32}
+
+
+def test_profile_model_flops_shape_and_positivity():
+    prof = profile_model("KWT_SPEECHCOMMANDS", batch_size=4,
+                         model_kwargs=TINY_KWT, method="flops")
+    assert len(prof["exe_time"]) == 17       # KWT layer count
+    assert len(prof["size_data"]) == 17
+    assert all(t > 0 for t in prof["exe_time"])
+    assert all(s > 0 for s in prof["size_data"])
+    assert prof["speed"] > 0
+    # encoder blocks (4..15) cost more than the param-free CLS concat
+    blocks = prof["exe_time"][3:15]
+    assert min(blocks) > prof["exe_time"][1] / 10
+
+
+def test_profile_model_time_mode():
+    prof = profile_model("KWT_SPEECHCOMMANDS", batch_size=2,
+                         model_kwargs=TINY_KWT, method="time",
+                         warmup=1, repeats=2)
+    assert len(prof["exe_time"]) == 17
+    assert all(t > 0 for t in prof["exe_time"])
+
+
+def test_profile_feeds_auto_partition(tmp_path):
+    """profiling.json → REGISTER → plan_clusters auto mode end-to-end."""
+    import json
+    from split_learning_tpu.config import from_dict
+    from split_learning_tpu.runtime.plan import Registration, plan_clusters
+
+    prof = profile_model("KWT_SPEECHCOMMANDS", batch_size=4,
+                         model_kwargs=TINY_KWT, method="flops")
+    # network deliberately left at the unprobed default (0.0): the planner
+    # must treat it as unconstrained, not divide by zero
+    path = tmp_path / "profiling.json"
+    write_profile(str(path), prof)
+    with open(path) as f:
+        loaded = json.load(f)
+
+    cfg = from_dict(dict(
+        model="KWT", dataset="SPEECHCOMMANDS", clients=[2, 1],
+        model_kwargs=TINY_KWT, synthetic_size=32,
+        topology={"mode": "auto"},
+        distribution={"num_samples": 16}))
+    regs = [Registration(f"c{i}", 1, profile=loaded) for i in range(2)]
+    regs.append(Registration("c_last", 2))
+    plans = plan_clusters(cfg, regs)
+    assert len(plans[0].cuts) == 1
+    assert 1 <= plans[0].cuts[0] < 17
+
+
+def test_profile_network_inproc():
+    bus = InProcTransport()
+    bw = profile_network(bus, sizes_mb=[1], repeats=2)
+    assert bw > 0
