@@ -34,6 +34,14 @@ class ConfigError(ValueError):
     pass
 
 
+#: LoRA kernel-name targets (single source of truth; ops/lora re-exports).
+#: Mirrors the reference peft config [query, key, value, dense] where HF
+#: "dense" suffix-matches the attention out-projection and both FFN
+#: linears (src/RpcClient.py:61-66) — listed here under their flax names.
+LORA_DEFAULT_TARGETS = ("query", "key", "value", "out", "dense",
+                        "intermediate", "output", "mlp_in", "mlp_out")
+
+
 def _check(cond: bool, msg: str):
     if not cond:
         raise ConfigError(msg)
@@ -51,8 +59,14 @@ class LearningConfig:
     clip_grad_norm: float | None = None  # Vanilla_SL Scheduler.py:204-205
     lr_decay: float = 1.0           # DCSL Server.py:38-39
     lr_decay_every: int = 0         # rounds; 0 = off
+    # LoRA adapters (reference peft wrap for BERT, RpcClient.py:61-66):
+    # rank 0 disables; targets match kernel path names
+    lora_rank: int = 0
+    lora_alpha: float = 16.0
+    lora_targets: tuple = LORA_DEFAULT_TARGETS
 
     def validate(self):
+        _check(self.lora_rank >= 0, "lora-rank must be >= 0")
         _check(self.learning_rate > 0, "learning-rate must be > 0")
         _check(self.batch_size > 0, "batch-size must be > 0")
         _check(self.optimizer in ("sgd", "adamw"),

@@ -16,6 +16,8 @@ from split_learning_tpu.models.split import (
 import split_learning_tpu.models.vgg  # noqa: F401  (registers VGG16_*)
 import split_learning_tpu.models.bert  # noqa: F401  (registers BERT_*)
 import split_learning_tpu.models.kwt  # noqa: F401  (registers KWT_*)
+import split_learning_tpu.models.vit  # noqa: F401  (registers ViT_*)
+import split_learning_tpu.models.mobilenet  # noqa: F401  (MobileNetv1_*)
 
 __all__ = [
     "LayerSpec", "SplitModel", "build_model", "model_registry",

@@ -1,0 +1,69 @@
+"""MobileNetv1 (Vanilla_SL variant flavor) as 84 indexed layers.
+
+Layer-for-layer indexing parity with ``/root/reference/other/Vanilla_SL/
+src/model/MobileNetv1_CIFAR10.py:5-185``: 27 conv→bn→relu triplets
+(the variant's "MobileNet" uses full 3x3 convs + 1x1 pointwise convs,
+not depthwise grouping — reproduced as-is for behavioral parity), then
+maxpool (82), flatten (83), linear head (84).  Strides 2 at triplets
+4/8/12/24 take 32px -> 2px before the pool.  NHWC + bfloat16-capable.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from split_learning_tpu.models.split import (
+    LayerSpec, register_model, relu_fn, maxpool2_fn, flatten_fn,
+    batchnorm_fn,
+)
+
+#: (out_channels, kernel, stride) per conv triplet — 27 triplets
+_CONVS = [
+    (32, 3, 1), (32, 3, 1), (64, 1, 1),
+    (64, 3, 2), (128, 1, 1), (128, 3, 1), (128, 1, 1),
+    (128, 3, 2), (256, 1, 1), (256, 3, 1), (256, 1, 1),
+    (256, 3, 2), (512, 1, 1),
+    (512, 3, 1), (512, 1, 1), (512, 3, 1), (512, 1, 1),
+    (512, 3, 1), (512, 1, 1), (512, 3, 1), (512, 1, 1),
+    (512, 3, 1), (512, 1, 1),
+    (512, 3, 2), (1024, 1, 1), (1024, 3, 1), (1024, 1, 1),
+]
+
+
+def _mobilenet_specs(num_classes: int, dtype=jnp.float32) -> tuple:
+    bn = functools.partial(nn.BatchNorm, momentum=0.9, epsilon=1e-5,
+                           dtype=dtype)
+    specs: list[LayerSpec] = []
+    idx = 0
+
+    def add(make=None, fn=None):
+        nonlocal idx
+        idx += 1
+        specs.append(LayerSpec(name=f"layer{idx}", make=make, fn=fn))
+
+    for out_ch, k, s in _CONVS:
+        add(make=functools.partial(
+            nn.Conv, features=out_ch, kernel_size=(k, k), strides=(s, s),
+            padding=(1 if k == 3 else 0), dtype=dtype))
+        add(make=bn, fn=batchnorm_fn)
+        add(fn=relu_fn)
+    add(fn=maxpool2_fn)
+    add(fn=flatten_fn)
+    add(make=functools.partial(nn.Dense, features=num_classes, dtype=dtype))
+    assert len(specs) == 84
+    return tuple(specs)
+
+
+@register_model("MobileNetv1_CIFAR10")
+def mobilenet_cifar10(dtype=jnp.float32) -> tuple:
+    """CIFAR-10: (B, 32, 32, 3) NHWC, 10 classes, 84 layers."""
+    return _mobilenet_specs(10, dtype=dtype)
+
+
+@register_model("MobileNetv1_MNIST")
+def mobilenet_mnist(dtype=jnp.float32) -> tuple:
+    """MNIST: (B, 28, 28, 1) NHWC, 10 classes, 84 layers."""
+    return _mobilenet_specs(10, dtype=dtype)

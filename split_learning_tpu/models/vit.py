@@ -1,0 +1,128 @@
+"""ViT as 12 indexed layers (Vanilla_SL variant parity).
+
+Layer indexing matches ``/root/reference/other/Vanilla_SL/src/model/
+ViT_CIFAR10.py:29-116``: 1 = patch conv (4x4 stride 4, embed 128),
+2 = patch flatten, 3 = CLS-token concat (a learned parameter layer),
+4 = learned position embedding, 5-10 = six pre-LN encoder blocks
+(4 heads, MLP 256), 11 = LayerNorm over the CLS token, 12 = linear head.
+NHWC + fused-qkv attention instead of the reference's NCHW + per-tensor
+``nn.MultiheadAttention``.
+
+``ViT_S16_CIFAR10`` is the north-star scale-up (BASELINE.json config #4):
+ViT-S geometry (384 embed, 6 heads, 12 blocks, MLP 1536) over the same
+split-layer contract, 18 layers total.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from split_learning_tpu.models.split import (
+    LayerSpec, register_model, module_plain_fn as _plain_fn,
+    module_train_fn as _train_fn,
+)
+from split_learning_tpu.models.transformer import PreLNBlock
+
+
+class PatchFlatten(nn.Module):
+    """(B, H', W', C) -> (B, H'*W', C) token sequence."""
+
+    @nn.compact
+    def __call__(self, x):
+        b, h, w, c = x.shape
+        return x.reshape(b, h * w, c)
+
+
+class ClsToken(nn.Module):
+    """Prepend a learned CLS token (reference ``layer3``/cls_token)."""
+    embed_dim: int
+
+    @nn.compact
+    def __call__(self, x):
+        cls = self.param("cls", nn.initializers.normal(1.0),
+                         (1, 1, self.embed_dim))
+        cls = jnp.broadcast_to(cls.astype(x.dtype),
+                               (x.shape[0], 1, self.embed_dim))
+        return jnp.concatenate([cls, x], axis=1)
+
+
+class PosEmbed(nn.Module):
+    """Learned position embedding over tokens (reference ``pos_embed``)."""
+    n_tokens: int
+    embed_dim: int
+
+    @nn.compact
+    def __call__(self, x):
+        pos = self.param("pos", nn.initializers.normal(1.0),
+                         (1, self.n_tokens, self.embed_dim))
+        return x + pos.astype(x.dtype)
+
+
+class ClsNorm(nn.Module):
+    """LayerNorm applied to the CLS token only (reference ``layer11``)."""
+
+    @nn.compact
+    def __call__(self, x):
+        return nn.LayerNorm(name="norm")(x[:, 0])
+
+
+def _vit_specs(img_size: int, num_classes: int,
+               patch_size: int = 4, embed_dim: int = 128,
+               num_heads: int = 4, mlp_dim: int = 256, n_block: int = 6,
+               dropout_rate: float = 0.0, dtype=jnp.float32) -> tuple:
+    n_tokens = (img_size // patch_size) ** 2 + 1
+    specs = [
+        LayerSpec("layer1", make=functools.partial(
+            nn.Conv, features=embed_dim,
+            kernel_size=(patch_size, patch_size),
+            strides=(patch_size, patch_size), padding="VALID", dtype=dtype),
+            fn=_plain_fn),
+        LayerSpec("layer2", make=PatchFlatten, fn=_plain_fn),
+        LayerSpec("layer3", make=functools.partial(
+            ClsToken, embed_dim=embed_dim), fn=_plain_fn),
+        LayerSpec("layer4", make=functools.partial(
+            PosEmbed, n_tokens=n_tokens, embed_dim=embed_dim),
+            fn=_plain_fn),
+    ]
+    for i in range(n_block):
+        specs.append(LayerSpec(
+            f"layer{5 + i}",
+            make=functools.partial(
+                PreLNBlock, embed_dim=embed_dim, num_heads=num_heads,
+                mlp_dim=mlp_dim, dropout_rate=dropout_rate, dtype=dtype),
+            fn=_train_fn))
+    specs.append(LayerSpec(f"layer{5 + n_block}", make=ClsNorm,
+                           fn=_plain_fn))
+    specs.append(LayerSpec(
+        f"layer{6 + n_block}",
+        make=functools.partial(nn.Dense, features=num_classes, dtype=dtype),
+        fn=_plain_fn))
+    return tuple(specs)
+
+
+@register_model("ViT_CIFAR10")
+def vit_cifar10(dtype=jnp.float32, **kw) -> tuple:
+    """CIFAR-10 ViT: (B, 32, 32, 3) NHWC -> 10 classes, 12 layers."""
+    specs = _vit_specs(32, 10, dtype=dtype, **kw)
+    if not kw:
+        assert len(specs) == 12
+    return specs
+
+
+@register_model("ViT_MNIST")
+def vit_mnist(dtype=jnp.float32, **kw) -> tuple:
+    """MNIST ViT: (B, 28, 28, 1) -> 10 classes, 12 layers."""
+    return _vit_specs(28, 10, dtype=dtype, **kw)
+
+
+@register_model("ViT_S16_CIFAR10")
+def vit_s16_cifar10(dtype=jnp.float32, **kw) -> tuple:
+    """ViT-S geometry on CIFAR-10 (north-star config #4): patch 4 (CIFAR
+    scale for 8x8 tokens), 384 wide, 6 heads, 12 blocks -> 18 layers."""
+    defaults = dict(patch_size=4, embed_dim=384, num_heads=6,
+                    mlp_dim=1536, n_block=12, dropout_rate=0.1)
+    defaults.update(kw)
+    return _vit_specs(32, 10, dtype=dtype, **defaults)

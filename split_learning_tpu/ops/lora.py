@@ -1,0 +1,132 @@
+"""Parameter-level LoRA adapters (peft parity).
+
+The reference wraps BERT shards with HF peft LoRA (r=8, alpha=16, targets
+query/key/value/dense) at START, trains only the adapters (+ the
+classifier on the last stage), and bakes them into the weights with
+``merge_and_unload`` before UPDATE
+(``/root/reference/src/RpcClient.py:61-66``, ``:99-103``, ``:121-122``).
+
+Here LoRA lives at the parameter-pytree level, independent of module
+internals: for every kernel whose path matches a target name, keep a pair
+of factors ``a: (in, r)``, ``b: (r, out)``; the effective weight is
+``W + (alpha/r) a @ b``.  This composes with ANY flax model in the zoo
+(fused-qkv attention included — DenseGeneral kernels are treated as 2-D
+by flattening the head dims) and with the split/pipeline machinery, since
+adapters are just another pytree sliced by layer name.
+
+Training trains the adapter tree (plus an optional unfrozen set) while
+the base params stay constant: differentiate the merged apply w.r.t. the
+adapter tree only — exactly peft's semantics, not a masked update.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from split_learning_tpu.config import (
+    LORA_DEFAULT_TARGETS as DEFAULT_TARGETS,
+)
+
+
+def _path_names(path) -> list:
+    names = []
+    for p in path:
+        if hasattr(p, "key"):
+            names.append(str(p.key))
+        elif hasattr(p, "idx"):
+            names.append(str(p.idx))
+        else:
+            names.append(str(p))
+    return names
+
+
+def _is_target(path, leaf, targets) -> bool:
+    names = _path_names(path)
+    if not names or names[-1] != "kernel":
+        return False
+    if np.ndim(leaf) < 2:
+        return False
+    return any(t in names for t in targets)
+
+
+def _as_2d(shape: Sequence[int], names: Sequence[str]) -> tuple[int, int]:
+    """(in, out) view of a kernel.
+
+    Flax MHA q/k/v kernels are (embed, heads, head_dim) — input first,
+    fused heads on the OUTPUT side; the out-projection kernel is
+    (heads, head_dim, embed) — heads on the INPUT side.  Getting this
+    wrong would factor the wrong matrix (a (heads, r) x (r, head_dim*embed)
+    pair instead of rank-r over the real (in, out))."""
+    if len(shape) <= 2:
+        return int(shape[0]), int(np.prod(shape[1:]))
+    if "out" in names:
+        return int(np.prod(shape[:-1])), int(shape[-1])
+    return int(shape[0]), int(np.prod(shape[1:]))
+
+
+def lora_init(rng, params, targets: Sequence[str] = DEFAULT_TARGETS,
+              rank: int = 8) -> dict:
+    """Adapter tree mirroring ``params``: matched kernels get
+    ``{"a", "b"}``, everything else an empty placeholder pruned from the
+    tree.  ``a`` is Gaussian/r, ``b`` zeros — so the merged model starts
+    exactly at the base weights (peft init)."""
+    flat = jax.tree_util.tree_flatten_with_path(params)[0]
+    out: dict = {}
+    keys = jax.random.split(rng, max(1, len(flat)))
+    for (path, leaf), k in zip(flat, keys):
+        if not _is_target(path, leaf, targets):
+            continue
+        names = _path_names(path)
+        d_in, d_out = _as_2d(np.shape(leaf), names)
+        node = out
+        for name in names[:-1]:
+            node = node.setdefault(name, {})
+        node[names[-1]] = {
+            "a": (jax.random.normal(k, (d_in, rank),
+                                    jnp.asarray(leaf).dtype) / rank),
+            "b": jnp.zeros((rank, d_out), jnp.asarray(leaf).dtype),
+        }
+    return out
+
+
+def _lookup(tree: dict, names: list):
+    node = tree
+    for n in names:
+        if not isinstance(node, dict) or n not in node:
+            return None
+        node = node[n]
+    return node
+
+
+def lora_merge(params, lora: dict, alpha: float = 16.0,
+               rank: int = 8):
+    """Bake adapters into the base weights: ``W + (alpha/r) a @ b``
+    (peft ``merge_and_unload``)."""
+    scale = alpha / rank
+
+    def merge_leaf(path, leaf):
+        entry = _lookup(lora, _path_names(path))
+        if not (isinstance(entry, dict) and "a" in entry and "b" in entry):
+            return leaf
+        delta = (entry["a"] @ entry["b"]).reshape(np.shape(leaf))
+        return leaf + scale * delta.astype(jnp.asarray(leaf).dtype)
+
+    return jax.tree_util.tree_map_with_path(merge_leaf, params)
+
+
+def lora_param_count(lora: dict) -> int:
+    return sum(int(np.prod(np.shape(leaf)))
+               for leaf in jax.tree_util.tree_leaves(lora))
+
+
+def split_frozen(params, unfrozen_names: Sequence[str]):
+    """Partition a param tree into (frozen, trainable) by top-level layer
+    name — the reference unfreezes the classifier head on the last stage
+    (``src/RpcClient.py:101-103``)."""
+    frozen = {k: v for k, v in params.items() if k not in unfrozen_names}
+    trainable = {k: v for k, v in params.items() if k in unfrozen_names}
+    return frozen, trainable

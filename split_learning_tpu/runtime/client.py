@@ -45,6 +45,7 @@ import optax
 from split_learning_tpu.config import Config, LearningConfig, from_yaml
 from split_learning_tpu.data import make_data_loader
 from split_learning_tpu.models import build_model
+from split_learning_tpu.ops.lora import lora_init, lora_merge, split_frozen
 from split_learning_tpu.runtime.bus import Transport, make_transport
 from split_learning_tpu.runtime.log import Logger
 from split_learning_tpu.runtime.protocol import (
@@ -65,7 +66,16 @@ def make_optimizer_from_dict(learning: dict | None) -> tuple[
 
 
 class ShardRunner:
-    """Jitted forward / recompute-backward / optimizer ops for one shard."""
+    """Jitted forward / recompute-backward / optimizer ops for one shard.
+
+    Parameters are carried as ``(frozen, trainable)``: ``trainable`` is
+    ``{"lora": adapters, "head": unfrozen params}``.  Without LoRA the
+    whole shard rides in ``head`` and ``frozen``/``lora`` are empty, so
+    plain training and adapter training share one code path.  With
+    ``learning.lora_rank > 0`` this reproduces the reference's peft wrap:
+    adapters on attention kernels, base frozen, classifier head unfrozen
+    on the final shard (``src/RpcClient.py:61-66``, ``:99-103``).
+    """
 
     def __init__(self, model_key: str, start_layer: int, end_layer: int,
                  learning: dict | None, model_kwargs: dict | None = None,
@@ -77,6 +87,15 @@ class ShardRunner:
         self.optimizer, self.learning = make_optimizer_from_dict(learning)
         self.rng = jax.random.key(seed)
         self._counter = 0
+        lrn = self.learning
+        self.lora_rank, self.lora_alpha = lrn.lora_rank, lrn.lora_alpha
+
+        def merged(frozen, t):
+            base = {**frozen, **t["head"]}
+            if not t["lora"]:
+                return base
+            return lora_merge(base, t["lora"], alpha=self.lora_alpha,
+                              rank=self.lora_rank)
 
         def _variables(params, stats):
             v = {"params": params}
@@ -85,74 +104,106 @@ class ShardRunner:
             return v
 
         @jax.jit
-        def fwd(params, stats, x, rng):
+        def fwd(frozen, t, stats, x, rng):
             """Forward in train mode; batch_stats update deferred to the
             backward recompute (single update per consumed batch)."""
             out, _ = self.model.apply(
-                _variables(params, stats), x, train=True,
+                _variables(merged(frozen, t), stats), x, train=True,
                 mutable=["batch_stats"], rngs={"dropout": rng})
             return out
 
         @jax.jit
-        def bwd(params, stats, x, ct, rng):
+        def bwd(frozen, t, stats, x, ct, rng):
             """Recompute forward, backprop the received cotangent.
 
-            Returns (param_grads, input_grad, new_stats)."""
-            def f(p, xx):
+            Returns (trainable_grads, input_grad, new_stats)."""
+            def f(tt, xx):
                 out, mut = self.model.apply(
-                    _variables(p, stats), xx, train=True,
+                    _variables(merged(frozen, tt), stats), xx, train=True,
                     mutable=["batch_stats"], rngs={"dropout": rng})
                 return jnp.vdot(out.astype(jnp.float32),
                                 ct.astype(jnp.float32)), mut
-            grad_fn = jax.grad(f, argnums=(0, 1), has_aux=True)
-            (gp, gx), mut = grad_fn(params, x)
+            # allow_int: stage-1 inputs can be integer token ids; their
+            # float0 cotangent is never used (no upstream hop to route to)
+            grad_fn = jax.grad(f, argnums=(0, 1), has_aux=True,
+                               allow_int=True)
+            (gt, gx), mut = grad_fn(t, x)
             new_stats = dict(stats)
             new_stats.update(mut.get("batch_stats", {}))
-            return gp, gx, new_stats
+            return gt, gx, new_stats
 
         @jax.jit
-        def last_step(params, stats, x, labels, rng):
-            """Last stage: CE loss, grads wrt params AND input activation.
+        def last_step(frozen, t, stats, x, labels, rng):
+            """Last stage: CE loss, grads wrt trainables AND input.
 
-            Returns (loss, param_grads, input_grad, new_stats)."""
-            def f(p, xx):
+            Returns (loss, trainable_grads, input_grad, new_stats)."""
+            def f(tt, xx):
                 out, mut = self.model.apply(
-                    _variables(p, stats), xx, train=True,
+                    _variables(merged(frozen, tt), stats), xx, train=True,
                     mutable=["batch_stats"], rngs={"dropout": rng})
                 loss = optax.softmax_cross_entropy_with_integer_labels(
                     out.astype(jnp.float32), labels).mean()
                 return loss, mut
-            (loss, mut), (gp, gx) = jax.value_and_grad(
-                f, argnums=(0, 1), has_aux=True)(params, x)
+            (loss, mut), (gt, gx) = jax.value_and_grad(
+                f, argnums=(0, 1), has_aux=True, allow_int=True)(t, x)
             new_stats = dict(stats)
             new_stats.update(mut.get("batch_stats", {}))
-            return loss, gp, gx, new_stats
+            return loss, gt, gx, new_stats
 
         @jax.jit
-        def whole_step(params, stats, x, labels, rng):
+        def whole_step(frozen, t, stats, x, labels, rng):
             """Degenerate whole-model client (``layers == [0, 0]``,
             ``src/Server.py:241-243``): plain local train step."""
-            def f(p):
+            def f(tt):
                 out, mut = self.model.apply(
-                    _variables(p, stats), x, train=True,
+                    _variables(merged(frozen, tt), stats), x, train=True,
                     mutable=["batch_stats"], rngs={"dropout": rng})
                 loss = optax.softmax_cross_entropy_with_integer_labels(
                     out.astype(jnp.float32), labels).mean()
                 return loss, mut
-            (loss, mut), gp = jax.value_and_grad(f, has_aux=True)(params)
+            (loss, mut), gt = jax.value_and_grad(f, has_aux=True)(t)
             new_stats = dict(stats)
             new_stats.update(mut.get("batch_stats", {}))
-            return loss, gp, new_stats
+            return loss, gt, new_stats
 
         @jax.jit
-        def apply_update(params, opt_state, grads):
-            updates, new_opt = self.optimizer.update(grads, opt_state,
-                                                     params)
-            return optax.apply_updates(params, updates), new_opt
+        def apply_update(t, opt_state, grads):
+            updates, new_opt = self.optimizer.update(grads, opt_state, t)
+            return optax.apply_updates(t, updates), new_opt
 
         self.fwd, self.bwd = fwd, bwd
         self.last_step, self.whole_step = last_step, whole_step
         self.apply_update = apply_update
+        self._merged = jax.jit(merged)
+
+    def partition_params(self, params, is_final_shard: bool):
+        """(frozen, trainable) split of the shard's params.
+
+        LoRA off: everything trainable.  LoRA on: adapters over target
+        kernels; the model's final layer (classifier) is unfrozen when
+        this shard holds it."""
+        self.lora_noop = False
+        if self.lora_rank <= 0:
+            return {}, {"lora": {}, "head": params}
+        unfrozen_names = []
+        if is_final_shard:
+            unfrozen_names = [self.model.specs[-1].name]
+        frozen, head = split_frozen(params, unfrozen_names)
+        adapters = lora_init(self.next_rng(), frozen,
+                             targets=self.learning.lora_targets,
+                             rank=self.lora_rank)
+        if not adapters and not head:
+            # no target kernels in this shard (conv-only model/slice):
+            # freezing everything would silently train nothing — fall
+            # back to full training and let the caller warn
+            self.lora_noop = True
+            return {}, {"lora": {}, "head": params}
+        return frozen, {"lora": adapters, "head": head}
+
+    def merge_params(self, frozen, t):
+        """Bake adapters back into dense weights (merge_and_unload,
+        ``src/RpcClient.py:121-122``) for UPDATE/aggregation."""
+        return self._merged(frozen, t)
 
     def next_rng(self):
         self._counter += 1
@@ -185,7 +236,8 @@ class ProtocolClient:
         self.log = logger or Logger(cfg.log_path, debug=cfg.debug,
                                     console=False, name=client_id)
         self.runner: ShardRunner | None = None
-        self.params = None
+        self.frozen: dict = {}
+        self.trainable: dict = {}
         self.stats: dict = {}
         self.opt_state = None
         self.loader = None
@@ -247,11 +299,19 @@ class ProtocolClient:
             self.cfg.model_key, msg.start_layer, msg.end_layer,
             msg.learning, model_kwargs=model_kwargs,
             seed=self.cfg.seed + hash(self.client_id) % 100000)
-        self.params = jax.tree_util.tree_map(jnp.asarray, msg.params)
+        params = jax.tree_util.tree_map(jnp.asarray, msg.params)
         self.stats = jax.tree_util.tree_map(
             jnp.asarray, msg.batch_stats or {})
-        self.opt_state = self.runner.optimizer.init(self.params)
         self.n_stages = int(extra.get("n_stages", self.cfg.num_stages))
+        is_final = (msg.end_layer == -1
+                    or msg.end_layer >= len(self.runner.model.specs))
+        self.frozen, self.trainable = self.runner.partition_params(
+            params, is_final)
+        if getattr(self.runner, "lora_noop", False):
+            self.log.warning(
+                "lora_rank set but no target kernels in this shard; "
+                "training full shard parameters instead")
+        self.opt_state = self.runner.optimizer.init(self.trainable)
         if self.stage == 1 and msg.label_counts is not None:
             self.loader = make_data_loader(
                 dataset_for_model(self.cfg.model_key),
@@ -278,7 +338,8 @@ class ProtocolClient:
             self._send_update()
 
     def _send_update(self):
-        params_h = jax.tree_util.tree_map(np.asarray, self.params)
+        merged = self.runner.merge_params(self.frozen, self.trainable)
+        params_h = jax.tree_util.tree_map(np.asarray, merged)
         stats_h = jax.tree_util.tree_map(np.asarray, self.stats)
         self.bus.publish(RPC_QUEUE, encode(Update(
             client_id=self.client_id, stage=self.stage,
@@ -315,12 +376,13 @@ class ProtocolClient:
         for _ in range(self.epochs):
             for x, labels in self.loader:
                 loss, grads, self.stats = r.whole_step(
-                    self.params, self.stats, jnp.asarray(x),
+                    self.frozen, self.trainable, self.stats,
+                    jnp.asarray(x),
                     jnp.asarray(labels.astype(np.int32)), r.next_rng())
                 if not bool(jnp.isfinite(loss)):
                     self.round_ok = False
-                self.params, self.opt_state = r.apply_update(
-                    self.params, self.opt_state, grads)
+                self.trainable, self.opt_state = r.apply_update(
+                    self.trainable, self.opt_state, grads)
                 self.num_samples += len(labels)
         self.bus.publish(RPC_QUEUE, encode(Notify(
             client_id=self.client_id, cluster=self.cluster)))
@@ -343,11 +405,11 @@ class ProtocolClient:
                 if raw is not None:
                     g = decode(raw)
                     ent = inflight.pop(g.data_id)
-                    gp, _, self.stats = r.bwd(
-                        self.params, self.stats, ent.x,
+                    gt, _, self.stats = r.bwd(
+                        self.frozen, self.trainable, self.stats, ent.x,
                         jnp.asarray(g.data), ent.rng)
-                    self.params, self.opt_state = r.apply_update(
-                        self.params, self.opt_state, gp)
+                    self.trainable, self.opt_state = r.apply_update(
+                        self.trainable, self.opt_state, gt)
                     n_bwd += 1
                     continue
                 if exhausted or len(inflight) >= cap:
@@ -359,7 +421,8 @@ class ProtocolClient:
                     continue
                 x = jnp.asarray(x)
                 rng = r.next_rng()
-                out = r.fwd(self.params, self.stats, x, rng)
+                out = r.fwd(self.frozen, self.trainable, self.stats, x,
+                            rng)
                 data_id = uuid.uuid4().hex
                 inflight[data_id] = _Inflight(x=x, rng=rng,
                                               trace=[self.client_id])
@@ -389,11 +452,11 @@ class ProtocolClient:
             if raw is not None:
                 g = decode(raw)
                 ent = inflight.pop(g.data_id)
-                gp, gx, self.stats = r.bwd(
-                    self.params, self.stats, ent.x, jnp.asarray(g.data),
-                    ent.rng)
-                self.params, self.opt_state = r.apply_update(
-                    self.params, self.opt_state, gp)
+                gt, gx, self.stats = r.bwd(
+                    self.frozen, self.trainable, self.stats, ent.x,
+                    jnp.asarray(g.data), ent.rng)
+                self.trainable, self.opt_state = r.apply_update(
+                    self.trainable, self.opt_state, gt)
                 origin = ent.trace[-1]
                 self.bus.publish(
                     gradient_queue(self.stage - 1, origin),
@@ -407,7 +470,7 @@ class ProtocolClient:
             act = decode(raw)
             x = jnp.asarray(act.data)
             rng = r.next_rng()
-            out = r.fwd(self.params, self.stats, x, rng)
+            out = r.fwd(self.frozen, self.trainable, self.stats, x, rng)
             inflight[act.data_id] = _Inflight(x=x, rng=rng,
                                               trace=list(act.trace))
             self.num_samples += len(act.labels)
@@ -449,12 +512,13 @@ class ProtocolClient:
         x = jnp.concatenate([jnp.asarray(a.data) for a in window])
         labels = jnp.concatenate(
             [jnp.asarray(a.labels, jnp.int32) for a in window])
-        loss, gp, gx, self.stats = r.last_step(
-            self.params, self.stats, x, labels, r.next_rng())
+        loss, gt, gx, self.stats = r.last_step(
+            self.frozen, self.trainable, self.stats, x, labels,
+            r.next_rng())
         if not bool(jnp.isfinite(loss)):
             self.round_ok = False   # NaN sentinel (src/train/VGG16.py:169)
-        self.params, self.opt_state = r.apply_update(
-            self.params, self.opt_state, gp)
+        self.trainable, self.opt_state = r.apply_update(
+            self.trainable, self.opt_state, gt)
         self.num_samples += int(sum(sizes))
         gx = np.asarray(gx, np.float32)
         off = 0

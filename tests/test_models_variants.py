@@ -1,0 +1,76 @@
+"""Golden split tests for the variant-parity models (ViT, MobileNetv1)
+and the ViT-S north-star geometry."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from split_learning_tpu.models import build_model, num_layers, shard_params
+
+
+def _init_full(name, x, **kw):
+    model = build_model(name, **kw)
+    variables = model.init(jax.random.key(0), x, train=False)
+    return model, variables
+
+
+def _split_apply(name, variables, x, cut, train=False, **kw):
+    m1 = build_model(name, start_layer=0, end_layer=cut, **kw)
+    m2 = build_model(name, start_layer=cut, end_layer=-1, **kw)
+    specs = m1.specs
+
+    def sl(start, end):
+        return {col: shard_params(tree, specs, start, end)
+                for col, tree in variables.items()}
+    h = m1.apply(sl(0, cut), x, train=train)
+    return m2.apply(sl(cut, len(specs)), h, train=train)
+
+
+def test_vit_cifar10_12_layers_and_split():
+    assert num_layers("ViT_CIFAR10") == 12
+    x = jax.random.normal(jax.random.key(1), (2, 32, 32, 3))
+    model, variables = _init_full("ViT_CIFAR10", x)
+    ref = model.apply(variables, x, train=False)
+    assert ref.shape == (2, 10)
+    # cuts through the param-layer region (3: cls, 4: pos) and blocks
+    for cut in [1, 2, 3, 4, 7, 11]:
+        out = _split_apply("ViT_CIFAR10", variables, x, cut)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=1e-5, atol=1e-5,
+                                   err_msg=f"cut={cut}")
+
+
+def test_vit_mnist_shapes():
+    x = jnp.zeros((2, 28, 28, 1))
+    model, variables = _init_full("ViT_MNIST", x)
+    assert model.apply(variables, x, train=False).shape == (2, 10)
+
+
+def test_vit_s16_geometry():
+    assert num_layers("ViT_S16_CIFAR10") == 18
+    x = jnp.zeros((1, 32, 32, 3))
+    model, variables = _init_full("ViT_S16_CIFAR10", x)
+    # 384-wide CLS head output
+    assert variables["params"]["layer5"]["attention"]["out"][
+        "kernel"].shape[-1] == 384
+    assert model.apply(variables, x, train=False).shape == (1, 10)
+
+
+def test_mobilenet_84_layers_and_split():
+    assert num_layers("MobileNetv1_CIFAR10") == 84
+    x = jax.random.normal(jax.random.key(2), (2, 32, 32, 3))
+    model, variables = _init_full("MobileNetv1_CIFAR10", x)
+    ref = model.apply(variables, x, train=False)
+    assert ref.shape == (2, 10)
+    for cut in [3, 12, 40, 81]:
+        out = _split_apply("MobileNetv1_CIFAR10", variables, x, cut)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=1e-4, atol=1e-4,
+                                   err_msg=f"cut={cut}")
+
+
+def test_mobilenet_mnist_spatial_math():
+    x = jnp.zeros((2, 28, 28, 1))
+    model, variables = _init_full("MobileNetv1_MNIST", x)
+    assert model.apply(variables, x, train=False).shape == (2, 10)
