@@ -217,6 +217,30 @@ def emotion(train: bool = True, synthetic_size: int | None = None):
                              seed=400 + (0 if train else 1))
 
 
+@register_dataset("TINYSTORIES")
+def tinystories(train: bool = True, synthetic_size: int | None = None,
+                seq_len: int = 257, vocab: int = 32000):
+    """Causal-LM token streams (north-star TinyLlama config).
+
+    On-disk: ``data/TinyStories/{train,valid}.npy`` of shape (N, seq_len)
+    int32 token ids; otherwise synthetic Markov-ish token sequences.
+    Inputs are ids[:, :-1]; labels the next-token shift ids[:, 1:]."""
+    path = (data_dir() / "TinyStories"
+            / ("train.npy" if train else "valid.npy"))
+    if path.exists():
+        ids = np.load(path).astype(np.int32)
+    else:
+        n = synthetic_size or (4000 if train else 400)
+        rng = np.random.default_rng(500 + (0 if train else 1))
+        # band-structured transitions so a real LM can reduce loss
+        starts = rng.integers(0, vocab - 64, size=(n, 1))
+        steps = rng.integers(-32, 33, size=(n, seq_len - 1)).cumsum(axis=1)
+        ids = np.clip(starts + np.concatenate(
+            [np.zeros((n, 1), np.int64), steps], axis=1), 0, vocab - 1)
+        ids = ids.astype(np.int32)
+    return ArrayDataset(ids[:, :-1], ids[:, 1:].astype(np.int32))
+
+
 # --------------------------------------------------------------------------
 # SpeechCommands (MFCC)
 # --------------------------------------------------------------------------
@@ -277,7 +301,15 @@ def make_data_loader(name: str, batch_size: int,
     ds = get_dataset(name, train=train, synthetic_size=synthetic_size)
     if distribution is not None:
         rng = np.random.default_rng(seed)
-        idx = label_count_subset(ds.labels, distribution, rng)
+        if np.ndim(ds.labels) > 1:
+            # sequence labels (causal LM): class counts are meaningless —
+            # take a random subset of the requested total size instead,
+            # wrapping with replacement like label_count_subset does
+            total = max(1, int(np.sum(distribution)))
+            idx = rng.choice(len(ds), size=total,
+                             replace=total > len(ds))
+        else:
+            idx = label_count_subset(ds.labels, distribution, rng)
         ds = ds.take(idx)
     augment = cifar_augment if (train and name in ("CIFAR10", "CIFAR100")) \
         else None

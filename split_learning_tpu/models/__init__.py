@@ -18,6 +18,8 @@ import split_learning_tpu.models.bert  # noqa: F401  (registers BERT_*)
 import split_learning_tpu.models.kwt  # noqa: F401  (registers KWT_*)
 import split_learning_tpu.models.vit  # noqa: F401  (registers ViT_*)
 import split_learning_tpu.models.mobilenet  # noqa: F401  (MobileNetv1_*)
+import split_learning_tpu.models.resnet  # noqa: F401  (ResNet50_*)
+import split_learning_tpu.models.llama  # noqa: F401  (TinyLlama_*)
 
 __all__ = [
     "LayerSpec", "SplitModel", "build_model", "model_registry",

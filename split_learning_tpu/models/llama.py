@@ -1,0 +1,137 @@
+"""TinyLlama-style causal LM as indexed layers (north-star config #5).
+
+Fresh design — the reference tops out at BERT-base/128 tokens; the
+4-stage-pipeline target config needs a modern decoder.  LLaMA family
+geometry: RMSNorm pre-norm blocks, rotary position embeddings, grouped-
+query attention, SwiGLU MLP, untied LM head.  TinyLlama-1.1B defaults
+(2048 hidden, 22 blocks, 32 Q / 4 KV heads, 5632 intermediate, 32000
+vocab); tests pass tiny overrides through the same builder.
+
+Split-layer contract: 1 = token embedding, 2..n_block+1 = decoder blocks,
+n_block+2 = final RMSNorm, n_block+3 = LM head (25 layers at full size).
+The streaming activation between any two stages is the (B, S, H) hidden
+state — exactly what ``ppermute``/the wire carries.  Causality needs no
+mask plumbing across stages: each block rebuilds its own causal mask from
+the sequence length.
+
+Loss: next-token CE — the labels tensor is the input shifted by the data
+pipeline (``data/datasets.py`` TINYSTORIES provider), so the pipeline's
+``softmax_cross_entropy`` path broadcasts over (B, S) unchanged.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import flax.linen as nn
+import jax.numpy as jnp
+import numpy as np
+
+from split_learning_tpu.models.split import (
+    LayerSpec, register_model, module_plain_fn as _plain_fn,
+)
+
+
+def _rope(x: jnp.ndarray, positions: jnp.ndarray,
+          base: float = 10000.0) -> jnp.ndarray:
+    """Rotary embedding over the last dim of (B, S, H, D)."""
+    d = x.shape[-1]
+    inv_freq = 1.0 / (base ** (np.arange(0, d, 2) / d))
+    freqs = positions[:, None].astype(jnp.float32) * inv_freq[None, :]
+    cos = jnp.cos(freqs)[None, :, None, :]
+    sin = jnp.sin(freqs)[None, :, None, :]
+    x1, x2 = x[..., 0::2], x[..., 1::2]
+    rot = jnp.stack([x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1)
+    return rot.reshape(x.shape).astype(x.dtype)
+
+
+class LlamaAttention(nn.Module):
+    """Causal GQA with RoPE."""
+    hidden_size: int
+    num_heads: int
+    num_kv_heads: int
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        b, s, _ = x.shape
+        hd = self.hidden_size // self.num_heads
+        dense = functools.partial(nn.Dense, use_bias=False,
+                                  dtype=self.dtype)
+        q = dense(self.num_heads * hd, name="q_proj")(x)
+        k = dense(self.num_kv_heads * hd, name="k_proj")(x)
+        v = dense(self.num_kv_heads * hd, name="v_proj")(x)
+        q = q.reshape(b, s, self.num_heads, hd)
+        k = k.reshape(b, s, self.num_kv_heads, hd)
+        v = v.reshape(b, s, self.num_kv_heads, hd)
+
+        pos = jnp.arange(s)
+        q, k = _rope(q, pos), _rope(k, pos)
+        rep = self.num_heads // self.num_kv_heads
+        if rep > 1:
+            k = jnp.repeat(k, rep, axis=2)
+            v = jnp.repeat(v, rep, axis=2)
+
+        scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(hd)
+        mask = jnp.tril(jnp.ones((s, s), bool))
+        scores = jnp.where(mask[None, None], scores, -1e30)
+        probs = nn.softmax(scores.astype(jnp.float32)).astype(self.dtype)
+        out = jnp.einsum("bhqk,bkhd->bqhd", probs, v).reshape(b, s, -1)
+        return dense(self.hidden_size, name="o_proj")(out)
+
+
+class LlamaBlock(nn.Module):
+    """Pre-RMSNorm: x + attn(norm(x)); x + swiglu(norm(x))."""
+    hidden_size: int
+    num_heads: int
+    num_kv_heads: int
+    intermediate_size: int
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        h = nn.RMSNorm(epsilon=1e-5, dtype=self.dtype,
+                       name="input_norm")(x)
+        x = x + LlamaAttention(
+            hidden_size=self.hidden_size, num_heads=self.num_heads,
+            num_kv_heads=self.num_kv_heads, dtype=self.dtype,
+            name="attention")(h)
+        h = nn.RMSNorm(epsilon=1e-5, dtype=self.dtype,
+                       name="post_norm")(x)
+        dense = functools.partial(nn.Dense, use_bias=False,
+                                  dtype=self.dtype)
+        gate = nn.silu(dense(self.intermediate_size, name="gate_proj")(h))
+        up = dense(self.intermediate_size, name="up_proj")(h)
+        return x + dense(self.hidden_size, name="down_proj")(gate * up)
+
+
+def _llama_specs(vocab_size: int = 32000, hidden_size: int = 2048,
+                 num_heads: int = 32, num_kv_heads: int = 4,
+                 intermediate_size: int = 5632, n_block: int = 22,
+                 dtype=jnp.float32) -> tuple:
+    specs = [LayerSpec("layer1", make=functools.partial(
+        nn.Embed, num_embeddings=vocab_size, features=hidden_size,
+        dtype=dtype), fn=_plain_fn)]
+    for i in range(n_block):
+        specs.append(LayerSpec(
+            f"layer{2 + i}",
+            make=functools.partial(
+                LlamaBlock, hidden_size=hidden_size, num_heads=num_heads,
+                num_kv_heads=num_kv_heads,
+                intermediate_size=intermediate_size, dtype=dtype),
+            fn=_plain_fn))
+    specs.append(LayerSpec(f"layer{2 + n_block}",
+                           make=functools.partial(nn.RMSNorm, epsilon=1e-5,
+                                                  dtype=dtype),
+                           fn=_plain_fn))
+    specs.append(LayerSpec(f"layer{3 + n_block}", make=functools.partial(
+        nn.Dense, features=vocab_size, use_bias=False, dtype=dtype),
+        fn=_plain_fn))
+    return tuple(specs)
+
+
+@register_model("TinyLlama_TINYSTORIES")
+def tinyllama_tinystories(dtype=jnp.float32, **kw) -> tuple:
+    """TinyLlama-1.1B geometry; input (B, S) int32 token ids, output
+    (B, S, vocab) next-token logits.  25 layers at default size."""
+    return _llama_specs(dtype=dtype, **kw)

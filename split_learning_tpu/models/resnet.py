@@ -1,0 +1,100 @@
+"""ResNet-50 as indexed layers (north-star BASELINE.json config #3).
+
+Fresh design — the reference has no ResNet; the 3-way-split target config
+needs one.  To honor the split-layer contract (every layer index is a
+valid cut point with a single streaming activation), each bottleneck
+residual block is ONE layer — the same granularity the reference uses for
+transformer blocks (``src/model/BERT_AGNEWS.py:185-200``, one block per
+index).  CIFAR stem (3x3 stride 1, no maxpool):
+
+1 = stem conv, 2 = stem BN, 3 = relu, 4..19 = 16 bottleneck blocks
+(3-4-6-3 geometry, strides 2 at stage entries), 20 = global average
+pool + flatten, 21 = linear head — 21 layers.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from split_learning_tpu.models.split import (
+    LayerSpec, register_model, relu_fn, batchnorm_fn,
+    module_train_fn as _train_fn,
+)
+
+
+class Bottleneck(nn.Module):
+    """1x1 reduce -> 3x3 -> 1x1 expand with projection shortcut."""
+    features: int                  # bottleneck width; out = 4x
+    strides: int = 1
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        conv = functools.partial(nn.Conv, use_bias=False, dtype=self.dtype)
+        bn = functools.partial(nn.BatchNorm, momentum=0.9, epsilon=1e-5,
+                               dtype=self.dtype,
+                               use_running_average=not train)
+        out_ch = self.features * 4
+        residual = x
+        y = conv(self.features, (1, 1), name="conv1")(x)
+        y = nn.relu(bn(name="bn1")(y))
+        y = conv(self.features, (3, 3), strides=(self.strides,) * 2,
+                 padding=1, name="conv2")(y)
+        y = nn.relu(bn(name="bn2")(y))
+        y = conv(out_ch, (1, 1), name="conv3")(y)
+        y = bn(name="bn3")(y)
+        if residual.shape[-1] != out_ch or self.strides != 1:
+            residual = conv(out_ch, (1, 1), strides=(self.strides,) * 2,
+                            name="proj")(x)
+            residual = bn(name="proj_bn")(residual)
+        return nn.relu(y + residual)
+
+
+def _avgpool_flatten(_, x, train):
+    return jnp.mean(x, axis=(1, 2))
+
+
+def _resnet50_specs(num_classes: int, dtype=jnp.float32) -> tuple:
+    bn = functools.partial(nn.BatchNorm, momentum=0.9, epsilon=1e-5,
+                           dtype=dtype)
+    specs = [
+        LayerSpec("layer1", make=functools.partial(
+            nn.Conv, features=64, kernel_size=(3, 3), padding=1,
+            use_bias=False, dtype=dtype)),
+        LayerSpec("layer2", make=bn, fn=batchnorm_fn),
+        LayerSpec("layer3", fn=relu_fn),
+    ]
+    idx = 3
+
+    def blk(features, strides):
+        nonlocal idx
+        idx += 1
+        specs.append(LayerSpec(
+            f"layer{idx}",
+            make=functools.partial(Bottleneck, features=features,
+                                   strides=strides, dtype=dtype),
+            fn=_train_fn))
+
+    for features, n_blocks, first_stride in (
+            (64, 3, 1), (128, 4, 2), (256, 6, 2), (512, 3, 2)):
+        for i in range(n_blocks):
+            blk(features, first_stride if i == 0 else 1)
+    specs.append(LayerSpec(f"layer{idx + 1}", fn=_avgpool_flatten))
+    specs.append(LayerSpec(f"layer{idx + 2}", make=functools.partial(
+        nn.Dense, features=num_classes, dtype=dtype)))
+    assert len(specs) == 21
+    return tuple(specs)
+
+
+@register_model("ResNet50_CIFAR100")
+def resnet50_cifar100(dtype=jnp.float32) -> tuple:
+    """(B, 32, 32, 3) NHWC -> 100 classes, 21 layers."""
+    return _resnet50_specs(100, dtype=dtype)
+
+
+@register_model("ResNet50_CIFAR10")
+def resnet50_cifar10(dtype=jnp.float32) -> tuple:
+    return _resnet50_specs(10, dtype=dtype)

@@ -22,9 +22,26 @@ def synthesize_label_counts(num_clients: int, num_labels: int,
     if non_iid:
         rng = np.random.default_rng(seed)
         probs = rng.dirichlet([alpha] * num_labels, size=num_clients)
-        return (probs * num_samples).astype(int)
-    return np.full((num_clients, num_labels), num_samples // num_labels,
-                   dtype=int)
+        counts = _largest_remainder(probs * num_samples)
+    else:
+        counts = _largest_remainder(
+            np.full((num_clients, num_labels),
+                    num_samples / num_labels, dtype=float))
+    return counts
+
+
+def _largest_remainder(target: np.ndarray) -> np.ndarray:
+    """Round rows to ints preserving each row's total (the reference's
+    plain ``int()`` truncation loses up to num_labels-1 samples per client
+    and zeroes everything when num_samples < num_labels)."""
+    floor = np.floor(target).astype(int)
+    remainder = target - floor
+    deficit = np.round(target.sum(axis=1)).astype(int) - floor.sum(axis=1)
+    for i in range(target.shape[0]):
+        if deficit[i] > 0:
+            top = np.argsort(-remainder[i])[:deficit[i]]
+            floor[i, top] += 1
+    return floor
 
 
 def fixed_matrix_label_counts(matrix) -> np.ndarray:

@@ -83,6 +83,6 @@ def evaluate(model_key: str, variables: dict, batch_size: int = 200,
                              jnp.asarray(labels))
         total_loss += float(loss)
         total_correct += int(correct)
-        n += len(labels)
+        n += int(np.asarray(labels).size)   # token-level for LM labels
     return ValResult(loss=total_loss / max(n, 1),
                      accuracy=total_correct / max(n, 1), num_samples=n)
