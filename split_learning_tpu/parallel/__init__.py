@@ -1,11 +1,20 @@
-"""Mesh planning and the compiled split-learning pipeline runtime."""
+"""Mesh planning, the compiled split-learning pipeline runtime, and the
+sequence/tensor parallel primitives."""
 
 from split_learning_tpu.parallel.mesh import make_mesh, stage_ranges
 from split_learning_tpu.parallel.pipeline import (
     PipelineModel, make_train_step, make_fedavg_step,
 )
+from split_learning_tpu.parallel.sequence import (
+    make_ring_attention_fn, ring_attention, ulysses_attention,
+)
+from split_learning_tpu.parallel.tensor import (
+    make_tp_train_step, shard_params_tp, tp_shardings, tp_spec,
+)
 
 __all__ = [
     "make_mesh", "stage_ranges", "PipelineModel", "make_train_step",
-    "make_fedavg_step",
+    "make_fedavg_step", "ring_attention", "ulysses_attention",
+    "make_ring_attention_fn", "make_tp_train_step", "shard_params_tp",
+    "tp_shardings", "tp_spec",
 ]

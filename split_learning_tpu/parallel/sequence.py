@@ -1,0 +1,152 @@
+"""Sequence/context parallelism: ring attention and Ulysses all-to-all.
+
+The reference has no long-context support at all (max 128 tokens,
+SURVEY.md §5.7); these are the TPU-native primitives that make sequence
+length a mesh axis, sized so the framework scales context the way the
+reference scales depth:
+
+* :func:`ring_attention` — each device holds one sequence block of
+  Q/K/V; K/V blocks rotate around the ``seq`` axis via
+  ``jax.lax.ppermute`` (ICI neighbor hops, overlap-friendly) while a
+  flash-style online-softmax accumulator (running max / denominator /
+  weighted sum) builds exact attention without ever materializing the
+  full (S, S) score matrix.  Causal masking uses global block offsets
+  from ``axis_index``; with ``causal=True`` fully-masked source blocks
+  still traverse the ring (the schedule is static) but contribute
+  nothing.
+* :func:`ulysses_attention` — ``jax.lax.all_to_all`` re-shards from
+  sequence-split to head-split, runs ordinary full attention locally
+  (heads are embarrassingly parallel), and re-shards back.  One
+  collective each way; preferable when n_heads >= ring size and the
+  full S fits per device memory.
+
+Both are pure functions of per-device blocks, differentiable (the
+ppermute/all_to_all transpose gives the reverse communication pattern
+automatically), and meant to be called inside ``shard_map`` over a mesh
+with a ``seq`` axis — composing with the (client, stage) pipeline mesh
+by adding the axis to the mesh tuple.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _online_block(q, k, v, mask, m, l, o, scale):
+    """One flash-attention accumulation step over a K/V block.
+
+    q: (B, Sq, H, D); k/v: (B, Sk, H, D); mask: (Sq, Sk) or None;
+    m, l: (B, H, Sq); o: (B, Sq, H, D).
+    """
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    if mask is not None:
+        s = jnp.where(mask[None, None], s, -jnp.inf)
+    m_new = jnp.maximum(m, s.max(axis=-1))
+    # fully-masked rows keep m = -inf; exp(-inf - -inf) would be NaN
+    safe_m = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
+    p = jnp.exp(s - safe_m[..., None])
+    if mask is not None:
+        p = jnp.where(mask[None, None], p, 0.0)
+    # m finite -> exponent <= 0 (safe_m >= m); m == -inf -> exp == 0.0
+    corr = jnp.exp(jnp.where(jnp.isfinite(m), m - safe_m, -jnp.inf))
+    l_new = l * corr + p.sum(axis=-1)
+    o_new = (o * corr.transpose(0, 2, 1)[..., None]
+             + jnp.einsum("bhqk,bkhd->bqhd", p, v))
+    return m_new, l_new, o_new
+
+
+def ring_attention(q, k, v, axis_name: str = "seq",
+                   causal: bool = False) -> jnp.ndarray:
+    """Exact blockwise attention over a ring of sequence shards.
+
+    Per-device shapes (B, S_block, H, D); must run inside
+    ``shard_map``/``pmap`` with ``axis_name`` defined.  Returns the local
+    output block (B, S_block, H, D).
+    """
+    n = jax.lax.axis_size(axis_name)
+    i = jax.lax.axis_index(axis_name)
+    b, s_blk, h, d = q.shape
+    scale = 1.0 / np.sqrt(d)
+    q32, k32, v32 = (t.astype(jnp.float32) for t in (q, k, v))
+
+    m = jnp.full((b, h, s_blk), -jnp.inf, jnp.float32)
+    l = jnp.zeros((b, h, s_blk), jnp.float32)
+    o = jnp.zeros((b, s_blk, h, d), jnp.float32)
+    perm = [(j, (j + 1) % n) for j in range(n)]
+
+    def body(j, carry):
+        m, l, o, k_cur, v_cur = carry
+        src = (i - j) % n            # ring position this K/V came from
+        if causal:
+            q_pos = i * s_blk + jnp.arange(s_blk)[:, None]
+            k_pos = src * s_blk + jnp.arange(s_blk)[None, :]
+            mask = k_pos <= q_pos
+        else:
+            mask = None
+        m, l, o = _online_block(q32, k_cur, v_cur, mask, m, l, o, scale)
+        k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
+        return m, l, o, k_nxt, v_nxt
+
+    carry = (m, l, o, k32, v32)
+    # static python loop: n is a mesh constant, keeps masks cheap
+    for j in range(n):
+        carry = body(j, carry)
+    _, l, o, _, _ = carry
+    denom = jnp.where(l > 0, l, 1.0).transpose(0, 2, 1)[..., None]
+    return (o / denom).astype(q.dtype)
+
+
+def ulysses_attention(q, k, v, axis_name: str = "seq",
+                      causal: bool = False) -> jnp.ndarray:
+    """All-to-all (DeepSpeed-Ulysses style) sequence parallelism.
+
+    Trades the ring's N-1 neighbor hops for two global all-to-alls:
+    re-shard (B, S/N, H, D) -> (B, S, H/N, D), run plain full attention
+    over the whole sequence on the local head group, and re-shard back.
+    Requires H divisible by the axis size.
+    """
+    n = jax.lax.axis_size(axis_name)
+    b, s_blk, h, d = q.shape
+    if h % n:
+        raise ValueError(f"heads {h} not divisible by seq axis {n}")
+    # (B, S/N, H, D) -> gather seq, scatter heads -> (B, S, H/N, D)
+    qg, kg, vg = (
+        jax.lax.all_to_all(t, axis_name, split_axis=2, concat_axis=1,
+                           tiled=True)
+        for t in (q, k, v))
+    s_full = s_blk * n
+    scale = 1.0 / np.sqrt(d)
+    scores = jnp.einsum("bqhd,bkhd->bhqk", qg.astype(jnp.float32),
+                        kg.astype(jnp.float32)) * scale
+    if causal:
+        mask = jnp.tril(jnp.ones((s_full, s_full), bool))
+        scores = jnp.where(mask[None, None], scores, -1e30)
+    probs = jax.nn.softmax(scores)
+    out = jnp.einsum("bhqk,bkhd->bqhd", probs, vg.astype(jnp.float32))
+    # (B, S, H/N, D) -> scatter seq, gather heads -> (B, S/N, H, D)
+    out = jax.lax.all_to_all(out, axis_name, split_axis=1, concat_axis=2,
+                             tiled=True)
+    return out.astype(q.dtype)
+
+
+def make_ring_attention_fn(mesh, axis_name: str = "seq",
+                           causal: bool = False, impl: str = "ring"):
+    """shard_map-wrapped callable over full (B, S, H, D) arrays sharded
+    along ``axis_name`` on dim 1."""
+    from jax.sharding import PartitionSpec as P
+
+    if impl not in ("ring", "ulysses"):
+        raise ValueError(f"unknown impl {impl!r}; use ring|ulysses")
+    fn = ring_attention if impl == "ring" else ulysses_attention
+    spec = P(None, axis_name)
+
+    def local(q, k, v):
+        return fn(q, k, v, axis_name=axis_name, causal=causal)
+
+    mapped = jax.shard_map(local, mesh=mesh,
+                           in_specs=(spec, spec, spec), out_specs=spec,
+                           check_vma=False)
+    return jax.jit(mapped)

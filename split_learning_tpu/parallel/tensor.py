@@ -1,0 +1,114 @@
+"""Tensor parallelism: GSPMD param-sharding rules over a ``model`` axis.
+
+The reference has no tensor parallelism (SURVEY.md §2.2) — this is the
+fresh TPU-native design: instead of manual collectives, parameters are
+annotated with Megatron-style ``PartitionSpec``s and ``jit`` lets XLA
+insert the all-gathers/reduce-scatters (the GSPMD recipe from the
+scaling-book):
+
+* column-parallel kernels (q/k/v, FFN up/gate) shard their OUTPUT dim —
+  the following elementwise work stays local;
+* row-parallel kernels (attention out, FFN down) shard their INPUT dim —
+  XLA emits one psum after the matmul pair;
+* embeddings shard the feature dim; norms/bias-only layers replicate.
+
+Annotations are layout hints, not math: a miss-listed layer still
+computes correctly, it just replicates.  The rules operate on param-path
+names, so they compose with the split-layer models (a shard's subtree
+annotates the same way) and stack with the (cluster, client, stage)
+mesh axes — TP is just one more axis in the mesh tuple.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+#: kernels whose OUTPUT dim is sharded (column parallel)
+COLUMN_PARALLEL = frozenset({
+    "query", "key", "value", "q_proj", "k_proj", "v_proj",
+    "gate_proj", "up_proj", "intermediate", "mlp_in",
+})
+#: kernels whose INPUT dim is sharded (row parallel)
+ROW_PARALLEL = frozenset({
+    "out", "o_proj", "down_proj", "output", "mlp_out",
+})
+
+
+def _names(path) -> list:
+    out = []
+    for p in path:
+        out.append(str(p.key) if hasattr(p, "key") else str(p))
+    return out
+
+
+def tp_spec(path, leaf, axis: str = "model") -> P:
+    """PartitionSpec for one param leaf under tensor parallelism."""
+    names = _names(path)
+    ndim = np.ndim(leaf)
+    leafname = names[-1] if names else ""
+    in_col = any(n in COLUMN_PARALLEL for n in names)
+    in_row = any(n in ROW_PARALLEL for n in names)
+    if leafname == "kernel" and ndim >= 2:
+        if in_col:   # e.g. (in, heads, head_dim) / (in, out): shard out
+            return P(*([None] * (ndim - 1) + [axis])) if ndim == 2 \
+                else P(None, axis, *([None] * (ndim - 2)))
+        if in_row:   # e.g. (heads, head_dim, out) / (in, out): shard in
+            return P(axis, *([None] * (ndim - 1)))
+    if leafname == "bias" and in_col and ndim >= 1:
+        # column-parallel bias lives with the sharded output features
+        return P(axis, *([None] * (ndim - 1)))
+    if leafname == "embedding" and ndim == 2:
+        return P(None, axis)   # features sharded, vocab gather local
+    return P()
+
+
+def tp_shardings(params, mesh: Mesh, axis: str = "model"):
+    """NamedSharding pytree for a param tree (pass to device_put or as
+    jit in_shardings)."""
+    return jax.tree_util.tree_map_with_path(
+        lambda path, leaf: NamedSharding(mesh, tp_spec(path, leaf, axis)),
+        params)
+
+
+def shard_params_tp(params, mesh: Mesh, axis: str = "model"):
+    """Place a param tree onto the mesh under the TP rules."""
+    return jax.tree_util.tree_map(
+        jax.device_put, params, tp_shardings(params, mesh, axis))
+
+
+def make_tp_train_step(model, optimizer, mesh: Mesh,
+                       axis: str = "model", dp_axis: str | None = None):
+    """Jitted TP(+DP) train step for a full (unsplit) model.
+
+    Params/opt state are TP-sharded; the batch shards over ``dp_axis``
+    (replicated if None).  XLA derives every collective: all-gather for
+    column-parallel outputs feeding replicated ops, psum closing each
+    row-parallel matmul, and the DP gradient mean.
+    """
+    import jax.numpy as jnp
+    import optax
+
+    data_spec = P(dp_axis) if dp_axis else P()
+    data_sh = NamedSharding(mesh, data_spec)
+
+    def step(params, opt_state, x, labels, rng):
+        def loss_fn(p):
+            out = model.apply({"params": p}, x, train=True,
+                              rngs={"dropout": rng})
+            return optax.softmax_cross_entropy_with_integer_labels(
+                out.astype(jnp.float32), labels).mean()
+        lval, grads = jax.value_and_grad(loss_fn)(params)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, lval
+
+    def place(params, opt_state, x, labels, rng):
+        return step(params, opt_state,
+                    jax.lax.with_sharding_constraint(x, data_sh),
+                    jax.lax.with_sharding_constraint(labels, data_sh),
+                    rng)
+
+    return jax.jit(place, donate_argnums=(0, 1))

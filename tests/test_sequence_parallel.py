@@ -1,0 +1,84 @@
+"""Sequence parallelism: ring / Ulysses attention vs the exact full
+softmax attention, forward and backward, on the 8-device seq mesh."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from split_learning_tpu.parallel.sequence import (
+    make_ring_attention_fn, ring_attention, ulysses_attention,
+)
+
+
+def full_attention(q, k, v, causal=False):
+    d = q.shape[-1]
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(d)
+    if causal:
+        n = q.shape[1]
+        s = jnp.where(jnp.tril(jnp.ones((n, n), bool))[None, None],
+                      s, -jnp.inf)
+    p = jax.nn.softmax(s)
+    return jnp.einsum("bhqk,bkhd->bqhd", p, v)
+
+
+@pytest.fixture(scope="module")
+def seq_mesh(eight_devices):
+    return Mesh(np.array(eight_devices), ("seq",))
+
+
+def _qkv(key, b=2, s=32, h=8, d=8):
+    ks = jax.random.split(key, 3)
+    return tuple(jax.random.normal(k, (b, s, h, d)) for k in ks)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("impl", ["ring", "ulysses"])
+def test_matches_full_attention(seq_mesh, impl, causal):
+    q, k, v = _qkv(jax.random.key(0))
+    ref = full_attention(q, k, v, causal=causal)
+    fn = make_ring_attention_fn(seq_mesh, causal=causal, impl=impl)
+    shard = NamedSharding(seq_mesh, P(None, "seq"))
+    out = fn(*(jax.device_put(t, shard) for t in (q, k, v)))
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+@pytest.mark.parametrize("impl", ["ring", "ulysses"])
+def test_gradients_match_full_attention(seq_mesh, impl):
+    """d(loss)/d(q,k,v) through the collective schedule == dense grads."""
+    q, k, v = _qkv(jax.random.key(1), s=16)
+
+    def dense_loss(q, k, v):
+        return (full_attention(q, k, v, causal=True) ** 2).sum()
+
+    impl_fn = ring_attention if impl == "ring" else ulysses_attention
+
+    def ring_loss(q, k, v):
+        def local(q, k, v):
+            out = impl_fn(q, k, v, causal=True)
+            return jax.lax.psum((out.astype(jnp.float32) ** 2).sum(),
+                                "seq")
+        spec = P(None, "seq")
+        return jax.shard_map(local, mesh=seq_mesh,
+                             in_specs=(spec,) * 3, out_specs=P(),
+                             check_vma=False)(q, k, v)
+
+    g_ref = jax.grad(dense_loss, argnums=(0, 1, 2))(q, k, v)
+    g_par = jax.jit(jax.grad(ring_loss, argnums=(0, 1, 2)))(q, k, v)
+    for a, b in zip(g_ref, g_par):
+        np.testing.assert_allclose(np.asarray(b), np.asarray(a),
+                                   rtol=5e-4, atol=5e-4)
+
+
+def test_ring_attention_long_context_block_memory(seq_mesh):
+    """The ring path never builds the (S, S) matrix: per-device peak is
+    (S_blk, S_blk). Smoke at S=1024 over 8 devices (128 per block)."""
+    q, k, v = _qkv(jax.random.key(2), b=1, s=1024, h=2, d=8)
+    fn = make_ring_attention_fn(seq_mesh, causal=True, impl="ring")
+    shard = NamedSharding(seq_mesh, P(None, "seq"))
+    out = fn(*(jax.device_put(t, shard) for t in (q, k, v)))
+    ref = full_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
