@@ -46,11 +46,17 @@ def _rope(x: jnp.ndarray, positions: jnp.ndarray,
 
 
 class LlamaAttention(nn.Module):
-    """Causal GQA with RoPE."""
+    """Causal GQA with RoPE.
+
+    ``use_flash`` routes the score/softmax/value contraction through the
+    fused Pallas kernel (``ops/flash_attention.py``) instead of the
+    XLA einsum path — same math, O(S) memory.
+    """
     hidden_size: int
     num_heads: int
     num_kv_heads: int
     dtype: jnp.dtype = jnp.float32
+    use_flash: bool = False
 
     @nn.compact
     def __call__(self, x):
@@ -72,11 +78,18 @@ class LlamaAttention(nn.Module):
             k = jnp.repeat(k, rep, axis=2)
             v = jnp.repeat(v, rep, axis=2)
 
-        scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(hd)
-        mask = jnp.tril(jnp.ones((s, s), bool))
-        scores = jnp.where(mask[None, None], scores, -1e30)
-        probs = nn.softmax(scores.astype(jnp.float32)).astype(self.dtype)
-        out = jnp.einsum("bhqk,bkhd->bqhd", probs, v).reshape(b, s, -1)
+        if self.use_flash:
+            from split_learning_tpu.ops.flash_attention import (
+                flash_attention,
+            )
+            out = flash_attention(q, k, v, causal=True).reshape(b, s, -1)
+        else:
+            scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(hd)
+            mask = jnp.tril(jnp.ones((s, s), bool))
+            scores = jnp.where(mask[None, None], scores, -1e30)
+            probs = nn.softmax(
+                scores.astype(jnp.float32)).astype(self.dtype)
+            out = jnp.einsum("bhqk,bkhd->bqhd", probs, v).reshape(b, s, -1)
         return dense(self.hidden_size, name="o_proj")(out)
 
 
@@ -87,6 +100,7 @@ class LlamaBlock(nn.Module):
     num_kv_heads: int
     intermediate_size: int
     dtype: jnp.dtype = jnp.float32
+    use_flash: bool = False
 
     @nn.compact
     def __call__(self, x):
@@ -95,7 +109,7 @@ class LlamaBlock(nn.Module):
         x = x + LlamaAttention(
             hidden_size=self.hidden_size, num_heads=self.num_heads,
             num_kv_heads=self.num_kv_heads, dtype=self.dtype,
-            name="attention")(h)
+            use_flash=self.use_flash, name="attention")(h)
         h = nn.RMSNorm(epsilon=1e-5, dtype=self.dtype,
                        name="post_norm")(x)
         dense = functools.partial(nn.Dense, use_bias=False,
@@ -108,7 +122,7 @@ class LlamaBlock(nn.Module):
 def _llama_specs(vocab_size: int = 32000, hidden_size: int = 2048,
                  num_heads: int = 32, num_kv_heads: int = 4,
                  intermediate_size: int = 5632, n_block: int = 22,
-                 dtype=jnp.float32) -> tuple:
+                 use_flash: bool = False, dtype=jnp.float32) -> tuple:
     specs = [LayerSpec("layer1", make=functools.partial(
         nn.Embed, num_embeddings=vocab_size, features=hidden_size,
         dtype=dtype), fn=_plain_fn)]
@@ -118,7 +132,8 @@ def _llama_specs(vocab_size: int = 32000, hidden_size: int = 2048,
             make=functools.partial(
                 LlamaBlock, hidden_size=hidden_size, num_heads=num_heads,
                 num_kv_heads=num_kv_heads,
-                intermediate_size=intermediate_size, dtype=dtype),
+                intermediate_size=intermediate_size, use_flash=use_flash,
+                dtype=dtype),
             fn=_plain_fn))
     specs.append(LayerSpec(f"layer{2 + n_block}",
                            make=functools.partial(nn.RMSNorm, epsilon=1e-5,

@@ -21,8 +21,6 @@ mesh axes — TP is just one more axis in the mesh tuple.
 
 from __future__ import annotations
 
-from typing import Sequence
-
 import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P

@@ -28,3 +28,28 @@ def eight_devices():
     devs = jax.devices()
     assert len(devs) >= 8, f"expected 8 virtual devices, got {len(devs)}"
     return devs
+
+
+def dense_attention(q, k, v, causal=False):
+    """Reference full-softmax attention oracle shared by the flash /
+    ring / ulysses parity tests ((B, S, H, D) layout, fp32 compute)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    d = q.shape[-1]
+    s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) / np.sqrt(d)
+    if causal:
+        n = q.shape[1]
+        s = jnp.where(jnp.tril(jnp.ones((n, n), bool))[None, None],
+                      s, -jnp.inf)
+    p = jax.nn.softmax(s)
+    return jnp.einsum("bhqk,bkhd->bqhd", p,
+                      v.astype(jnp.float32)).astype(q.dtype)
+
+
+def qkv_batch(key, b=2, s=32, h=8, d=8):
+    import jax
+    ks = jax.random.split(key, 3)
+    return tuple(jax.random.normal(k, (b, s, h, d)) for k in ks)

@@ -10,17 +10,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from split_learning_tpu.parallel.sequence import (
     make_ring_attention_fn, ring_attention, ulysses_attention,
 )
-
-
-def full_attention(q, k, v, causal=False):
-    d = q.shape[-1]
-    s = jnp.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(d)
-    if causal:
-        n = q.shape[1]
-        s = jnp.where(jnp.tril(jnp.ones((n, n), bool))[None, None],
-                      s, -jnp.inf)
-    p = jax.nn.softmax(s)
-    return jnp.einsum("bhqk,bkhd->bqhd", p, v)
+from tests.conftest import dense_attention as full_attention, qkv_batch
 
 
 @pytest.fixture(scope="module")
@@ -28,9 +18,7 @@ def seq_mesh(eight_devices):
     return Mesh(np.array(eight_devices), ("seq",))
 
 
-def _qkv(key, b=2, s=32, h=8, d=8):
-    ks = jax.random.split(key, 3)
-    return tuple(jax.random.normal(k, (b, s, h, d)) for k in ks)
+_qkv = qkv_batch
 
 
 @pytest.mark.parametrize("causal", [False, True])

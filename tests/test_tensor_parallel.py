@@ -10,7 +10,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from split_learning_tpu.models import build_model
 from split_learning_tpu.parallel.tensor import (
-    make_tp_train_step, shard_params_tp, tp_spec, tp_shardings,
+    make_tp_train_step, shard_params_tp, tp_spec,
 )
 
 TINY_LLAMA = dict(vocab_size=128, hidden_size=32, num_heads=4,
