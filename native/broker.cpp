@@ -1,0 +1,342 @@
+// slt_broker — native message broker for the split-learning control and
+// data plane.
+//
+// The reference deployment depends on an external RabbitMQ broker (an
+// Erlang process, /root/reference/README.md:43-69); this is the
+// framework's own native equivalent: a single-threaded poll(2) event
+// loop speaking the same length-prefixed frame protocol as the Python
+// Transport (split_learning_tpu/runtime/bus.py):
+//
+//   request:  op(1) | name_len(4 BE) | name | payload_len(8 BE) | payload
+//     op 'P' publish: name = queue, payload = message bytes
+//     op 'G' get:     name = queue, payload = 8-byte BE timeout ms
+//                     (0 = block forever)
+//     op 'X' purge:   payload = comma-separated queue names ("" = all)
+//   reply ('G' only): 'R' | 0(4 BE) | payload_len(8 BE) | payload
+//     timeout signalled by payload_len == 0xFFFFFFFFFFFFFFFF, no bytes.
+//
+// Blocking GETs park the connection on a FIFO waiter list per queue;
+// a PUBLISH hands the message straight to the oldest live waiter
+// (never touching the queue), so latency under load is one event-loop
+// turn.  One outstanding request per connection (the Python client
+// serializes under a lock), messages delivered at-least-once in FIFO
+// order per queue.
+//
+// Build: g++ -O2 -std=c++17 -o slt_broker broker.cpp
+// Run:   slt_broker [port]   (0 = ephemeral; prints "LISTENING <port>")
+
+#include <arpa/inet.h>
+#include <fcntl.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <poll.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <cerrno>
+#include <chrono>
+#include <cstdint>
+#include <cstdlib>
+#include <cstdio>
+#include <cstring>
+#include <deque>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+constexpr uint64_t kTimeoutSentinel = 0xFFFFFFFFFFFFFFFFull;
+// Hard sanity caps: a desynced client must kill its connection, not the
+// broker (length arithmetic is checked against these before any alloc).
+constexpr uint64_t kMaxName = 1 << 16;         // 64 KiB queue name
+constexpr uint64_t kMaxPayload = 1ull << 32;   // 4 GiB message
+
+double now_s() {
+  return std::chrono::duration<double>(
+             std::chrono::steady_clock::now().time_since_epoch())
+      .count();
+}
+
+struct Conn {
+  int fd = -1;
+  std::vector<uint8_t> in;   // partial inbound frame bytes
+  std::deque<uint8_t> out;   // pending outbound bytes
+  bool waiting = false;      // parked on a blocking GET
+  std::string wait_queue;
+  double deadline = 0.0;     // 0 = no deadline (wait forever)
+  bool dead = false;
+};
+
+struct Broker {
+  std::unordered_map<int, Conn> conns;
+  std::unordered_map<std::string, std::deque<std::string>> queues;
+  // FIFO of fds parked on each queue
+  std::unordered_map<std::string, std::deque<int>> waiters;
+
+  static void put32(std::string* b, uint32_t v) {
+    uint32_t n = htonl(v);
+    b->append(reinterpret_cast<char*>(&n), 4);
+  }
+  static void put64(std::string* b, uint64_t v) {
+    for (int i = 7; i >= 0; --i)
+      b->push_back(static_cast<char>((v >> (8 * i)) & 0xff));
+  }
+  static uint32_t get32(const uint8_t* p) {
+    uint32_t v;
+    memcpy(&v, p, 4);
+    return ntohl(v);
+  }
+  static uint64_t get64(const uint8_t* p) {
+    uint64_t v = 0;
+    for (int i = 0; i < 8; ++i) v = (v << 8) | p[i];
+    return v;
+  }
+
+  void send_reply(Conn* c, const std::string* payload) {
+    std::string frame;
+    frame.push_back('R');
+    put32(&frame, 0);
+    if (payload == nullptr) {
+      put64(&frame, kTimeoutSentinel);
+    } else {
+      put64(&frame, payload->size());
+      frame += *payload;
+    }
+    c->out.insert(c->out.end(), frame.begin(), frame.end());
+  }
+
+  // Deliver one message to the oldest live waiter of `queue`.
+  // Returns false if no live waiter took it.
+  bool hand_to_waiter(const std::string& queue, const std::string& msg) {
+    auto it = waiters.find(queue);
+    if (it == waiters.end()) return false;
+    auto& fifo = it->second;
+    while (!fifo.empty()) {
+      int fd = fifo.front();
+      fifo.pop_front();
+      auto cit = conns.find(fd);
+      if (cit == conns.end() || cit->second.dead ||
+          !cit->second.waiting || cit->second.wait_queue != queue)
+        continue;
+      cit->second.waiting = false;
+      send_reply(&cit->second, &msg);
+      return true;
+    }
+    return false;
+  }
+
+  void handle_frame(Conn* c, uint8_t op, std::string name,
+                    std::string payload) {
+    if (op == 'P') {
+      if (!hand_to_waiter(name, payload))
+        queues[name].push_back(std::move(payload));
+    } else if (op == 'G') {
+      uint64_t ms = payload.size() >= 8
+                        ? get64(reinterpret_cast<const uint8_t*>(
+                              payload.data()))
+                        : 0;
+      auto qit = queues.find(name);
+      if (qit != queues.end() && !qit->second.empty()) {
+        send_reply(c, &qit->second.front());
+        qit->second.pop_front();
+      } else {
+        c->waiting = true;
+        c->wait_queue = name;
+        c->deadline = ms == 0 ? 0.0 : now_s() + ms / 1000.0;
+        waiters[name].push_back(c->fd);
+      }
+    } else if (op == 'X') {
+      if (payload.empty()) {
+        queues.clear();
+      } else {
+        size_t start = 0;
+        while (start <= payload.size()) {
+          size_t comma = payload.find(',', start);
+          std::string q = payload.substr(
+              start, comma == std::string::npos ? std::string::npos
+                                                : comma - start);
+          queues.erase(q);
+          if (comma == std::string::npos) break;
+          start = comma + 1;
+        }
+      }
+    }
+  }
+
+  // Parse as many complete frames as `c->in` holds.
+  void drain_input(Conn* c) {
+    size_t off = 0;
+    while (true) {
+      const size_t have = c->in.size() - off;
+      if (have < 1 + 4) break;
+      const uint8_t* p = c->in.data() + off;
+      uint32_t nlen = get32(p + 1);
+      if (nlen > kMaxName) {        // desynced/hostile framing
+        c->dead = true;
+        break;
+      }
+      if (have < 1 + 4 + nlen + 8) break;
+      uint64_t plen = get64(p + 1 + 4 + nlen);
+      if (plen == kTimeoutSentinel) plen = 0;
+      if (plen > kMaxPayload) {     // also guards length-sum overflow
+        c->dead = true;
+        break;
+      }
+      if (have < 1 + 4 + nlen + 8 + plen) break;
+      std::string name(reinterpret_cast<const char*>(p + 5), nlen);
+      std::string payload(
+          reinterpret_cast<const char*>(p + 5 + nlen + 8), plen);
+      uint8_t op = p[0];
+      off += 1 + 4 + nlen + 8 + plen;
+      handle_frame(c, op, std::move(name), std::move(payload));
+    }
+    if (off > 0) c->in.erase(c->in.begin(), c->in.begin() + off);
+  }
+
+  void remove_waiter(int fd, const std::string& queue) {
+    auto it = waiters.find(queue);
+    if (it == waiters.end()) return;
+    auto& fifo = it->second;
+    for (auto w = fifo.begin(); w != fifo.end(); ++w) {
+      if (*w == fd) {
+        fifo.erase(w);
+        break;
+      }
+    }
+    if (fifo.empty()) waiters.erase(it);
+  }
+
+  void expire_waiters() {
+    double t = now_s();
+    for (auto& [fd, c] : conns) {
+      if (c.waiting && c.deadline > 0.0 && t >= c.deadline) {
+        c.waiting = false;
+        remove_waiter(fd, c.wait_queue);
+        send_reply(&c, nullptr);
+      }
+    }
+  }
+
+  int poll_timeout_ms() const {
+    double best = -1.0;
+    double t = now_s();
+    for (const auto& [fd, c] : conns) {
+      if (c.waiting && c.deadline > 0.0) {
+        double remain = c.deadline - t;
+        if (remain < 0) remain = 0;
+        if (best < 0 || remain < best) best = remain;
+      }
+    }
+    if (best < 0) return 1000;
+    int ms = static_cast<int>(best * 1000) + 1;
+    return ms > 1000 ? 1000 : ms;
+  }
+};
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  int port = argc > 1 ? atoi(argv[1]) : 5672;
+
+  int lfd = socket(AF_INET, SOCK_STREAM, 0);
+  if (lfd < 0) return perror("socket"), 1;
+  int one = 1;
+  setsockopt(lfd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(INADDR_LOOPBACK);
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  if (bind(lfd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) < 0)
+    return perror("bind"), 1;
+  if (listen(lfd, 128) < 0) return perror("listen"), 1;
+  socklen_t alen = sizeof(addr);
+  getsockname(lfd, reinterpret_cast<sockaddr*>(&addr), &alen);
+  printf("LISTENING %d\n", ntohs(addr.sin_port));
+  fflush(stdout);
+
+  Broker broker;
+  std::vector<pollfd> pfds;
+  std::vector<uint8_t> buf(1 << 20);
+
+  while (true) {
+    pfds.clear();
+    pfds.push_back({lfd, POLLIN, 0});
+    for (auto& [fd, c] : broker.conns) {
+      short ev = POLLIN;
+      if (!c.out.empty()) ev |= POLLOUT;
+      pfds.push_back({fd, ev, 0});
+    }
+    int rc = poll(pfds.data(), pfds.size(), broker.poll_timeout_ms());
+    if (rc < 0) {
+      if (errno == EINTR) continue;
+      return perror("poll"), 1;
+    }
+    broker.expire_waiters();
+
+    if (pfds[0].revents & POLLIN) {
+      int cfd = accept(lfd, nullptr, nullptr);
+      if (cfd >= 0) {
+        setsockopt(cfd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+        fcntl(cfd, F_SETFL, fcntl(cfd, F_GETFL, 0) | O_NONBLOCK);
+        Conn c;
+        c.fd = cfd;
+        broker.conns.emplace(cfd, std::move(c));
+      }
+    }
+
+    std::vector<int> closed;
+    for (size_t i = 1; i < pfds.size(); ++i) {
+      auto it = broker.conns.find(pfds[i].fd);
+      if (it == broker.conns.end()) continue;
+      Conn& c = it->second;
+      if (pfds[i].revents & (POLLERR | POLLHUP)) {
+        c.dead = true;
+        closed.push_back(c.fd);
+        continue;
+      }
+      if (pfds[i].revents & POLLIN) {
+        // drain everything available (non-blocking socket)
+        while (true) {
+          ssize_t n = read(c.fd, buf.data(), buf.size());
+          if (n > 0) {
+            c.in.insert(c.in.end(), buf.data(), buf.data() + n);
+            if (static_cast<size_t>(n) < buf.size()) break;
+            continue;
+          }
+          if (n < 0 && (errno == EAGAIN || errno == EWOULDBLOCK)) break;
+          c.dead = true;
+          closed.push_back(c.fd);
+          break;
+        }
+        if (!c.dead) broker.drain_input(&c);
+      }
+    }
+    // flush every connection with pending output NOW — replies created
+    // this iteration must not wait out the next poll timeout
+    for (auto& [fd, c] : broker.conns) {
+      while (!c.dead && !c.out.empty()) {
+        std::vector<uint8_t> chunk(c.out.begin(),
+                                   c.out.begin() +
+                                       std::min(c.out.size(), buf.size()));
+        ssize_t n = write(c.fd, chunk.data(), chunk.size());
+        if (n > 0) {
+          c.out.erase(c.out.begin(), c.out.begin() + n);
+          continue;
+        }
+        if (n < 0 && (errno == EAGAIN || errno == EWOULDBLOCK))
+          break;  // kernel buffer full: POLLOUT will resume it
+        c.dead = true;
+        closed.push_back(c.fd);
+        break;
+      }
+    }
+    for (int fd : closed) {
+      auto it = broker.conns.find(fd);
+      if (it != broker.conns.end() && it->second.waiting)
+        broker.remove_waiter(fd, it->second.wait_queue);
+      close(fd);
+      broker.conns.erase(fd);
+    }
+  }
+}

@@ -1,0 +1,76 @@
+"""Native runtime components (C++), built on demand with the system
+toolchain.
+
+``NativeBroker`` wraps ``native/broker.cpp`` — the framework's native
+message broker (the role RabbitMQ plays for the reference,
+``/root/reference/README.md:43-69``): compile (cached by source mtime),
+spawn as a subprocess, parse the bound port, and manage lifetime.  The
+Python ``TcpTransport`` speaks to it unchanged; ``python -m
+split_learning_tpu.broker`` prefers it and falls back to the threaded
+Python broker when no compiler is available.
+"""
+
+from __future__ import annotations
+
+import pathlib
+import shutil
+import subprocess
+
+_ROOT = pathlib.Path(__file__).resolve().parents[2]
+_SRC = _ROOT / "native" / "broker.cpp"
+_BIN_DIR = _ROOT / "native" / "bin"
+_BIN = _BIN_DIR / "slt_broker"
+
+
+class NativeBuildError(RuntimeError):
+    pass
+
+
+def build_broker(force: bool = False) -> pathlib.Path:
+    """Compile the broker if the cached binary is missing or stale."""
+    if not _SRC.exists():
+        raise NativeBuildError(f"missing source {_SRC}")
+    if not force and _BIN.exists() \
+            and _BIN.stat().st_mtime >= _SRC.stat().st_mtime:
+        return _BIN
+    gxx = shutil.which("g++") or shutil.which("clang++")
+    if gxx is None:
+        raise NativeBuildError("no C++ compiler on PATH")
+    _BIN_DIR.mkdir(parents=True, exist_ok=True)
+    cmd = [gxx, "-O2", "-std=c++17", "-o", str(_BIN), str(_SRC)]
+    proc = subprocess.run(cmd, capture_output=True, text=True)
+    if proc.returncode != 0:
+        raise NativeBuildError(
+            f"broker build failed:\n{proc.stderr[-2000:]}")
+    return _BIN
+
+
+class NativeBroker:
+    """A running native broker subprocess."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        if host not in ("127.0.0.1", "localhost"):
+            raise NativeBuildError("native broker binds loopback only")
+        binary = build_broker()
+        self._proc = subprocess.Popen(
+            [str(binary), str(port)], stdout=subprocess.PIPE, text=True)
+        line = self._proc.stdout.readline().strip()
+        if not line.startswith("LISTENING "):
+            self._proc.kill()
+            raise NativeBuildError(f"unexpected broker banner {line!r}")
+        self.host = host
+        self.port = int(line.split()[1])
+
+    def close(self):
+        if self._proc.poll() is None:
+            self._proc.terminate()
+            try:
+                self._proc.wait(timeout=5)
+            except subprocess.TimeoutExpired:
+                self._proc.kill()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
