@@ -31,6 +31,15 @@ from jax.experimental import pallas as pl
 NEG_INF = -1e30
 
 
+def _pick_precision(dtype):
+    """Full-f32 MXU accumulation for genuinely-f32 inputs (the MXU's
+    native multiply is bf16; DEFAULT would silently truncate); bf16
+    inputs keep the fast single-pass path.  Forward and backward MUST
+    agree or gradients desync from the primal."""
+    return (jax.lax.Precision.HIGHEST if dtype == jnp.float32
+            else jax.lax.Precision.DEFAULT)
+
+
 def _pick_block(s: int, target: int = 128) -> int:
     """Largest divisor of s that is <= target (TPU-friendly when s is a
     multiple of 128; exact fallback for small/odd test shapes)."""
@@ -41,7 +50,7 @@ def _pick_block(s: int, target: int = 128) -> int:
 
 
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, causal: bool,
-                scale: float, block_q: int):
+                scale: float, block_q: int, precision):
     qi = pl.program_id(1)
     q = q_ref[0].astype(jnp.float32) * scale          # (block_q, D)
     s_total = k_ref.shape[1]
@@ -62,7 +71,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, causal: bool,
         v = v_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32)        # (block_q, block_k)
+            preferred_element_type=jnp.float32,
+            precision=precision)                       # (block_q, block_k)
         if causal:
             q_pos = qi * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, s.shape, 0)
@@ -75,7 +85,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, causal: bool,
         l_new = l * corr + p.sum(axis=-1, keepdims=True)
         acc_new = acc * corr + jax.lax.dot_general(
             p, v, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
+            preferred_element_type=jnp.float32, precision=precision)
         return m_new, l_new, acc_new
 
     m, l, acc = jax.lax.fori_loop(0, nk_eff, body, (m0, l0, acc0))
@@ -88,9 +98,10 @@ def _flash_fwd_bhsd(q, k, v, causal: bool, interpret: bool,
     bh, s, d = q.shape
     scale = 1.0 / np.sqrt(d)
     grid = (bh, s // block_q)
+    precision = _pick_precision(q.dtype)
     kernel = functools.partial(_fwd_kernel, block_k=block_k,
                                causal=causal, scale=scale,
-                               block_q=block_q)
+                               block_q=block_q, precision=precision)
     return pl.pallas_call(
         kernel,
         out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
@@ -120,6 +131,7 @@ def _flash_bwd_rule(causal, interpret, block_q, block_k, res, do):
     q, k, v, o = res
     bh, s, d = q.shape
     scale = 1.0 / np.sqrt(d)
+    prec = _pick_precision(q.dtype)
     q32, k32, v32 = (t.astype(jnp.float32) for t in (q, k, v))
     do32, o32 = do.astype(jnp.float32), o.astype(jnp.float32)
 
@@ -131,7 +143,7 @@ def _flash_bwd_rule(causal, interpret, block_q, block_k, res, do):
         kblk = jax.lax.dynamic_slice_in_dim(k32, kb * block_k, block_k, 1)
         sblk = jax.lax.dot_general(
             q32, kblk, (((2,), (2,)), ((0,), (0,))),
-            preferred_element_type=jnp.float32) * scale
+            preferred_element_type=jnp.float32, precision=prec) * scale
         if causal:
             q_pos = jnp.arange(s)[:, None]
             k_pos = kb * block_k + jnp.arange(block_k)[None, :]
@@ -152,22 +164,25 @@ def _flash_bwd_rule(causal, interpret, block_q, block_k, res, do):
         vblk = jax.lax.dynamic_slice_in_dim(v32, kb * block_k, block_k, 1)
         sblk = jax.lax.dot_general(
             q32, kblk, (((2,), (2,)), ((0,), (0,))),
-            preferred_element_type=jnp.float32) * scale
+            preferred_element_type=jnp.float32, precision=prec) * scale
         if causal:
             q_pos = jnp.arange(s)[:, None]
             k_pos = kb * block_k + jnp.arange(block_k)[None, :]
             sblk = jnp.where((k_pos <= q_pos)[None], sblk, NEG_INF)
         p = jnp.exp(sblk - m[..., None]) / l[..., None]  # (BH, S, bk)
         dv = jax.lax.dot_general(p, do32, (((1,), (1,)), ((0,), (0,))),
-                                 preferred_element_type=jnp.float32)
+                                 preferred_element_type=jnp.float32,
+                                 precision=prec)
         dp = jax.lax.dot_general(do32, vblk, (((2,), (2,)), ((0,), (0,))),
-                                 preferred_element_type=jnp.float32)
+                                 preferred_element_type=jnp.float32,
+                                 precision=prec)
         ds = p * (dp - delta[..., None]) * scale
         dq = dq + jax.lax.dot_general(
             ds, kblk, (((2,), (1,)), ((0,), (0,))),
-            preferred_element_type=jnp.float32)
+            preferred_element_type=jnp.float32, precision=prec)
         dk = jax.lax.dot_general(ds, q32, (((1,), (1,)), ((0,), (0,))),
-                                 preferred_element_type=jnp.float32)
+                                 preferred_element_type=jnp.float32,
+                                 precision=prec)
         return dq, (dk, dv)
 
     dq, (dk_blocks, dv_blocks) = jax.lax.scan(
