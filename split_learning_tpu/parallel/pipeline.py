@@ -24,9 +24,10 @@ handled with ``lax.switch`` over per-stage branches; activations cross the
 wire flattened and padded to the largest boundary so every device runs the
 same collective.  Parameters are replicated along ``stage`` (each device
 holds the full model, uses only its stage's slice; gradients are psum'd
-over ``stage`` to keep replicas in sync).  This is the fully-general path —
-a stacked-parameter homogeneous path for big transformer models lives in
-:mod:`split_learning_tpu.parallel.stacked` (memory O(params/S) per device).
+over ``stage`` to keep replicas in sync) — the fully-general path for
+arbitrary heterogeneous cuts.  Big homogeneous transformer models should
+additionally shard parameters along ``model`` (tensor parallelism,
+:mod:`split_learning_tpu.parallel.tensor`) to cut per-device memory.
 
 Semantic note: the reference steps the optimizer once per in-flight batch
 with stale weights (async pipelining); here microbatch gradients are

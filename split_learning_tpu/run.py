@@ -43,6 +43,11 @@ def synthesize_registrations(cfg: Config,
 def run_local(cfg: Config, devices=None,
               logger: Logger | None = None,
               profiles: dict | None = None) -> TrainResult:
+    from split_learning_tpu.parallel.multihost import ensure_initialized
+    if ensure_initialized():
+        import jax
+        print(f"multi-host: process {jax.process_index()}"
+              f"/{jax.process_count()}")
     logger = logger or Logger(cfg.log_path, debug=cfg.debug)
     regs = synthesize_registrations(cfg, profiles)
     plans = plan_clusters(cfg, regs)

@@ -94,8 +94,18 @@ class TrainContext:
 class MeshContext(TrainContext):
     """In-process compiled-mesh backend."""
 
+    #: ProtocolContext overrides: remote ShardRunner clients train LoRA
+    supports_lora = False
+
     def __init__(self, cfg: Config, devices=None):
         self.cfg = cfg
+        if cfg.learning.lora_rank > 0 and not self.supports_lora:
+            # adapters are a protocol-client feature so far; training full
+            # params here would silently diverge from the config's intent
+            raise NotImplementedError(
+                "learning.lora_rank > 0 is supported by the multi-process "
+                "protocol backend (python -m split_learning_tpu.server/"
+                ".client), not by the in-process mesh backend yet")
         self.devices = list(devices if devices is not None
                             else jax.devices())
         self.model_kwargs = dict(cfg.model_kwargs or {})

@@ -23,6 +23,7 @@ from split_learning_tpu.runtime.context import TrainContext
 from split_learning_tpu.runtime.log import Logger
 from split_learning_tpu.runtime.plan import ClusterPlan
 from split_learning_tpu.runtime.strategies import make_strategy
+from split_learning_tpu.runtime.trace import StepTimer
 
 
 @dataclasses.dataclass
@@ -72,10 +73,12 @@ def run_training(cfg: Config, ctx: TrainContext,
             f"rejected={plan.rejected}", "cyan")
 
     history: list[RoundRecord] = []
+    timer = StepTimer()
     t_start = time.perf_counter()
     for r in range(start_round, cfg.global_rounds):
         t0 = time.perf_counter()
-        outcome = strategy.run_round(ctx, plans, r, params, stats)
+        with timer.phase("train"):
+            outcome = strategy.run_round(ctx, plans, r, params, stats)
         wall = time.perf_counter() - t0
         rec = RoundRecord(round_idx=r, ok=outcome.ok,
                           num_samples=outcome.num_samples, wall_s=wall)
@@ -88,7 +91,8 @@ def run_training(cfg: Config, ctx: TrainContext,
         prev_params, prev_stats = params, stats
         params, stats = outcome.params, outcome.stats
         if outcome.validate and cfg.checkpoint.validate:
-            val = ctx.validate(params, stats)
+            with timer.phase("validate"):
+                val = ctx.validate(params, stats)
             rec.val_loss, rec.val_accuracy = val.loss, val.accuracy
             rec.ok = val.ok
             logger.info(
@@ -106,10 +110,12 @@ def run_training(cfg: Config, ctx: TrainContext,
             logger.info(f"Round {r}: samples={outcome.num_samples} "
                         f"({wall:.1f}s)", "green")
         if rec.ok and cfg.checkpoint.save:
-            save_checkpoint(cfg.checkpoint.directory, cfg.model_key,
-                            params, stats, round_idx=r + 1)
+            with timer.phase("checkpoint"):
+                save_checkpoint(cfg.checkpoint.directory, cfg.model_key,
+                                params, stats, round_idx=r + 1)
         history.append(rec)
-        logger.metric(**dataclasses.asdict(rec))
+        logger.metric(**dataclasses.asdict(rec), phases=timer.summary())
+        timer.reset()
         if cfg.limited_time and (time.perf_counter() - t_start
                                  > cfg.limited_time):
             logger.warning(f"Wall-clock budget {cfg.limited_time}s "

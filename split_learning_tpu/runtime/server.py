@@ -63,6 +63,8 @@ class ProtocolContext(MeshContext):
     reference's ``src/val/get_val.py``).
     """
 
+    supports_lora = True    # remote ShardRunner clients train adapters
+
     def __init__(self, cfg: Config, transport: Transport,
                  logger: Logger | None = None,
                  client_timeout: float = 600.0):
@@ -232,6 +234,10 @@ class ProtocolServer:
                                    client_timeout=client_timeout)
 
     def serve(self) -> TrainResult:
+        from split_learning_tpu.parallel.multihost import (
+            ensure_initialized,
+        )
+        ensure_initialized()
         regs = self.ctx.wait_for_registrations()
         plans = plan_clusters(self.cfg, regs)
         try:

@@ -1,0 +1,83 @@
+"""Runtime tracing: XLA profiler capture + per-phase step timing.
+
+The reference's only observability is tqdm bars, per-batch loss prints,
+and a one-shot message-size probe (SURVEY.md §5.1); its in-message
+``trace`` field is routing state, not tracing.  Here:
+
+* :class:`StepTimer` — named wall-clock phase accumulators with
+  ``jax.block_until_ready`` fencing, dumped as a metrics dict (feeds the
+  metrics.jsonl sidecar, ``runtime/log.py``);
+* :func:`trace` — context manager around ``jax.profiler`` writing a
+  TensorBoard-loadable XLA trace;
+* :func:`annotate` — ``TraceAnnotation`` wrapper so host-side round
+  phases (plan/train/aggregate/validate) show up on the trace timeline.
+"""
+
+from __future__ import annotations
+
+import collections
+import contextlib
+import time
+
+import jax
+
+
+class StepTimer:
+    """Accumulates wall-clock per named phase; device-fenced."""
+
+    def __init__(self):
+        self.totals: dict = collections.defaultdict(float)
+        self.counts: dict = collections.defaultdict(int)
+
+    @contextlib.contextmanager
+    def phase(self, name: str):
+        """Time a phase.  The context yields a ``fence`` callable: pass
+        it the pytree produced INSIDE the block and it is blocked on
+        before the clock stops, so async dispatch doesn't hide device
+        time::
+
+            with timer.phase("step") as fence:
+                out = step(...)
+                fence(out)
+        """
+        pending = []
+        t0 = time.perf_counter()
+        try:
+            yield pending.append
+        finally:
+            for tree in pending:
+                jax.block_until_ready(tree)
+            self.totals[name] += time.perf_counter() - t0
+            self.counts[name] += 1
+
+    def record(self, name: str, seconds: float):
+        self.totals[name] += seconds
+        self.counts[name] += 1
+
+    def summary(self) -> dict:
+        return {
+            name: {"total_s": round(self.totals[name], 6),
+                   "count": self.counts[name],
+                   "mean_s": round(self.totals[name]
+                                   / max(self.counts[name], 1), 6)}
+            for name in sorted(self.totals)
+        }
+
+    def reset(self):
+        self.totals.clear()
+        self.counts.clear()
+
+
+@contextlib.contextmanager
+def trace(log_dir: str):
+    """Capture an XLA profiler trace (view with TensorBoard/XProf)."""
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+def annotate(name: str):
+    """Host-side phase marker visible on the profiler timeline."""
+    return jax.profiler.TraceAnnotation(name)

@@ -1,0 +1,68 @@
+"""Multi-host mesh construction (single-host fallback path) and the
+tracing utilities."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from split_learning_tpu.parallel.multihost import (
+    HostTopology, ensure_initialized, global_mesh, local_process_info,
+)
+from split_learning_tpu.runtime.trace import StepTimer, annotate, trace
+
+
+def test_single_host_noop():
+    assert ensure_initialized(HostTopology()) is False
+    # JAX-standard env fallback populates all three fields
+    import os
+    os.environ["JAX_COORDINATOR_ADDRESS"] = "h:1"
+    os.environ["JAX_NUM_PROCESSES"] = "4"
+    os.environ["JAX_PROCESS_ID"] = "2"
+    try:
+        topo = HostTopology.from_env()
+        assert topo == HostTopology("h:1", 4, 2)
+    finally:
+        for k in ("JAX_COORDINATOR_ADDRESS", "JAX_NUM_PROCESSES",
+                  "JAX_PROCESS_ID"):
+            os.environ.pop(k)
+    info = local_process_info()
+    assert info["process_count"] == 1
+    assert info["global_devices"] >= 8
+
+
+def test_global_mesh_wildcard(eight_devices):
+    mesh = global_mesh({"client": -1, "stage": 2})
+    assert mesh.shape == {"client": 4, "stage": 2}
+    mesh = global_mesh({"cluster": 2, "client": 2, "stage": -1})
+    assert mesh.shape == {"cluster": 2, "client": 2, "stage": 2}
+
+
+def test_global_mesh_errors(eight_devices):
+    with pytest.raises(ValueError):
+        global_mesh({"a": -1, "b": -1})
+    with pytest.raises(ValueError):
+        global_mesh({"a": 3, "b": -1})    # 8 % 3 != 0
+    with pytest.raises(ValueError):
+        global_mesh({"a": 2, "b": 2})     # 4 != 8
+
+
+def test_step_timer_fences_device_work():
+    t = StepTimer()
+    x = jnp.ones((256, 256))
+    with t.phase("matmul") as fence:
+        y = jax.jit(lambda a: a @ a)(x)
+        fence(y)   # block on work created INSIDE the block
+    with t.phase("matmul") as fence:
+        fence(jax.jit(lambda a: a @ a)(y))
+    s = t.summary()
+    assert s["matmul"]["count"] == 2
+    assert s["matmul"]["total_s"] > 0
+
+
+def test_trace_writes_profile(tmp_path):
+    with trace(str(tmp_path)):
+        with annotate("phase_x"):
+            jax.block_until_ready(jnp.ones((64, 64)) @ jnp.ones((64, 64)))
+    # something was captured
+    assert any(tmp_path.rglob("*"))
