@@ -250,6 +250,39 @@ def _restore(tree):
     return jax.tree_util.tree_map(lambda a: a[None], tree)
 
 
+
+def _make_grad_sync(client_sync: dict | None, mesh: Mesh):
+    """Shared grouped-gradient-mean closure for the dense and LoRA steps.
+
+    Returns ``sync(grads_by_layer, c_idx)`` applying the per-layer
+    ``axis_index_groups`` psum-mean, or None when no sync is configured.
+    """
+    if not client_sync:
+        return None
+    n_client = mesh.shape["client"]
+    group_denom = {}
+    for name, groups in client_sync.items():
+        sizes = np.ones(n_client, np.float32)
+        for g in groups:
+            for col in g:
+                sizes[col] = len(g)
+        group_denom[name] = sizes
+
+    def sync(grads_part, c_idx):
+        synced = dict(grads_part)
+        for name, groups in client_sync.items():
+            if name not in grads_part:
+                continue
+            denom = jnp.asarray(group_denom[name])[c_idx]
+            synced[name] = jax.tree_util.tree_map(
+                lambda g: jax.lax.psum(
+                    g, "client", axis_index_groups=groups) / denom,
+                grads_part[name])
+        return synced
+
+    return sync
+
+
 def make_train_step(pipe: PipelineModel, optimizer: optax.GradientTransformation,
                     mesh: Mesh, train: bool = True,
                     donate: bool = True,
@@ -278,15 +311,7 @@ def make_train_step(pipe: PipelineModel, optimizer: optax.GradientTransformation
 
     Returns (params, opt_state, stats, loss[C]).
     """
-    group_denom = {}
-    if client_sync:
-        n_client = mesh.shape["client"]
-        for name, groups in client_sync.items():
-            sizes = np.ones(n_client, np.float32)
-            for g in groups:
-                for col in g:
-                    sizes[col] = len(g)
-            group_denom[name] = sizes
+    grad_sync = _make_grad_sync(client_sync, mesh)
 
     def body(params, opt_state, stats, x, labels, rngs):
         params, opt_state, stats = map(_strip, (params, opt_state, stats))
@@ -302,18 +327,8 @@ def make_train_step(pipe: PipelineModel, optimizer: optax.GradientTransformation
         # each device produced grads for its own stage only; sync replicas
         grads = jax.tree_util.tree_map(
             lambda g: jax.lax.psum(g, "stage"), grads)
-        if client_sync:
-            c_idx = jax.lax.axis_index("client")
-            synced = dict(grads)
-            for name, groups in client_sync.items():
-                if name not in grads:
-                    continue
-                denom = jnp.asarray(group_denom[name])[c_idx]
-                synced[name] = jax.tree_util.tree_map(
-                    lambda g: jax.lax.psum(
-                        g, "client", axis_index_groups=groups) / denom,
-                    grads[name])
-            grads = synced
+        if grad_sync is not None:
+            grads = grad_sync(grads, jax.lax.axis_index("client"))
         updates, new_opt = optimizer.update(grads, opt_state, params)
         new_params = optax.apply_updates(params, updates)
         return (*map(_restore, (new_params, new_opt, new_stats)),
@@ -331,6 +346,59 @@ def make_train_step(pipe: PipelineModel, optimizer: optax.GradientTransformation
         check_vma=False,
     )
     return jax.jit(mapped, donate_argnums=(0, 1, 2) if donate else ())
+
+
+def make_lora_train_step(pipe: PipelineModel,
+                         optimizer: optax.GradientTransformation,
+                         mesh: Mesh, lora_alpha: float, lora_rank: int,
+                         client_sync: dict | None = None) -> Callable:
+    """LoRA variant of :func:`make_train_step`.
+
+    Parameters ride as ``(frozen, trainable)`` per client —
+    ``trainable = {"lora": adapters, "head": unfrozen layers}`` — and the
+    pipelined loss differentiates the *merged* model w.r.t. the trainable
+    tree only (peft semantics, ``src/RpcClient.py:61-66``).  Both trees
+    are client-stacked so FLEX-style per-client bases keep working.
+
+    Returns ``step(frozen_c, t_c, opt_c, stats_c, x, labels, rngs) ->
+    (t_c, opt_c, stats_c, loss)``; frozen never changes.
+    """
+    from split_learning_tpu.ops.lora import lora_merge
+
+    grad_sync = _make_grad_sync(client_sync, mesh)
+
+    def body(frozen, t, opt_state, stats, x, labels, rngs):
+        frozen, t, opt_state, stats = map(_strip,
+                                          (frozen, t, opt_state, stats))
+        x, labels, rng = x[0], labels[0], rngs[0]
+
+        def loss_fn(tt):
+            merged = lora_merge({**frozen, **tt["head"]}, tt["lora"],
+                                alpha=lora_alpha, rank=lora_rank)
+            local, aux = pipe.device_loss(merged, stats, x, labels, rng,
+                                          train=True)
+            return local, aux
+
+        (_, (loss, new_stats)), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(t)
+        grads = jax.tree_util.tree_map(
+            lambda g: jax.lax.psum(g, "stage"), grads)
+        if grad_sync is not None:
+            c_idx = jax.lax.axis_index("client")
+            grads = {"lora": grad_sync(grads["lora"], c_idx),
+                     "head": grad_sync(grads["head"], c_idx)}
+        updates, new_opt = optimizer.update(grads, opt_state, t)
+        new_t = optax.apply_updates(t, updates)
+        return (*map(_restore, (new_t, new_opt, new_stats)), loss[None])
+
+    spec_c = P("client")
+    mapped = jax.shard_map(
+        body, mesh=mesh,
+        in_specs=(spec_c,) * 7,
+        out_specs=(spec_c,) * 4,
+        check_vma=False,
+    )
+    return jax.jit(mapped, donate_argnums=(1, 2, 3))
 
 
 def make_fedavg_step(mesh: Mesh) -> Callable:

@@ -40,7 +40,8 @@ from split_learning_tpu.models import build_model, shard_params
 from split_learning_tpu.models.split import SplitModel
 from split_learning_tpu.parallel.mesh import make_mesh, stage_ranges
 from split_learning_tpu.parallel.pipeline import (
-    PipelineModel, make_train_step, shard_to_mesh, stack_for_clients,
+    PipelineModel, make_lora_train_step, make_train_step, shard_to_mesh,
+    stack_for_clients,
 )
 from split_learning_tpu.runtime.plan import ClusterPlan
 from split_learning_tpu.runtime.protocol import Update
@@ -94,18 +95,8 @@ class TrainContext:
 class MeshContext(TrainContext):
     """In-process compiled-mesh backend."""
 
-    #: ProtocolContext overrides: remote ShardRunner clients train LoRA
-    supports_lora = False
-
     def __init__(self, cfg: Config, devices=None):
         self.cfg = cfg
-        if cfg.learning.lora_rank > 0 and not self.supports_lora:
-            # adapters are a protocol-client feature so far; training full
-            # params here would silently diverge from the config's intent
-            raise NotImplementedError(
-                "learning.lora_rank > 0 is supported by the multi-process "
-                "protocol backend (python -m split_learning_tpu.server/"
-                ".client), not by the in-process mesh backend yet")
         self.devices = list(devices if devices is not None
                             else jax.devices())
         self.model_kwargs = dict(cfg.model_kwargs or {})
@@ -160,21 +151,52 @@ class MeshContext(TrainContext):
     def _compiled(self, plan: ClusterPlan, c_phys: int, s_phys: int,
                   cuts_phys: list, lr: float | None,
                   sync_map_key: tuple, client_sync: dict | None):
+        lrn = self.cfg.learning
+        use_lora = lrn.lora_rank > 0
         key = (plan.cluster_id, c_phys, s_phys, tuple(cuts_phys), lr,
-               sync_map_key)
+               sync_map_key, use_lora)
         if key in self._step_cache:
             return self._step_cache[key]
         mesh = make_mesh(c_phys, s_phys, self.devices)
         pipe = PipelineModel(
             self.cfg.model_key, cuts=cuts_phys,
             example_input=self._example,
-            num_microbatches=self.cfg.learning.control_count,
+            num_microbatches=lrn.control_count,
             model_kwargs=self.model_kwargs)
-        optimizer = make_optimizer(self.cfg.learning, lr)
-        step = make_train_step(pipe, optimizer, mesh,
-                               client_sync=client_sync)
+        optimizer = make_optimizer(lrn, lr)
+        if use_lora:
+            step = make_lora_train_step(
+                pipe, optimizer, mesh, lora_alpha=lrn.lora_alpha,
+                lora_rank=lrn.lora_rank, client_sync=client_sync)
+        else:
+            step = make_train_step(pipe, optimizer, mesh,
+                                   client_sync=client_sync)
         self._step_cache[key] = (mesh, pipe, optimizer, step)
         return self._step_cache[key]
+
+    def _lora_partition(self, tree):
+        """(frozen, trainable) for one client's base tree: adapters over
+        target kernels, model's final (classifier) layer unfrozen —
+        mirrors the protocol ShardRunner partition, including its
+        no-target fallback to full training.
+
+        Adapter init is seeded from cfg.seed alone — NOT per client:
+        sync groups (shared later stages) require every column in a
+        group to hold identical shard params, and grouped gradient
+        means only preserve that when the inits match too.  The merged
+        model starts at the base weights either way (b = 0)."""
+        import warnings
+        from split_learning_tpu.ops.lora import lora_init, split_frozen
+        lrn = self.cfg.learning
+        frozen, head = split_frozen(tree, [self.specs[-1].name])
+        adapters = lora_init(jax.random.key(self.cfg.seed), frozen,
+                             targets=lrn.lora_targets, rank=lrn.lora_rank)
+        if not adapters:
+            warnings.warn(
+                "lora_rank set but no target kernels in this model; "
+                "training full parameters instead", stacklevel=3)
+            return {}, {"lora": {}, "head": tree}
+        return frozen, {"lora": adapters, "head": head}
 
     def _sync_map(self, plan: ClusterPlan, c_phys: int, n_real: int,
                   sync_all: bool) -> tuple[dict | None, tuple]:
@@ -231,14 +253,27 @@ class MeshContext(TrainContext):
             trees = [
                 (per_client_params or {}).get(c, params) for c in cols
             ]
-            params_c = jax.tree_util.tree_map(
-                lambda *xs: jnp.stack([jnp.asarray(x) for x in xs]), *trees)
+            def stack(ts):
+                return jax.tree_util.tree_map(
+                    lambda *xs: jnp.stack([jnp.asarray(x) for x in xs]),
+                    *ts)
+
+            use_lora = self.cfg.learning.lora_rank > 0
+            frozen_c = None
+            if use_lora:
+                parts = [self._lora_partition(t) for t in trees]
+                frozen_c = stack([f for f, _ in parts])
+                params_c = stack([t for _, t in parts])
+            else:
+                params_c = stack(trees)
             opt0 = optimizer.init(
                 jax.tree_util.tree_map(lambda a: a[0], params_c))
             opt_c = stack_for_clients(opt0, c_phys)
             stats_c = stack_for_clients(stats, c_phys)
             params_c, opt_c, stats_c = (
                 shard_to_mesh(t, mesh) for t in (params_c, opt_c, stats_c))
+            if frozen_c is not None:
+                frozen_c = shard_to_mesh(frozen_c, mesh)
 
             loaders = [self._loader(c, counts[c]) for c in cols]
             steps_per_epoch = max(
@@ -265,11 +300,26 @@ class MeshContext(TrainContext):
                         ys.append(np.stack(by))
                     x = jnp.asarray(np.stack(xs))
                     labels = jnp.asarray(np.stack(ys).astype(np.int32))
-                    params_c, opt_c, stats_c, loss = step(
-                        params_c, opt_c, stats_c, x, labels, rngs)
+                    if use_lora:
+                        params_c, opt_c, stats_c, loss = step(
+                            frozen_c, params_c, opt_c, stats_c, x,
+                            labels, rngs)
+                    else:
+                        params_c, opt_c, stats_c, loss = step(
+                            params_c, opt_c, stats_c, x, labels, rngs)
                     consumed += M * mb
             loss_h = (np.asarray(loss) if loss is not None
                       else np.zeros(c_phys))
+            if use_lora:
+                # bake adapters into dense weights per column before shard
+                # extraction (merge_and_unload parity)
+                from split_learning_tpu.ops.lora import lora_merge
+                lrn = self.cfg.learning
+                params_c = jax.vmap(
+                    lambda f, t: lora_merge(
+                        {**f, **t["head"]}, t["lora"],
+                        alpha=lrn.lora_alpha, rank=lrn.lora_rank)
+                )(frozen_c, params_c)
             params_h = jax.tree_util.tree_map(np.asarray, params_c)
             stats_h = jax.tree_util.tree_map(np.asarray, stats_c)
             updates.extend(self._extract_updates(

@@ -63,8 +63,6 @@ class ProtocolContext(MeshContext):
     reference's ``src/val/get_val.py``).
     """
 
-    supports_lora = True    # remote ShardRunner clients train adapters
-
     def __init__(self, cfg: Config, transport: Transport,
                  logger: Logger | None = None,
                  client_timeout: float = 600.0):
