@@ -1,0 +1,180 @@
+// slt_mfcc — native MFCC feature extraction for the SpeechCommands data
+// path.
+//
+// Same math as the Python pipeline (split_learning_tpu/data/mfcc.py,
+// itself parity with the reference's manual numpy/scipy chain,
+// /root/reference/src/dataset/SPEECHCOMMANDS.py:11-47): pre-emphasis,
+// 25/10 ms framing, Hamming window, radix-2 real FFT power spectrum,
+// triangular mel filterbank (floor-binned), log, DCT-II with ortho
+// normalization.  Double precision internally so outputs match the
+// numpy float64 pipeline to ~1e-6.
+//
+// C ABI (ctypes):
+//   int slt_mfcc_batch(const float* signals, int batch, int n_samples,
+//                      int sample_rate, int n_mfcc, double frame_ms,
+//                      double hop_ms, int n_fft, int n_mels,
+//                      double pre_emphasis, float* out, int* n_frames_out)
+// out must hold batch * n_mfcc * n_frames floats; returns 0 on success.
+//
+// Build: g++ -O2 -std=c++17 -shared -fPIC -o libslt_mfcc.so mfcc.cpp
+
+#include <cmath>
+#include <cstring>
+#include <vector>
+
+namespace {
+
+constexpr double kPi = 3.14159265358979323846;
+
+// In-place iterative radix-2 complex FFT (n must be a power of two).
+void fft(std::vector<double>& re, std::vector<double>& im) {
+  const size_t n = re.size();
+  for (size_t i = 1, j = 0; i < n; ++i) {  // bit reversal
+    size_t bit = n >> 1;
+    for (; j & bit; bit >>= 1) j ^= bit;
+    j ^= bit;
+    if (i < j) {
+      std::swap(re[i], re[j]);
+      std::swap(im[i], im[j]);
+    }
+  }
+  for (size_t len = 2; len <= n; len <<= 1) {
+    const double ang = -2.0 * kPi / static_cast<double>(len);
+    const double wr = std::cos(ang), wi = std::sin(ang);
+    for (size_t i = 0; i < n; i += len) {
+      double cr = 1.0, ci = 0.0;
+      for (size_t k = 0; k < len / 2; ++k) {
+        const size_t a = i + k, b = i + k + len / 2;
+        const double tr = re[b] * cr - im[b] * ci;
+        const double ti = re[b] * ci + im[b] * cr;
+        re[b] = re[a] - tr;
+        im[b] = im[a] - ti;
+        re[a] += tr;
+        im[a] += ti;
+        const double ncr = cr * wr - ci * wi;
+        ci = cr * wi + ci * wr;
+        cr = ncr;
+      }
+    }
+  }
+}
+
+double hz_to_mel(double hz) { return 2595.0 * std::log10(1.0 + hz / 700.0); }
+double mel_to_hz(double mel) {
+  return 700.0 * (std::pow(10.0, mel / 2595.0) - 1.0);
+}
+
+// (n_mels, n_fft/2+1) triangular filterbank, floor-binned like the
+// Python mel_filterbank.
+std::vector<double> filterbank(int n_mels, int n_fft, int sample_rate) {
+  const int n_bins = n_fft / 2 + 1;
+  std::vector<double> fb(static_cast<size_t>(n_mels) * n_bins, 0.0);
+  std::vector<int> bins(n_mels + 2);
+  const double mel_lo = hz_to_mel(0.0);
+  const double mel_hi = hz_to_mel(sample_rate / 2.0);
+  for (int m = 0; m < n_mels + 2; ++m) {
+    const double mel = mel_lo + (mel_hi - mel_lo) * m / (n_mels + 1);
+    bins[m] = static_cast<int>(
+        std::floor((n_fft + 1) * mel_to_hz(mel) / sample_rate));
+  }
+  for (int m = 1; m <= n_mels; ++m) {
+    const int lo = bins[m - 1], ctr = bins[m], hi = bins[m + 1];
+    for (int k = lo; k < ctr; ++k)
+      if (ctr > lo) fb[(m - 1) * n_bins + k] =
+          static_cast<double>(k - lo) / (ctr - lo);
+    for (int k = ctr; k < hi; ++k)
+      if (hi > ctr) fb[(m - 1) * n_bins + k] =
+          static_cast<double>(hi - k) / (hi - ctr);
+  }
+  return fb;
+}
+
+}  // namespace
+
+extern "C" int slt_mfcc_batch(const float* signals, int batch,
+                              int n_samples, int sample_rate, int n_mfcc,
+                              double frame_ms, double hop_ms, int n_fft,
+                              int n_mels, double pre_emphasis, float* out,
+                              int* n_frames_out) {
+  if ((n_fft & (n_fft - 1)) != 0 || n_fft <= 0) return 1;  // power of two
+  const int frame_len =
+      static_cast<int>(std::lround(sample_rate * frame_ms / 1000.0));
+  const int hop =
+      static_cast<int>(std::lround(sample_rate * hop_ms / 1000.0));
+  if (frame_len <= 0 || hop <= 0 || frame_len > n_fft) return 2;
+  const int n_frames =
+      n_samples >= frame_len
+          ? 1 + (n_samples - frame_len) / hop
+          : 1;
+  *n_frames_out = n_frames;
+  const int n_bins = n_fft / 2 + 1;
+
+  std::vector<double> hamming(frame_len);
+  for (int i = 0; i < frame_len; ++i)
+    hamming[i] = 0.54 - 0.46 * std::cos(2.0 * kPi * i / (frame_len - 1));
+  const std::vector<double> fb = filterbank(n_mels, n_fft, sample_rate);
+
+  // DCT-II ortho basis: (n_mfcc, n_mels)
+  std::vector<double> dct(static_cast<size_t>(n_mfcc) * n_mels);
+  for (int k = 0; k < n_mfcc; ++k) {
+    const double scale =
+        k == 0 ? std::sqrt(1.0 / n_mels) : std::sqrt(2.0 / n_mels);
+    for (int i = 0; i < n_mels; ++i)
+      dct[k * n_mels + i] =
+          scale * std::cos(kPi * k * (2 * i + 1) / (2.0 * n_mels));
+  }
+
+  // sparse filterbank: each mel touches only its triangle's bins
+  std::vector<int> mel_lo(n_mels), mel_hi(n_mels);
+  {
+    for (int m = 0; m < n_mels; ++m) {
+      int lo = n_bins, hi = 0;
+      for (int k = 0; k < n_bins; ++k)
+        if (fb[static_cast<size_t>(m) * n_bins + k] != 0.0) {
+          if (k < lo) lo = k;
+          hi = k + 1;
+        }
+      mel_lo[m] = lo < n_bins ? lo : 0;
+      mel_hi[m] = hi;
+    }
+  }
+
+  std::vector<double> sig(n_samples);
+  std::vector<double> re(n_fft), im(n_fft);
+  std::vector<double> power(n_bins);
+  std::vector<double> mel(n_mels);
+
+  for (int b = 0; b < batch; ++b) {
+    const float* s = signals + static_cast<size_t>(b) * n_samples;
+    sig[0] = s[0];
+    for (int i = 1; i < n_samples; ++i)
+      sig[i] = s[i] - pre_emphasis * s[i - 1];
+
+    float* o = out + static_cast<size_t>(b) * n_mfcc * n_frames;
+    for (int f = 0; f < n_frames; ++f) {
+      const int start = f * hop;
+      std::fill(re.begin() + frame_len, re.end(), 0.0);
+      std::fill(im.begin(), im.end(), 0.0);
+      for (int i = 0; i < frame_len; ++i) {
+        const int src = start + i;
+        re[i] = (src < n_samples ? sig[src] : 0.0) * hamming[i];
+      }
+      fft(re, im);
+      for (int k = 0; k < n_bins; ++k)
+        power[k] = (re[k] * re[k] + im[k] * im[k]) / n_fft;
+      for (int m = 0; m < n_mels; ++m) {
+        double acc = 0.0;
+        const double* w = fb.data() + static_cast<size_t>(m) * n_bins;
+        for (int k = mel_lo[m]; k < mel_hi[m]; ++k) acc += w[k] * power[k];
+        mel[m] = std::log(acc + 1e-10);
+      }
+      for (int k = 0; k < n_mfcc; ++k) {
+        double acc = 0.0;
+        for (int m = 0; m < n_mels; ++m)
+          acc += dct[k * n_mels + m] * mel[m];
+        o[k * n_frames + f] = static_cast<float>(acc);
+      }
+    }
+  }
+  return 0;
+}

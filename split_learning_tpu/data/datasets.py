@@ -253,24 +253,25 @@ _SC_CLASSES = ["yes", "no", "up", "down", "left", "right", "on", "off",
 def speechcommands(train: bool = True, synthetic_size: int | None = None):
     root = data_dir() / "SpeechCommands" / "speech_commands_v0.02"
     if root.exists():
-        from split_learning_tpu.data.mfcc import compute_mfcc
+        from split_learning_tpu.data.mfcc import mfcc_batch
         split_files: set[str] = set()
         for listing in ("validation_list.txt", "testing_list.txt"):
             p = root / listing
             if p.exists():
                 split_files |= set(p.read_text().split())
-        feats, labels = [], []
+        signals, labels = [], []
         for ci, cls in enumerate(_SC_CLASSES):
             for wav in sorted((root / cls).glob("*.wav")):
                 rel = f"{cls}/{wav.name}"
                 if train == (rel in split_files):
                     continue
                 sig = _read_wav_mono(wav)
-                sig = np.pad(sig, (0, max(0, 16000 - len(sig))))[:16000]
-                feats.append(compute_mfcc(sig))
+                signals.append(
+                    np.pad(sig, (0, max(0, 16000 - len(sig))))[:16000])
                 labels.append(ci)
-        if feats:
-            return ArrayDataset(np.stack(feats),
+        if signals:
+            # one batched call: hits the native C++ extractor when built
+            return ArrayDataset(mfcc_batch(np.stack(signals)),
                                 np.asarray(labels, np.int32))
     # synthetic MFCC-shaped blobs: (40, 98) like a 1 s 16 kHz clip
     n = synthetic_size or (4000 if train else 800)

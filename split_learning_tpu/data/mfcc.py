@@ -78,6 +78,23 @@ def compute_mfcc(signal: np.ndarray, sample_rate: int = 16000,
     return mfcc.T.astype(np.float32)  # (n_mfcc, n_frames)
 
 
+_NATIVE_OK: bool | None = None   # None = untried; False = failed once
+
+
 def mfcc_batch(signals: np.ndarray, **kw) -> np.ndarray:
-    """(B, n_mfcc, n_frames) over a batch of equal-length signals."""
+    """(B, n_mfcc, n_frames) over a batch of equal-length signals.
+
+    Prefers the native C++ extractor when a compiler is available;
+    numerically interchangeable with the numpy pipeline.  A failed build
+    or an unsupported kwarg (e.g. ``eps``) disables the native path for
+    the process rather than retrying the compile per call."""
+    global _NATIVE_OK
+    if _NATIVE_OK is not False:
+        try:
+            from split_learning_tpu.native import mfcc_batch_native
+            out = mfcc_batch_native(np.asarray(signals), **kw)
+            _NATIVE_OK = True
+            return out
+        except Exception:   # no compiler / load failure / kwarg mismatch
+            _NATIVE_OK = False
     return np.stack([compute_mfcc(s, **kw) for s in signals])

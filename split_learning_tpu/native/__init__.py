@@ -17,32 +17,98 @@ import shutil
 import subprocess
 
 _ROOT = pathlib.Path(__file__).resolve().parents[2]
-_SRC = _ROOT / "native" / "broker.cpp"
-_BIN_DIR = _ROOT / "native" / "bin"
+_SRC_DIR = _ROOT / "native"
+_SRC = _SRC_DIR / "broker.cpp"
+_BIN_DIR = _SRC_DIR / "bin"
 _BIN = _BIN_DIR / "slt_broker"
+_MFCC_SRC = _SRC_DIR / "mfcc.cpp"
+_MFCC_LIB = _BIN_DIR / "libslt_mfcc.so"
 
 
 class NativeBuildError(RuntimeError):
     pass
 
 
-def build_broker(force: bool = False) -> pathlib.Path:
-    """Compile the broker if the cached binary is missing or stale."""
-    if not _SRC.exists():
-        raise NativeBuildError(f"missing source {_SRC}")
-    if not force and _BIN.exists() \
-            and _BIN.stat().st_mtime >= _SRC.stat().st_mtime:
-        return _BIN
+def _compiler() -> str:
     gxx = shutil.which("g++") or shutil.which("clang++")
     if gxx is None:
         raise NativeBuildError("no C++ compiler on PATH")
+    return gxx
+
+
+def _build(src: pathlib.Path, dest: pathlib.Path,
+           extra: list | None = None, force: bool = False) -> pathlib.Path:
+    """Compile ``src`` -> ``dest`` unless the cached artifact is fresh."""
+    if not src.exists():
+        raise NativeBuildError(f"missing source {src}")
+    if not force and dest.exists() \
+            and dest.stat().st_mtime >= src.stat().st_mtime:
+        return dest
     _BIN_DIR.mkdir(parents=True, exist_ok=True)
-    cmd = [gxx, "-O2", "-std=c++17", "-o", str(_BIN), str(_SRC)]
+    cmd = [_compiler(), "-O2", "-std=c++17", *(extra or []),
+           "-o", str(dest), str(src)]
     proc = subprocess.run(cmd, capture_output=True, text=True)
     if proc.returncode != 0:
         raise NativeBuildError(
-            f"broker build failed:\n{proc.stderr[-2000:]}")
-    return _BIN
+            f"build of {src.name} failed:\n{proc.stderr[-2000:]}")
+    return dest
+
+
+def build_broker(force: bool = False) -> pathlib.Path:
+    return _build(_SRC, _BIN, force=force)
+
+
+def build_mfcc(force: bool = False) -> pathlib.Path:
+    return _build(_MFCC_SRC, _MFCC_LIB,
+                  extra=["-O3", "-shared", "-fPIC"], force=force)
+
+
+_mfcc_lib = None
+
+
+def mfcc_batch_native(signals, sample_rate: int = 16000, n_mfcc: int = 40,
+                      frame_ms: float = 25.0, hop_ms: float = 10.0,
+                      n_fft: int = 512, n_mels: int = 64,
+                      pre_emphasis: float = 0.97):
+    """(B, n_mfcc, n_frames) MFCCs via the C++ extractor.
+
+    Raises :class:`NativeBuildError` when no compiler is available —
+    callers fall back to the numpy pipeline (``data/mfcc.py``).
+    """
+    import ctypes
+
+    import numpy as np
+
+    global _mfcc_lib
+    if _mfcc_lib is None:
+        lib = ctypes.CDLL(str(build_mfcc()))
+        lib.slt_mfcc_batch.restype = ctypes.c_int
+        lib.slt_mfcc_batch.argtypes = [
+            ctypes.POINTER(ctypes.c_float), ctypes.c_int, ctypes.c_int,
+            ctypes.c_int, ctypes.c_int, ctypes.c_double, ctypes.c_double,
+            ctypes.c_int, ctypes.c_int, ctypes.c_double,
+            ctypes.POINTER(ctypes.c_float), ctypes.POINTER(ctypes.c_int),
+        ]
+        _mfcc_lib = lib
+
+    sig = np.ascontiguousarray(signals, dtype=np.float32)
+    if sig.ndim == 1:
+        sig = sig[None]
+    batch, n_samples = sig.shape
+    frame_len = int(round(sample_rate * frame_ms / 1000.0))
+    hop = int(round(sample_rate * hop_ms / 1000.0))
+    n_frames = max(1, 1 + (n_samples - frame_len) // hop)
+    out = np.empty((batch, n_mfcc, n_frames), dtype=np.float32)
+    got_frames = ctypes.c_int(0)
+    rc = _mfcc_lib.slt_mfcc_batch(
+        sig.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        batch, n_samples, sample_rate, n_mfcc, frame_ms, hop_ms,
+        n_fft, n_mels, pre_emphasis,
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        ctypes.byref(got_frames))
+    if rc != 0 or got_frames.value != n_frames:
+        raise NativeBuildError(f"slt_mfcc_batch failed rc={rc}")
+    return out
 
 
 class NativeBroker:

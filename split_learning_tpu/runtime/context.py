@@ -189,14 +189,19 @@ class MeshContext(TrainContext):
         from split_learning_tpu.ops.lora import lora_init, split_frozen
         lrn = self.cfg.learning
         frozen, head = split_frozen(tree, [self.specs[-1].name])
-        adapters = lora_init(jax.random.key(self.cfg.seed), frozen,
-                             targets=lrn.lora_targets, rank=lrn.lora_rank)
-        if not adapters:
-            warnings.warn(
-                "lora_rank set but no target kernels in this model; "
-                "training full parameters instead", stacklevel=3)
+        if not hasattr(self, "_lora_adapters"):
+            # adapters depend only on kernel SHAPES + the global seed:
+            # compute once per context, reuse every column/chunk/round
+            self._lora_adapters = lora_init(
+                jax.random.key(self.cfg.seed), frozen,
+                targets=lrn.lora_targets, rank=lrn.lora_rank)
+            if not self._lora_adapters:
+                warnings.warn(
+                    "lora_rank set but no target kernels in this model; "
+                    "training full parameters instead", stacklevel=3)
+        if not self._lora_adapters:
             return {}, {"lora": {}, "head": tree}
-        return frozen, {"lora": adapters, "head": head}
+        return frozen, {"lora": self._lora_adapters, "head": head}
 
     def _sync_map(self, plan: ClusterPlan, c_phys: int, n_real: int,
                   sync_all: bool) -> tuple[dict | None, tuple]:
