@@ -349,6 +349,13 @@ class ProtocolClient:
         self.log.info(f"[>>>] UPDATE samples={self.num_samples} "
                       f"ok={self.round_ok}")
 
+    def _redeliver_stop(self, msg: Stop) -> Pause:
+        """A STOP arriving mid-training: requeue it for the run() loop and
+        unwind the hot loop without uploading (the server is shutting
+        down; an UPDATE would go nowhere)."""
+        self.bus.publish(reply_queue(self.client_id), encode(msg))
+        return Pause(send_weights=False)
+
     def _wait_pause(self) -> Pause:
         q = reply_queue(self.client_id)
         while True:
@@ -359,15 +366,22 @@ class ProtocolClient:
             if isinstance(msg, Pause):
                 self.log.info("[<<<] PAUSE")
                 return msg
+            if isinstance(msg, Stop):
+                return self._redeliver_stop(msg)
             self.log.warning(f"ignoring {type(msg).__name__} while "
                              f"awaiting PAUSE")
 
     def _check_pause(self) -> Pause | None:
+        """Non-blocking-ish control poll from inside a hot loop."""
         raw = self.bus.get(reply_queue(self.client_id), timeout=0.001)
         if raw is None:
             return None
         msg = decode(raw)
-        return msg if isinstance(msg, Pause) else None
+        if isinstance(msg, Pause):
+            return msg
+        if isinstance(msg, Stop):
+            return self._redeliver_stop(msg)
+        return None
 
     # -- hot loops -----------------------------------------------------------
 
@@ -404,7 +418,9 @@ class ProtocolClient:
                 raw = self.bus.get(grad_q, timeout=0.0005)
                 if raw is not None:
                     g = decode(raw)
-                    ent = inflight.pop(g.data_id)
+                    ent = inflight.pop(g.data_id, None)
+                    if ent is None:   # stale gradient from a cut round
+                        continue
                     gt, _, self.stats = r.bwd(
                         self.frozen, self.trainable, self.stats, ent.x,
                         jnp.asarray(g.data), ent.rng)
@@ -412,6 +428,16 @@ class ProtocolClient:
                         self.trainable, self.opt_state, gt)
                     n_bwd += 1
                     continue
+                # idle: check for early PAUSE/STOP (downstream died or the
+                # server dropped the round) rather than waiting forever
+                # for gradients that will never come — the reference
+                # hangs here (SURVEY.md §5.3).  Checked only on idle
+                # iterations so the steady-state loop pays no extra RPC.
+                pause = self._check_pause()
+                if pause is not None:
+                    self.log.warning(
+                        f"PAUSE mid-loop with {len(inflight)} in flight")
+                    return pause
                 if exhausted or len(inflight) >= cap:
                     continue
                 try:
@@ -451,7 +477,9 @@ class ProtocolClient:
             raw = self.bus.get(grad_q, timeout=0.0005)
             if raw is not None:
                 g = decode(raw)
-                ent = inflight.pop(g.data_id)
+                ent = inflight.pop(g.data_id, None)
+                if ent is None:   # stale gradient from a cut round
+                    continue
                 gt, gx, self.stats = r.bwd(
                     self.frozen, self.trainable, self.stats, ent.x,
                     jnp.asarray(g.data), ent.rng)

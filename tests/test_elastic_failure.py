@@ -1,0 +1,38 @@
+"""Elastic failure handling: a registered client that dies before
+training must be dropped at the barrier deadline and the round completed
+with the survivors — the reference hangs forever in this case
+(SURVEY.md §5.3: counters at src/Server.py:161/:173 never fire)."""
+
+import threading
+
+from split_learning_tpu.runtime.bus import InProcTransport
+from split_learning_tpu.runtime.client import ProtocolClient
+from split_learning_tpu.runtime.protocol import RPC_QUEUE, Register, encode
+from split_learning_tpu.runtime.server import ProtocolServer
+
+from tests.test_protocol_runtime import proto_cfg
+
+
+def test_dead_client_dropped_round_completes(tmp_path):
+    bus = InProcTransport()
+    cfg = proto_cfg(tmp_path, clients=[2, 1])
+    # deadline long enough for jit compiles, short enough to test drops
+    server = ProtocolServer(cfg, transport=bus, client_timeout=45)
+
+    threads = []
+    for cid, stage in (("live_1", 1), ("live_2", 2)):
+        c = ProtocolClient(cfg, cid, stage, transport=bus)
+        th = threading.Thread(target=c.run, daemon=True)
+        th.start()
+        threads.append(th)
+    # the "dead" client registers but never serves its reply queue
+    bus.publish(RPC_QUEUE, encode(Register(client_id="dead_1", stage=1)))
+
+    result = server.serve()
+    rec = result.history[0]
+    assert rec.ok
+    # only the live stage-1 client's samples counted
+    assert 0 < rec.num_samples <= 24
+    for th in threads:
+        th.join(timeout=30)
+        assert not th.is_alive()
