@@ -24,8 +24,14 @@ import time
 CACHE = pathlib.Path(__file__).parent / ".baseline_cache.json"
 
 
-def measure_torch_baseline(batch_size: int = 32, steps: int = 3) -> float:
-    """samples/sec of a torch-CPU VGG16-BN train step (reference compute)."""
+def measure_torch_baseline(steps: int = 3) -> float:
+    """samples/sec of a torch-CPU VGG16-BN train step (reference compute).
+
+    Swept over batch sizes and reported at the best — the JAX side is
+    likewise measured at its own throughput-optimal batch, so the ratio
+    compares each implementation at its best operating point rather than
+    handicapping either side with the other's batch geometry.
+    """
     import torch
     import torch.nn as nn
 
@@ -47,17 +53,18 @@ def measure_torch_baseline(batch_size: int = 32, steps: int = 3) -> float:
     model = nn.Sequential(*layers)
     opt = torch.optim.SGD(model.parameters(), lr=5e-4, momentum=0.9)
     loss_fn = nn.CrossEntropyLoss()
-    x = torch.randn(batch_size, 3, 32, 32)
-    y = torch.randint(0, 10, (batch_size,))
 
-    # one warmup step, then timed
-    for _ in range(1):
-        opt.zero_grad(); loss_fn(model(x), y).backward(); opt.step()
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        opt.zero_grad(); loss_fn(model(x), y).backward(); opt.step()
-    dt = time.perf_counter() - t0
-    return batch_size * steps / dt
+    best = 0.0
+    for batch_size in (32, 128, 512):
+        x = torch.randn(batch_size, 3, 32, 32)
+        y = torch.randint(0, 10, (batch_size,))
+        opt.zero_grad(); loss_fn(model(x), y).backward(); opt.step()  # warm
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            opt.zero_grad(); loss_fn(model(x), y).backward(); opt.step()
+        dt = time.perf_counter() - t0
+        best = max(best, batch_size * steps / dt)
+    return best
 
 
 def get_baseline() -> float:
@@ -93,9 +100,11 @@ def measure_ours() -> tuple[float, int]:
     mesh = Mesh(np.array(devs[:1]).reshape(1, 1), ("client", "stage"))
     n_chips = 1
 
-    mb = 32 if on_cpu else 256
+    # batch 8192 saturates the MXU (measured: ~86 bf16 TFLOP/s on one chip,
+    # equal to the chip's raw matmul rate; batch 256 reaches only ~24)
+    mb = 32 if on_cpu else 8192
     n_micro = 1
-    steps = 3 if on_cpu else 20
+    steps = 3 if on_cpu else 10
     dtype = jnp.float32 if on_cpu else jnp.bfloat16
 
     pipe = PipelineModel(
@@ -118,16 +127,19 @@ def measure_ours() -> tuple[float, int]:
     labels = jnp.zeros((1, n_micro, mb), jnp.int32)
 
     step = make_train_step(pipe, optimizer, mesh)
-    # warmup/compile
+    # warmup/compile.  Sync by FETCHING the loss, not block_until_ready:
+    # on tunneled backends block_until_ready can return before execution
+    # finishes (observed: impossible >1 PFLOP/s readings); a device->host
+    # value transfer is an unfakeable barrier on every backend.
     params_c, opt_c, stats_c, loss = step(params_c, opt_c, stats_c, x,
                                           labels, rng)
-    jax.block_until_ready(loss)
+    float(np.asarray(loss)[0])
 
     t0 = time.perf_counter()
     for _ in range(steps):
         params_c, opt_c, stats_c, loss = step(params_c, opt_c, stats_c, x,
                                               labels, rng)
-    jax.block_until_ready(loss)
+    float(np.asarray(loss)[0])
     dt = time.perf_counter() - t0
     return mb * n_micro * steps / dt, n_chips
 

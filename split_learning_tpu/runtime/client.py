@@ -216,6 +216,7 @@ class _Inflight:
     rng: Any
     trace: list
     labels: Any = None
+    n: int = 0   # batch size; counted into num_samples at BACKWARD time
 
 
 class ProtocolClient:
@@ -294,6 +295,11 @@ class ProtocolClient:
         self.epochs = int(extra.get("epochs", 1))
         self.sda_size = int(extra.get("sda_size", 1))
         self.round_idx = msg.round_idx
+        # server-issued per-invocation generation: stamps every message
+        # this client sends so the server/peers can drop strays from an
+        # invocation that was already abandoned (round_idx alone can't —
+        # sequential strategies reuse it across sub-calls)
+        self.fence = int(extra.get("gen", msg.round_idx))
         model_kwargs = dict(self.cfg.model_kwargs or {})
         self.runner = ShardRunner(
             self.cfg.model_key, msg.start_layer, msg.end_layer,
@@ -322,6 +328,7 @@ class ProtocolClient:
     def _on_syn(self, msg: Syn):
         self.log.info(f"[<<<] SYN round={msg.round_idx}")
         self.round_ok = True
+        self.round_idx = msg.round_idx
         self.num_samples = 0
         whole = (self.runner.start_layer == 0
                  and self.runner.model.resolved_end
@@ -345,7 +352,7 @@ class ProtocolClient:
             client_id=self.client_id, stage=self.stage,
             cluster=self.cluster, params=params_h,
             batch_stats=stats_h, num_samples=self.num_samples,
-            ok=self.round_ok)))
+            ok=self.round_ok, round_idx=self.fence)))
         self.log.info(f"[>>>] UPDATE samples={self.num_samples} "
                       f"ok={self.round_ok}")
 
@@ -353,6 +360,17 @@ class ProtocolClient:
         """A STOP arriving mid-training: requeue it for the run() loop and
         unwind the hot loop without uploading (the server is shutting
         down; an UPDATE would go nowhere)."""
+        self.bus.publish(reply_queue(self.client_id), encode(msg))
+        return Pause(send_weights=False)
+
+    def _redeliver_start(self, msg: Start) -> Pause:
+        """A START arriving while still in a previous round's loop: the
+        server timed this client out of that round and has moved on (its
+        barriers no longer count us, so no PAUSE is coming).  Requeue the
+        START for the run() loop and unwind without uploading — the
+        client then rejoins from the fresh START instead of being lost
+        until STOP."""
+        self.log.warning("START while mid-round: rejoining next round")
         self.bus.publish(reply_queue(self.client_id), encode(msg))
         return Pause(send_weights=False)
 
@@ -368,6 +386,8 @@ class ProtocolClient:
                 return msg
             if isinstance(msg, Stop):
                 return self._redeliver_stop(msg)
+            if isinstance(msg, Start):
+                return self._redeliver_start(msg)
             self.log.warning(f"ignoring {type(msg).__name__} while "
                              f"awaiting PAUSE")
 
@@ -381,6 +401,8 @@ class ProtocolClient:
             return msg
         if isinstance(msg, Stop):
             return self._redeliver_stop(msg)
+        if isinstance(msg, Start):
+            return self._redeliver_start(msg)
         return None
 
     # -- hot loops -----------------------------------------------------------
@@ -399,7 +421,8 @@ class ProtocolClient:
                     self.trainable, self.opt_state, grads)
                 self.num_samples += len(labels)
         self.bus.publish(RPC_QUEUE, encode(Notify(
-            client_id=self.client_id, cluster=self.cluster)))
+            client_id=self.client_id, cluster=self.cluster,
+            round_idx=self.fence)))
         return self._wait_pause()
 
     def _train_first(self) -> Pause:
@@ -418,8 +441,10 @@ class ProtocolClient:
                 raw = self.bus.get(grad_q, timeout=0.0005)
                 if raw is not None:
                     g = decode(raw)
+                    if g.round_idx != self.fence:
+                        continue   # gradient from a dropped round
                     ent = inflight.pop(g.data_id, None)
-                    if ent is None:   # stale gradient from a cut round
+                    if ent is None:   # no longer tracked (cut round)
                         continue
                     gt, _, self.stats = r.bwd(
                         self.frozen, self.trainable, self.stats, ent.x,
@@ -427,18 +452,23 @@ class ProtocolClient:
                     self.trainable, self.opt_state = r.apply_update(
                         self.trainable, self.opt_state, gt)
                     n_bwd += 1
+                    # counted here, not at dispatch: a mid-loop PAUSE
+                    # abandons in-flight forwards, and the FedAvg weight
+                    # must only cover samples whose update was applied
+                    self.num_samples += ent.n
                     continue
-                # idle: check for early PAUSE/STOP (downstream died or the
-                # server dropped the round) rather than waiting forever
-                # for gradients that will never come — the reference
-                # hangs here (SURVEY.md §5.3).  Checked only on idle
-                # iterations so the steady-state loop pays no extra RPC.
-                pause = self._check_pause()
-                if pause is not None:
-                    self.log.warning(
-                        f"PAUSE mid-loop with {len(inflight)} in flight")
-                    return pause
                 if exhausted or len(inflight) >= cap:
+                    # truly idle (no gradient, nothing to dispatch): check
+                    # for early PAUSE/STOP (downstream died or the server
+                    # dropped the round) rather than waiting forever for
+                    # gradients that will never come — the reference hangs
+                    # here (SURVEY.md §5.3).  Kept off the dispatch path so
+                    # steady-state forwards pay no extra RPC.
+                    pause = self._check_pause()
+                    if pause is not None:
+                        self.log.warning(
+                            f"PAUSE mid-loop with {len(inflight)} in flight")
+                        return pause
                     continue
                 try:
                     x, labels = next(data_iter)
@@ -451,15 +481,17 @@ class ProtocolClient:
                             rng)
                 data_id = uuid.uuid4().hex
                 inflight[data_id] = _Inflight(x=x, rng=rng,
-                                              trace=[self.client_id])
+                                              trace=[self.client_id],
+                                              n=len(labels))
                 self.bus.publish(out_q, encode(Activation(
                     data_id=data_id, data=np.asarray(out, np.float32),
                     labels=np.asarray(labels, np.int32),
-                    trace=[self.client_id], cluster=self.cluster)))
+                    trace=[self.client_id], cluster=self.cluster,
+                    round_idx=self.fence)))
                 n_fwd += 1
-                self.num_samples += len(labels)
         self.bus.publish(RPC_QUEUE, encode(Notify(
-            client_id=self.client_id, cluster=self.cluster)))
+            client_id=self.client_id, cluster=self.cluster,
+            round_idx=self.fence)))
         self.log.info(f"[>>>] NOTIFY fwd={n_fwd} bwd={n_bwd}")
         return self._wait_pause()
 
@@ -477,35 +509,41 @@ class ProtocolClient:
             raw = self.bus.get(grad_q, timeout=0.0005)
             if raw is not None:
                 g = decode(raw)
+                if g.round_idx != self.fence:
+                    continue   # gradient from a dropped round
                 ent = inflight.pop(g.data_id, None)
-                if ent is None:   # stale gradient from a cut round
+                if ent is None:   # no longer tracked (cut round)
                     continue
                 gt, gx, self.stats = r.bwd(
                     self.frozen, self.trainable, self.stats, ent.x,
                     jnp.asarray(g.data), ent.rng)
                 self.trainable, self.opt_state = r.apply_update(
                     self.trainable, self.opt_state, gt)
+                self.num_samples += ent.n   # see _train_first
                 origin = ent.trace[-1]
                 self.bus.publish(
                     gradient_queue(self.stage - 1, origin),
                     encode(Gradient(data_id=g.data_id,
                                     data=np.asarray(gx, np.float32),
-                                    trace=ent.trace[:-1])))
+                                    trace=ent.trace[:-1],
+                                    round_idx=self.fence)))
                 continue
             raw = self.bus.get(in_q, timeout=0.0005)
             if raw is None:
                 continue
             act = decode(raw)
+            if act.round_idx != self.fence:
+                continue   # activation from a dropped round: discard
             x = jnp.asarray(act.data)
             rng = r.next_rng()
             out = r.fwd(self.frozen, self.trainable, self.stats, x, rng)
             inflight[act.data_id] = _Inflight(x=x, rng=rng,
-                                              trace=list(act.trace))
-            self.num_samples += len(act.labels)
+                                              trace=list(act.trace),
+                                              n=len(act.labels))
             self.bus.publish(out_q, encode(Activation(
                 data_id=act.data_id, data=np.asarray(out, np.float32),
                 labels=act.labels, trace=list(act.trace) + [self.client_id],
-                cluster=self.cluster)))
+                cluster=self.cluster, round_idx=self.fence)))
 
     def _train_last(self) -> Pause:
         """Loss + backward + routed input-gradient return
@@ -529,7 +567,10 @@ class ProtocolClient:
                     self._sda_step(window)
                     window = []
                 continue
-            window.append(decode(raw))
+            act = decode(raw)
+            if act.round_idx != self.fence:
+                continue   # activation from a dropped round: discard
+            window.append(act)
             if len(window) >= self.sda_size:
                 self._sda_step(window)
                 window = []
@@ -557,7 +598,8 @@ class ProtocolClient:
             self.bus.publish(
                 gradient_queue(self.stage - 1, origin),
                 encode(Gradient(data_id=act.data_id, data=part,
-                                trace=list(act.trace)[:-1])))
+                                trace=list(act.trace)[:-1],
+                                round_idx=self.fence)))
 
 
 def main(argv=None):

@@ -64,14 +64,22 @@ class Ready:
 
 @dataclasses.dataclass
 class Notify:
-    """stage-1 client → server: local data exhausted this round."""
+    """stage-1 client → server: local data exhausted this round.
+
+    ``round_idx`` fences the barrier: a straggler's NOTIFY from a round
+    the server already dropped must not satisfy a later round's barrier."""
     client_id: str
     cluster: int
+    round_idx: int = 0
 
 
 @dataclasses.dataclass
 class Update:
-    """client → server: round's trained shard parameters."""
+    """client → server: round's trained shard parameters.
+
+    ``round_idx`` fences aggregation: without it, a straggler dropped in
+    round N that wakes during round N+1 would have its stale round-N
+    weights counted as N+1's contribution."""
     client_id: str
     stage: int
     cluster: int
@@ -79,6 +87,7 @@ class Update:
     num_samples: int                # FedAvg weight (data_count semantics)
     ok: bool = True                 # False -> NaN seen, skip aggregation
     batch_stats: Any | None = None  # shard's running stats (BN models)
+    round_idx: int = 0
 
 
 @dataclasses.dataclass
@@ -124,12 +133,18 @@ class Stop:
 class Activation:
     """stage k → stage k+1. ``trace`` is the routing stack of client_ids,
     appended per forward hop, popped per backward hop
-    (``src/train/VGG16.py:24-31``, ``:41-43``)."""
+    (``src/train/VGG16.py:24-31``, ``:41-43``).  ``round_idx`` fences
+    rounds: a consumer drops messages stamped with a different round, so
+    activations published into a round the server already dropped (elastic
+    mid-round PAUSE) can't leak into the next round's batches — the
+    reference has no such fence because its queues only ever carry one
+    round at a time (it hangs instead of dropping rounds, SURVEY.md §5.3)."""
     data_id: str
     data: np.ndarray
     labels: np.ndarray
     trace: list
     cluster: int
+    round_idx: int = 0
 
 
 @dataclasses.dataclass
@@ -138,6 +153,7 @@ class Gradient:
     data_id: str
     data: np.ndarray
     trace: list
+    round_idx: int = 0
 
 
 CONTROL_TYPES = (Register, Ready, Notify, Update, Start, Syn, Pause, Stop)

@@ -65,16 +65,28 @@ class ProtocolContext(MeshContext):
 
     def __init__(self, cfg: Config, transport: Transport,
                  logger: Logger | None = None,
-                 client_timeout: float = 600.0):
+                 client_timeout: float = 600.0,
+                 ready_timeout: float | None = None):
         super().__init__(cfg)
         self.bus = transport
         self.log = logger or Logger(cfg.log_path, debug=cfg.debug,
                                     console=False, name="server")
         self.client_timeout = client_timeout
+        # registration/READY happen before any jit work on the client, so
+        # they can run on a much shorter deadline than the training
+        # barriers (NOTIFY/UPDATE), which cover compile + a full round
+        self.ready_timeout = (client_timeout if ready_timeout is None
+                              else ready_timeout)
         self._registrations: dict[str, Registration] = {}
         self._ready: set = set()
         self._notified: set = set()
         self._updates: list[Update] = []
+        # fence: messages are stamped with a per-train_cluster-invocation
+        # generation (NOT the round index — sequential strategies run
+        # several invocations with the same round_idx, and a straggler
+        # from sub-call k must not satisfy sub-call k+1's barriers)
+        self._gen = 0
+        self._cur_gen = 0
 
     # -- rpc pump ------------------------------------------------------------
 
@@ -95,12 +107,23 @@ class ProtocolContext(MeshContext):
         elif isinstance(msg, Ready):
             self._ready.add(msg.client_id)
         elif isinstance(msg, Notify):
-            self._notified.add(msg.client_id)
-            self.log.received(f"NOTIFY {msg.client_id}")
+            if msg.round_idx != self._cur_gen:
+                self.log.warning(f"stale NOTIFY {msg.client_id} "
+                                 f"gen={msg.round_idx} (dropped)")
+            else:
+                self._notified.add(msg.client_id)
+                self.log.received(f"NOTIFY {msg.client_id}")
         elif isinstance(msg, Update):
-            self._updates.append(msg)
-            self.log.received(f"UPDATE {msg.client_id} "
-                              f"samples={msg.num_samples} ok={msg.ok}")
+            # a straggler dropped in invocation k that wakes during k+1
+            # must not have its stale weights aggregated as k+1's
+            # contribution
+            if msg.round_idx != self._cur_gen:
+                self.log.warning(f"stale UPDATE {msg.client_id} "
+                                 f"gen={msg.round_idx} (dropped)")
+            else:
+                self._updates.append(msg)
+                self.log.received(f"UPDATE {msg.client_id} "
+                                  f"samples={msg.num_samples} ok={msg.ok}")
         return True
 
     def _pump_until(self, pred: Callable[[], bool],
@@ -125,6 +148,9 @@ class ProtocolContext(MeshContext):
     def wait_for_registrations(self) -> list[Registration]:
         """Block until every configured client has registered
         (``src/Server.py:111-135``)."""
+        # full client_timeout here, NOT ready_timeout: registration covers
+        # client process startup (jax import, transport connect) and a
+        # miss is fatal rather than an elastic drop
         total = sum(self.cfg.clients)
         self._pump_until(lambda: len(self._registrations) >= total,
                          f"{total} registrations",
@@ -161,6 +187,8 @@ class ProtocolContext(MeshContext):
         self._ready.clear()
         self._notified.clear()
         self._updates = []
+        self._gen += 1
+        self._cur_gen = self._gen
 
         for cid, s in active:
             a, b = ranges[s - 1]
@@ -178,12 +206,15 @@ class ProtocolContext(MeshContext):
                 batch_stats=shard_s, learning=learning,
                 label_counts=label_counts, round_idx=round_idx,
                 extra={"epochs": epochs, "sda_size": sda,
-                       "n_stages": plan.n_stages})))
+                       "n_stages": plan.n_stages,
+                       "gen": self._cur_gen})))
             self.log.sent(f"START -> {cid} layers=[{a}, {end_layer}]")
 
         ids = {cid for cid, _ in active}
-        if not self._pump_until(lambda: ids <= self._ready,
-                                f"READY from {ids - self._ready}"):
+        if not self._pump_until(
+                lambda: ids <= self._ready,
+                f"READY from {ids - self._ready}",
+                deadline=time.monotonic() + self.ready_timeout):
             ids &= self._ready  # drop unresponsive clients mid-round
         for cid in ids:
             self.bus.publish(reply_queue(cid), encode(Syn(round_idx)))
@@ -221,7 +252,8 @@ class ProtocolServer:
 
     def __init__(self, cfg: Config, transport: Transport | None = None,
                  logger: Logger | None = None,
-                 client_timeout: float = 600.0):
+                 client_timeout: float = 600.0,
+                 ready_timeout: float | None = None):
         self.cfg = cfg
         self.log = logger or Logger(cfg.log_path, debug=cfg.debug,
                                     name="server")
@@ -229,7 +261,8 @@ class ProtocolServer:
             cfg.transport.kind, cfg.transport.host, cfg.transport.port)
         bus.purge()   # queue hygiene at startup (src/Utils.py:8-32)
         self.ctx = ProtocolContext(cfg, bus, logger=self.log,
-                                   client_timeout=client_timeout)
+                                   client_timeout=client_timeout,
+                                   ready_timeout=ready_timeout)
 
     def serve(self) -> TrainResult:
         from split_learning_tpu.parallel.multihost import (
@@ -253,13 +286,17 @@ def main(argv=None):
     ap.add_argument("--broker", action="store_true",
                     help="also host the TCP broker in this process")
     ap.add_argument("--client_timeout", type=float, default=600.0)
+    ap.add_argument("--ready_timeout", type=float, default=None,
+                    help="registration/READY barrier deadline "
+                         "(default: --client_timeout)")
     args = ap.parse_args(argv)
     cfg = from_yaml(args.config)
     broker = None
     if args.broker and cfg.transport.kind == "tcp":
         broker = Broker(cfg.transport.host, cfg.transport.port)
     try:
-        server = ProtocolServer(cfg, client_timeout=args.client_timeout)
+        server = ProtocolServer(cfg, client_timeout=args.client_timeout,
+                                ready_timeout=args.ready_timeout)
         result = server.serve()
         for rec in result.history:
             acc = (f" val_acc={rec.val_accuracy:.4f}"

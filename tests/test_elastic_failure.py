@@ -7,8 +7,10 @@ import threading
 
 from split_learning_tpu.runtime.bus import InProcTransport
 from split_learning_tpu.runtime.client import ProtocolClient
-from split_learning_tpu.runtime.protocol import RPC_QUEUE, Register, encode
-from split_learning_tpu.runtime.server import ProtocolServer
+from split_learning_tpu.runtime.protocol import (
+    RPC_QUEUE, Notify, Register, Update, encode,
+)
+from split_learning_tpu.runtime.server import ProtocolContext, ProtocolServer
 
 from tests.test_protocol_runtime import proto_cfg
 
@@ -16,8 +18,12 @@ from tests.test_protocol_runtime import proto_cfg
 def test_dead_client_dropped_round_completes(tmp_path):
     bus = InProcTransport()
     cfg = proto_cfg(tmp_path, clients=[2, 1])
-    # deadline long enough for jit compiles, short enough to test drops
-    server = ProtocolServer(cfg, transport=bus, client_timeout=45)
+    # READY is acked before any jit work (_on_start builds the shard and
+    # loader only), so the dead client is dropped after just 15 s; the
+    # training barriers keep a generous deadline — they cover jit compiles
+    # and the whole round, which takes ~20 s on a loaded CI machine
+    server = ProtocolServer(cfg, transport=bus, client_timeout=300,
+                            ready_timeout=15)
 
     threads = []
     for cid, stage in (("live_1", 1), ("live_2", 2)):
@@ -36,3 +42,32 @@ def test_dead_client_dropped_round_completes(tmp_path):
     for th in threads:
         th.join(timeout=30)
         assert not th.is_alive()
+
+
+def test_stale_messages_fenced_by_generation(tmp_path):
+    """A straggler's NOTIFY/UPDATE stamped with an older generation must
+    not satisfy the current invocation's barriers — even within the same
+    round_idx (sequential strategies reuse round_idx across sub-calls)."""
+    bus = InProcTransport()
+    cfg = proto_cfg(tmp_path, clients=[1, 1])
+    ctx = ProtocolContext(cfg, bus)
+    ctx._gen = 3
+    ctx._cur_gen = 3
+
+    # stale messages from generation 2 (dropped invocation)
+    bus.publish(RPC_QUEUE, encode(Notify(
+        client_id="a", cluster=0, round_idx=2)))
+    bus.publish(RPC_QUEUE, encode(Update(
+        client_id="a", stage=1, cluster=0, params={}, num_samples=7,
+        round_idx=2)))
+    # current-generation messages
+    bus.publish(RPC_QUEUE, encode(Notify(
+        client_id="b", cluster=0, round_idx=3)))
+    bus.publish(RPC_QUEUE, encode(Update(
+        client_id="b", stage=1, cluster=0, params={}, num_samples=5,
+        round_idx=3)))
+
+    for _ in range(4):
+        assert ctx._pump_one(timeout=0.1)
+    assert ctx._notified == {"b"}
+    assert [u.client_id for u in ctx._updates] == ["b"]
