@@ -103,6 +103,8 @@ class TopologyConfig:
     num_clusters: int = 1
     cluster_algorithm: str = "kmeans"  # kmeans | affinity
     selection: bool = False         # GMM straggler rejection on/off
+    force_pipeline: bool = False    # keep stage ppermute even where the
+    # backend would rather collapse to DP (CPU big-model safety fallback)
 
     def validate(self):
         _check(self.mode in ("manual", "auto"),

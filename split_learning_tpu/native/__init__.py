@@ -1,27 +1,49 @@
 """Native runtime components (C++), built on demand with the system
 toolchain.
 
-``NativeBroker`` wraps ``native/broker.cpp`` — the framework's native
+``NativeBroker`` wraps ``src/broker.cpp`` (shipped as package data so
+installed distributions can build it too) — the framework's native
 message broker (the role RabbitMQ plays for the reference,
 ``/root/reference/README.md:43-69``): compile (cached by source mtime),
 spawn as a subprocess, parse the bound port, and manage lifetime.  The
 Python ``TcpTransport`` speaks to it unchanged; ``python -m
 split_learning_tpu.broker`` prefers it and falls back to the threaded
 Python broker when no compiler is available.
+
+Built artifacts go next to the sources when that directory is writable
+(source checkout), else to ``~/.cache/split_learning_tpu/bin``
+(site-packages installs are often read-only).
 """
 
 from __future__ import annotations
 
+import os
 import pathlib
 import shutil
 import subprocess
 
-_ROOT = pathlib.Path(__file__).resolve().parents[2]
-_SRC_DIR = _ROOT / "native"
+_SRC_DIR = pathlib.Path(__file__).resolve().parent / "src"
 _SRC = _SRC_DIR / "broker.cpp"
-_BIN_DIR = _SRC_DIR / "bin"
-_BIN = _BIN_DIR / "slt_broker"
 _MFCC_SRC = _SRC_DIR / "mfcc.cpp"
+
+
+def _bin_dir() -> pathlib.Path:
+    override = os.environ.get("SLT_NATIVE_BIN")
+    if override:
+        return pathlib.Path(override)
+    local = _SRC_DIR.parent / "bin"
+    try:
+        local.mkdir(parents=True, exist_ok=True)
+        probe = local / ".writable"
+        probe.touch()
+        probe.unlink()
+        return local
+    except OSError:
+        return pathlib.Path.home() / ".cache" / "split_learning_tpu" / "bin"
+
+
+_BIN_DIR = _bin_dir()
+_BIN = _BIN_DIR / "slt_broker"
 _MFCC_LIB = _BIN_DIR / "libslt_mfcc.so"
 
 
@@ -44,7 +66,10 @@ def _build(src: pathlib.Path, dest: pathlib.Path,
     if not force and dest.exists() \
             and dest.stat().st_mtime >= src.stat().st_mtime:
         return dest
-    _BIN_DIR.mkdir(parents=True, exist_ok=True)
+    try:
+        _BIN_DIR.mkdir(parents=True, exist_ok=True)
+    except OSError as e:
+        raise NativeBuildError(f"cannot create bin dir {_BIN_DIR}: {e}")
     cmd = [_compiler(), "-O2", "-std=c++17", *(extra or []),
            "-o", str(dest), str(src)]
     proc = subprocess.run(cmd, capture_output=True, text=True)

@@ -276,8 +276,8 @@ class ProtocolClient:
             if isinstance(msg, Start):
                 started = True
                 self._on_start(msg)
-                self.bus.publish(RPC_QUEUE,
-                                 encode(Ready(client_id=self.client_id)))
+                self.bus.publish(RPC_QUEUE, encode(Ready(
+                    client_id=self.client_id, round_idx=self.fence)))
                 self.log.info("[>>>] READY")
             elif isinstance(msg, Syn):
                 self._on_syn(msg)

@@ -137,11 +137,32 @@ class MeshContext(TrainContext):
                 seed=seed, synthetic_size=self.cfg.synthetic_size)
         return self._loader_cache[key]
 
+    # params above this, on the CPU backend, force DP-only geometry: XLA's
+    # CPU collectives abort the process when one rendezvous participant is
+    # >40 s late (rendezvous.cc termination timeout), and a heavy pipeline
+    # stage per scan tick on oversubscribed virtual devices blows that
+    # budget.  Tiny test/dryrun models stay under it and keep exercising
+    # the real ppermute pipeline path.
+    _CPU_PIPELINE_PARAM_LIMIT = 2_000_000
+
+    def _param_count(self) -> int:
+        if not hasattr(self, "_n_params"):
+            shapes = jax.eval_shape(self.init_variables)
+            self._n_params = int(sum(
+                np.prod(l.shape)
+                for l in jax.tree_util.tree_leaves(shapes["params"])))
+        return self._n_params
+
     def _geometry(self, plan: ClusterPlan, n_active: int):
         """(C_phys, S_phys, physical cuts) fitted to the device budget."""
         S = len(plan.cuts) + 1
         D = len(self.devices)
-        if D >= S and plan.cuts:
+        pipeline_ok = D >= S and bool(plan.cuts)
+        if (pipeline_ok and jax.default_backend() == "cpu"
+                and self._param_count() > self._CPU_PIPELINE_PARAM_LIMIT
+                and not self.cfg.topology.force_pipeline):
+            pipeline_ok = False
+        if pipeline_ok:
             s_phys, cuts_phys = S, list(plan.cuts)
         else:
             s_phys, cuts_phys = 1, []

@@ -58,8 +58,14 @@ class Register:
 
 @dataclasses.dataclass
 class Ready:
-    """client → server: shard built, data loaded — replaces sleep(25)."""
+    """client → server: shard built, data loaded — replaces sleep(25).
+
+    ``round_idx`` carries the START's generation: a late READY from an
+    invocation the server already gave up on must not count toward a
+    newer invocation's READY barrier (the server would then SYN a client
+    that is still unwinding the old round)."""
     client_id: str
+    round_idx: int = 0
 
 
 @dataclasses.dataclass

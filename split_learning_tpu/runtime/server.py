@@ -105,7 +105,14 @@ class ProtocolContext(MeshContext):
                 client_id=msg.client_id, stage=msg.stage,
                 cluster=msg.cluster, profile=msg.profile)
         elif isinstance(msg, Ready):
-            self._ready.add(msg.client_id)
+            # fenced like Notify/Update: a late READY from a dropped
+            # invocation must not let the server SYN a client that is
+            # still unwinding the old round
+            if msg.round_idx != self._cur_gen:
+                self.log.warning(f"stale READY {msg.client_id} "
+                                 f"gen={msg.round_idx} (dropped)")
+            else:
+                self._ready.add(msg.client_id)
         elif isinstance(msg, Notify):
             if msg.round_idx != self._cur_gen:
                 self.log.warning(f"stale NOTIFY {msg.client_id} "

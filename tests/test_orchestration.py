@@ -168,3 +168,37 @@ def test_nan_round_skips_aggregation(tmp_path):
                                  variables.get("batch_stats", {}))
     assert not outcome.ok
     assert outcome.params is poisoned  # untouched
+
+
+def test_cpu_geometry_collapses_heavy_pipeline(tmp_path):
+    """On the CPU backend a heavy model must drop the stage axis (XLA CPU
+    collectives abort when a rendezvous participant is >40 s late; a full
+    VGG stage per tick on oversubscribed virtual devices exceeds that),
+    while tiny models keep the real ppermute pipeline path and
+    ``topology.force_pipeline`` restores it on request."""
+    from split_learning_tpu.runtime.plan import plan_clusters, Registration
+
+    def geom(cfg):
+        regs = [Registration(client_id=f"c{i}_{s}", stage=s)
+                for s in (1, 2) for i in range(cfg.clients[s - 1])]
+        plan = plan_clusters(cfg, regs)[0]
+        return MeshContext(cfg)._geometry(plan, cfg.clients[0])
+
+    tiny = tiny_cfg(tmp_path)
+    c, s, cuts = geom(tiny)
+    assert (s, cuts) == (2, [2])   # tiny: pipeline kept
+
+    def vgg_cfg(**topo):
+        return from_dict(dict(
+            model="VGG16", dataset="CIFAR10", clients=[2, 1],
+            synthetic_size=16, log_path=str(tmp_path),
+            learning={"batch_size": 4, "control_count": 2},
+            distribution={"num_samples": 8},
+            topology={"cut_layers": [7], **topo},
+            checkpoint={"directory": str(tmp_path / "ckpt")}))
+
+    c, s, cuts = geom(vgg_cfg())
+    assert (s, cuts) == (1, [])    # heavy on CPU: DP-only
+
+    c, s, cuts = geom(vgg_cfg(force_pipeline=True))
+    assert (s, cuts) == (2, [7])   # explicit override keeps pipeline
