@@ -1,0 +1,37 @@
+"""Honor ``JAX_PLATFORMS`` even when jax was pre-imported.
+
+A ``sitecustomize`` (or any other early import) can initialize jax before
+this package's CLI entry points run, at which point the ``JAX_PLATFORMS``
+environment variable no longer has any effect — a child process spawned
+with ``JAX_PLATFORMS=cpu`` silently lands on the site-pinned accelerator
+instead.  ``jax.config.update`` wins over a pre-import, so every CLI main
+calls this first.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def apply_platform_env() -> None:
+    plat = os.environ.get("JAX_PLATFORMS")
+    if not plat:
+        return
+    import sys
+
+    import jax
+
+    try:
+        jax.config.update("jax_platforms", plat)
+    except Exception as e:
+        print(f"warning: JAX_PLATFORMS={plat} could not be applied "
+              f"({e}); backends may already be initialized",
+              file=sys.stderr)
+        return
+    try:
+        got = jax.default_backend()
+        if got not in plat.split(","):
+            print(f"warning: JAX_PLATFORMS={plat} requested but the "
+                  f"effective backend is {got!r}", file=sys.stderr)
+    except Exception:
+        pass  # backend init deferred — the update took effect

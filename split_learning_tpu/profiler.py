@@ -178,6 +178,8 @@ def write_profile(path: str, profile: dict) -> None:
 
 
 def main(argv=None):
+    from split_learning_tpu.platform import apply_platform_env
+    apply_platform_env()
     ap = argparse.ArgumentParser(
         description="Profile a model + link for the partition planner "
                     "(reference profiling.py parity).")

@@ -59,6 +59,8 @@ def run_local(cfg: Config, devices=None,
 
 
 def main(argv=None):
+    from split_learning_tpu.platform import apply_platform_env
+    apply_platform_env()
     ap = argparse.ArgumentParser(
         description="Run a full split-learning training cell in-process.")
     ap.add_argument("--config", default="config.yaml")

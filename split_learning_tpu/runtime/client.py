@@ -603,6 +603,8 @@ class ProtocolClient:
 
 
 def main(argv=None):
+    from split_learning_tpu.platform import apply_platform_env
+    apply_platform_env()
     ap = argparse.ArgumentParser(
         description="Split-learning protocol client (reference client.py "
                     "parity).")

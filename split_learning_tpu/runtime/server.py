@@ -286,6 +286,8 @@ class ProtocolServer:
 
 
 def main(argv=None):
+    from split_learning_tpu.platform import apply_platform_env
+    apply_platform_env()
     ap = argparse.ArgumentParser(
         description="Split-learning protocol server (reference server.py "
                     "parity).")
