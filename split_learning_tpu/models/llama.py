@@ -119,22 +119,61 @@ class LlamaBlock(nn.Module):
         return x + dense(self.hidden_size, name="down_proj")(gate * up)
 
 
+class MoELlamaBlock(nn.Module):
+    """LlamaBlock with the dense SwiGLU MLP swapped for a top-k
+    mixture-of-experts FFN (:class:`~split_learning_tpu.parallel.expert.
+    MoEMLP`) — the expert-parallel scale-out variant (no reference
+    counterpart; SURVEY.md §2.2 EP row)."""
+    hidden_size: int
+    num_heads: int
+    num_kv_heads: int
+    intermediate_size: int
+    num_experts: int = 8
+    k: int = 2
+    dtype: jnp.dtype = jnp.float32
+    use_flash: bool = False
+
+    @nn.compact
+    def __call__(self, x):
+        from split_learning_tpu.parallel.expert import MoEMLP
+        h = nn.RMSNorm(epsilon=1e-5, dtype=self.dtype,
+                       name="input_norm")(x)
+        x = x + LlamaAttention(
+            hidden_size=self.hidden_size, num_heads=self.num_heads,
+            num_kv_heads=self.num_kv_heads, dtype=self.dtype,
+            use_flash=self.use_flash, name="attention")(h)
+        h = nn.RMSNorm(epsilon=1e-5, dtype=self.dtype,
+                       name="post_norm")(x)
+        return x + MoEMLP(
+            hidden_size=self.hidden_size,
+            intermediate_size=self.intermediate_size,
+            num_experts=self.num_experts, k=self.k, dtype=self.dtype,
+            name="moe")(h)
+
+
 def _llama_specs(vocab_size: int = 32000, hidden_size: int = 2048,
                  num_heads: int = 32, num_kv_heads: int = 4,
                  intermediate_size: int = 5632, n_block: int = 22,
-                 use_flash: bool = False, dtype=jnp.float32) -> tuple:
+                 use_flash: bool = False, dtype=jnp.float32,
+                 num_experts: int = 0, k: int = 2) -> tuple:
     specs = [LayerSpec("layer1", make=functools.partial(
         nn.Embed, num_embeddings=vocab_size, features=hidden_size,
         dtype=dtype), fn=_plain_fn)]
     for i in range(n_block):
-        specs.append(LayerSpec(
-            f"layer{2 + i}",
-            make=functools.partial(
+        if num_experts > 0:
+            block = functools.partial(
+                MoELlamaBlock, hidden_size=hidden_size,
+                num_heads=num_heads, num_kv_heads=num_kv_heads,
+                intermediate_size=intermediate_size,
+                num_experts=num_experts, k=k, use_flash=use_flash,
+                dtype=dtype)
+        else:
+            block = functools.partial(
                 LlamaBlock, hidden_size=hidden_size, num_heads=num_heads,
                 num_kv_heads=num_kv_heads,
                 intermediate_size=intermediate_size, use_flash=use_flash,
-                dtype=dtype),
-            fn=_plain_fn))
+                dtype=dtype)
+        specs.append(LayerSpec(f"layer{2 + i}", make=block, fn=_plain_fn))
     specs.append(LayerSpec(f"layer{2 + n_block}",
                            make=functools.partial(nn.RMSNorm, epsilon=1e-5,
                                                   dtype=dtype),
@@ -148,5 +187,15 @@ def _llama_specs(vocab_size: int = 32000, hidden_size: int = 2048,
 @register_model("TinyLlama_TINYSTORIES")
 def tinyllama_tinystories(dtype=jnp.float32, **kw) -> tuple:
     """TinyLlama-1.1B geometry; input (B, S) int32 token ids, output
-    (B, S, vocab) next-token logits.  25 layers at default size."""
+    (B, S, vocab) next-token logits.  25 layers at full size."""
     return _llama_specs(dtype=dtype, **kw)
+
+
+@register_model("TinyLlamaMoE_TINYSTORIES")
+def tinyllama_moe_tinystories(dtype=jnp.float32, num_experts: int = 8,
+                              **kw) -> tuple:
+    """Sparse-MoE variant: every decoder block's MLP is a top-k
+    mixture of ``num_experts`` SwiGLU experts, shardable over an
+    ``expert`` mesh axis (``parallel/expert.py``).  Same split-layer
+    contract as the dense model."""
+    return _llama_specs(dtype=dtype, num_experts=num_experts, **kw)

@@ -1,0 +1,225 @@
+"""Expert parallelism: Mixture-of-Experts routing over an ``expert`` axis.
+
+The reference has no expert parallelism (SURVEY.md §2.2 marks EP absent)
+— this is a fresh TPU-native extension completing the parallelism
+surface (dp/pp/tp/sp/ep).  Design follows the GSPMD recipe rather than
+hand-written collectives:
+
+* the MoE layer computes dense ``dispatch``/``combine`` tensors
+  (Switch/GShard-style top-k routing with a static per-expert capacity,
+  so every shape is known to XLA — no dynamic gather/scatter);
+* expert parameters carry a leading ``num_experts`` dim (``nn.vmap``
+  over an FFN) and are sharded ``P("expert", ...)``;
+* tokens ride the data axis; the two routing einsums
+  ``tec,th->ech`` / ``tec,ech->th`` then force XLA to insert the
+  expert-parallel all-to-alls on its own — the same collective an
+  NCCL MoE implementation would issue by hand, but fused and
+  overlapped by the compiler.
+
+Routing math: softmax router in fp32, top-k experts per token with
+renormalized gate weights, tokens over capacity dropped (their combine
+weight is zero, so they pass through the residual unchanged — standard
+Switch semantics).  The load-balance auxiliary loss is sown into the
+``intermediates`` collection; :func:`moe_aux_loss` or the bundled
+train step adds it to the objective.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def topk_dispatch(probs: jnp.ndarray, k: int, capacity: int):
+    """Top-k routing tensors from router probabilities.
+
+    Args:
+      probs: (T, E) fp32 router probabilities (rows sum to 1).
+      k: experts per token.
+      capacity: static per-expert token budget C.
+
+    Returns ``(combine, dispatch, aux)``: combine (T, E, C) fp32 gate
+    weights (renormalized over the top-k, zero for dropped tokens),
+    dispatch (T, E, C) {0,1} routing mask, and the Switch load-balance
+    auxiliary loss ``E * Σ_e f_e · P_e`` over first-choice assignments.
+    """
+    t, e = probs.shape
+    remaining = probs
+    onehots, gates = [], []
+    for _ in range(k):
+        idx = jnp.argmax(remaining, axis=1)
+        onehot = jax.nn.one_hot(idx, e, dtype=probs.dtype)
+        onehots.append(onehot)
+        gates.append(jnp.sum(probs * onehot, axis=1))
+        remaining = remaining * (1.0 - onehot)
+
+    # renormalize gate weights over the chosen k (Mixtral convention)
+    denom = functools.reduce(jnp.add, gates)
+    gates = [g / jnp.maximum(denom, 1e-9) for g in gates]
+
+    combine = jnp.zeros((t, e, capacity), probs.dtype)
+    dispatch = jnp.zeros((t, e, capacity), probs.dtype)
+    prev_counts = jnp.zeros((e,), probs.dtype)
+    for onehot, gate in zip(onehots, gates):
+        # position of each token within its expert's buffer, counting
+        # earlier routing rounds (priority: round 0 fills first)
+        pos_all = jnp.cumsum(onehot, axis=0) - 1.0 + prev_counts[None, :]
+        pos = jnp.sum(pos_all * onehot, axis=1)
+        keep = (pos < capacity).astype(probs.dtype)
+        prev_counts = prev_counts + jnp.sum(onehot, axis=0)
+        slot = jax.nn.one_hot(pos.astype(jnp.int32), capacity,
+                              dtype=probs.dtype)
+        d = onehot[:, :, None] * slot[:, None, :] * keep[:, None, None]
+        dispatch = dispatch + d
+        combine = combine + d * gate[:, None, None]
+
+    # load balance: fraction routed (first choice) x mean router prob
+    frac = jnp.mean(onehots[0], axis=0)
+    mean_prob = jnp.mean(probs, axis=0)
+    aux = e * jnp.sum(frac * mean_prob)
+    return combine, dispatch, aux
+
+
+class ExpertFFN(nn.Module):
+    """One expert: SwiGLU FFN (LLaMA geometry)."""
+    hidden_size: int
+    intermediate_size: int
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        dense = functools.partial(nn.Dense, use_bias=False,
+                                  dtype=self.dtype)
+        gate = nn.silu(dense(self.intermediate_size, name="gate_proj")(x))
+        up = dense(self.intermediate_size, name="up_proj")(x)
+        return dense(self.hidden_size, name="down_proj")(gate * up)
+
+
+class MoEMLP(nn.Module):
+    """Top-k mixture-of-experts FFN, drop-in for a dense SwiGLU MLP.
+
+    Input/output (B, S, H).  ``capacity_factor`` scales the per-expert
+    buffer ``C = ceil(k·T/E · factor)``; tokens over budget are dropped
+    (combine weight 0 → they contribute nothing, the caller's residual
+    carries them through).  The aux loss is sown under
+    ``intermediates/aux_loss`` when that collection is mutable.
+    """
+    hidden_size: int
+    intermediate_size: int
+    num_experts: int = 8
+    k: int = 2
+    capacity_factor: float = 1.5
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        b, s, h = x.shape
+        t = b * s
+        xt = x.reshape(t, h)
+        logits = nn.Dense(self.num_experts, use_bias=False,
+                          dtype=jnp.float32, name="router")(
+            xt.astype(jnp.float32))
+        probs = jax.nn.softmax(logits, axis=-1)
+        capacity = max(1, int(np.ceil(
+            self.k * t / self.num_experts * self.capacity_factor)))
+        combine, dispatch, aux = topk_dispatch(probs, self.k, capacity)
+        self.sow("intermediates", "aux_loss", aux)
+
+        # (T,E,C),(T,H) -> (E,C,H): the expert-parallel scatter all-to-all
+        expert_in = jnp.einsum("tec,th->ech",
+                               dispatch.astype(self.dtype), xt)
+        experts = nn.vmap(
+            ExpertFFN,
+            in_axes=0, out_axes=0,
+            variable_axes={"params": 0},
+            split_rngs={"params": True},
+            axis_size=self.num_experts,
+            metadata_params={nn.PARTITION_NAME: "expert"},
+        )(hidden_size=self.hidden_size,
+          intermediate_size=self.intermediate_size,
+          dtype=self.dtype, name="experts")
+        expert_out = experts(expert_in)            # (E, C, H)
+        # (T,E,C),(E,C,H) -> (T,H): the gather all-to-all
+        out = jnp.einsum("tec,ech->th", combine.astype(self.dtype),
+                         expert_out)
+        return out.reshape(b, s, h)
+
+
+# --------------------------------------------------------------------------
+# sharding rules
+# --------------------------------------------------------------------------
+
+def _names(path) -> list:
+    return [str(p.key) if hasattr(p, "key") else str(p) for p in path]
+
+
+def ep_spec(path, leaf, axis: str = "expert") -> P:
+    """PartitionSpec for one param leaf under expert parallelism: leaves
+    under an ``experts`` vmap scope shard their leading (expert) dim;
+    everything else replicates.  Compose with :func:`tp_spec` for
+    EP x TP by passing its result for non-expert leaves."""
+    ndim = np.ndim(leaf)
+    if "experts" in _names(path) and ndim >= 1:
+        return P(axis, *([None] * (ndim - 1)))
+    return P()
+
+
+def ep_shardings(params, mesh: Mesh, axis: str = "expert"):
+    return jax.tree_util.tree_map_with_path(
+        lambda path, leaf: NamedSharding(mesh, ep_spec(path, leaf, axis)),
+        params)
+
+
+def shard_params_ep(params, mesh: Mesh, axis: str = "expert"):
+    """Place a param tree onto the mesh under the EP rules."""
+    return jax.tree_util.tree_map(
+        jax.device_put, params, ep_shardings(params, mesh, axis))
+
+
+def moe_aux_loss(intermediates: dict) -> jnp.ndarray:
+    """Sum every sown ``aux_loss`` in an intermediates collection."""
+    total = jnp.zeros(())
+    for leaf in jax.tree_util.tree_leaves(intermediates):
+        total = total + jnp.sum(leaf)
+    return total
+
+
+def make_ep_train_step(model, optimizer, mesh: Mesh,
+                       axis: str = "expert", dp_axis: str | None = None,
+                       aux_weight: float = 0.01):
+    """Jitted EP(+DP) train step for a full (unsplit) MoE model.
+
+    Expert params stay sharded over ``axis``; the batch shards over
+    ``dp_axis``.  XLA derives the dispatch/gather all-to-alls from the
+    routing einsums.  The sown load-balance losses are added to the CE
+    objective with weight ``aux_weight``.
+    """
+    import optax
+
+    data_sh = NamedSharding(mesh, P(dp_axis) if dp_axis else P())
+
+    def step(params, opt_state, x, labels, rng):
+        def loss_fn(p):
+            out, mut = model.apply(
+                {"params": p}, x, train=True, rngs={"dropout": rng},
+                mutable=["intermediates"])
+            ce = optax.softmax_cross_entropy_with_integer_labels(
+                out.astype(jnp.float32), labels).mean()
+            return ce + aux_weight * moe_aux_loss(
+                mut.get("intermediates", {})), ce
+        (_, ce), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, ce
+
+    def place(params, opt_state, x, labels, rng):
+        return step(params, opt_state,
+                    jax.lax.with_sharding_constraint(x, data_sh),
+                    jax.lax.with_sharding_constraint(labels, data_sh),
+                    rng)
+
+    return jax.jit(place, donate_argnums=(0, 1))
