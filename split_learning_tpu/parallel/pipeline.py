@@ -130,33 +130,6 @@ class PipelineModel:
 
     # -- per-device pipeline body -----------------------------------------
 
-    def _stage_branch(self, s: int, train: bool):
-        model = self.stage_models[s]
-        a, b = self.ranges[s]
-        in_struct = self.boundary[s]
-
-        def apply_stage(params, stats, wire_in, rng_data):
-            # raw uint32 key data crosses the switch boundary: typed PRNG
-            # key avals confuse lax.switch's residual unification under
-            # autodiff (observed MLIR verifier failure, jax 0.9)
-            rng = jax.random.wrap_key_data(rng_data)
-            x = self._from_wire(wire_in, in_struct)
-            variables: dict = {"params": shard_params(params, self.specs,
-                                                      a, b)}
-            st = shard_params(stats, self.specs, a, b)
-            if st:
-                variables["batch_stats"] = st
-            out, mut = model.apply(
-                variables, x, train=train, mutable=["batch_stats"],
-                rngs={"dropout": rng} if train else None)
-            new_stats = dict(stats)
-            new_stats.update(mut.get("batch_stats", {}))
-            return self._to_wire(out), new_stats
-
-        if self.remat:
-            apply_stage = jax.checkpoint(apply_stage)
-        return apply_stage
-
     def loss_from_logits(self, logits, labels):
         if self.loss_name == "softmax_cross_entropy":
             return optax.softmax_cross_entropy_with_integer_labels(
@@ -165,11 +138,60 @@ class PipelineModel:
             return jnp.mean((logits - labels) ** 2)
         raise ValueError(f"unknown loss {self.loss_name!r}")
 
+    def _device_branch(self, d: int, k: int, train: bool):
+        """Branch for mesh-axis position ``d`` holding stages
+        ``[d*k, (d+1)*k)`` chained locally (virtual pipeline stages).
+
+        ``k == 1`` is the classic one-stage-per-device GPipe mapping; on a
+        1-wide ``stage`` axis (single chip) the whole split model chains
+        locally — same cut semantics and microbatch accumulation, no
+        inter-device hop.  Activations between co-located stages stay in
+        their native shape/dtype (no wire round-trip).
+        """
+        lo, hi = d * k, (d + 1) * k
+        in_struct = self.boundary[lo]
+
+        def apply_device(params, stats, wire_in, rng_data):
+            x = self._from_wire(wire_in, in_struct)
+            new_stats = dict(stats)
+            for s in range(lo, hi):
+                model = self.stage_models[s]
+                a, b = self.ranges[s]
+
+                # raw uint32 key data stays raw across the remat/switch
+                # boundary: typed PRNG key avals confuse lax.switch's
+                # residual unification under autodiff (observed MLIR
+                # verifier failure, jax 0.9)
+                def apply_one(params, st_in, x, rng_data,
+                              model=model, a=a, b=b):
+                    rng = jax.random.wrap_key_data(rng_data)
+                    variables: dict = {
+                        "params": shard_params(params, self.specs, a, b)}
+                    st = shard_params(st_in, self.specs, a, b)
+                    if st:
+                        variables["batch_stats"] = st
+                    out, mut = model.apply(
+                        variables, x, train=train,
+                        mutable=["batch_stats"],
+                        rngs={"dropout": rng} if train else None)
+                    return out, mut.get("batch_stats", {})
+
+                if self.remat:
+                    apply_one = jax.checkpoint(apply_one)
+                x, mut_stats = apply_one(params, new_stats, x, rng_data)
+                new_stats.update(mut_stats)
+            return self._to_wire(x), new_stats
+
+        return apply_device
+
     def device_loss(self, params, stats, x_mb, labels, rng,
                     train: bool = True,
-                    mesh_axes: tuple = ("client", "stage")):
+                    mesh_axes: tuple = ("client", "stage"),
+                    stage_axis_size: int | None = None):
         """Per-device pipelined loss. Must run inside shard_map with a
-        ``stage`` axis.
+        ``stage`` axis of size ``stage_axis_size`` (default: one device
+        per stage).  When the axis is smaller than ``n_stages`` each
+        device chains ``n_stages/axis`` consecutive stages locally.
 
         Returns ``(local_loss, (loss, new_stats))``: ``local_loss`` is this
         device's (unsummed) contribution — the value to differentiate;
@@ -177,8 +199,14 @@ class PipelineModel:
         the stage-merged batch stats.
         """
         S, M = self.n_stages, self.num_microbatches
-        stage = jax.lax.axis_index("stage")
-        branches = [self._stage_branch(s, train) for s in range(S)]
+        A = S if stage_axis_size is None else stage_axis_size
+        if S % A != 0:
+            raise ValueError(
+                f"n_stages={S} must be a multiple of the stage axis "
+                f"size {A}")
+        k = S // A
+        dev = jax.lax.axis_index("stage")
+        branches = [self._device_branch(d, k, train) for d in range(A)]
         stats0 = stats
 
         def tick(carry, t):
@@ -187,22 +215,22 @@ class PipelineModel:
             x_inj = self._to_wire(
                 jax.lax.dynamic_index_in_dim(x_mb, inj_idx, 0,
                                              keepdims=False))
-            act_in = jnp.where(stage == 0, x_inj, act_wire)
-            mb_idx = jnp.clip(t - stage, 0, M - 1)
+            act_in = jnp.where(dev == 0, x_inj, act_wire)
+            mb_idx = jnp.clip(t - dev, 0, M - 1)
             rng_t = jax.random.fold_in(rng, mb_idx)
 
             out_wire, new_stats = jax.lax.switch(
-                stage, branches, params, stats, act_in,
+                dev, branches, params, stats, act_in,
                 jax.random.key_data(rng_t))
 
             # bubble ticks compute garbage: keep their stats out
-            valid = (t >= stage) & (t < stage + M)
+            valid = (t >= dev) & (t < dev + M)
             new_stats = jax.tree_util.tree_map(
                 lambda n, o: jnp.where(valid, n, o), new_stats, stats)
 
-            # last stage collects logits for microbatch t-(S-1)
-            c_idx = jnp.clip(t - (S - 1), 0, M - 1)
-            collect = (stage == S - 1) & (t >= S - 1)
+            # last device collects logits for microbatch t-(A-1)
+            c_idx = jnp.clip(t - (A - 1), 0, M - 1)
+            collect = (dev == A - 1) & (t >= A - 1)
             logits_flat = out_wire[:, :self.n_out]
             out_buf = jnp.where(
                 collect,
@@ -210,7 +238,7 @@ class PipelineModel:
                     out_buf, logits_flat, c_idx, 0),
                 out_buf)
 
-            perm = [(i, i + 1) for i in range(S - 1)]
+            perm = [(i, i + 1) for i in range(A - 1)]
             act_next = (jax.lax.ppermute(out_wire, "stage", perm)
                         if perm else out_wire)
             return (act_next, new_stats, out_buf), None
@@ -219,20 +247,20 @@ class PipelineModel:
         act0 = jnp.zeros((self.mb_size, self.max_flat), self.wire_dtype)
         out_buf0 = jnp.zeros((M, self.mb_size, self.n_out), self.wire_dtype)
         (_, stats_f, out_buf), _ = jax.lax.scan(
-            tick, (act0, stats0, out_buf0), jnp.arange(M + S - 1))
+            tick, (act0, stats0, out_buf0), jnp.arange(M + A - 1))
 
         logits = out_buf.astype(self.out_struct.dtype).reshape(
             (M * self.mb_size,) + tuple(self.out_struct.shape[1:]))
         # collapse (M, mb, ...) -> (M*mb, ...): int labels stay 1-D for CE,
         # vector targets keep their feature dims for MSE
         labels_flat = labels.reshape((M * self.mb_size,) + labels.shape[2:])
-        local = jnp.where(stage == S - 1,
+        local = jnp.where(dev == A - 1,
                           self.loss_from_logits(logits, labels_flat),
                           0.0)
-        # NOTE: `local` (nonzero only on the last stage) is what must be
+        # NOTE: `local` (nonzero only on the last device) is what must be
         # differentiated.  Cross-stage gradient flow happens through the
         # ppermute transpose; psum-ing the loss BEFORE grad would seed a
-        # cotangent on every stage replica and overcount grads by S.
+        # cotangent on every stage replica and overcount grads by A.
         loss = jax.lax.psum(jax.lax.stop_gradient(local), "stage")
 
         # exactly one stage updated each stats leaf; share via delta-psum
@@ -309,9 +337,16 @@ def make_train_step(pipe: PipelineModel, optimizer: optax.GradientTransformation
     one fwd/bwd over ``sda_size`` concatenated client batches) is the same
     mechanism with a full-axis group.
 
+    The mesh's ``stage`` axis may be smaller than ``pipe.n_stages`` (it
+    must divide it): stages are then blocked onto devices as virtual
+    pipeline stages — on a 1-wide axis the whole split model runs on one
+    device with microbatch gradient accumulation (no collective hops),
+    preserving cut semantics on a single chip.
+
     Returns (params, opt_state, stats, loss[C]).
     """
     grad_sync = _make_grad_sync(client_sync, mesh)
+    stage_axis = int(mesh.shape["stage"])
 
     def body(params, opt_state, stats, x, labels, rngs):
         params, opt_state, stats = map(_strip, (params, opt_state, stats))
@@ -319,7 +354,8 @@ def make_train_step(pipe: PipelineModel, optimizer: optax.GradientTransformation
 
         def loss_fn(p):
             local, aux = pipe.device_loss(p, stats, x, labels, rng,
-                                          train=train)
+                                          train=train,
+                                          stage_axis_size=stage_axis)
             return local, aux
 
         (_, (loss, new_stats)), grads = jax.value_and_grad(
@@ -366,6 +402,7 @@ def make_lora_train_step(pipe: PipelineModel,
     from split_learning_tpu.ops.lora import lora_merge
 
     grad_sync = _make_grad_sync(client_sync, mesh)
+    stage_axis = int(mesh.shape["stage"])
 
     def body(frozen, t, opt_state, stats, x, labels, rngs):
         frozen, t, opt_state, stats = map(_strip,
@@ -376,7 +413,8 @@ def make_lora_train_step(pipe: PipelineModel,
             merged = lora_merge({**frozen, **tt["head"]}, tt["lora"],
                                 alpha=lora_alpha, rank=lora_rank)
             local, aux = pipe.device_loss(merged, stats, x, labels, rng,
-                                          train=True)
+                                          train=True,
+                                          stage_axis_size=stage_axis)
             return local, aux
 
         (_, (loss, new_stats)), grads = jax.value_and_grad(

@@ -88,15 +88,20 @@ def test_kwt_pipeline_matches_sequential(eight_devices, cuts, M):
                 err_msg=f"client {c} {path}")
 
 
-def test_vgg_pipeline_train_mode_with_batchnorm(eight_devices):
+@pytest.mark.parametrize("stage_devs", [2, 1])
+def test_vgg_pipeline_train_mode_with_batchnorm(eight_devices, stage_devs):
     """Train-mode pipeline: BN batch_stats and dropout must match the
-    sequential reference; bubble ticks must NOT pollute stats."""
+    sequential reference; bubble ticks must NOT pollute stats.
+
+    ``stage_devs=1`` runs both stages chained on ONE device (the
+    single-chip virtual-stage path) — same oracle, exercising the
+    train-mode rng/batch_stats flow through chained remat stages."""
     mb, C, M, cuts = 2, 1, 3, [7]
     pipe = PipelineModel(
         "VGG16_CIFAR10", cuts,
         jax.ShapeDtypeStruct((mb, 32, 32, 3), jnp.float32),
         num_microbatches=M)
-    mesh = make_mesh(C, 2, eight_devices[:2])
+    mesh = make_mesh(C, stage_devs, eight_devices[:stage_devs])
 
     variables = init_pipeline_variables(
         pipe, jax.random.key(0),
@@ -186,3 +191,42 @@ def test_bert_pipeline_int_tokens(eight_devices):
     ref_loss, _ = _ref_loss(model, variables["params"], {}, x[0], labels[0],
                             jax.random.key(9), False)
     np.testing.assert_allclose(float(out[3][0]), float(ref_loss), rtol=1e-5)
+
+
+@pytest.mark.parametrize("n_stage_devs", [1, 2])
+def test_virtual_stages_match_full_mesh(eight_devices, n_stage_devs):
+    """4 pipeline stages blocked onto a smaller stage axis (k=4 on one
+    device, k=2 on two) must produce the same loss and updated params as
+    the one-stage-per-device mapping — the single-chip split path."""
+    mb, M, C, cuts = 2, 3, 2, [1, 2, 3]
+    kw = dict(vocab_size=64, hidden_size=32, num_heads=2,
+              intermediate_size=64, max_position_embeddings=16, n_block=4)
+    x_struct = jax.ShapeDtypeStruct((mb, 16), jnp.int32)
+
+    def run(a):
+        pipe = PipelineModel("BERT_AGNEWS", cuts, x_struct,
+                             num_microbatches=M, model_kwargs=kw)
+        mesh = make_mesh(C, a, eight_devices[:C * a])
+        variables = init_pipeline_variables(pipe, jax.random.key(0),
+                                            x_struct)
+        params = variables["params"]
+        opt = optax.sgd(1e-2)
+        x = jax.random.randint(jax.random.key(1), (C, M, mb, 16), 0, 64)
+        labels = jax.random.randint(jax.random.key(2), (C, M, mb), 0, 4)
+        step = make_train_step(pipe, opt, mesh, train=False, donate=False)
+        new_p, _, _, loss = step(
+            shard_to_mesh(stack_for_clients(params, C), mesh),
+            shard_to_mesh(stack_for_clients(opt.init(params), C), mesh),
+            shard_to_mesh(stack_for_clients({}, C), mesh),
+            x, labels, jax.random.split(jax.random.key(3), C))
+        return (jax.tree_util.tree_map(np.asarray, new_p),
+                np.asarray(loss))
+
+    got_p, got_loss = run(n_stage_devs)
+    ref_p, ref_loss = run(4)
+    np.testing.assert_allclose(got_loss, ref_loss, rtol=1e-6)
+    for (path, a), (_, b) in zip(
+            jax.tree_util.tree_leaves_with_path(got_p),
+            jax.tree_util.tree_leaves_with_path(ref_p)):
+        np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-6,
+                                   err_msg=str(path))
