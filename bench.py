@@ -1,17 +1,39 @@
-"""Benchmark: VGG16/CIFAR10 split-learning training throughput.
+"""Benchmark: split-learning training throughput on the local accelerator.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", "extra"}.
+Human-readable per-section detail goes to stderr.
 
-The reference publishes no numbers (BASELINE.md), so the baseline is
-self-measured: a PyTorch-CPU VGG16-BN training step — the compute the
-reference's clients run per batch (``/root/reference/src/train/VGG16.py``
-drives ``model(x)``/``backward`` through stock torch layers on CPU/CUDA;
-no GPU in this image).  The torch measurement is cached in
-``.baseline_cache.json`` so repeat bench runs only time the JAX path.
+Sections (BASELINE.md configs; VERDICT round-1 items 2-3):
 
-Ours: the compiled split-learning train step (PipelineModel) on whatever
-accelerator JAX exposes — bfloat16 compute, synthetic CIFAR-shaped data,
-samples/sec normalized per chip.
+* **headline** — unsplit VGG16/CIFAR10 compiled train step, bf16,
+  throughput-optimal batch (vs_baseline compares against a torch-CPU
+  VGG16-BN step, the compute the reference's clients run per batch —
+  ``/root/reference/src/train/VGG16.py`` drives ``model(x)``/``backward``
+  through stock torch layers; no GPU in this image).
+* **split_cut7** — the SAME model split at cut layer 7 (the reference's
+  studied cut, ``other/Vanilla_SL/README.md:54-62``) and driven through
+  the pipelined path with microbatches in the measured step — the thing
+  this framework exists to do.  On one chip the two stages run as
+  virtual pipeline stages (chained on-device, microbatch gradient
+  accumulation, exact cut semantics).
+* **round** — one full global round (train -> FedAvg -> validate ->
+  checkpoint) of the reference's default config shape (VGG16/CIFAR10,
+  cut=7) through the real runtime round loop, wall-clock.
+* **configs** — single-chip train-step throughput for the BASELINE.json
+  north-star configs 3-5: ResNet-50/CIFAR100 3-way split, ViT-S/16
+  split at encoder block 6 with remat, TinyLlama/TinyStories 4-stage.
+* **MFU** — model FLOPs utilization of the headline step against (a)
+  the chip's DATASHEET bf16 peak (chip named from device_kind) and (b)
+  this chip's measured big-matmul roofline.  Both denominators are
+  printed; neither is self-referential.
+
+Timing note: every measurement syncs by FETCHING a device value, not
+``block_until_ready`` — on tunneled backends block_until_ready can
+return before execution finishes (observed: impossible >1 PFLOP/s
+readings); a device->host value transfer is an unfakeable barrier.
+
+The torch baseline is cached in ``.baseline_cache.json`` so repeat
+bench runs only time the JAX path.
 """
 
 from __future__ import annotations
@@ -19,9 +41,26 @@ from __future__ import annotations
 import json
 import os
 import pathlib
+import sys
 import time
 
 CACHE = pathlib.Path(__file__).parent / ".baseline_cache.json"
+
+# Datasheet bf16 peak TFLOP/s per chip, keyed by jax device_kind.
+# v5e: 197 TFLOP/s bf16; v4: 275; v6e: 918 (public TPU spec tables).
+DATASHEET_BF16_TFLOPS = {
+    "TPU v5 lite": 197.0,
+    "TPU v5e": 197.0,
+    "TPU v5": 459.0,  # v5p
+    "TPU v5p": 459.0,
+    "TPU v4": 275.0,
+    "TPU v6 lite": 918.0,
+    "TPU v6e": 918.0,
+}
+
+
+def log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
 
 
 def measure_torch_baseline(steps: int = 3) -> float:
@@ -81,12 +120,20 @@ def get_baseline() -> float:
     return sps
 
 
-def measure_ours() -> tuple[float, int]:
-    """(samples/sec, n_chips) of the compiled split-learning train step."""
+# --------------------------------------------------------------------------
+# generic pipelined-step measurement
+# --------------------------------------------------------------------------
+
+def _measure_pipe_step(model_name: str, cuts, example_shape, example_dtype,
+                       mb: int, n_micro: int, steps: int,
+                       optimizer, model_kwargs=None, label_shape=(),
+                       n_classes: int = 10, n_vocab: int = 1000,
+                       seed: int = 0):
+    """(samples/sec, flops/step or None) of a compiled split train step
+    on a (client=1, stage=1) single-chip mesh (virtual stages)."""
     import jax
     import jax.numpy as jnp
     import numpy as np
-    import optax
     from jax.sharding import Mesh
 
     from split_learning_tpu.parallel.pipeline import (
@@ -94,65 +141,257 @@ def measure_ours() -> tuple[float, int]:
         stack_for_clients, shard_to_mesh,
     )
 
-    on_cpu = jax.default_backend() == "cpu"
-    devs = jax.devices()
-    # one chip = (client=1, stage=1); the driver benches single-chip.
-    mesh = Mesh(np.array(devs[:1]).reshape(1, 1), ("client", "stage"))
-    n_chips = 1
-
-    # batch 8192 saturates the MXU (measured: ~86 bf16 TFLOP/s on one chip,
-    # equal to the chip's raw matmul rate; batch 256 reaches only ~24)
-    mb = 32 if on_cpu else 8192
-    n_micro = 1
-    steps = 3 if on_cpu else 10
-    dtype = jnp.float32 if on_cpu else jnp.bfloat16
-
-    pipe = PipelineModel(
-        "VGG16_CIFAR10", cuts=[],
-        example_input=jax.ShapeDtypeStruct((mb, 32, 32, 3), jnp.float32),
-        num_microbatches=n_micro, model_kwargs={"dtype": dtype})
-    variables = init_pipeline_variables(
-        pipe, jax.random.key(0),
-        jax.ShapeDtypeStruct((mb, 32, 32, 3), jnp.float32))
+    mesh = Mesh(np.array(jax.devices()[:1]).reshape(1, 1),
+                ("client", "stage"))
+    struct = jax.ShapeDtypeStruct((mb,) + tuple(example_shape),
+                                  example_dtype)
+    pipe = PipelineModel(model_name, cuts=list(cuts), example_input=struct,
+                         num_microbatches=n_micro,
+                         model_kwargs=dict(model_kwargs or {}))
+    variables = init_pipeline_variables(pipe, jax.random.key(seed), struct)
     params, stats = variables["params"], variables.get("batch_stats", {})
-    optimizer = optax.sgd(5e-4, momentum=0.9)
     opt_state = optimizer.init(params)
 
     params_c = shard_to_mesh(stack_for_clients(params, 1), mesh)
     opt_c = shard_to_mesh(stack_for_clients(opt_state, 1), mesh)
     stats_c = shard_to_mesh(stack_for_clients(stats, 1), mesh)
     rng = jax.random.split(jax.random.key(1), 1)
-    kx = jax.random.key(2)
-    x = jax.random.normal(kx, (1, n_micro, mb, 32, 32, 3), jnp.float32)
-    labels = jnp.zeros((1, n_micro, mb), jnp.int32)
+    if example_dtype == jnp.int32:  # token models
+        x = jax.random.randint(jax.random.key(2),
+                               (1, n_micro, mb) + tuple(example_shape),
+                               0, n_vocab, jnp.int32)
+    else:
+        x = jax.random.normal(jax.random.key(2),
+                              (1, n_micro, mb) + tuple(example_shape),
+                              jnp.float32)
+    labels = jax.random.randint(jax.random.key(3),
+                                (1, n_micro, mb) + tuple(label_shape),
+                                0, n_classes, jnp.int32)
 
     step = make_train_step(pipe, optimizer, mesh)
-    # warmup/compile.  Sync by FETCHING the loss, not block_until_ready:
-    # on tunneled backends block_until_ready can return before execution
-    # finishes (observed: impossible >1 PFLOP/s readings); a device->host
-    # value transfer is an unfakeable barrier on every backend.
+    flops = None
+    if jax.default_backend() != "cpu":
+        try:
+            # AOT-compile once and EXECUTE the same compiled object — a
+            # separate jit warmup would recompile the whole program.
+            # (Skipped on CPU: AOT bypasses the persistent compilation
+            # cache the CI smoke depends on, and flops aren't reported
+            # there.)
+            compiled = step.lower(params_c, opt_c, stats_c, x, labels,
+                                  rng).compile()
+            cost = compiled.cost_analysis()
+            if cost and cost.get("flops"):
+                flops = float(cost["flops"])
+            step = compiled
+        except Exception:
+            pass  # fall back to the jitted callable
+
+    # warmup/compile, then timed loop; sync via value fetch (see module
+    # docstring)
     params_c, opt_c, stats_c, loss = step(params_c, opt_c, stats_c, x,
                                           labels, rng)
     float(np.asarray(loss)[0])
-
     t0 = time.perf_counter()
     for _ in range(steps):
         params_c, opt_c, stats_c, loss = step(params_c, opt_c, stats_c, x,
                                               labels, rng)
     float(np.asarray(loss)[0])
     dt = time.perf_counter() - t0
-    return mb * n_micro * steps / dt, n_chips
+    return mb * n_micro * steps / dt, flops
+
+
+def measure_matmul_roofline() -> float:
+    """Measured bf16 matmul TFLOP/s on this chip (empirical roofline)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    on_cpu = jax.default_backend() == "cpu"
+    n = 1024 if on_cpu else 8192
+    steps = 2 if on_cpu else 10
+    a = jnp.ones((n, n), jnp.bfloat16)
+
+    @jax.jit
+    def mm(a):
+        return a @ a
+
+    b = mm(a)
+    float(np.asarray(b[0, 0], np.float32))
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        b = mm(b)
+    float(np.asarray(b[0, 0], np.float32))
+    dt = time.perf_counter() - t0
+    return 2 * n ** 3 * steps / dt / 1e12
+
+
+def measure_round() -> dict:
+    """One full global round (train -> FedAvg -> validate -> checkpoint)
+    of the reference default config shape through the runtime loop."""
+    import shutil
+    import jax
+
+    from split_learning_tpu import config as cfgmod
+    from split_learning_tpu.run import run_local
+
+    on_cpu = jax.default_backend() == "cpu"
+    ckpt = "/tmp/slt_bench_round"
+    shutil.rmtree(ckpt, ignore_errors=True)
+    cfg = cfgmod.from_dict({
+        "model": "VGG16", "dataset": "CIFAR10",
+        "clients": [1, 1], "global-rounds": 2,
+        "synthetic-size": 32 if on_cpu else 4096,
+        "val-max-batches": 1 if on_cpu else 8,
+        "val-batch-size": 8 if on_cpu else 256,
+        "compute-dtype": "float32" if on_cpu else "bfloat16",
+        "topology": {"cut-layers": [7]},
+        "distribution": {"mode": "iid",
+                         "num-samples": 32 if on_cpu else 4096},
+        "aggregation": {"strategy": "fedavg"},
+        "learning": {"batch-size": 8 if on_cpu else 256,
+                     "control-count": 2 if on_cpu else 4,
+                     "optimizer": "sgd",
+                     "learning-rate": 5e-4, "momentum": 0.9},
+        "checkpoint": {"directory": ckpt},
+        "log-path": "/tmp/slt_bench_round_logs",
+    })
+    t0 = time.perf_counter()
+    result = run_local(cfg)
+    wall = time.perf_counter() - t0
+    rec = result.history[-1]  # round 2 = steady state (no compile)
+    return {
+        "total_wall_s_2rounds_incl_compile": round(wall, 2),
+        "steady_round_wall_s": round(rec.wall_s, 2),
+        "train_samples_per_round": rec.num_samples,
+        "samples_per_sec": round(rec.num_samples / max(rec.wall_s, 1e-9), 1),
+        "val_accuracy": rec.val_accuracy,
+        "geometry": "clients [1,1], cut [7], 1 chip (virtual stages), "
+                    "synthetic CIFAR10",
+    }
 
 
 def main():
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    # persistent compile cache: repeat bench runs only pay execution
+    try:
+        jax.config.update(
+            "jax_compilation_cache_dir",
+            str(pathlib.Path(__file__).parent / ".jax_cache"))
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    except Exception:
+        pass
+
+    on_cpu = jax.default_backend() == "cpu"
+    kind = jax.devices()[0].device_kind
+    steps = 2 if on_cpu else 10
+    dtype_kw = {} if on_cpu else {"dtype": jnp.bfloat16}
+    extra: dict = {"chip": kind, "n_chips": 1}
+    log(f"[bench] device: {kind} (backend {jax.default_backend()})")
+
     baseline = get_baseline()
-    sps, n_chips = measure_ours()
-    value = sps / n_chips
+    log(f"[bench] torch-CPU VGG16 baseline: {baseline:.1f} samples/s")
+
+    # -- headline: unsplit VGG16 train step --------------------------------
+    mb = 32 if on_cpu else 8192
+    sps_unsplit, flops_step = _measure_pipe_step(
+        "VGG16_CIFAR10", [], (32, 32, 3), jnp.float32, mb, 1, steps,
+        optax.sgd(5e-4, momentum=0.9), model_kwargs=dtype_kw)
+    log(f"[bench] headline unsplit VGG16 (batch {mb}): "
+        f"{sps_unsplit:.0f} samples/s")
+
+    # -- MFU: datasheet + measured-roofline denominators -------------------
+    roofline = measure_matmul_roofline()
+    peak = DATASHEET_BF16_TFLOPS.get(kind)
+    mfu = {"datasheet_bf16_tflops": peak,
+           "measured_matmul_roofline_tflops": round(roofline, 1)}
+    if flops_step:
+        tflops = flops_step * sps_unsplit / mb / 1e12
+        mfu["headline_tflops"] = round(tflops, 1)
+        if peak:
+            mfu["mfu_vs_datasheet"] = round(tflops / peak, 3)
+        mfu["frac_of_measured_roofline"] = round(tflops / roofline, 3)
+    extra["mfu"] = mfu
+    log(f"[bench] MFU: {mfu}")
+
+    # -- split path: cut=7, microbatched pipeline --------------------------
+    n_micro = 4
+    sps_split, _ = _measure_pipe_step(
+        "VGG16_CIFAR10", [7], (32, 32, 3), jnp.float32,
+        mb // n_micro, n_micro, steps,
+        optax.sgd(5e-4, momentum=0.9), model_kwargs=dtype_kw)
+    extra["split_cut7"] = {
+        "samples_per_sec": round(sps_split, 1),
+        "microbatches": n_micro,
+        "ratio_vs_unsplit": round(sps_split / sps_unsplit, 3),
+        "note": "2 stages as virtual pipeline stages on 1 chip: no "
+                "bubbles (gradient accumulation), overhead = per-stage "
+                "remat + smaller per-microbatch kernels",
+    }
+    log(f"[bench] split cut=7 x{n_micro} microbatches: "
+        f"{sps_split:.0f} samples/s "
+        f"({sps_split / sps_unsplit:.0%} of unsplit)")
+
+    # -- full round through the runtime loop -------------------------------
+    extra["round"] = measure_round()
+    log(f"[bench] full round: {extra['round']}")
+
+    # -- north-star configs 3-5 -------------------------------------------
+    cfgs: dict = {}
+    mbi = 16 if on_cpu else 512
+    sps, _ = _measure_pipe_step(
+        "ResNet50_CIFAR100", [3, 6], (32, 32, 3), jnp.float32,
+        mbi // 4, 4, steps, optax.sgd(5e-4, momentum=0.9),
+        model_kwargs=dtype_kw, n_classes=100)
+    cfgs["resnet50_cifar100_3way_cut_3_6"] = {
+        "samples_per_sec": round(sps, 1)}
+    log(f"[bench] ResNet-50/CIFAR100 3-way split: {sps:.0f} samples/s")
+
+    # block i = layer 4+i (4 stem layers); block 6 boundary = cut [10]
+    sps, _ = _measure_pipe_step(
+        "ViT_S16_CIFAR10", [10], (32, 32, 3), jnp.float32,
+        mbi // 4, 4, steps, optax.adamw(1e-3), model_kwargs=dtype_kw)
+    cfgs["vit_s16_cifar10_cut_block6"] = {"samples_per_sec": round(sps, 1)}
+    log(f"[bench] ViT-S/16 split at block 6: {sps:.0f} samples/s")
+
+    # TinyLlama: full 1.1B adam states exceed one chip's HBM (the
+    # BASELINE config targets a v5e-16); single-chip line uses plain SGD
+    # + seq 1024 + remat, reported as tokens/sec.
+    seq = 128 if on_cpu else 1024
+    llama_kw = (dict(vocab_size=256, hidden_size=64, num_heads=4,
+                     num_kv_heads=2, intermediate_size=128, n_block=4)
+                if on_cpu else {})
+    llama_cuts = [2, 3, 4] if on_cpu else [7, 13, 19]
+    lb = 1 if on_cpu else 2
+    try:
+        vocab = llama_kw.get("vocab_size", 32000)
+        sps, _ = _measure_pipe_step(
+            "TinyLlama_TINYSTORIES", llama_cuts, (seq,), jnp.int32,
+            lb, 4, max(1, steps // 2), optax.sgd(1e-4),
+            model_kwargs=llama_kw, label_shape=(seq,), n_classes=vocab,
+            n_vocab=vocab)
+        cfgs["tinyllama_tinystories_4stage"] = {
+            "tokens_per_sec": round(sps * seq, 1), "seq_len": seq,
+            "optimizer": "sgd (adam states exceed single-chip HBM; "
+                         "reference scale is v5e-16)",
+            "tiny_overrides": bool(llama_kw),
+        }
+        log(f"[bench] TinyLlama 4-stage: {sps * seq:.0f} tokens/s")
+    except Exception as e:  # single-chip OOM is environment, not failure
+        cfgs["tinyllama_tinystories_4stage"] = {
+            "error": f"{type(e).__name__}: {str(e)[:200]}"}
+        log(f"[bench] TinyLlama 4-stage: FAILED {type(e).__name__}")
+    extra["configs"] = cfgs
+
+    value = sps_unsplit  # per chip (n_chips == 1)
     print(json.dumps({
         "metric": "vgg16_cifar10_train_samples_per_sec_per_chip",
         "value": round(value, 2),
         "unit": "samples/sec/chip",
         "vs_baseline": round(value / baseline, 3),
+        "extra": extra,
     }))
 
 

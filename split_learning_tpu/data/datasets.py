@@ -196,13 +196,35 @@ def _agnews_csv(path: pathlib.Path) -> tuple | None:
     return texts, np.asarray(labels, np.int32)
 
 
+def _tokenize(texts: list[str], seq_len: int, vocab: int) -> np.ndarray:
+    """Real WordPiece when a pretrained vocab.txt is on disk (token ids
+    then match the reference's BertTokenizer, ``src/dataset/AGNEWS.py:
+    13-30``); deterministic hash tokenization otherwise (zero egress)."""
+    from split_learning_tpu.data.wordpiece import (
+        WordPieceTokenizer, find_vocab,
+    )
+    vocab_path = find_vocab(data_dir())
+    if vocab_path is not None:
+        tok = WordPieceTokenizer.from_file(vocab_path)
+        if len(tok.vocab) > vocab:
+            # e.g. an uncased 30522-entry vocab.txt against the 28996
+            # cased embedding table: out-of-range ids would be silently
+            # clamped by the embedding gather under jit
+            raise ValueError(
+                f"{vocab_path} has {len(tok.vocab)} entries but the "
+                f"model's embedding table holds {vocab}; use the "
+                "matching (cased) vocab")
+        return tok.encode_batch(texts, seq_len)
+    return _hash_tokenize(texts, seq_len, vocab)
+
+
 @register_dataset("AGNEWS")
 def agnews(train: bool = True, synthetic_size: int | None = None):
     raw = _agnews_csv(data_dir() / "ag_news"
                       / ("train.csv" if train else "test.csv"))
     if raw is not None:
         texts, labels = raw
-        ids = _hash_tokenize(texts, _AGNEWS_SEQ_LEN, _BERT_VOCAB)
+        ids = _tokenize(texts, _AGNEWS_SEQ_LEN, _BERT_VOCAB)
         return ArrayDataset(ids, labels)
     n = synthetic_size or (8000 if train else 1600)
     return _synthetic_tokens(n, _AGNEWS_SEQ_LEN, _BERT_VOCAB, 4,

@@ -14,12 +14,35 @@ import functools
 import jax.numpy as jnp
 
 from split_learning_tpu.models.split import (
-    LayerSpec, register_model,
-    module_train_fn as _train_fn, module_plain_fn as _plain_fn,
+    LayerSpec, register_model, module_train_fn as _train_fn,
 )
 from split_learning_tpu.models.transformer import (
     BertBlock, BertEmbeddings, Pooler, ClassifierHead,
 )
+
+_PAD_ID = 0  # [PAD] is id 0 in BERT vocabs (wordpiece.py, HF convention)
+
+
+def _embed_fn(mod, input_ids, train):
+    """Layer 1: derive the attention padding mask from the token ids
+    (reference parity: the AGNEWS pipeline carries attention_mask
+    end-to-end, ``src/dataset/AGNEWS.py:22-30``) and thread it alongside
+    the hidden states so it crosses split/stage boundaries with the
+    activations."""
+    mask = (input_ids != _PAD_ID)
+    return mod(input_ids, train=train), mask
+
+
+def _block_fn(mod, xm, train):
+    """Encoder block on (hidden, mask): padded key positions are not
+    attended (broadcast (B, 1, 1, S_kv) boolean mask)."""
+    x, mask = xm
+    return mod(x, mask=mask[:, None, None, :], train=train), mask
+
+
+def _pooler_fn(mod, xm, train):
+    x, _ = xm  # CLS pooling: the mask's job is done
+    return mod(x)
 
 
 def _bert_specs(num_labels: int, vocab_size: int = 28996,
@@ -33,7 +56,7 @@ def _bert_specs(num_labels: int, vocab_size: int = 28996,
             BertEmbeddings, vocab_size=vocab_size, hidden_size=hidden_size,
             max_position_embeddings=max_position_embeddings,
             dropout_rate=dropout_rate, dtype=dtype),
-        fn=_train_fn)]
+        fn=_embed_fn)]
     for i in range(n_block):
         specs.append(LayerSpec(
             name=f"layer{2 + i}",
@@ -41,11 +64,11 @@ def _bert_specs(num_labels: int, vocab_size: int = 28996,
                 BertBlock, hidden_size=hidden_size, num_heads=num_heads,
                 intermediate_size=intermediate_size,
                 dropout_rate=dropout_rate, dtype=dtype),
-            fn=_train_fn))
+            fn=_block_fn))
     specs.append(LayerSpec(
         name=f"layer{2 + n_block}",
         make=functools.partial(Pooler, hidden_size=hidden_size, dtype=dtype),
-        fn=_plain_fn))
+        fn=_pooler_fn))
     specs.append(LayerSpec(
         name=f"layer{3 + n_block}",
         make=functools.partial(ClassifierHead, num_labels=num_labels,

@@ -49,6 +49,11 @@ def topk_dispatch(probs: jnp.ndarray, k: int, capacity: int):
     auxiliary loss ``E * Σ_e f_e · P_e`` over first-choice assignments.
     """
     t, e = probs.shape
+    if k > e:
+        raise ValueError(
+            f"top-k k={k} exceeds num_experts={e}: argmax over the "
+            "masked-out remainder would re-select expert 0 and "
+            "double-count its gate weight")
     remaining = probs
     onehots, gates = [], []
     for _ in range(k):
@@ -108,6 +113,13 @@ class MoEMLP(nn.Module):
     (combine weight 0 → they contribute nothing, the caller's residual
     carries them through).  The aux loss is sown under
     ``intermediates/aux_loss`` when that collection is mutable.
+
+    Memory note: the dense dispatch/combine tensors are (T, E, C) with
+    C ≈ k·T/E·factor, i.e. O(k·T²·factor) per MoE layer regardless of
+    E.  At T = B·S ≈ 16k tokens that is ~GB-scale in fp32; keep
+    T ≲ 8k per call (shard the batch/sequence first), or route within
+    fixed-size groups (reshape to (G, T/G) and vmap this module over G)
+    before scaling further.
     """
     hidden_size: int
     intermediate_size: int
@@ -182,10 +194,16 @@ def shard_params_ep(params, mesh: Mesh, axis: str = "expert"):
 
 
 def moe_aux_loss(intermediates: dict) -> jnp.ndarray:
-    """Sum every sown ``aux_loss`` in an intermediates collection."""
+    """Sum the sown ``aux_loss`` entries in an intermediates collection.
+
+    Only leaves whose path contains the key ``aux_loss`` are summed —
+    other sown diagnostics (router entropy, attention stats, ...) must
+    never silently become a weighted loss term.
+    """
     total = jnp.zeros(())
-    for leaf in jax.tree_util.tree_leaves(intermediates):
-        total = total + jnp.sum(leaf)
+    for path, leaf in jax.tree_util.tree_leaves_with_path(intermediates):
+        if any(getattr(p, "key", None) == "aux_loss" for p in path):
+            total = total + jnp.sum(leaf)
     return total
 
 

@@ -57,6 +57,12 @@ def _flat_size(shape: Sequence[int]) -> int:
     return int(np.prod(shape[1:]))  # per-sample, excluding batch dim
 
 
+def _tree_flat_size(struct_tree) -> int:
+    """Total per-sample wire width of a (possibly pytree) boundary."""
+    return sum(_flat_size(leaf.shape)
+               for leaf in jax.tree_util.tree_leaves(struct_tree))
+
+
 class PipelineModel:
     """Static description + compiled bodies for one pipelined split model.
 
@@ -68,8 +74,10 @@ class PipelineModel:
                  num_microbatches: int = 4,
                  loss: str = "softmax_cross_entropy",
                  remat: bool = True,
-                 model_kwargs: dict | None = None):
+                 model_kwargs: dict | None = None,
+                 moe_aux_weight: float = 0.01):
         self.model_name = model_name
+        self.moe_aux_weight = moe_aux_weight
         self.model_kwargs = dict(model_kwargs or {})
         self.full_model: SplitModel = build_model(model_name,
                                                   **self.model_kwargs)
@@ -109,24 +117,42 @@ class PipelineModel:
                 functools.partial(m.apply, train=False), sub,
                 self.boundary[-1])
             self.boundary.append(out)
-        self.out_struct = self.boundary[-1]
+        out_leaves = jax.tree_util.tree_leaves(self.boundary[-1])
+        if len(out_leaves) != 1:
+            raise ValueError(
+                "the final stage must output a single logits array, got "
+                f"a {len(out_leaves)}-leaf pytree")
+        self.out_struct = out_leaves[0]
         self.n_out = _flat_size(self.out_struct.shape)
-        self.max_flat = max(_flat_size(b.shape) for b in self.boundary)
-        # wire dtype: float32 carries every boundary exactly (token ids are
-        # < 2^24; bf16/f32 activations upcast losslessly)
+        self.max_flat = max(_tree_flat_size(b) for b in self.boundary)
+        # wire dtype: float32 carries every boundary exactly (token ids
+        # are < 2^24; bf16/f32 activations upcast losslessly; bool masks
+        # ride as 0.0/1.0)
         self.wire_dtype = jnp.float32
 
     # -- wire packing ------------------------------------------------------
+    # A boundary may be any pytree (e.g. BERT's (hidden, attention_mask)
+    # — models/bert.py threads the pad mask with the activations): leaves
+    # are flattened per sample, concatenated, and padded to the widest
+    # boundary so every stage hop moves one (mb, max_flat) buffer.
 
     def _to_wire(self, x) -> jnp.ndarray:
-        flat = x.reshape(x.shape[0], -1).astype(self.wire_dtype)
+        leaves = jax.tree_util.tree_leaves(x)
+        flat = jnp.concatenate(
+            [l.reshape(l.shape[0], -1).astype(self.wire_dtype)
+             for l in leaves], axis=1)
         pad = self.max_flat - flat.shape[1]
         return jnp.pad(flat, ((0, 0), (0, pad))) if pad else flat
 
-    def _from_wire(self, wire, struct: jax.ShapeDtypeStruct):
-        n = _flat_size(struct.shape)
-        return wire[:, :n].astype(struct.dtype).reshape(
-            (wire.shape[0],) + tuple(struct.shape[1:]))
+    def _from_wire(self, wire, struct):
+        leaves, treedef = jax.tree_util.tree_flatten(struct)
+        out, off = [], 0
+        for leaf in leaves:
+            n = _flat_size(leaf.shape)
+            out.append(wire[:, off:off + n].astype(leaf.dtype).reshape(
+                (wire.shape[0],) + tuple(leaf.shape[1:])))
+            off += n
+        return jax.tree_util.tree_unflatten(treedef, out)
 
     # -- per-device pipeline body -----------------------------------------
 
@@ -154,6 +180,7 @@ class PipelineModel:
         def apply_device(params, stats, wire_in, rng_data):
             x = self._from_wire(wire_in, in_struct)
             new_stats = dict(stats)
+            aux = jnp.zeros(())
             for s in range(lo, hi):
                 model = self.stage_models[s]
                 a, b = self.ranges[s]
@@ -164,6 +191,9 @@ class PipelineModel:
                 # verifier failure, jax 0.9)
                 def apply_one(params, st_in, x, rng_data,
                               model=model, a=a, b=b):
+                    from split_learning_tpu.parallel.expert import (
+                        moe_aux_loss,
+                    )
                     rng = jax.random.wrap_key_data(rng_data)
                     variables: dict = {
                         "params": shard_params(params, self.specs, a, b)}
@@ -172,15 +202,20 @@ class PipelineModel:
                         variables["batch_stats"] = st
                     out, mut = model.apply(
                         variables, x, train=train,
-                        mutable=["batch_stats"],
+                        mutable=["batch_stats", "intermediates"],
                         rngs={"dropout": rng} if train else None)
-                    return out, mut.get("batch_stats", {})
+                    # sown MoE load-balance losses (zero for dense
+                    # stages) join the objective on THIS device
+                    return (out, mut.get("batch_stats", {}),
+                            moe_aux_loss(mut.get("intermediates", {})))
 
                 if self.remat:
                     apply_one = jax.checkpoint(apply_one)
-                x, mut_stats = apply_one(params, new_stats, x, rng_data)
+                x, mut_stats, stage_aux = apply_one(params, new_stats, x,
+                                                    rng_data)
                 new_stats.update(mut_stats)
-            return self._to_wire(x), new_stats
+                aux = aux + stage_aux
+            return self._to_wire(x), new_stats, aux
 
         return apply_device
 
@@ -210,7 +245,7 @@ class PipelineModel:
         stats0 = stats
 
         def tick(carry, t):
-            act_wire, stats, out_buf = carry
+            act_wire, stats, out_buf, aux_acc = carry
             inj_idx = jnp.clip(t, 0, M - 1)
             x_inj = self._to_wire(
                 jax.lax.dynamic_index_in_dim(x_mb, inj_idx, 0,
@@ -219,7 +254,7 @@ class PipelineModel:
             mb_idx = jnp.clip(t - dev, 0, M - 1)
             rng_t = jax.random.fold_in(rng, mb_idx)
 
-            out_wire, new_stats = jax.lax.switch(
+            out_wire, new_stats, aux = jax.lax.switch(
                 dev, branches, params, stats, act_in,
                 jax.random.key_data(rng_t))
 
@@ -227,6 +262,7 @@ class PipelineModel:
             valid = (t >= dev) & (t < dev + M)
             new_stats = jax.tree_util.tree_map(
                 lambda n, o: jnp.where(valid, n, o), new_stats, stats)
+            aux_acc = aux_acc + jnp.where(valid, aux, 0.0)
 
             # last device collects logits for microbatch t-(A-1)
             c_idx = jnp.clip(t - (A - 1), 0, M - 1)
@@ -241,27 +277,33 @@ class PipelineModel:
             perm = [(i, i + 1) for i in range(A - 1)]
             act_next = (jax.lax.ppermute(out_wire, "stage", perm)
                         if perm else out_wire)
-            return (act_next, new_stats, out_buf), None
+            return (act_next, new_stats, out_buf, aux_acc), None
 
         del mesh_axes  # only relevant under check_vma, which we disable
         act0 = jnp.zeros((self.mb_size, self.max_flat), self.wire_dtype)
         out_buf0 = jnp.zeros((M, self.mb_size, self.n_out), self.wire_dtype)
-        (_, stats_f, out_buf), _ = jax.lax.scan(
-            tick, (act0, stats0, out_buf0), jnp.arange(M + A - 1))
+        (_, stats_f, out_buf, aux_acc), _ = jax.lax.scan(
+            tick, (act0, stats0, out_buf0, jnp.zeros(())),
+            jnp.arange(M + A - 1))
 
         logits = out_buf.astype(self.out_struct.dtype).reshape(
             (M * self.mb_size,) + tuple(self.out_struct.shape[1:]))
         # collapse (M, mb, ...) -> (M*mb, ...): int labels stay 1-D for CE,
         # vector targets keep their feature dims for MSE
         labels_flat = labels.reshape((M * self.mb_size,) + labels.shape[2:])
-        local = jnp.where(dev == A - 1,
-                          self.loss_from_logits(logits, labels_flat),
-                          0.0)
-        # NOTE: `local` (nonzero only on the last device) is what must be
-        # differentiated.  Cross-stage gradient flow happens through the
-        # ppermute transpose; psum-ing the loss BEFORE grad would seed a
-        # cotangent on every stage replica and overcount grads by A.
-        loss = jax.lax.psum(jax.lax.stop_gradient(local), "stage")
+        ce_local = jnp.where(dev == A - 1,
+                             self.loss_from_logits(logits, labels_flat),
+                             0.0)
+        # MoE load-balance aux (mean over microbatches, weighted) joins
+        # the objective on whichever device computed it; dense models sow
+        # nothing and aux_acc is identically 0.  Reported loss stays CE.
+        local = ce_local + self.moe_aux_weight * aux_acc / M
+        # NOTE: `local` (CE nonzero only on the last device, aux on the
+        # device that owns the MoE stage) is what must be differentiated.
+        # Cross-stage gradient flow happens through the ppermute
+        # transpose; psum-ing the loss BEFORE grad would seed a cotangent
+        # on every stage replica and overcount grads by A.
+        loss = jax.lax.psum(jax.lax.stop_gradient(ce_local), "stage")
 
         # exactly one stage updated each stats leaf; share via delta-psum
         delta = jax.tree_util.tree_map(lambda f, i: f - i, stats_f, stats0)

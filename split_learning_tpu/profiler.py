@@ -96,8 +96,11 @@ def profile_model(model_key: str, batch_size: int = 32,
         raise ValueError(f"unknown method {method!r}")
     bounds, layer_models, full = _boundary_structs(model_key, example, kw)
     specs = full.specs
+    # a boundary may be a pytree (e.g. BERT's (hidden, mask)): bytes sum
+    # over leaves, matching what actually crosses the wire per batch
     size_data = [
-        int(np.prod(b.shape[1:])) * np.dtype(b.dtype).itemsize * b.shape[0]
+        sum(int(np.prod(l.shape)) * np.dtype(l.dtype).itemsize
+            for l in jax.tree_util.tree_leaves(b))
         for b in bounds[1:]
     ]
 

@@ -32,10 +32,28 @@ class QueueClosed(Exception):
 
 
 class Transport:
-    """Named-queue message transport (byte payloads)."""
+    """Named-queue message transport (byte payloads).
+
+    Concrete transports call :meth:`_count` from ``publish`` so tests
+    and metrics can audit wire traffic (e.g. FLEX's no-upload rounds
+    must move no weight bytes) via :attr:`bytes_out`.
+    """
 
     def publish(self, queue: str, payload: bytes) -> None:
         raise NotImplementedError
+
+    def _count(self, queue: str, payload: bytes) -> None:
+        # own lock: one transport is shared by server + client threads
+        # (dict.setdefault is atomic under the GIL, so lazy init is safe)
+        lock = self.__dict__.setdefault("_count_lock", threading.Lock())
+        with lock:
+            d = getattr(self, "bytes_out", None)
+            if d is None:
+                d = self.bytes_out = {}
+            d[queue] = d.get(queue, 0) + len(payload)
+
+    def total_bytes_out(self) -> int:
+        return sum(getattr(self, "bytes_out", {}).values())
 
     def get(self, queue: str, timeout: float | None = None) -> bytes | None:
         """Pop one message; block up to ``timeout`` (None = forever).
@@ -60,6 +78,7 @@ class InProcTransport(Transport):
         self._closed = False
 
     def publish(self, queue: str, payload: bytes) -> None:
+        self._count(queue, payload)
         with self._cond:
             if self._closed:
                 raise QueueClosed(queue)
@@ -213,6 +232,7 @@ class TcpTransport(Transport):
         self._lock = threading.Lock()
 
     def publish(self, queue: str, payload: bytes) -> None:
+        self._count(queue, payload)
         with self._lock:
             _send_frame(self._sock, _OP_PUB, queue.encode(), payload)
 

@@ -56,6 +56,47 @@ from split_learning_tpu.runtime.protocol import (
 from split_learning_tpu.runtime.validation import dataset_for_model
 
 
+def _to_wire_tree(tree):
+    """Device pytree -> numpy payload for Activation/Gradient messages.
+
+    Stage boundaries may be pytrees (e.g. BERT's (hidden, mask),
+    models/bert.py): float leaves travel fp32, bool/int leaves keep
+    their dtype, and float0 gradient leaves (cotangents of
+    non-differentiable inputs) become fp32 zeros so they pickle."""
+    def conv(leaf):
+        if getattr(leaf, "dtype", None) == jax.dtypes.float0:
+            return np.zeros(np.shape(leaf), np.float32)
+        a = np.asarray(leaf)
+        if np.issubdtype(a.dtype, np.floating):
+            return a.astype(np.float32, copy=False)
+        return a
+    return jax.tree_util.tree_map(conv, tree)
+
+
+def _from_wire_tree(tree):
+    return jax.tree_util.tree_map(jnp.asarray, tree)
+
+
+def _wire_vdot(out_tree, ct_tree):
+    """<out, cotangent> over the float leaves of a boundary pytree (the
+    scalar whose gradient backpropagates a received cotangent)."""
+    tot = jnp.zeros((), jnp.float32)
+    for o, c in zip(jax.tree_util.tree_leaves(out_tree),
+                    jax.tree_util.tree_leaves(ct_tree)):
+        if jnp.issubdtype(o.dtype, jnp.floating):
+            tot = tot + jnp.vdot(o.astype(jnp.float32),
+                                 c.astype(jnp.float32))
+    return tot
+
+
+@dataclasses.dataclass
+class _AbortPause(Pause):
+    """Local sentinel: the round was abandoned (STOP/fresh START arrived
+    mid-loop) — unwind WITHOUT publishing any UPDATE.  Distinct from a
+    server Pause(send_weights=False), which still expects a weight-less
+    UPDATE (FLEX non-aggregation rounds)."""
+
+
 def make_optimizer_from_dict(learning: dict | None) -> tuple[
         optax.GradientTransformation, LearningConfig]:
     d = dict(learning or {})
@@ -84,6 +125,7 @@ class ShardRunner:
                                  end_layer=end_layer,
                                  **(model_kwargs or {}))
         self.start_layer = start_layer
+        self.learning_dict = dict(learning or {})  # for change detection
         self.optimizer, self.learning = make_optimizer_from_dict(learning)
         self.rng = jax.random.key(seed)
         self._counter = 0
@@ -121,8 +163,7 @@ class ShardRunner:
                 out, mut = self.model.apply(
                     _variables(merged(frozen, tt), stats), xx, train=True,
                     mutable=["batch_stats"], rngs={"dropout": rng})
-                return jnp.vdot(out.astype(jnp.float32),
-                                ct.astype(jnp.float32)), mut
+                return _wire_vdot(out, ct), mut
             # allow_int: stage-1 inputs can be integer token ids; their
             # float0 cotangent is never used (no upstream hop to route to)
             grad_fn = jax.grad(f, argnums=(0, 1), has_aux=True,
@@ -300,6 +341,32 @@ class ProtocolClient:
         # invocation that was already abandoned (round_idx alone can't —
         # sequential strategies reuse it across sub-calls)
         self.fence = int(extra.get("gen", msg.round_idx))
+        self.n_stages = int(extra.get("n_stages", self.cfg.num_stages))
+        if msg.params is None:
+            # FLEX non-reseed round (other/FLEX/src/Server.py:220-226):
+            # START without weights — keep the locally persisted shard
+            # (and its optimizer state) from the previous round
+            if (getattr(self, "runner", None) is None
+                    or self.runner.start_layer != msg.start_layer):
+                raise RuntimeError(
+                    "START without params but no matching local shard "
+                    f"(layers [{msg.start_layer}, {msg.end_layer}])")
+            if dict(msg.learning or {}) != self.runner.learning_dict:
+                # hyperparams changed mid-hold (e.g. lr decay): rebuild
+                # the jitted ops around the kept weights; optimizer
+                # state resets, matching the reference's fresh-optimizer-
+                # per-round behavior (src/train/VGG16.py:62)
+                self.runner = ShardRunner(
+                    self.cfg.model_key, msg.start_layer, msg.end_layer,
+                    msg.learning,
+                    model_kwargs=dict(self.cfg.model_kwargs or {}),
+                    seed=self.cfg.seed + hash(self.client_id) % 100000)
+                self.opt_state = self.runner.optimizer.init(self.trainable)
+                self.log.info("hyperparams changed: rebuilt runner "
+                              "(weights kept)")
+            else:
+                self.log.info("keeping local shard weights (no re-seed)")
+            return
         model_kwargs = dict(self.cfg.model_kwargs or {})
         self.runner = ShardRunner(
             self.cfg.model_key, msg.start_layer, msg.end_layer,
@@ -308,7 +375,6 @@ class ProtocolClient:
         params = jax.tree_util.tree_map(jnp.asarray, msg.params)
         self.stats = jax.tree_util.tree_map(
             jnp.asarray, msg.batch_stats or {})
-        self.n_stages = int(extra.get("n_stages", self.cfg.num_stages))
         is_final = (msg.end_layer == -1
                     or msg.end_layer >= len(self.runner.model.specs))
         self.frozen, self.trainable = self.runner.partition_params(
@@ -341,27 +407,37 @@ class ProtocolClient:
             pause = self._train_last()
         else:
             pause = self._train_middle()
-        if pause is None or pause.send_weights:
+        if isinstance(pause, _AbortPause):
+            return   # round abandoned: the server stopped counting us
+        if pause is not None and not pause.send_weights:
+            # FLEX non-aggregation round (other/FLEX/src/RpcClient.py:
+            # 110-121): UPDATE still reports samples/result, but carries
+            # NO weights — the shard persists locally for the next round
+            self._send_update(with_weights=False)
+        else:
             self._send_update()
 
-    def _send_update(self):
-        merged = self.runner.merge_params(self.frozen, self.trainable)
-        params_h = jax.tree_util.tree_map(np.asarray, merged)
-        stats_h = jax.tree_util.tree_map(np.asarray, self.stats)
+    def _send_update(self, with_weights: bool = True):
+        params_h = stats_h = None
+        if with_weights:
+            merged = self.runner.merge_params(self.frozen, self.trainable)
+            params_h = jax.tree_util.tree_map(np.asarray, merged)
+            stats_h = jax.tree_util.tree_map(np.asarray, self.stats)
         self.bus.publish(RPC_QUEUE, encode(Update(
             client_id=self.client_id, stage=self.stage,
             cluster=self.cluster, params=params_h,
             batch_stats=stats_h, num_samples=self.num_samples,
             ok=self.round_ok, round_idx=self.fence)))
         self.log.info(f"[>>>] UPDATE samples={self.num_samples} "
-                      f"ok={self.round_ok}")
+                      f"ok={self.round_ok}"
+                      + ("" if with_weights else " (no weights)"))
 
     def _redeliver_stop(self, msg: Stop) -> Pause:
         """A STOP arriving mid-training: requeue it for the run() loop and
         unwind the hot loop without uploading (the server is shutting
         down; an UPDATE would go nowhere)."""
         self.bus.publish(reply_queue(self.client_id), encode(msg))
-        return Pause(send_weights=False)
+        return _AbortPause(send_weights=False)
 
     def _redeliver_start(self, msg: Start) -> Pause:
         """A START arriving while still in a previous round's loop: the
@@ -372,7 +448,7 @@ class ProtocolClient:
         until STOP."""
         self.log.warning("START while mid-round: rejoining next round")
         self.bus.publish(reply_queue(self.client_id), encode(msg))
-        return Pause(send_weights=False)
+        return _AbortPause(send_weights=False)
 
     def _wait_pause(self) -> Pause:
         q = reply_queue(self.client_id)
@@ -448,7 +524,7 @@ class ProtocolClient:
                         continue
                     gt, _, self.stats = r.bwd(
                         self.frozen, self.trainable, self.stats, ent.x,
-                        jnp.asarray(g.data), ent.rng)
+                        _from_wire_tree(g.data), ent.rng)
                     self.trainable, self.opt_state = r.apply_update(
                         self.trainable, self.opt_state, gt)
                     n_bwd += 1
@@ -484,7 +560,7 @@ class ProtocolClient:
                                               trace=[self.client_id],
                                               n=len(labels))
                 self.bus.publish(out_q, encode(Activation(
-                    data_id=data_id, data=np.asarray(out, np.float32),
+                    data_id=data_id, data=_to_wire_tree(out),
                     labels=np.asarray(labels, np.int32),
                     trace=[self.client_id], cluster=self.cluster,
                     round_idx=self.fence)))
@@ -516,7 +592,7 @@ class ProtocolClient:
                     continue
                 gt, gx, self.stats = r.bwd(
                     self.frozen, self.trainable, self.stats, ent.x,
-                    jnp.asarray(g.data), ent.rng)
+                    _from_wire_tree(g.data), ent.rng)
                 self.trainable, self.opt_state = r.apply_update(
                     self.trainable, self.opt_state, gt)
                 self.num_samples += ent.n   # see _train_first
@@ -524,7 +600,7 @@ class ProtocolClient:
                 self.bus.publish(
                     gradient_queue(self.stage - 1, origin),
                     encode(Gradient(data_id=g.data_id,
-                                    data=np.asarray(gx, np.float32),
+                                    data=_to_wire_tree(gx),
                                     trace=ent.trace[:-1],
                                     round_idx=self.fence)))
                 continue
@@ -534,14 +610,14 @@ class ProtocolClient:
             act = decode(raw)
             if act.round_idx != self.fence:
                 continue   # activation from a dropped round: discard
-            x = jnp.asarray(act.data)
+            x = _from_wire_tree(act.data)
             rng = r.next_rng()
             out = r.fwd(self.frozen, self.trainable, self.stats, x, rng)
             inflight[act.data_id] = _Inflight(x=x, rng=rng,
                                               trace=list(act.trace),
                                               n=len(act.labels))
             self.bus.publish(out_q, encode(Activation(
-                data_id=act.data_id, data=np.asarray(out, np.float32),
+                data_id=act.data_id, data=_to_wire_tree(out),
                 labels=act.labels, trace=list(act.trace) + [self.client_id],
                 cluster=self.cluster, round_idx=self.fence)))
 
@@ -578,7 +654,11 @@ class ProtocolClient:
     def _sda_step(self, window: list[Activation]):
         r = self.runner
         sizes = [len(a.labels) for a in window]
-        x = jnp.concatenate([jnp.asarray(a.data) for a in window])
+        # boundary payloads may be pytrees (mask-carrying models):
+        # concatenate per leaf along the batch axis, split grads back
+        x = jax.tree_util.tree_map(
+            lambda *xs: jnp.concatenate(xs),
+            *[_from_wire_tree(a.data) for a in window])
         labels = jnp.concatenate(
             [jnp.asarray(a.labels, jnp.int32) for a in window])
         loss, gt, gx, self.stats = r.last_step(
@@ -589,10 +669,10 @@ class ProtocolClient:
         self.trainable, self.opt_state = r.apply_update(
             self.trainable, self.opt_state, gt)
         self.num_samples += int(sum(sizes))
-        gx = np.asarray(gx, np.float32)
+        gx = _to_wire_tree(gx)
         off = 0
         for act, n in zip(window, sizes):
-            part = gx[off:off + n]
+            part = jax.tree_util.tree_map(lambda a: a[off:off + n], gx)
             off += n
             origin = act.trace[-1]
             self.bus.publish(

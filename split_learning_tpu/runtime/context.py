@@ -15,9 +15,9 @@ A :class:`TrainContext` exposes two operations to the round strategies
 jitted SPMD program on a (client, stage) mesh (see
 :mod:`split_learning_tpu.parallel.pipeline`).  Logical clients beyond the
 physical device budget are processed in column chunks; a cluster whose
-stage count exceeds the device budget runs stage-fused (cuts still define
-shard extraction, so the aggregation surface is unchanged — split fwd/bwd
-is numerically the unsplit one).
+stage count exceeds the device budget chains stages on-device as virtual
+pipeline stages (cuts and shard extraction unchanged — split fwd/bwd is
+numerically the one-stage-per-device program).
 
 The multi-process protocol backend (real clients over a transport) lives
 in :mod:`split_learning_tpu.runtime.server` and satisfies the same
@@ -74,6 +74,13 @@ def client_groups(n_columns: int, n_logical: int) -> list[list[int]]:
 
 
 class TrainContext:
+    # True when "clients" persist shard weights between train_cluster
+    # calls (remote protocol clients); False when every round rebuilds
+    # client state from the server's trees (in-process mesh columns).
+    # FLEX-style strategies use this to decide whether weights must be
+    # re-pushed every round.
+    clients_hold_state = False
+
     def init_variables(self) -> dict:
         raise NotImplementedError
 
@@ -137,12 +144,13 @@ class MeshContext(TrainContext):
                 seed=seed, synthetic_size=self.cfg.synthetic_size)
         return self._loader_cache[key]
 
-    # params above this, on the CPU backend, force DP-only geometry: XLA's
-    # CPU collectives abort the process when one rendezvous participant is
-    # >40 s late (rendezvous.cc termination timeout), and a heavy pipeline
-    # stage per scan tick on oversubscribed virtual devices blows that
-    # budget.  Tiny test/dryrun models stay under it and keep exercising
-    # the real ppermute pipeline path.
+    # params above this, on the CPU backend, force a 1-wide stage axis
+    # (stages chained on-device, cuts preserved): XLA's CPU collectives
+    # abort the process when one rendezvous participant is >40 s late
+    # (rendezvous.cc termination timeout), and a heavy pipeline stage per
+    # scan tick on oversubscribed virtual devices blows that budget.
+    # Tiny test/dryrun models stay under it and keep exercising the real
+    # ppermute pipeline path.
     _CPU_PIPELINE_PARAM_LIMIT = 2_000_000
 
     def _param_count(self) -> int:
@@ -154,20 +162,24 @@ class MeshContext(TrainContext):
         return self._n_params
 
     def _geometry(self, plan: ClusterPlan, n_active: int):
-        """(C_phys, S_phys, physical cuts) fitted to the device budget."""
+        """(C_phys, S_phys, physical cuts) fitted to the device budget.
+
+        Cuts are ALWAYS preserved: when the device budget (or the CPU
+        rendezvous limit below) cannot give every stage its own device,
+        the stage axis shrinks to the largest divisor of the stage count
+        that fits and stages are chained on-device as virtual pipeline
+        stages (same split semantics, microbatch gradient accumulation,
+        no cross-device stage collectives at axis width 1)."""
         S = len(plan.cuts) + 1
         D = len(self.devices)
-        pipeline_ok = D >= S and bool(plan.cuts)
-        if (pipeline_ok and jax.default_backend() == "cpu"
+        budget = min(S, D)
+        if (jax.default_backend() == "cpu"
                 and self._param_count() > self._CPU_PIPELINE_PARAM_LIMIT
                 and not self.cfg.topology.force_pipeline):
-            pipeline_ok = False
-        if pipeline_ok:
-            s_phys, cuts_phys = S, list(plan.cuts)
-        else:
-            s_phys, cuts_phys = 1, []
+            budget = 1  # heavy stages on CPU: chain locally (see above)
+        s_phys = max(a for a in range(1, budget + 1) if S % a == 0)
         c_phys = max(1, min(n_active, D // s_phys))
-        return c_phys, s_phys, cuts_phys
+        return c_phys, s_phys, list(plan.cuts)
 
     def _compiled(self, plan: ClusterPlan, c_phys: int, s_phys: int,
                   cuts_phys: list, lr: float | None,
@@ -257,7 +269,13 @@ class MeshContext(TrainContext):
                       client_subset: list | None = None,
                       per_client_params: dict | None = None,
                       lr: float | None = None,
-                      sync_all_later_stages: bool = False) -> list[Update]:
+                      sync_all_later_stages: bool = False,
+                      send_params: bool = True,
+                      send_weights: bool | dict = True) -> list[Update]:
+        # send_params/send_weights are FLEX wire-economy knobs: in-process
+        # columns have no wire, so "uploads" are free views and both flags
+        # are no-ops here (ProtocolContext honors them)
+        del send_params, send_weights
         stage1 = [c for c in plan.stage1_clients
                   if client_subset is None or c in client_subset]
         if not stage1:

@@ -146,8 +146,8 @@ class Activation:
     reference has no such fence because its queues only ever carry one
     round at a time (it hangs instead of dropping rounds, SURVEY.md §5.3)."""
     data_id: str
-    data: np.ndarray
-    labels: np.ndarray
+    data: Any          # ndarray, or a pytree of ndarrays for models whose
+    labels: np.ndarray  # stage boundaries carry extras (e.g. BERT's mask)
     trace: list
     cluster: int
     round_idx: int = 0
@@ -157,7 +157,7 @@ class Activation:
 class Gradient:
     """stage k+1 → the originating stage-k client."""
     data_id: str
-    data: np.ndarray
+    data: Any   # cotangent, same pytree structure as the Activation.data
     trace: list
     round_idx: int = 0
 

@@ -63,6 +63,8 @@ class ProtocolContext(MeshContext):
     reference's ``src/val/get_val.py``).
     """
 
+    clients_hold_state = True   # remote shards persist between rounds
+
     def __init__(self, cfg: Config, transport: Transport,
                  logger: Logger | None = None,
                  client_timeout: float = 600.0,
@@ -175,7 +177,19 @@ class ProtocolContext(MeshContext):
                       client_subset: list | None = None,
                       per_client_params: dict | None = None,
                       lr: float | None = None,
-                      sync_all_later_stages: bool = False) -> list[Update]:
+                      sync_all_later_stages: bool = False,
+                      send_params: bool | dict = True,
+                      send_weights: bool | dict = True) -> list[Update]:
+        """One remote round for one cluster.
+
+        FLEX wire economy (``other/FLEX/src/Server.py:140-143``):
+        ``send_params`` False (bool, or {stage: bool}) sends START
+        without weights (clients keep their local shard — client-side
+        persistence between rounds); ``send_weights`` (same shape) rides
+        the PAUSE so clients on non-aggregation rounds reply UPDATE
+        without a state_dict (sample counts still flow; no weight bytes
+        move).
+        """
         stage1 = [c for c in plan.stage1_clients
                   if client_subset is None or c in client_subset]
         if not stage1:
@@ -199,9 +213,15 @@ class ProtocolContext(MeshContext):
 
         for cid, s in active:
             a, b = ranges[s - 1]
-            base = (per_client_params or {}).get(cid, params)
-            shard_p = _np_tree(shard_params(base, self.specs, a, b))
-            shard_s = _np_tree(shard_params(stats or {}, self.specs, a, b))
+            sp = (send_params.get(s, True)
+                  if isinstance(send_params, dict) else bool(send_params))
+            if sp:
+                base = (per_client_params or {}).get(cid, params)
+                shard_p = _np_tree(shard_params(base, self.specs, a, b))
+                shard_s = _np_tree(shard_params(stats or {},
+                                                self.specs, a, b))
+            else:
+                shard_p = shard_s = None
             label_counts = None
             if s == 1:
                 label_counts = np.asarray(
@@ -215,7 +235,8 @@ class ProtocolContext(MeshContext):
                 extra={"epochs": epochs, "sda_size": sda,
                        "n_stages": plan.n_stages,
                        "gen": self._cur_gen})))
-            self.log.sent(f"START -> {cid} layers=[{a}, {end_layer}]")
+            self.log.sent(f"START -> {cid} layers=[{a}, {end_layer}]"
+                          + ("" if sp else " (no weights)"))
 
         ids = {cid for cid, _ in active}
         if not self._pump_until(
@@ -231,8 +252,14 @@ class ProtocolContext(MeshContext):
         deadline = time.monotonic() + self.client_timeout
         self._pump_until(lambda: s1_ids <= self._notified,
                          "NOTIFY from stage-1 clients", deadline=deadline)
+        stage_of = dict(active)
         for cid in ids:
-            self.bus.publish(reply_queue(cid), encode(Pause()))
+            if isinstance(send_weights, dict):
+                flag = bool(send_weights.get(stage_of[cid], True))
+            else:
+                flag = bool(send_weights)
+            self.bus.publish(reply_queue(cid),
+                             encode(Pause(send_weights=flag)))
         self.log.sent(f"PAUSE -> {sorted(ids)}")
 
         got = lambda: {u.client_id for u in self._updates} >= ids  # noqa

@@ -226,49 +226,82 @@ class ClusterRelayStrategy(RoundStrategy):
 class PeriodicStrategy(RoundStrategy):
     """FLEX: per-client weights PERSIST across rounds; client-level FedAvg
     every ``t_client`` rounds, global merge + validation every ``t_global``
-    rounds (``other/FLEX/src/Server.py:169-183``, ``:200-208``)."""
+    rounds (``other/FLEX/src/Server.py:169-183``, ``:200-208``).
+
+    Wire economy over the protocol backend (contexts with
+    ``clients_hold_state``): on non-aggregation rounds clients neither
+    receive weights in START nor upload them in UPDATE — the PAUSE
+    ``send`` flag and param-less START of
+    ``other/FLEX/src/Server.py:140-143``/``:220-226``.  Stage-1 clients
+    upload on ``t_client`` and ``t_global`` boundaries; later stages only
+    on ``t_global`` (``client_send``/``edge_send``).  In-process mesh
+    contexts rebuild client state every round, so there the strategy
+    re-pushes persisted trees each round (no wire to economize).
+    """
     name = "periodic"
 
     def __init__(self, cfg):
         super().__init__(cfg)
         self._client_params: dict = {}   # client_id -> full tree
+        self._reseed_stages: set = {0}   # 0 = every stage (initial seed)
 
     def run_round(self, ctx, plans, round_idx, params, stats):
         agg = self.cfg.aggregation
+        hold = getattr(ctx, "clients_hold_state", False)
+        boundary_c = (round_idx + 1) % agg.t_client == 0
+        boundary_g = (round_idx + 1) % agg.t_global == 0
         total, ok = 0, True
         cluster_params, cluster_stats = [], []
-        cur_stats = stats
         for plan in plans:
+            if hold:
+                send_w = {s: (boundary_c or boundary_g) if s == 1
+                          else boundary_g
+                          for s in range(1, plan.n_stages + 1)}
+                send_p = {s: (0 in self._reseed_stages
+                              or s in self._reseed_stages)
+                          for s in range(1, plan.n_stages + 1)}
+            else:
+                send_w = send_p = True
             ups = ctx.train_cluster(
                 plan, params, stats, round_idx=round_idx,
                 per_client_params=dict(self._client_params),
-                lr=self._lr(round_idx))
+                lr=self._lr(round_idx),
+                send_params=send_p, send_weights=send_w)
             ok &= all(u.ok for u in ups)
-            # persist each logical client's full tree (its shard overlaid
-            # on the round's base)
             for u in ups:
-                base = self._client_params.get(u.client_id, params)
-                self._client_params[u.client_id] = _fill(base, u.params)
                 if u.stage == 1:
                     total += u.num_samples
-            p, s, _ = aggregate_cluster(ups)
-            cluster_params.append(_fill(params, p))
-            cluster_stats.append(_fill(stats, s))
-            if (round_idx + 1) % agg.t_client == 0:
-                # client-level FedAvg: reset the cluster's clients to the
-                # cluster average (other/FLEX/src/Server.py:169-183)
-                for ids in plan.clients:
-                    for cid in ids:
-                        self._client_params[cid] = cluster_params[-1]
+            # persist each uploading client's full tree (its shard
+            # overlaid on the round's base); weight-less updates (FLEX
+            # non-aggregation rounds) persist nothing
+            got_w = [u for u in ups if u.params is not None]
+            for u in got_w:
+                base = self._client_params.get(u.client_id, params)
+                self._client_params[u.client_id] = _fill(base, u.params)
+            if got_w:
+                p, s, _ = aggregate_cluster(got_w)
+                cluster_params.append(_fill(params, p))
+                cluster_stats.append(_fill(stats, s))
+            if boundary_c and not boundary_g and got_w:
+                # client-level FedAvg: reset the cluster's stage-1
+                # clients to the cluster average
+                # (other/FLEX/src/Server.py:169-183)
+                for cid in plan.stage1_clients:
+                    self._client_params[cid] = cluster_params[-1]
         if not ok:
+            self._reseed_stages = {0}   # deterministic recovery re-seed
             return RoundOutcome(params, stats, ok=False, validate=False)
-        if (round_idx + 1) % agg.t_global == 0:
+        self._reseed_stages = set()
+        if boundary_g:
             merged = merge_clusters(cluster_params)
             merged_stats = merge_clusters(cluster_stats)
             self._client_params.clear()  # re-seed everyone from global
+            self._reseed_stages = {0}
             return RoundOutcome(merged, merged_stats, num_samples=total,
                                 validate=True)
-        return RoundOutcome(params, cur_stats, num_samples=total,
+        if boundary_c:
+            self._reseed_stages = {1}
+        return RoundOutcome(params, stats, num_samples=total,
                             validate=False)
 
 

@@ -171,11 +171,13 @@ def test_nan_round_skips_aggregation(tmp_path):
 
 
 def test_cpu_geometry_collapses_heavy_pipeline(tmp_path):
-    """On the CPU backend a heavy model must drop the stage axis (XLA CPU
-    collectives abort when a rendezvous participant is >40 s late; a full
-    VGG stage per tick on oversubscribed virtual devices exceeds that),
-    while tiny models keep the real ppermute pipeline path and
-    ``topology.force_pipeline`` restores it on request."""
+    """On the CPU backend a heavy model must shrink the stage axis to 1
+    (XLA CPU collectives abort when a rendezvous participant is >40 s
+    late; a full VGG stage per tick on oversubscribed virtual devices
+    exceeds that) while KEEPING the cuts — stages chain on-device as
+    virtual pipeline stages.  Tiny models keep the real ppermute
+    pipeline path and ``topology.force_pipeline`` restores it on
+    request."""
     from split_learning_tpu.runtime.plan import plan_clusters, Registration
 
     def geom(cfg):
@@ -198,7 +200,7 @@ def test_cpu_geometry_collapses_heavy_pipeline(tmp_path):
             checkpoint={"directory": str(tmp_path / "ckpt")}))
 
     c, s, cuts = geom(vgg_cfg())
-    assert (s, cuts) == (1, [])    # heavy on CPU: DP-only
+    assert (s, cuts) == (1, [7])   # heavy on CPU: chained, cuts kept
 
     c, s, cuts = geom(vgg_cfg(force_pipeline=True))
     assert (s, cuts) == (2, [7])   # explicit override keeps pipeline

@@ -1,0 +1,186 @@
+"""WordPiece tokenizer goldens + attention-mask plumbing.
+
+Round-2 parity items (VERDICT r1 #5/#6): real WordPiece ids must match
+the pretrained BertTokenizer when a vocab is on disk
+(``/root/reference/src/dataset/AGNEWS.py:13-30``), and the pad mask must
+flow from the dataset through every split boundary so padded positions
+are never attended (``other/Vanilla_SL/src/model/BERT_EMOTION.py:344``).
+"""
+
+import numpy as np
+import pytest
+
+_VOCAB = ["[PAD]", "[UNK]", "[CLS]", "[SEP]", "[MASK]",
+          "the", "quick", "brown", "fox", "jump", "##ed", "##s", "over",
+          "lazy", "dog", ",", ".", "!", "?", "'", "un", "##aff",
+          "##able", "run", "##ning", "New", "York", "2024", "##24",
+          "20", "hello"]
+
+_SENTS = [
+    "the quick brown fox jumped over the lazy dog.",
+    "unaffable, running!  New York 2024?",
+    "hello unknownword the fox's dog",
+    "the 2024 20 fox,dog.",
+    "",
+]
+
+
+@pytest.fixture()
+def vocab_file(tmp_path):
+    p = tmp_path / "vocab.txt"
+    p.write_text("\n".join(_VOCAB) + "\n")
+    return p
+
+
+class TestWordPiece:
+    def test_matches_hf_bert_tokenizer(self, vocab_file):
+        transformers = pytest.importorskip("transformers")
+        hf = transformers.BertTokenizer(str(vocab_file),
+                                        do_lower_case=False)
+        from split_learning_tpu.data.wordpiece import WordPieceTokenizer
+        mine = WordPieceTokenizer.from_file(vocab_file)
+        for s in _SENTS:
+            want = hf(s, max_length=16, truncation=True,
+                      padding="max_length")["input_ids"]
+            got = mine.encode(s, 16).tolist()
+            assert got == want, s
+
+    def test_truncation_and_padding(self, vocab_file):
+        from split_learning_tpu.data.wordpiece import WordPieceTokenizer
+        tok = WordPieceTokenizer.from_file(vocab_file)
+        long = " ".join(["dog"] * 50)
+        ids = tok.encode(long, 8)
+        assert ids.shape == (8,)
+        assert ids[0] == tok.cls_id and ids[-1] == tok.sep_id
+        short = tok.encode("dog", 8)
+        assert short[3:].tolist() == [tok.pad_id] * 5
+
+    def test_agnews_uses_vocab_when_present(self, tmp_path, monkeypatch):
+        """With vocab.txt + CSVs under data_dir, AGNEWS emits real
+        WordPiece ids (not hash buckets)."""
+        (tmp_path / "vocab.txt").write_text("\n".join(_VOCAB) + "\n")
+        ag = tmp_path / "ag_news"
+        ag.mkdir()
+        ag.joinpath("train.csv").write_text(
+            '"3","the fox","jumped over the lazy dog"\n'
+            '"1","hello York","running 2024"\n')
+        from split_learning_tpu.data import datasets
+        monkeypatch.setattr(datasets, "data_dir", lambda: tmp_path)
+        ds = datasets.agnews(train=True)
+        from split_learning_tpu.data.wordpiece import WordPieceTokenizer
+        tok = WordPieceTokenizer.from_file(tmp_path / "vocab.txt")
+        want = tok.encode("the fox jumped over the lazy dog", 128)
+        np.testing.assert_array_equal(np.asarray(ds.inputs[0]), want)
+        assert int(ds.labels[0]) == 2
+
+
+class TestMaskPlumbing:
+    _KW = dict(vocab_size=97, hidden_size=32, num_heads=2,
+               intermediate_size=64, max_position_embeddings=64,
+               n_block=2)
+
+    def test_padding_invariance_full_model(self, eight_devices):
+        """Appending [PAD] tokens must not change the logits — only true
+        when the attention mask is derived and applied."""
+        import jax
+        import jax.numpy as jnp
+        from split_learning_tpu.models import build_model
+
+        model = build_model("BERT_AGNEWS", **self._KW)
+        short = jax.random.randint(jax.random.key(0), (2, 6), 3, 97)
+        padded = jnp.concatenate(
+            [short, jnp.zeros((2, 10), jnp.int32)], axis=1)
+        v = model.init(jax.random.key(1), padded, train=False)
+        np.testing.assert_allclose(
+            np.asarray(model.apply(v, short, train=False)),
+            np.asarray(model.apply(v, padded, train=False)),
+            rtol=1e-5, atol=1e-6)
+
+    def test_mask_changes_logits(self, eight_devices):
+        """Same hidden content, pad ids present: masked model output must
+        differ from a mask-less forward (pads attended)."""
+        import jax
+        import jax.numpy as jnp
+        from split_learning_tpu.models import build_model
+        from split_learning_tpu.models import bert as bert_mod
+
+        model = build_model("BERT_AGNEWS", **self._KW)
+        ids = jnp.concatenate(
+            [jax.random.randint(jax.random.key(0), (2, 6), 3, 97),
+             jnp.zeros((2, 10), jnp.int32)], axis=1)
+        v = model.init(jax.random.key(1), ids, train=False)
+        masked = model.apply(v, ids, train=False)
+
+        # forward with the mask defeated (treat every position as real)
+        orig = bert_mod._PAD_ID
+        try:
+            bert_mod._PAD_ID = -1
+            unmasked_model = build_model("BERT_AGNEWS", **self._KW)
+            unmasked = unmasked_model.apply(v, ids, train=False)
+        finally:
+            bert_mod._PAD_ID = orig
+        assert not np.allclose(np.asarray(masked), np.asarray(unmasked),
+                               atol=1e-4)
+
+    def test_shard_runner_wire_roundtrip_matches_full(self, eight_devices):
+        """Protocol-mode parity: stage-1 fwd -> pickled pytree activation
+        (hidden, mask) -> stage-2 loss/backward -> pytree gradient ->
+        stage-1 recompute-backward must equal full-model grads."""
+        import jax
+        import jax.numpy as jnp
+        import optax
+        from split_learning_tpu.models import build_model
+        from split_learning_tpu.runtime.client import (
+            ShardRunner, _from_wire_tree, _to_wire_tree,
+        )
+        from split_learning_tpu.runtime.protocol import (
+            Activation, decode, encode,
+        )
+
+        cut, n_layers = 2, 6   # cut inside the encoder blocks
+        learning = {"optimizer": "sgd", "learning_rate": 0.0}
+        r1 = ShardRunner("BERT_AGNEWS", 0, cut, learning,
+                         model_kwargs=self._KW, seed=0)
+        r2 = ShardRunner("BERT_AGNEWS", cut, -1, learning,
+                         model_kwargs=self._KW, seed=1)
+
+        full = build_model("BERT_AGNEWS", **self._KW)
+        ids = jnp.concatenate(
+            [jax.random.randint(jax.random.key(0), (2, 6), 3, 97),
+             jnp.zeros((2, 4), jnp.int32)], axis=1)
+        labels = jnp.asarray([1, 3], jnp.int32)
+        variables = full.init(jax.random.key(2), ids, train=False)
+        params = variables["params"]
+        from split_learning_tpu.models.split import shard_params
+        f1, t1 = r1.partition_params(
+            shard_params(params, full.specs, 0, cut), False)
+        f2, t2 = r2.partition_params(
+            shard_params(params, full.specs, cut, len(full.specs)), True)
+
+        rng = jax.random.key(3)
+        out1 = r1.fwd(f1, t1, {}, ids, rng)
+        # simulate the broker hop: encode/decode the pytree payload
+        msg = decode(encode(Activation(
+            data_id="d", data=_to_wire_tree(out1),
+            labels=np.asarray(labels), trace=["c1"], cluster=0)))
+        x2 = _from_wire_tree(msg.data)
+        assert isinstance(x2, tuple) and len(x2) == 2  # (hidden, mask)
+        assert np.asarray(x2[1]).dtype == np.bool_
+
+        loss, gt2, gx, _ = r2.last_step(f2, t2, {}, x2, labels, rng)
+        gx = _from_wire_tree(_to_wire_tree(gx))
+        gt1, _, _ = r1.bwd(f1, t1, {}, ids, gx, rng)
+
+        # oracle: full-model grads at the same params
+        def loss_fn(p):
+            out = full.apply({"params": p}, ids, train=True,
+                             rngs={"dropout": rng})
+            return optax.softmax_cross_entropy_with_integer_labels(
+                out.astype(jnp.float32), labels).mean()
+        g_full = jax.grad(loss_fn)(params)
+        got = {**gt1["head"], **gt2["head"]}
+        ref_leaves = dict(jax.tree_util.tree_leaves_with_path(g_full))
+        for path, leaf in jax.tree_util.tree_leaves_with_path(got):
+            np.testing.assert_allclose(
+                np.asarray(leaf), np.asarray(ref_leaves[path]),
+                rtol=2e-4, atol=1e-5, err_msg=str(path))
