@@ -101,6 +101,8 @@ class TopologyConfig:
     cut_layers: tuple = (7,)        # manual: one cut list for all clusters
     cluster_cut_layers: tuple | None = None  # per-cluster cut lists (FLEX)
     num_clusters: int = 1
+    in_clusters: int = 1            # 2LS: in-clusters per out-cluster
+    # (clients pair 1:1 edge<->head inside each; other/2LS/client.py:15-17)
     cluster_algorithm: str = "kmeans"  # kmeans | affinity
     selection: bool = False         # GMM straggler rejection on/off
     force_pipeline: bool = False    # keep stage ppermute even where the
@@ -110,6 +112,7 @@ class TopologyConfig:
         _check(self.mode in ("manual", "auto"),
                f"topology mode must be manual|auto, got {self.mode!r}")
         _check(self.num_clusters >= 1, "num-clusters must be >= 1")
+        _check(self.in_clusters >= 1, "in-clusters must be >= 1")
         _check(self.cluster_algorithm in ("kmeans", "affinity"),
                f"cluster-algorithm must be kmeans|affinity, "
                f"got {self.cluster_algorithm!r}")

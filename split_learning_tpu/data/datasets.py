@@ -206,14 +206,14 @@ def _tokenize(texts: list[str], seq_len: int, vocab: int) -> np.ndarray:
     vocab_path = find_vocab(data_dir())
     if vocab_path is not None:
         tok = WordPieceTokenizer.from_file(vocab_path)
-        if len(tok.vocab) > vocab:
+        if max(tok.vocab.values(), default=0) >= vocab:
             # e.g. an uncased 30522-entry vocab.txt against the 28996
             # cased embedding table: out-of-range ids would be silently
             # clamped by the embedding gather under jit
             raise ValueError(
-                f"{vocab_path} has {len(tok.vocab)} entries but the "
-                f"model's embedding table holds {vocab}; use the "
-                "matching (cased) vocab")
+                f"{vocab_path} holds token ids up to "
+                f"{max(tok.vocab.values())} but the model's embedding "
+                f"table holds {vocab}; use the matching (cased) vocab")
         return tok.encode_batch(texts, seq_len)
     return _hash_tokenize(texts, seq_len, vocab)
 

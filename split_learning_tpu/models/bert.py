@@ -17,7 +17,8 @@ from split_learning_tpu.models.split import (
     LayerSpec, register_model, module_train_fn as _train_fn,
 )
 from split_learning_tpu.models.transformer import (
-    BertBlock, BertEmbeddings, Pooler, ClassifierHead,
+    BertAttentionSublayer, BertBlock, BertEmbeddings, BertFfnSublayer,
+    Pooler, ClassifierHead,
 )
 
 _PAD_ID = 0  # [PAD] is id 0 in BERT vocabs (wordpiece.py, HF convention)
@@ -45,11 +46,30 @@ def _pooler_fn(mod, xm, train):
     return mod(x)
 
 
+def _attn_sublayer_fn(mod, xm, train):
+    x, mask = xm
+    return mod(x, mask=mask[:, None, None, :], train=train), mask
+
+
+def _ffn_sublayer_fn(mod, xm, train):
+    x, mask = xm
+    return mod(x, train=train), mask
+
+
 def _bert_specs(num_labels: int, vocab_size: int = 28996,
                 hidden_size: int = 768, num_heads: int = 12,
                 intermediate_size: int = 3072,
                 max_position_embeddings: int = 512, n_block: int = 12,
-                dropout_rate: float = 0.1, dtype=jnp.float32) -> tuple:
+                dropout_rate: float = 0.1, dtype=jnp.float32,
+                fine_grained: bool = False) -> tuple:
+    """``fine_grained=False``: 15 macro layers (1 embeddings, 2-13
+    blocks, 14 pooler, 15 classifier — ``src/model/BERT_AGNEWS.py:
+    185-200``).  ``fine_grained=True``: 27 per-sublayer layers — each
+    block splits into an attention sublayer and an FFN sublayer, so cut
+    points land INSIDE blocks (reference BERT_EMOTION,
+    ``other/Vanilla_SL/src/model/BERT_EMOTION.py:183-185``: 1
+    embeddings, 2-25 alternating attn/ffn, 26 pooler, 27 classifier).
+    """
     specs = [LayerSpec(
         name="layer1",
         make=functools.partial(
@@ -57,20 +77,41 @@ def _bert_specs(num_labels: int, vocab_size: int = 28996,
             max_position_embeddings=max_position_embeddings,
             dropout_rate=dropout_rate, dtype=dtype),
         fn=_embed_fn)]
-    for i in range(n_block):
-        specs.append(LayerSpec(
-            name=f"layer{2 + i}",
-            make=functools.partial(
-                BertBlock, hidden_size=hidden_size, num_heads=num_heads,
-                intermediate_size=intermediate_size,
-                dropout_rate=dropout_rate, dtype=dtype),
-            fn=_block_fn))
+    idx = 2
+    for _ in range(n_block):
+        if fine_grained:
+            specs.append(LayerSpec(
+                name=f"layer{idx}",
+                make=functools.partial(
+                    BertAttentionSublayer, hidden_size=hidden_size,
+                    num_heads=num_heads, dropout_rate=dropout_rate,
+                    dtype=dtype),
+                fn=_attn_sublayer_fn))
+            idx += 1
+            specs.append(LayerSpec(
+                name=f"layer{idx}",
+                make=functools.partial(
+                    BertFfnSublayer, hidden_size=hidden_size,
+                    intermediate_size=intermediate_size,
+                    dropout_rate=dropout_rate, dtype=dtype),
+                fn=_ffn_sublayer_fn))
+            idx += 1
+        else:
+            specs.append(LayerSpec(
+                name=f"layer{idx}",
+                make=functools.partial(
+                    BertBlock, hidden_size=hidden_size,
+                    num_heads=num_heads,
+                    intermediate_size=intermediate_size,
+                    dropout_rate=dropout_rate, dtype=dtype),
+                fn=_block_fn))
+            idx += 1
     specs.append(LayerSpec(
-        name=f"layer{2 + n_block}",
+        name=f"layer{idx}",
         make=functools.partial(Pooler, hidden_size=hidden_size, dtype=dtype),
         fn=_pooler_fn))
     specs.append(LayerSpec(
-        name=f"layer{3 + n_block}",
+        name=f"layer{idx + 1}",
         make=functools.partial(ClassifierHead, num_labels=num_labels,
                                dropout_rate=dropout_rate, dtype=dtype),
         fn=_train_fn))
@@ -85,5 +126,7 @@ def bert_agnews(dtype=jnp.float32, **kw) -> tuple:
 
 @register_model("BERT_EMOTION")
 def bert_emotion(dtype=jnp.float32, **kw) -> tuple:
-    """Emotion: 6 classes (Vanilla_SL variant parity at macro granularity)."""
+    """Emotion: 6 classes (Vanilla_SL variant).  Pass
+    ``fine_grained=True`` for the reference's 27 per-sublayer cut
+    points (``other/Vanilla_SL/src/model/BERT_EMOTION.py:183-185``)."""
     return _bert_specs(6, dtype=dtype, **kw)

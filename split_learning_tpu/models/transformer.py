@@ -44,6 +44,49 @@ class BertBlock(nn.Module):
                             name="output_norm")(x + h)
 
 
+class BertAttentionSublayer(nn.Module):
+    """The attention half of a post-LN block: attn -> dropout ->
+    add&norm.  A standalone split layer for fine-grained (per-sublayer)
+    cut points (reference BERT_EMOTION's 27-layer indexing,
+    ``other/Vanilla_SL/src/model/BERT_EMOTION.py:183-185``).
+    Submodule names match :class:`BertBlock` so block-level weights map
+    1:1 onto (attention, ffn) sublayer pairs."""
+    hidden_size: int
+    num_heads: int
+    dropout_rate: float = 0.1
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, mask=None, train: bool = False):
+        attn = nn.MultiHeadDotProductAttention(
+            num_heads=self.num_heads, qkv_features=self.hidden_size,
+            out_features=self.hidden_size, dtype=self.dtype,
+            dropout_rate=self.dropout_rate, name="attention")(
+                x, x, mask=mask, deterministic=not train)
+        attn = nn.Dropout(self.dropout_rate)(attn, deterministic=not train)
+        return nn.LayerNorm(epsilon=1e-12, dtype=self.dtype,
+                            name="attention_norm")(x + attn)
+
+
+class BertFfnSublayer(nn.Module):
+    """The FFN half of a post-LN block: dense-gelu-dense -> dropout ->
+    add&norm (the other sublayer of the fine-grained split)."""
+    hidden_size: int
+    intermediate_size: int
+    dropout_rate: float = 0.1
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        h = nn.Dense(self.intermediate_size, dtype=self.dtype,
+                     name="intermediate")(x)
+        h = nn.gelu(h)
+        h = nn.Dense(self.hidden_size, dtype=self.dtype, name="output")(h)
+        h = nn.Dropout(self.dropout_rate)(h, deterministic=not train)
+        return nn.LayerNorm(epsilon=1e-12, dtype=self.dtype,
+                            name="output_norm")(x + h)
+
+
 class PreLNBlock(nn.Module):
     """Pre-LN encoder block: x + attn(ln(x)); x + mlp(ln(x)) — the KWT/ViT
     shape."""

@@ -39,21 +39,22 @@ class Transport:
     must move no weight bytes) via :attr:`bytes_out`.
     """
 
+    def __init__(self):
+        # own lock: one transport is shared by server + client threads
+        self._count_lock = threading.Lock()
+        self.bytes_out: dict = {}
+
     def publish(self, queue: str, payload: bytes) -> None:
         raise NotImplementedError
 
     def _count(self, queue: str, payload: bytes) -> None:
-        # own lock: one transport is shared by server + client threads
-        # (dict.setdefault is atomic under the GIL, so lazy init is safe)
-        lock = self.__dict__.setdefault("_count_lock", threading.Lock())
-        with lock:
-            d = getattr(self, "bytes_out", None)
-            if d is None:
-                d = self.bytes_out = {}
-            d[queue] = d.get(queue, 0) + len(payload)
+        with self._count_lock:
+            self.bytes_out[queue] = (self.bytes_out.get(queue, 0)
+                                     + len(payload))
 
     def total_bytes_out(self) -> int:
-        return sum(getattr(self, "bytes_out", {}).values())
+        with self._count_lock:
+            return sum(self.bytes_out.values())
 
     def get(self, queue: str, timeout: float | None = None) -> bytes | None:
         """Pop one message; block up to ``timeout`` (None = forever).
@@ -71,6 +72,7 @@ class Transport:
 
 class InProcTransport(Transport):
     def __init__(self):
+        super().__init__()
         self._lock = threading.Lock()
         self._cond = threading.Condition(self._lock)
         self._queues: dict[str, collections.deque] = \
@@ -214,6 +216,7 @@ class TcpTransport(Transport):
     safe for one thread (create one per worker thread)."""
 
     def __init__(self, host: str, port: int, connect_timeout: float = 30.0):
+        super().__init__()
         # the broker may still be coming up (simultaneous launch): retry
         # with backoff instead of failing the whole client process
         deadline = time.monotonic() + connect_timeout

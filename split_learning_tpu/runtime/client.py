@@ -342,6 +342,9 @@ class ProtocolClient:
         # sequential strategies reuse it across sub-calls)
         self.fence = int(extra.get("gen", msg.round_idx))
         self.n_stages = int(extra.get("n_stages", self.cfg.num_stages))
+        # 2LS fixed edge<->head pairing: route this client's forward
+        # data plane through its pair-indexed queue (None = shared)
+        self.pair = extra.get("pair")
         if msg.params is None:
             # FLEX non-reseed round (other/FLEX/src/Server.py:220-226):
             # START without weights — keep the locally persisted shard
@@ -506,7 +509,7 @@ class ProtocolClient:
         r = self.runner
         inflight: dict[str, _Inflight] = {}
         grad_q = gradient_queue(self.stage, self.client_id)
-        out_q = intermediate_queue(self.stage, self.cluster)
+        out_q = intermediate_queue(self.stage, self.cluster, self.pair)
         cap = max(1, r.learning.control_count)
         n_fwd = n_bwd = 0
 
@@ -573,8 +576,8 @@ class ProtocolClient:
 
     def _train_middle(self) -> Pause:
         r = self.runner
-        in_q = intermediate_queue(self.stage - 1, self.cluster)
-        out_q = intermediate_queue(self.stage, self.cluster)
+        in_q = intermediate_queue(self.stage - 1, self.cluster, self.pair)
+        out_q = intermediate_queue(self.stage, self.cluster, self.pair)
         grad_q = gradient_queue(self.stage, self.client_id)
         inflight: dict[str, _Inflight] = {}
         while True:
@@ -627,7 +630,7 @@ class ProtocolClient:
         window of client batches and runs them as ONE concatenated fwd/bwd
         (DCSL SDA, ``other/DCSL/src/Scheduler.py:152-191``)."""
         r = self.runner
-        in_q = intermediate_queue(self.stage - 1, self.cluster)
+        in_q = intermediate_queue(self.stage - 1, self.cluster, self.pair)
         window: list[Activation] = []
         while True:
             pause = self._check_pause()

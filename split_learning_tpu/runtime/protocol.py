@@ -35,8 +35,14 @@ def reply_queue(client_id: str) -> str:
     return f"reply_{client_id}"
 
 
-def intermediate_queue(stage: int, cluster: int) -> str:
-    return f"intermediate_queue_{stage}_{cluster}"
+def intermediate_queue(stage: int, cluster: int,
+                       pair: int | None = None) -> str:
+    """Forward-activation queue.  ``pair`` selects 2LS's fixed 1:1
+    edge<->head pairing (``intermediate_queue_{layer}_{idx}``,
+    ``other/2LS/src/train/VGG16.py:23``) instead of the shared
+    per-cluster queue's natural load balancing."""
+    base = f"intermediate_queue_{stage}_{cluster}"
+    return base if pair is None else f"{base}_p{pair}"
 
 
 def gradient_queue(stage: int, client_id: str) -> str:

@@ -211,6 +211,28 @@ class ProtocolContext(MeshContext):
         self._gen += 1
         self._cur_gen = self._gen
 
+        # 2LS fixed 1:1 edge<->head pairing: when in_clusters in-groups
+        # each have their own head, the forward data plane runs over
+        # pair-indexed queues instead of the shared cluster queue
+        # (other/2LS/src/train/VGG16.py:23).  Requires a 2-stage plan
+        # with exactly one head per in-cluster; otherwise the shared
+        # queue's natural load balancing stays.
+        pair_of: dict = {}
+        n_in = self.cfg.topology.in_clusters
+        if n_in > 1 and plan.n_stages == 2:
+            from split_learning_tpu.runtime.context import client_groups
+            groups = client_groups(len(stage1), min(n_in, len(stage1)))
+            heads = plan.clients[1]
+            if len(heads) == len(groups):
+                for g, idxs in enumerate(groups):
+                    for i in idxs:
+                        pair_of[stage1[i]] = g
+                    pair_of[heads[g]] = g
+            else:
+                self.log.warning(
+                    f"in_clusters={n_in} but {len(heads)} heads for "
+                    f"{len(groups)} in-groups: keeping shared queues")
+
         for cid, s in active:
             a, b = ranges[s - 1]
             sp = (send_params.get(s, True)
@@ -234,6 +256,7 @@ class ProtocolContext(MeshContext):
                 label_counts=label_counts, round_idx=round_idx,
                 extra={"epochs": epochs, "sda_size": sda,
                        "n_stages": plan.n_stages,
+                       "pair": pair_of.get(cid),
                        "gen": self._cur_gen})))
             self.log.sent(f"START -> {cid} layers=[{a}, {end_layer}]"
                           + ("" if sp else " (no weights)"))

@@ -306,29 +306,63 @@ class PeriodicStrategy(RoundStrategy):
 
 
 class FedAsyncStrategy(RoundStrategy):
-    """2LS: clusters execute sequentially in shuffled order; each cluster's
-    aggregate merges into the global model with ``alpha = 1/(1+rank)`` (or
-    a fixed config alpha): ``g = (1-a) g + a c``
-    (``other/2LS/src/Server.py:201-233``)."""
+    """2LS two-level clustering + FedAsync
+    (``other/2LS/src/Server.py:170-233``).
+
+    Out-clusters (the ``plans``) execute sequentially in shuffled order
+    per round.  Within an out-cluster, ``topology.in_clusters``
+    in-clusters — contiguous groups of stage-1 clients, each paired with
+    a stage-2 head (``other/2LS/client.py:15-17``) — train
+    concurrently; each in-cluster's 2-stage average then merges into the
+    global model in completion order with ``alpha = 1/(1+rank)`` (or the
+    fixed config alpha): ``g = (1-a) g + a c``.  Rank resets per
+    out-cluster, so the first in-cluster's average replaces the global
+    (``fed_async_aggregate`` with ``alpha=1``) — continuity across
+    out-clusters flows through the training init, reference-faithfully.
+    ``in_clusters=1`` degenerates to one merge per out-cluster.
+    """
     name = "fedasync"
+
+    def _in_groups(self, plan: ClusterPlan) -> list[tuple[list, dict]]:
+        """[(stage1_member_ids, {stage: paired_later_client_id})] per
+        in-cluster — the fixed edge<->head pairing (round-robin when
+        heads are fewer than in-clusters)."""
+        from split_learning_tpu.runtime.context import client_groups
+        n_in = max(1, self.cfg.topology.in_clusters)
+        s1 = plan.stage1_clients
+        groups = client_groups(len(s1), min(n_in, len(s1)))
+        out = []
+        for g, idxs in enumerate(groups):
+            paired = {s: plan.clients[s - 1][g % len(plan.clients[s - 1])]
+                      for s in range(2, plan.n_stages + 1)
+                      if plan.clients[s - 1]}
+            out.append(([s1[i] for i in idxs], paired))
+        return out
 
     def run_round(self, ctx, plans, round_idx, params, stats):
         rng = np.random.default_rng(self.cfg.seed + round_idx)
         order = rng.permutation(len(plans))
         g_p, g_s = params, stats
         total, ok = 0, True
-        for rank, pi in enumerate(order):
+        for pi in order:
             plan = plans[pi]
             ups = ctx.train_cluster(plan, g_p, g_s, round_idx=round_idx,
                                     lr=self._lr(round_idx))
             ok &= all(u.ok for u in ups)
-            p, s, n = aggregate_cluster(ups)
-            alpha = (self.cfg.aggregation.fedasync_alpha
-                     if self.cfg.aggregation.fedasync_alpha is not None
-                     else 1.0 / (1.0 + rank))
-            g_p = _lerp(g_p, _fill(g_p, p), alpha)
-            g_s = _fill(g_s, s)
-            total += n
+            for rank, (members, paired) in enumerate(self._in_groups(plan)):
+                in_ups = [u for u in ups
+                          if (u.stage == 1 and u.client_id in members)
+                          or (u.stage >= 2
+                              and u.client_id == paired.get(u.stage))]
+                if not in_ups:
+                    continue
+                p, s, n = aggregate_cluster(in_ups)
+                alpha = (self.cfg.aggregation.fedasync_alpha
+                         if self.cfg.aggregation.fedasync_alpha is not None
+                         else 1.0 / (1.0 + rank))
+                g_p = _lerp(g_p, _fill(g_p, p), alpha)
+                g_s = _fill(g_s, s)
+                total += n
         if not ok:
             return RoundOutcome(params, stats, ok=False, validate=False)
         return RoundOutcome(g_p, g_s, num_samples=total)

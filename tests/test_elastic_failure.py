@@ -71,3 +71,70 @@ def test_stale_messages_fenced_by_generation(tmp_path):
         assert ctx._pump_one(timeout=0.1)
     assert ctx._notified == {"b"}
     assert [u.client_id for u in ctx._updates] == ["b"]
+
+
+def test_tcp_client_crash_mid_round_survivors_finish(tmp_path):
+    """VERDICT r1 #9: a TCP client whose process dies MID-STREAM (socket
+    closed after its first activations are in flight) must be dropped at
+    the NOTIFY deadline; the round completes with the survivors and the
+    NEXT round re-SYNs the survivors cleanly."""
+    from split_learning_tpu.runtime.bus import Broker, TcpTransport
+
+    class CrashingTransport(TcpTransport):
+        """Dies on the Nth publish — after REGISTER/READY and the first
+        data-plane messages, i.e. mid-round."""
+
+        def __init__(self, host, port, crash_after=4):
+            super().__init__(host, port)
+            self._left = crash_after
+
+        def publish(self, queue, payload):
+            self._left -= 1
+            if self._left < 0:
+                try:
+                    self.close()
+                finally:
+                    raise RuntimeError("simulated client crash")
+            super().publish(queue, payload)
+
+    broker = Broker("127.0.0.1", 0)
+    try:
+        cfg = proto_cfg(
+            tmp_path, clients=[2, 1], global_rounds=2,
+            transport={"kind": "tcp", "host": "127.0.0.1",
+                       "port": broker.port})
+        server = ProtocolServer(
+            cfg, transport=TcpTransport("127.0.0.1", broker.port),
+            client_timeout=45, ready_timeout=15)
+
+        threads = []
+
+        def run_quiet(client):
+            try:
+                client.run()
+            except RuntimeError:
+                pass  # the simulated crash
+
+        for cid, stage, crash in (("live_1", 1, None),
+                                  ("dying_1", 1, 4),
+                                  ("live_2", 2, None)):
+            bus = (TcpTransport("127.0.0.1", broker.port) if crash is None
+                   else CrashingTransport("127.0.0.1", broker.port,
+                                          crash_after=crash))
+            c = ProtocolClient(cfg, cid, stage, transport=bus)
+            th = threading.Thread(target=run_quiet, args=(c,), daemon=True)
+            th.start()
+            threads.append(th)
+
+        result = server.serve()
+        assert len(result.history) == 2
+        for rec in result.history:
+            assert rec.ok          # survivors' round aggregated fine
+            assert rec.num_samples > 0
+        # round 2 ran without the dead client: only live_1's data counted
+        assert result.history[1].num_samples <= 24
+        for th in threads:
+            th.join(timeout=30)
+            assert not th.is_alive()
+    finally:
+        broker.close()

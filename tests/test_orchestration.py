@@ -204,3 +204,96 @@ def test_cpu_geometry_collapses_heavy_pipeline(tmp_path):
 
     c, s, cuts = geom(vgg_cfg(force_pipeline=True))
     assert (s, cuts) == (2, [7])   # explicit override keeps pipeline
+
+
+def test_vgg16_cut7_real_pipeline_end_to_end(tmp_path):
+    """VERDICT r1 #4: the reference's default geometry — VGG16/CIFAR10 at
+    cut=7 (config.yaml:3-28, cut studied in other/Vanilla_SL/README.md)
+    — through the REAL multi-stage lax.switch+ppermute program on the
+    8-device CPU mesh, not the virtual-stage collapse.  Tiny batch and
+    sample counts keep each pipeline tick far below XLA CPU's 40 s
+    collective-rendezvous abort."""
+    cfg = from_dict(dict(
+        model="VGG16", dataset="CIFAR10", clients=[2, 2],
+        global_rounds=1, synthetic_size=16, val_max_batches=1,
+        val_batch_size=8, compute_dtype="float32",
+        log_path=str(tmp_path),
+        learning={"batch_size": 2, "control_count": 2,
+                  "optimizer": "sgd", "learning_rate": 5e-4,
+                  "momentum": 0.9},
+        distribution={"num_samples": 8},
+        topology={"cut_layers": [7], "force_pipeline": True},
+        checkpoint={"directory": str(tmp_path / "ckpt")},
+    ))
+    from split_learning_tpu.run import run_local
+    from split_learning_tpu.runtime.context import MeshContext
+    from split_learning_tpu.runtime.plan import plan_clusters, Registration
+
+    # preflight: this config must really select the 2-wide stage axis
+    regs = [Registration(client_id=f"c{s}_{i}", stage=s)
+            for s in (1, 2) for i in range(2)]
+    plan = plan_clusters(cfg, regs)[0]
+    c, s, cuts = MeshContext(cfg)._geometry(plan, 2)
+    assert (c, s, cuts) == (2, 2, [7])
+
+    result = run_local(cfg)
+    rec = result.history[0]
+    assert rec.ok
+    assert rec.num_samples >= 8   # both stage-1 clients consumed data
+    assert rec.val_accuracy is not None
+    assert "layer9" in result.params   # both stages' shards came back
+
+
+def test_2ls_two_level_fedasync_merge_math(tmp_path):
+    """2LS (VERDICT r1 #7): in-cluster (edge, head) pairs aggregate
+    separately; each merges into the global with alpha=1/(1+rank) in
+    order — first replaces (alpha=1), second blends 1/2
+    (other/2LS/src/Server.py:178-184)."""
+    from split_learning_tpu.runtime.context import TrainContext
+    from split_learning_tpu.runtime.plan import ClusterPlan
+    from split_learning_tpu.runtime.protocol import Update
+
+    vals = {"e0": 1.0, "e1": 3.0, "h0": 10.0, "h1": 30.0}
+
+    class FakeCtx(TrainContext):
+        def train_cluster(self, plan, params, stats, **kw):
+            ups = []
+            for cid in plan.stage1_clients:
+                ups.append(Update(
+                    client_id=cid, stage=1, cluster=plan.cluster_id,
+                    params={"layer1": np.full(2, vals[cid])},
+                    batch_stats={}, num_samples=10, ok=True))
+            for cid in plan.clients[1]:
+                ups.append(Update(
+                    client_id=cid, stage=2, cluster=plan.cluster_id,
+                    params={"layer2": np.full(2, vals[cid])},
+                    batch_stats={}, num_samples=10, ok=True))
+            return ups
+
+    cfg = tiny_cfg(tmp_path, aggregation={"strategy": "fedasync"},
+                   topology={"in_clusters": 2, "cut_layers": [2]})
+    strategy = make_strategy(cfg)
+    plan = ClusterPlan(cluster_id=0, cuts=[2],
+                       clients=[["e0", "e1"], ["h0", "h1"]],
+                       label_counts=np.ones((2, 10)), rejected=[])
+    base = {"layer1": np.zeros(2), "layer2": np.zeros(2)}
+    out = strategy.run_round(FakeCtx(), [plan], 0, base, {})
+    assert out.ok
+    assert out.num_samples == 20   # stage-1 data_count only
+    # in-cluster 0 = (e0, h0) replaces (alpha=1): g = {1, 10};
+    # in-cluster 1 = (e1, h1) blends alpha=1/2: g = {2, 20}
+    np.testing.assert_allclose(out.params["layer1"], np.full(2, 2.0))
+    np.testing.assert_allclose(out.params["layer2"], np.full(2, 20.0))
+
+
+def test_2ls_two_level_end_to_end_mesh(tmp_path):
+    """2 out-clusters x 2 in-clusters over the compiled mesh backend."""
+    cfg = tiny_cfg(tmp_path, clients=[4, 2], global_rounds=2,
+                   aggregation={"strategy": "fedasync"},
+                   topology={"num_clusters": 2, "in_clusters": 2,
+                             "cut_layers": [2]})
+    result = run_local(cfg)
+    assert len(result.history) == 2
+    assert all(rec.ok for rec in result.history)
+    assert result.history[-1].num_samples > 0
+    assert result.history[-1].val_accuracy is not None

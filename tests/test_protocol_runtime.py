@@ -163,3 +163,28 @@ def test_flex_periodic_wire_economy(tmp_path):
     updates = [has for name, has in bus.events if name == "Update"]
     assert len(starts) == 8 and sum(starts) == 3, starts
     assert len(updates) == 8 and sum(updates) == 3, updates
+
+
+def test_2ls_two_level_over_protocol_pair_queues(tmp_path):
+    """2LS over the protocol backend: 2 out-clusters x 2 in-clusters,
+    each (edge, head) pair wired through its OWN pair-indexed forward
+    queue (other/2LS/src/train/VGG16.py:23) instead of the shared
+    cluster queue."""
+    bus = InProcTransport()
+    cfg = proto_cfg(tmp_path, clients=[4, 4], global_rounds=1,
+                    aggregation={"strategy": "fedasync"},
+                    topology={"num_clusters": 2, "in_clusters": 2,
+                              "cut_layers": [2]})
+    result = run_deployment(cfg, lambda: bus, bus)
+    rec = result.history[0]
+    assert rec.ok
+    assert rec.num_samples > 0
+    assert rec.val_accuracy is not None
+    # the forward data plane really used pair-indexed queues
+    pair_queues = [q for q in bus.bytes_out
+                   if q.startswith("intermediate_queue_") and "_p" in q]
+    shared_queues = [q for q in bus.bytes_out
+                     if q.startswith("intermediate_queue_")
+                     and "_p" not in q]
+    assert len(pair_queues) >= 2, sorted(bus.bytes_out)
+    assert not shared_queues, shared_queues

@@ -184,3 +184,88 @@ class TestMaskPlumbing:
             np.testing.assert_allclose(
                 np.asarray(leaf), np.asarray(ref_leaves[path]),
                 rtol=2e-4, atol=1e-5, err_msg=str(path))
+
+
+class TestFineGrainedBert:
+    """BERT_EMOTION's 27 per-sublayer cut points (VERDICT r1 #10)."""
+
+    _KW = dict(vocab_size=97, hidden_size=32, num_heads=2,
+               intermediate_size=64, max_position_embeddings=64,
+               n_block=2, fine_grained=True)
+
+    def test_layer_count_full_size(self):
+        from split_learning_tpu.models import num_layers
+        assert num_layers("BERT_EMOTION", fine_grained=True) == 27
+        assert num_layers("BERT_EMOTION") == 15
+
+    def test_macro_equals_fine_grained_forward(self, eight_devices):
+        """A macro block's params are exactly the union of its two
+        sublayers' params — remapped weights must give identical
+        logits."""
+        import jax
+        import jax.numpy as jnp
+        from split_learning_tpu.models import build_model
+
+        macro_kw = {**self._KW}
+        macro_kw.pop("fine_grained")
+        macro = build_model("BERT_EMOTION", **macro_kw)
+        fine = build_model("BERT_EMOTION", **self._KW)
+        ids = jnp.concatenate(
+            [jax.random.randint(jax.random.key(0), (2, 6), 3, 97),
+             jnp.zeros((2, 4), jnp.int32)], axis=1)
+        mp = macro.init(jax.random.key(1), ids, train=False)["params"]
+
+        fp = {"layer1": mp["layer1"]}
+        n_block = self._KW["n_block"]
+        for b in range(n_block):
+            blk = mp[f"layer{2 + b}"]
+            fp[f"layer{2 + 2 * b}"] = {
+                "attention": blk["attention"],
+                "attention_norm": blk["attention_norm"]}
+            fp[f"layer{3 + 2 * b}"] = {
+                "intermediate": blk["intermediate"],
+                "output": blk["output"],
+                "output_norm": blk["output_norm"]}
+        fp[f"layer{2 + 2 * n_block}"] = mp[f"layer{2 + n_block}"]
+        fp[f"layer{3 + 2 * n_block}"] = mp[f"layer{3 + n_block}"]
+
+        np.testing.assert_allclose(
+            np.asarray(fine.apply({"params": fp}, ids, train=False)),
+            np.asarray(macro.apply({"params": mp}, ids, train=False)),
+            rtol=1e-5, atol=1e-6)
+
+    def test_split_inside_block_matches_unsplit(self, eight_devices):
+        """Cut at layer 2 = between block 1's attention and FFN
+        sublayers — a cut point the macro model cannot express."""
+        import jax
+        import jax.numpy as jnp
+        import optax
+        from split_learning_tpu.parallel import (
+            PipelineModel, make_train_step, make_mesh,
+        )
+        from split_learning_tpu.parallel.pipeline import (
+            init_pipeline_variables, stack_for_clients, shard_to_mesh,
+        )
+        from split_learning_tpu.models import build_model
+        from tests.test_pipeline import _ref_loss
+
+        mb, M = 2, 2
+        struct = jax.ShapeDtypeStruct((mb, 10), jnp.int32)
+        pipe = PipelineModel("BERT_EMOTION", [2], struct,
+                             num_microbatches=M, model_kwargs=self._KW)
+        mesh = make_mesh(1, 2, jax.devices()[:2])
+        variables = init_pipeline_variables(pipe, jax.random.key(0),
+                                            struct)
+        x = jax.random.randint(jax.random.key(1), (1, M, mb, 10), 0, 97)
+        labels = jax.random.randint(jax.random.key(2), (1, M, mb), 0, 6)
+        opt = optax.sgd(0.1)
+        step = make_train_step(pipe, opt, mesh, train=False, donate=False)
+        out = step(stack_for_clients(variables["params"], 1),
+                   stack_for_clients(opt.init(variables["params"]), 1),
+                   stack_for_clients({}, 1), x, labels,
+                   jax.random.key(5)[None])
+        model = build_model("BERT_EMOTION", **self._KW)
+        ref_loss, _ = _ref_loss(model, variables["params"], {}, x[0],
+                                labels[0], jax.random.key(9), False)
+        np.testing.assert_allclose(float(out[3][0]), float(ref_loss),
+                                   rtol=1e-5)
