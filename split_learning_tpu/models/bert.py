@@ -46,16 +46,6 @@ def _pooler_fn(mod, xm, train):
     return mod(x)
 
 
-def _attn_sublayer_fn(mod, xm, train):
-    x, mask = xm
-    return mod(x, mask=mask[:, None, None, :], train=train), mask
-
-
-def _ffn_sublayer_fn(mod, xm, train):
-    x, mask = xm
-    return mod(x, train=train), mask
-
-
 def _bert_specs(num_labels: int, vocab_size: int = 28996,
                 hidden_size: int = 768, num_heads: int = 12,
                 intermediate_size: int = 3072,
@@ -86,7 +76,7 @@ def _bert_specs(num_labels: int, vocab_size: int = 28996,
                     BertAttentionSublayer, hidden_size=hidden_size,
                     num_heads=num_heads, dropout_rate=dropout_rate,
                     dtype=dtype),
-                fn=_attn_sublayer_fn))
+                fn=_block_fn))
             idx += 1
             specs.append(LayerSpec(
                 name=f"layer{idx}",
@@ -94,7 +84,7 @@ def _bert_specs(num_labels: int, vocab_size: int = 28996,
                     BertFfnSublayer, hidden_size=hidden_size,
                     intermediate_size=intermediate_size,
                     dropout_rate=dropout_rate, dtype=dtype),
-                fn=_ffn_sublayer_fn))
+                fn=_block_fn))
             idx += 1
         else:
             specs.append(LayerSpec(

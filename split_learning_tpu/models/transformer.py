@@ -16,6 +16,36 @@ import flax.linen as nn
 import jax.numpy as jnp
 
 
+def _attn_half(x, mask, train, *, hidden_size, num_heads, dropout_rate,
+               dtype):
+    """Attention half of a post-LN block: attn -> dropout -> add&norm.
+
+    A plain function creating explicitly-named submodules in the
+    CALLER's compact scope: :class:`BertBlock` and
+    :class:`BertAttentionSublayer` share one body, so macro-block
+    weights map 1:1 onto sublayer weights by construction."""
+    attn = nn.MultiHeadDotProductAttention(
+        num_heads=num_heads, qkv_features=hidden_size,
+        out_features=hidden_size, dtype=dtype,
+        dropout_rate=dropout_rate, name="attention")(
+            x, x, mask=mask, deterministic=not train)
+    attn = nn.Dropout(dropout_rate)(attn, deterministic=not train)
+    return nn.LayerNorm(epsilon=1e-12, dtype=dtype,
+                        name="attention_norm")(x + attn)
+
+
+def _ffn_half(x, train, *, hidden_size, intermediate_size, dropout_rate,
+              dtype):
+    """FFN half of a post-LN block: dense-gelu-dense -> dropout ->
+    add&norm (shared by :class:`BertBlock` / :class:`BertFfnSublayer`)."""
+    h = nn.Dense(intermediate_size, dtype=dtype, name="intermediate")(x)
+    h = nn.gelu(h)
+    h = nn.Dense(hidden_size, dtype=dtype, name="output")(h)
+    h = nn.Dropout(dropout_rate)(h, deterministic=not train)
+    return nn.LayerNorm(epsilon=1e-12, dtype=dtype,
+                        name="output_norm")(x + h)
+
+
 class BertBlock(nn.Module):
     """Post-LN encoder block: attn -> add&norm -> FFN(gelu) -> add&norm."""
     hidden_size: int
@@ -26,31 +56,18 @@ class BertBlock(nn.Module):
 
     @nn.compact
     def __call__(self, x, mask=None, train: bool = False):
-        attn = nn.MultiHeadDotProductAttention(
-            num_heads=self.num_heads, qkv_features=self.hidden_size,
-            out_features=self.hidden_size, dtype=self.dtype,
-            dropout_rate=self.dropout_rate, name="attention")(
-                x, x, mask=mask, deterministic=not train)
-        attn = nn.Dropout(self.dropout_rate)(attn, deterministic=not train)
-        x = nn.LayerNorm(epsilon=1e-12, dtype=self.dtype,
-                         name="attention_norm")(x + attn)
-
-        h = nn.Dense(self.intermediate_size, dtype=self.dtype,
-                     name="intermediate")(x)
-        h = nn.gelu(h)
-        h = nn.Dense(self.hidden_size, dtype=self.dtype, name="output")(h)
-        h = nn.Dropout(self.dropout_rate)(h, deterministic=not train)
-        return nn.LayerNorm(epsilon=1e-12, dtype=self.dtype,
-                            name="output_norm")(x + h)
+        x = _attn_half(x, mask, train, hidden_size=self.hidden_size,
+                       num_heads=self.num_heads,
+                       dropout_rate=self.dropout_rate, dtype=self.dtype)
+        return _ffn_half(x, train, hidden_size=self.hidden_size,
+                         intermediate_size=self.intermediate_size,
+                         dropout_rate=self.dropout_rate, dtype=self.dtype)
 
 
 class BertAttentionSublayer(nn.Module):
-    """The attention half of a post-LN block: attn -> dropout ->
-    add&norm.  A standalone split layer for fine-grained (per-sublayer)
-    cut points (reference BERT_EMOTION's 27-layer indexing,
-    ``other/Vanilla_SL/src/model/BERT_EMOTION.py:183-185``).
-    Submodule names match :class:`BertBlock` so block-level weights map
-    1:1 onto (attention, ffn) sublayer pairs."""
+    """The attention half as a standalone split layer for fine-grained
+    (per-sublayer) cut points (reference BERT_EMOTION's 27-layer
+    indexing, ``other/Vanilla_SL/src/model/BERT_EMOTION.py:183-185``)."""
     hidden_size: int
     num_heads: int
     dropout_rate: float = 0.1
@@ -58,33 +75,24 @@ class BertAttentionSublayer(nn.Module):
 
     @nn.compact
     def __call__(self, x, mask=None, train: bool = False):
-        attn = nn.MultiHeadDotProductAttention(
-            num_heads=self.num_heads, qkv_features=self.hidden_size,
-            out_features=self.hidden_size, dtype=self.dtype,
-            dropout_rate=self.dropout_rate, name="attention")(
-                x, x, mask=mask, deterministic=not train)
-        attn = nn.Dropout(self.dropout_rate)(attn, deterministic=not train)
-        return nn.LayerNorm(epsilon=1e-12, dtype=self.dtype,
-                            name="attention_norm")(x + attn)
+        return _attn_half(x, mask, train, hidden_size=self.hidden_size,
+                          num_heads=self.num_heads,
+                          dropout_rate=self.dropout_rate, dtype=self.dtype)
 
 
 class BertFfnSublayer(nn.Module):
-    """The FFN half of a post-LN block: dense-gelu-dense -> dropout ->
-    add&norm (the other sublayer of the fine-grained split)."""
+    """The FFN half as a standalone split layer (fine-grained cuts)."""
     hidden_size: int
     intermediate_size: int
     dropout_rate: float = 0.1
     dtype: jnp.dtype = jnp.float32
 
     @nn.compact
-    def __call__(self, x, train: bool = False):
-        h = nn.Dense(self.intermediate_size, dtype=self.dtype,
-                     name="intermediate")(x)
-        h = nn.gelu(h)
-        h = nn.Dense(self.hidden_size, dtype=self.dtype, name="output")(h)
-        h = nn.Dropout(self.dropout_rate)(h, deterministic=not train)
-        return nn.LayerNorm(epsilon=1e-12, dtype=self.dtype,
-                            name="output_norm")(x + h)
+    def __call__(self, x, mask=None, train: bool = False):
+        del mask  # FFN is position-local; accepted for fn-signature parity
+        return _ffn_half(x, train, hidden_size=self.hidden_size,
+                         intermediate_size=self.intermediate_size,
+                         dropout_rate=self.dropout_rate, dtype=self.dtype)
 
 
 class PreLNBlock(nn.Module):

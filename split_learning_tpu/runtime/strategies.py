@@ -320,24 +320,33 @@ class FedAsyncStrategy(RoundStrategy):
     (``fed_async_aggregate`` with ``alpha=1``) — continuity across
     out-clusters flows through the training init, reference-faithfully.
     ``in_clusters=1`` degenerates to one merge per out-cluster.
+
+    When head counts don't match ``in_clusters`` the protocol backend
+    keeps shared forward queues (no fixed pairing on the wire;
+    ``runtime/server.py`` logs it) while aggregation still partitions
+    updates round-robin over the in-groups — every update counted
+    exactly once, merge order as configured.
     """
     name = "fedasync"
 
-    def _in_groups(self, plan: ClusterPlan) -> list[tuple[list, dict]]:
-        """[(stage1_member_ids, {stage: paired_later_client_id})] per
-        in-cluster — the fixed edge<->head pairing (round-robin when
-        heads are fewer than in-clusters)."""
+    def _in_groups(self, plan: ClusterPlan) -> list[tuple[list, set]]:
+        """[(stage1_member_ids, later_stage_member_ids)] per in-cluster.
+
+        Later-stage clients are PARTITIONED over the in-clusters
+        round-robin, so every update belongs to exactly one in-cluster
+        (1:1 pairing when counts match — the reference topology; with
+        ``in_clusters=1`` every client lands in the single group,
+        reducing to a whole-cluster average)."""
         from split_learning_tpu.runtime.context import client_groups
         n_in = max(1, self.cfg.topology.in_clusters)
         s1 = plan.stage1_clients
         groups = client_groups(len(s1), min(n_in, len(s1)))
-        out = []
-        for g, idxs in enumerate(groups):
-            paired = {s: plan.clients[s - 1][g % len(plan.clients[s - 1])]
-                      for s in range(2, plan.n_stages + 1)
-                      if plan.clients[s - 1]}
-            out.append(([s1[i] for i in idxs], paired))
-        return out
+        later: list[set] = [set() for _ in groups]
+        for s in range(2, plan.n_stages + 1):
+            for j, cid in enumerate(plan.clients[s - 1]):
+                later[j % len(groups)].add(cid)
+        return [([s1[i] for i in idxs], later[g])
+                for g, idxs in enumerate(groups)]
 
     def run_round(self, ctx, plans, round_idx, params, stats):
         rng = np.random.default_rng(self.cfg.seed + round_idx)
@@ -349,17 +358,21 @@ class FedAsyncStrategy(RoundStrategy):
             ups = ctx.train_cluster(plan, g_p, g_s, round_idx=round_idx,
                                     lr=self._lr(round_idx))
             ok &= all(u.ok for u in ups)
-            for rank, (members, paired) in enumerate(self._in_groups(plan)):
+            rank = 0   # over REPORTING in-clusters only: the reference
+            # enumerates check_in_cluster (groups that actually finished,
+            # other/2LS/src/Server.py:178-184), so a dropped in-cluster
+            # must not shift the survivors' alphas
+            for members, later in self._in_groups(plan):
                 in_ups = [u for u in ups
                           if (u.stage == 1 and u.client_id in members)
-                          or (u.stage >= 2
-                              and u.client_id == paired.get(u.stage))]
+                          or (u.stage >= 2 and u.client_id in later)]
                 if not in_ups:
                     continue
                 p, s, n = aggregate_cluster(in_ups)
                 alpha = (self.cfg.aggregation.fedasync_alpha
                          if self.cfg.aggregation.fedasync_alpha is not None
                          else 1.0 / (1.0 + rank))
+                rank += 1
                 g_p = _lerp(g_p, _fill(g_p, p), alpha)
                 g_s = _fill(g_s, s)
                 total += n

@@ -5,6 +5,8 @@ with the survivors — the reference hangs forever in this case
 
 import threading
 
+import pytest
+
 from split_learning_tpu.runtime.bus import InProcTransport
 from split_learning_tpu.runtime.client import ProtocolClient
 from split_learning_tpu.runtime.protocol import (
@@ -15,6 +17,7 @@ from split_learning_tpu.runtime.server import ProtocolContext, ProtocolServer
 from tests.test_protocol_runtime import proto_cfg
 
 
+@pytest.mark.slow
 def test_dead_client_dropped_round_completes(tmp_path):
     bus = InProcTransport()
     cfg = proto_cfg(tmp_path, clients=[2, 1])
@@ -73,6 +76,7 @@ def test_stale_messages_fenced_by_generation(tmp_path):
     assert [u.client_id for u in ctx._updates] == ["b"]
 
 
+@pytest.mark.slow
 def test_tcp_client_crash_mid_round_survivors_finish(tmp_path):
     """VERDICT r1 #9: a TCP client whose process dies MID-STREAM (socket
     closed after its first activations are in flight) must be dropped at

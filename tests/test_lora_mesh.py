@@ -3,9 +3,12 @@ adapters trained on-mesh, merged dense weights aggregated."""
 
 import jax
 import numpy as np
+import pytest
 
 from split_learning_tpu.config import from_dict
 from split_learning_tpu.run import run_local
+
+pytestmark = pytest.mark.slow  # full rounds through run_local
 
 TINY_BERT = dict(vocab_size=28996, hidden_size=16, num_heads=2,
                  intermediate_size=32, max_position_embeddings=128,

@@ -8,6 +8,8 @@ import numpy as np
 import optax
 import pytest
 
+pytestmark = pytest.mark.slow  # compiles real split programs
+
 from split_learning_tpu.models import build_model, num_layers, shard_params
 
 TINY_LLAMA = dict(vocab_size=128, hidden_size=32, num_heads=4,

@@ -90,6 +90,7 @@ def test_client_groups():
     assert client_groups(2, 5) == [[0], [1]]
 
 
+@pytest.mark.slow
 def test_mesh_context_updates_shape(tmp_path):
     cfg = tiny_cfg(tmp_path)
     plans = plan_clusters(cfg, synthesize_registrations(cfg))
@@ -111,6 +112,7 @@ def test_mesh_context_updates_shape(tmp_path):
 @pytest.mark.parametrize("strategy", ["fedavg", "sda", "relay",
                                       "cluster_relay", "periodic",
                                       "fedasync"])
+@pytest.mark.slow
 def test_strategy_end_to_end(tmp_path, strategy):
     over = {"aggregation": {"strategy": strategy}}
     if strategy == "periodic":
@@ -128,6 +130,7 @@ def test_strategy_end_to_end(tmp_path, strategy):
     assert validated, "no round was validated"
 
 
+@pytest.mark.slow
 def test_checkpoint_resume(tmp_path):
     cfg = tiny_cfg(tmp_path, global_rounds=1)
     result = run_local(cfg)
@@ -206,6 +209,7 @@ def test_cpu_geometry_collapses_heavy_pipeline(tmp_path):
     assert (s, cuts) == (2, [7])   # explicit override keeps pipeline
 
 
+@pytest.mark.slow
 def test_vgg16_cut7_real_pipeline_end_to_end(tmp_path):
     """VERDICT r1 #4: the reference's default geometry — VGG16/CIFAR10 at
     cut=7 (config.yaml:3-28, cut studied in other/Vanilla_SL/README.md)
@@ -286,6 +290,7 @@ def test_2ls_two_level_fedasync_merge_math(tmp_path):
     np.testing.assert_allclose(out.params["layer2"], np.full(2, 20.0))
 
 
+@pytest.mark.slow
 def test_2ls_two_level_end_to_end_mesh(tmp_path):
     """2 out-clusters x 2 in-clusters over the compiled mesh backend."""
     cfg = tiny_cfg(tmp_path, clients=[4, 2], global_rounds=2,
@@ -297,3 +302,42 @@ def test_2ls_two_level_end_to_end_mesh(tmp_path):
     assert all(rec.ok for rec in result.history)
     assert result.history[-1].num_samples > 0
     assert result.history[-1].val_accuracy is not None
+
+
+def test_fedasync_default_groups_keep_all_heads(tmp_path):
+    """Regression: with in_clusters=1 (default) and MORE heads than
+    groups, every later-stage update must still enter the merge (no
+    silently dropped heads)."""
+    from split_learning_tpu.runtime.context import TrainContext
+    from split_learning_tpu.runtime.plan import ClusterPlan
+    from split_learning_tpu.runtime.protocol import Update
+
+    vals = {"e0": 1.0, "e1": 3.0, "h0": 10.0, "h1": 30.0}
+
+    class FakeCtx(TrainContext):
+        def train_cluster(self, plan, params, stats, **kw):
+            ups = []
+            for cid in plan.stage1_clients:
+                ups.append(Update(
+                    client_id=cid, stage=1, cluster=plan.cluster_id,
+                    params={"layer1": np.full(2, vals[cid])},
+                    batch_stats={}, num_samples=10, ok=True))
+            for cid in plan.clients[1]:
+                ups.append(Update(
+                    client_id=cid, stage=2, cluster=plan.cluster_id,
+                    params={"layer2": np.full(2, vals[cid])},
+                    batch_stats={}, num_samples=10, ok=True))
+            return ups
+
+    cfg = tiny_cfg(tmp_path, aggregation={"strategy": "fedasync"})
+    strategy = make_strategy(cfg)
+    plan = ClusterPlan(cluster_id=0, cuts=[2],
+                       clients=[["e0", "e1"], ["h0", "h1"]],
+                       label_counts=np.ones((2, 10)), rejected=[])
+    base = {"layer1": np.zeros(2), "layer2": np.zeros(2)}
+    out = strategy.run_round(FakeCtx(), [plan], 0, base, {})
+    assert out.ok
+    # single in-cluster: alpha=1 replace by the whole-cluster average,
+    # which must include BOTH heads: layer2 = (10+30)/2 = 20
+    np.testing.assert_allclose(out.params["layer1"], np.full(2, 2.0))
+    np.testing.assert_allclose(out.params["layer2"], np.full(2, 20.0))

@@ -13,6 +13,8 @@ import numpy as np
 import optax
 import pytest
 
+pytestmark = pytest.mark.slow  # compiles real split programs
+
 from split_learning_tpu.models import build_model
 from split_learning_tpu.parallel import (
     PipelineModel, make_train_step, make_fedavg_step, make_mesh,

@@ -11,6 +11,8 @@ import threading
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow  # compiles real split programs
+
 from split_learning_tpu.config import from_dict
 from split_learning_tpu.runtime.bus import Broker, InProcTransport
 from split_learning_tpu.runtime.client import ProtocolClient

@@ -122,6 +122,7 @@ class TestMaskPlumbing:
         assert not np.allclose(np.asarray(masked), np.asarray(unmasked),
                                atol=1e-4)
 
+    @pytest.mark.slow
     def test_shard_runner_wire_roundtrip_matches_full(self, eight_devices):
         """Protocol-mode parity: stage-1 fwd -> pickled pytree activation
         (hidden, mask) -> stage-2 loss/backward -> pytree gradient ->
@@ -234,6 +235,7 @@ class TestFineGrainedBert:
             np.asarray(macro.apply({"params": mp}, ids, train=False)),
             rtol=1e-5, atol=1e-6)
 
+    @pytest.mark.slow
     def test_split_inside_block_matches_unsplit(self, eight_devices):
         """Cut at layer 2 = between block 1's attention and FFN
         sublayers — a cut point the macro model cannot express."""
