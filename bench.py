@@ -281,6 +281,13 @@ def _accelerator_reachable(timeout: float = 240.0) -> bool:
     import subprocess
     import sys
     if os.environ.get("JAX_PLATFORMS", "").startswith("cpu"):
+        # Enforce the env in THIS process too: a sitecustomize may have
+        # pinned a TPU platform via jax.config AFTER import, which beats
+        # the env var (observed on the axon image) — without this the
+        # env check would skip the probe yet main() would still
+        # initialize the (possibly wedged) TPU backend.
+        import jax
+        jax.config.update("jax_platforms", "cpu")
         return True
     code = ("import jax, numpy as np;"
             "x = jax.numpy.ones((128, 128));"

@@ -86,7 +86,9 @@ def _synthetic_tokens(n: int, seq_len: int, vocab: int, n_classes: int,
              + rng.integers(0, band, size=(n, seq_len)))
     use_topic = rng.random((n, seq_len)) < 0.3
     ids = np.where(use_topic, topic, common).astype(np.int32)
-    ids[:, 0] = 0  # CLS-like position
+    # [CLS]-like position: id 101 (the BERT [CLS] id), NOT 0 — id 0 is
+    # [PAD] and would be masked out of attention (models/bert.py)
+    ids[:, 0] = min(101, vocab - 1)
     return ArrayDataset(ids, labels.astype(np.int32))
 
 
