@@ -56,19 +56,28 @@ from split_learning_tpu.runtime.protocol import (
 from split_learning_tpu.runtime.validation import dataset_for_model
 
 
-def _to_wire_tree(tree):
+def _wire_np_dtype(name: str):
+    if name == "bfloat16":
+        import ml_dtypes
+        return ml_dtypes.bfloat16
+    return np.dtype(name)
+
+
+def _to_wire_tree(tree, dtype=np.float32):
     """Device pytree -> numpy payload for Activation/Gradient messages.
 
     Stage boundaries may be pytrees (e.g. BERT's (hidden, mask),
-    models/bert.py): float leaves travel fp32, bool/int leaves keep
-    their dtype, and float0 gradient leaves (cotangents of
-    non-differentiable inputs) become fp32 zeros so they pickle."""
+    models/bert.py): float leaves travel as ``dtype``
+    (``transport.wire-dtype``; fp16/bf16 halve the hop bytes vs the
+    reference's fp32 pickles), bool/int leaves keep their dtype, and
+    float0 gradient leaves (cotangents of non-differentiable inputs)
+    become zeros so they pickle."""
     def conv(leaf):
         if getattr(leaf, "dtype", None) == jax.dtypes.float0:
-            return np.zeros(np.shape(leaf), np.float32)
+            return np.zeros(np.shape(leaf), dtype)
         a = np.asarray(leaf)
         if np.issubdtype(a.dtype, np.floating):
-            return a.astype(np.float32, copy=False)
+            return a.astype(dtype, copy=False)
         return a
     return jax.tree_util.tree_map(conv, tree)
 
@@ -287,6 +296,7 @@ class ProtocolClient:
         self.sda_size = 1
         self.round_ok = True
         self.num_samples = 0
+        self.wire_dtype = _wire_np_dtype(cfg.transport.wire_dtype)
 
     # -- control plane -----------------------------------------------------
 
@@ -563,7 +573,8 @@ class ProtocolClient:
                                               trace=[self.client_id],
                                               n=len(labels))
                 self.bus.publish(out_q, encode(Activation(
-                    data_id=data_id, data=_to_wire_tree(out),
+                    data_id=data_id,
+                    data=_to_wire_tree(out, self.wire_dtype),
                     labels=np.asarray(labels, np.int32),
                     trace=[self.client_id], cluster=self.cluster,
                     round_idx=self.fence)))
@@ -603,7 +614,8 @@ class ProtocolClient:
                 self.bus.publish(
                     gradient_queue(self.stage - 1, origin),
                     encode(Gradient(data_id=g.data_id,
-                                    data=_to_wire_tree(gx),
+                                    data=_to_wire_tree(
+                                        gx, self.wire_dtype),
                                     trace=ent.trace[:-1],
                                     round_idx=self.fence)))
                 continue
@@ -620,7 +632,8 @@ class ProtocolClient:
                                               trace=list(act.trace),
                                               n=len(act.labels))
             self.bus.publish(out_q, encode(Activation(
-                data_id=act.data_id, data=_to_wire_tree(out),
+                data_id=act.data_id,
+                data=_to_wire_tree(out, self.wire_dtype),
                 labels=act.labels, trace=list(act.trace) + [self.client_id],
                 cluster=self.cluster, round_idx=self.fence)))
 
@@ -672,7 +685,7 @@ class ProtocolClient:
         self.trainable, self.opt_state = r.apply_update(
             self.trainable, self.opt_state, gt)
         self.num_samples += int(sum(sizes))
-        gx = _to_wire_tree(gx)
+        gx = _to_wire_tree(gx, self.wire_dtype)
         off = 0
         for act, n in zip(window, sizes):
             part = jax.tree_util.tree_map(lambda a: a[off:off + n], gx)

@@ -186,6 +186,7 @@ class _SafeUnpickler(pickle.Unpickler):
         ("builtins", "set"), ("builtins", "frozenset"),
         ("builtins", "complex"), ("builtins", "bytearray"),
         ("numpy", "dtype"), ("numpy", "ndarray"),
+        ("ml_dtypes", "bfloat16"),  # compressed wire payloads
         ("numpy._core.multiarray", "_reconstruct"),
         ("numpy.core.multiarray", "_reconstruct"),
         ("numpy._core.multiarray", "scalar"),

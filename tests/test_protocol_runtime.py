@@ -190,3 +190,31 @@ def test_2ls_two_level_over_protocol_pair_queues(tmp_path):
                      and "_p" not in q]
     assert len(pair_queues) >= 2, sorted(bus.bytes_out)
     assert not shared_queues, shared_queues
+
+
+_WIRE_BASELINE: dict = {}   # share the fp32 run across dtype params
+
+
+@pytest.mark.parametrize("dtype", ["float16", "bfloat16"])
+def test_wire_dtype_compression(tmp_path, dtype):
+    """transport.wire-dtype fp16/bf16 halves activation/gradient bytes
+    on the data plane (the reference always ships fp32 pickles,
+    src/train/VGG16.py:27) and the round still trains."""
+    def run(wire):
+        bus = InProcTransport()
+        cfg = proto_cfg(tmp_path, clients=[1, 1],
+                        transport={"wire_dtype": wire})
+        result = run_deployment(cfg, lambda: bus, bus)
+        data_bytes = sum(v for q, v in bus.bytes_out.items()
+                         if q.startswith(("intermediate_queue",
+                                          "gradient_queue")))
+        return result, data_bytes
+
+    if "f32" not in _WIRE_BASELINE:
+        _WIRE_BASELINE["f32"] = run("float32")
+    r32, b32 = _WIRE_BASELINE["f32"]
+    rc, bc = run(dtype)
+    assert rc.history[0].ok
+    assert rc.history[0].num_samples == r32.history[0].num_samples
+    assert rc.history[0].val_accuracy is not None
+    assert bc < 0.75 * b32, (bc, b32)
