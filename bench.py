@@ -233,6 +233,7 @@ def measure_round() -> dict:
 
     from split_learning_tpu import config as cfgmod
     from split_learning_tpu.run import run_local
+    from split_learning_tpu.runtime.log import Logger
 
     on_cpu = jax.default_backend() == "cpu"
     ckpt = "/tmp/slt_bench_round"
@@ -256,7 +257,9 @@ def measure_round() -> dict:
         "log-path": "/tmp/slt_bench_round_logs",
     })
     t0 = time.perf_counter()
-    result = run_local(cfg)
+    # console=False: the round loop's progress lines would land on
+    # stdout and break the bench's one-JSON-line output contract
+    result = run_local(cfg, logger=Logger(cfg.log_path, console=False))
     wall = time.perf_counter() - t0
     rec = result.history[-1]  # round 2 = steady state (no compile)
     return {
@@ -333,67 +336,105 @@ def main():
     baseline = get_baseline()
     log(f"[bench] torch-CPU VGG16 baseline: {baseline:.1f} samples/s")
 
+    def section(name, fn, into=None):
+        """Sections fail independently: one bad compile/OOM must not
+        lose the whole round artifact.  Errors are recorded under
+        ``into`` (default: extra) at ``name``."""
+        try:
+            return fn()
+        except Exception as e:
+            (extra if into is None else into)[name] = {
+                "error": f"{type(e).__name__}: {str(e)[:300]}"}
+            log(f"[bench] {name}: FAILED {type(e).__name__}: "
+                f"{str(e)[:120]}")
+            return None
+
     # -- headline: unsplit VGG16 train step --------------------------------
     mb = 32 if on_cpu else 8192
-    sps_unsplit, flops_step = _measure_pipe_step(
-        "VGG16_CIFAR10", [], (32, 32, 3), jnp.float32, mb, 1, steps,
-        optax.sgd(5e-4, momentum=0.9), model_kwargs=dtype_kw)
-    log(f"[bench] headline unsplit VGG16 (batch {mb}): "
-        f"{sps_unsplit:.0f} samples/s")
+
+    def headline():
+        sps, flops = _measure_pipe_step(
+            "VGG16_CIFAR10", [], (32, 32, 3), jnp.float32, mb, 1, steps,
+            optax.sgd(5e-4, momentum=0.9), model_kwargs=dtype_kw)
+        log(f"[bench] headline unsplit VGG16 (batch {mb}): "
+            f"{sps:.0f} samples/s")
+        return sps, flops
+
+    head = section("headline", headline)
+    sps_unsplit, flops_step = head if head else (0.0, None)
 
     # -- MFU: datasheet + measured-roofline denominators -------------------
-    roofline = measure_matmul_roofline()
-    peak = DATASHEET_BF16_TFLOPS.get(kind)
-    mfu = {"datasheet_bf16_tflops": peak,
-           "measured_matmul_roofline_tflops": round(roofline, 1)}
-    if flops_step:
-        tflops = flops_step * sps_unsplit / mb / 1e12
-        mfu["headline_tflops"] = round(tflops, 1)
-        if peak:
-            mfu["mfu_vs_datasheet"] = round(tflops / peak, 3)
-        mfu["frac_of_measured_roofline"] = round(tflops / roofline, 3)
-    extra["mfu"] = mfu
-    log(f"[bench] MFU: {mfu}")
+    def mfu_section():
+        roofline = measure_matmul_roofline()
+        peak = DATASHEET_BF16_TFLOPS.get(kind)
+        mfu = {"datasheet_bf16_tflops": peak,
+               "measured_matmul_roofline_tflops": round(roofline, 1)}
+        if flops_step and sps_unsplit:
+            tflops = flops_step * sps_unsplit / mb / 1e12
+            mfu["headline_tflops"] = round(tflops, 1)
+            if peak:
+                mfu["mfu_vs_datasheet"] = round(tflops / peak, 3)
+            mfu["frac_of_measured_roofline"] = round(tflops / roofline, 3)
+        extra["mfu"] = mfu
+        log(f"[bench] MFU: {mfu}")
+
+    section("mfu", mfu_section)
 
     # -- split path: cut=7, microbatched pipeline --------------------------
     n_micro = 4
-    sps_split, _ = _measure_pipe_step(
-        "VGG16_CIFAR10", [7], (32, 32, 3), jnp.float32,
-        mb // n_micro, n_micro, steps,
-        optax.sgd(5e-4, momentum=0.9), model_kwargs=dtype_kw)
-    extra["split_cut7"] = {
-        "samples_per_sec": round(sps_split, 1),
-        "microbatches": n_micro,
-        "ratio_vs_unsplit": round(sps_split / sps_unsplit, 3),
-        "note": "2 stages as virtual pipeline stages on 1 chip: no "
-                "bubbles (gradient accumulation), overhead = per-stage "
-                "remat + smaller per-microbatch kernels",
-    }
-    log(f"[bench] split cut=7 x{n_micro} microbatches: "
-        f"{sps_split:.0f} samples/s "
-        f"({sps_split / sps_unsplit:.0%} of unsplit)")
+
+    def split_section():
+        sps_split, _ = _measure_pipe_step(
+            "VGG16_CIFAR10", [7], (32, 32, 3), jnp.float32,
+            mb // n_micro, n_micro, steps,
+            optax.sgd(5e-4, momentum=0.9), model_kwargs=dtype_kw)
+        extra["split_cut7"] = {
+            "samples_per_sec": round(sps_split, 1),
+            "microbatches": n_micro,
+            "ratio_vs_unsplit": (round(sps_split / sps_unsplit, 3)
+                                 if sps_unsplit else None),
+            "note": "2 stages as virtual pipeline stages on 1 chip: no "
+                    "bubbles (gradient accumulation), overhead = "
+                    "per-stage remat + smaller per-microbatch kernels",
+        }
+        log(f"[bench] split cut=7 x{n_micro} microbatches: "
+            f"{sps_split:.0f} samples/s")
+
+    section("split_cut7", split_section)
 
     # -- full round through the runtime loop -------------------------------
-    extra["round"] = measure_round()
-    log(f"[bench] full round: {extra['round']}")
+    def round_section():
+        extra["round"] = measure_round()
+        log(f"[bench] full round: {extra['round']}")
+
+    section("round", round_section)
 
     # -- north-star configs 3-5 -------------------------------------------
     cfgs: dict = {}
+    extra["configs"] = cfgs
     mbi = 16 if on_cpu else 512
-    sps, _ = _measure_pipe_step(
-        "ResNet50_CIFAR100", [3, 6], (32, 32, 3), jnp.float32,
-        mbi // 4, 4, steps, optax.sgd(5e-4, momentum=0.9),
-        model_kwargs=dtype_kw, n_classes=100)
-    cfgs["resnet50_cifar100_3way_cut_3_6"] = {
-        "samples_per_sec": round(sps, 1)}
-    log(f"[bench] ResNet-50/CIFAR100 3-way split: {sps:.0f} samples/s")
 
-    # block i = layer 4+i (4 stem layers); block 6 boundary = cut [10]
-    sps, _ = _measure_pipe_step(
-        "ViT_S16_CIFAR10", [10], (32, 32, 3), jnp.float32,
-        mbi // 4, 4, steps, optax.adamw(1e-3), model_kwargs=dtype_kw)
-    cfgs["vit_s16_cifar10_cut_block6"] = {"samples_per_sec": round(sps, 1)}
-    log(f"[bench] ViT-S/16 split at block 6: {sps:.0f} samples/s")
+    def resnet_section():
+        sps, _ = _measure_pipe_step(
+            "ResNet50_CIFAR100", [3, 6], (32, 32, 3), jnp.float32,
+            mbi // 4, 4, steps, optax.sgd(5e-4, momentum=0.9),
+            model_kwargs=dtype_kw, n_classes=100)
+        cfgs["resnet50_cifar100_3way_cut_3_6"] = {
+            "samples_per_sec": round(sps, 1)}
+        log(f"[bench] ResNet-50/CIFAR100 3-way split: {sps:.0f} samples/s")
+
+    section("resnet50_cifar100_3way_cut_3_6", resnet_section, into=cfgs)
+
+    def vit_section():
+        # block i = layer 4+i (4 stem layers); block 6 boundary = cut [10]
+        sps, _ = _measure_pipe_step(
+            "ViT_S16_CIFAR10", [10], (32, 32, 3), jnp.float32,
+            mbi // 4, 4, steps, optax.adamw(1e-3), model_kwargs=dtype_kw)
+        cfgs["vit_s16_cifar10_cut_block6"] = {
+            "samples_per_sec": round(sps, 1)}
+        log(f"[bench] ViT-S/16 split at block 6: {sps:.0f} samples/s")
+
+    section("vit_s16_cifar10_cut_block6", vit_section, into=cfgs)
 
     # TinyLlama: full 1.1B adam states exceed one chip's HBM (the
     # BASELINE config targets a v5e-16); single-chip line uses plain SGD
@@ -404,7 +445,8 @@ def main():
                 if on_cpu else {})
     llama_cuts = [2, 3, 4] if on_cpu else [7, 13, 19]
     lb = 1 if on_cpu else 2
-    try:
+
+    def llama_section():
         vocab = llama_kw.get("vocab_size", 32000)
         sps, _ = _measure_pipe_step(
             "TinyLlama_TINYSTORIES", llama_cuts, (seq,), jnp.int32,
@@ -418,11 +460,8 @@ def main():
             "tiny_overrides": bool(llama_kw),
         }
         log(f"[bench] TinyLlama 4-stage: {sps * seq:.0f} tokens/s")
-    except Exception as e:  # single-chip OOM is environment, not failure
-        cfgs["tinyllama_tinystories_4stage"] = {
-            "error": f"{type(e).__name__}: {str(e)[:200]}"}
-        log(f"[bench] TinyLlama 4-stage: FAILED {type(e).__name__}")
-    extra["configs"] = cfgs
+
+    section("tinyllama_tinystories_4stage", llama_section, into=cfgs)
 
     value = sps_unsplit  # per chip (n_chips == 1)
     print(json.dumps({

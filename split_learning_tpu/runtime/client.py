@@ -76,7 +76,10 @@ def _to_wire_tree(tree, dtype=np.float32):
         if getattr(leaf, "dtype", None) == jax.dtypes.float0:
             return np.zeros(np.shape(leaf), dtype)
         a = np.asarray(leaf)
-        if np.issubdtype(a.dtype, np.floating):
+        # jnp.issubdtype, NOT np.issubdtype: numpy's lattice does not
+        # classify ml_dtypes (bfloat16 model activations) as floating,
+        # which would silently skip the wire cast
+        if jnp.issubdtype(a.dtype, jnp.floating):
             return a.astype(dtype, copy=False)
         return a
     return jax.tree_util.tree_map(conv, tree)
