@@ -56,6 +56,11 @@ class Transport:
         with self._count_lock:
             return sum(self.bytes_out.values())
 
+    def bytes_out_snapshot(self) -> dict:
+        """Consistent copy of the per-queue publish-byte counters."""
+        with self._count_lock:
+            return dict(self.bytes_out)
+
     def get(self, queue: str, timeout: float | None = None) -> bytes | None:
         """Pop one message; block up to ``timeout`` (None = forever).
         Returns None on timeout."""

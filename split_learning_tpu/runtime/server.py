@@ -290,6 +290,22 @@ class ProtocolContext(MeshContext):
                          deadline=time.monotonic() + self.client_timeout)
         updates = list(self._updates)
         self._updates = []
+        # wire audit: CUMULATIVE transport-wide publish bytes by queue
+        # kind (reply_* = server control/weights down; rpc = client
+        # control/weights up; data = activation/gradient plane).  On the
+        # shared in-process bus this covers every participant; over TCP
+        # each process's transport counts its own publishes.  Consumers
+        # should diff successive records — values never reset.
+        totals = {"reply": 0, "rpc": 0, "data": 0}
+        for q, n in self.bus.bytes_out_snapshot().items():
+            kind = ("reply" if q.startswith("reply_")
+                    else "rpc" if q == RPC_QUEUE else "data")
+            totals[kind] += n
+        self.log.metric(kind="wire", gen=self._cur_gen,
+                        round_idx=round_idx, cluster=plan.cluster_id,
+                        cumulative_reply_bytes=totals["reply"],
+                        cumulative_rpc_bytes=totals["rpc"],
+                        cumulative_data_bytes=totals["data"])
         return updates
 
     def stop_all(self, reason: str = "training complete"):
