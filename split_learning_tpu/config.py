@@ -107,12 +107,36 @@ class TopologyConfig:
     selection: bool = False         # GMM straggler rejection on/off
     force_pipeline: bool = False    # keep stage ppermute even where the
     # backend would rather collapse to DP (CPU big-model safety fallback)
+    # Reference clients refuse to start without profiling.json
+    # (client.py:52-62); with require_profiles the server-side planner
+    # restores that fail-fast contract: auto partitioning REJECTS
+    # registrations without a usable profile instead of silently falling
+    # back to an even layer split.
+    require_profiles: bool = False
+    # Intra-client acceleration axes (fresh TPU surface, SURVEY.md §2.2):
+    # shard each logical client's model over `model` (Megatron-style TP,
+    # parallel/tensor.py), its sequence over `seq` (ring attention,
+    # parallel/sequence.py), or its MoE experts over `expert`
+    # (parallel/expert.py).  They compose with client DP (remaining
+    # devices form the client axis); cuts are preserved as virtual
+    # stages on each group.
+    tensor_parallel: int = 1
+    sequence_parallel: int = 1
+    expert_parallel: int = 1
 
     def validate(self):
         _check(self.mode in ("manual", "auto"),
                f"topology mode must be manual|auto, got {self.mode!r}")
         _check(self.num_clusters >= 1, "num-clusters must be >= 1")
         _check(self.in_clusters >= 1, "in-clusters must be >= 1")
+        _check(self.tensor_parallel >= 1 and self.sequence_parallel >= 1
+               and self.expert_parallel >= 1,
+               "tensor/sequence/expert-parallel must be >= 1")
+        _check(sum(a > 1 for a in (self.tensor_parallel,
+                                   self.sequence_parallel,
+                                   self.expert_parallel)) <= 1,
+               "at most one of tensor/sequence/expert-parallel may "
+               "exceed 1 (each composes with client DP, not each other)")
         _check(self.cluster_algorithm in ("kmeans", "affinity"),
                f"cluster-algorithm must be kmeans|affinity, "
                f"got {self.cluster_algorithm!r}")

@@ -57,6 +57,11 @@ class LlamaAttention(nn.Module):
     num_kv_heads: int
     dtype: jnp.dtype = jnp.float32
     use_flash: bool = False
+    # sequence-parallel mode (parallel/sequence.py): when set, this
+    # module runs inside shard_map with `seq_axis` defined, x is the
+    # LOCAL token block, RoPE positions offset by the global block
+    # index, and attention goes through ring_attention
+    seq_axis: str | None = None
 
     @nn.compact
     def __call__(self, x):
@@ -71,14 +76,22 @@ class LlamaAttention(nn.Module):
         k = k.reshape(b, s, self.num_kv_heads, hd)
         v = v.reshape(b, s, self.num_kv_heads, hd)
 
-        pos = jnp.arange(s)
+        if self.seq_axis is not None:
+            import jax
+            pos = jax.lax.axis_index(self.seq_axis) * s + jnp.arange(s)
+        else:
+            pos = jnp.arange(s)
         q, k = _rope(q, pos), _rope(k, pos)
         rep = self.num_heads // self.num_kv_heads
         if rep > 1:
             k = jnp.repeat(k, rep, axis=2)
             v = jnp.repeat(v, rep, axis=2)
 
-        if self.use_flash:
+        if self.seq_axis is not None:
+            from split_learning_tpu.parallel.sequence import ring_attention
+            out = ring_attention(q, k, v, axis_name=self.seq_axis,
+                                 causal=True).reshape(b, s, -1)
+        elif self.use_flash:
             from split_learning_tpu.ops.flash_attention import (
                 flash_attention,
             )
@@ -101,6 +114,7 @@ class LlamaBlock(nn.Module):
     intermediate_size: int
     dtype: jnp.dtype = jnp.float32
     use_flash: bool = False
+    seq_axis: str | None = None
 
     @nn.compact
     def __call__(self, x):
@@ -109,7 +123,8 @@ class LlamaBlock(nn.Module):
         x = x + LlamaAttention(
             hidden_size=self.hidden_size, num_heads=self.num_heads,
             num_kv_heads=self.num_kv_heads, dtype=self.dtype,
-            use_flash=self.use_flash, name="attention")(h)
+            use_flash=self.use_flash, seq_axis=self.seq_axis,
+            name="attention")(h)
         h = nn.RMSNorm(epsilon=1e-5, dtype=self.dtype,
                        name="post_norm")(x)
         dense = functools.partial(nn.Dense, use_bias=False,
@@ -132,6 +147,7 @@ class MoELlamaBlock(nn.Module):
     k: int = 2
     dtype: jnp.dtype = jnp.float32
     use_flash: bool = False
+    seq_axis: str | None = None
 
     @nn.compact
     def __call__(self, x):
@@ -141,7 +157,8 @@ class MoELlamaBlock(nn.Module):
         x = x + LlamaAttention(
             hidden_size=self.hidden_size, num_heads=self.num_heads,
             num_kv_heads=self.num_kv_heads, dtype=self.dtype,
-            use_flash=self.use_flash, name="attention")(h)
+            use_flash=self.use_flash, seq_axis=self.seq_axis,
+            name="attention")(h)
         h = nn.RMSNorm(epsilon=1e-5, dtype=self.dtype,
                        name="post_norm")(x)
         return x + MoEMLP(
@@ -155,7 +172,8 @@ def _llama_specs(vocab_size: int = 32000, hidden_size: int = 2048,
                  num_heads: int = 32, num_kv_heads: int = 4,
                  intermediate_size: int = 5632, n_block: int = 22,
                  use_flash: bool = False, dtype=jnp.float32,
-                 num_experts: int = 0, k: int = 2) -> tuple:
+                 num_experts: int = 0, k: int = 2,
+                 seq_axis: str | None = None) -> tuple:
     specs = [LayerSpec("layer1", make=functools.partial(
         nn.Embed, num_embeddings=vocab_size, features=hidden_size,
         dtype=dtype), fn=_plain_fn)]
@@ -166,13 +184,13 @@ def _llama_specs(vocab_size: int = 32000, hidden_size: int = 2048,
                 num_heads=num_heads, num_kv_heads=num_kv_heads,
                 intermediate_size=intermediate_size,
                 num_experts=num_experts, k=k, use_flash=use_flash,
-                dtype=dtype)
+                seq_axis=seq_axis, dtype=dtype)
         else:
             block = functools.partial(
                 LlamaBlock, hidden_size=hidden_size, num_heads=num_heads,
                 num_kv_heads=num_kv_heads,
                 intermediate_size=intermediate_size, use_flash=use_flash,
-                dtype=dtype)
+                seq_axis=seq_axis, dtype=dtype)
         specs.append(LayerSpec(f"layer{2 + i}", make=block, fn=_plain_fn))
     specs.append(LayerSpec(f"layer{2 + n_block}",
                            make=functools.partial(nn.RMSNorm, epsilon=1e-5,

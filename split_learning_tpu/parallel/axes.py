@@ -1,0 +1,108 @@
+"""Config-surface composition: intra-client TP/EP axes x client DP.
+
+``topology.tensor_parallel`` / ``topology.expert_parallel`` turn these on
+from YAML alone (VERDICT r2 item 4): the mesh becomes ``(client, model)``
+or ``(client, expert)``, each logical client's replica is GSPMD-sharded
+over the second axis by the per-leaf rules from
+:mod:`split_learning_tpu.parallel.tensor` / ``.expert``, and XLA derives
+the collectives.  Clients stay federated: the step is a ``vmap`` over the
+leading client dim — no gradient mixing across clients, they only meet
+at the FedAvg barrier.
+
+The step matches ``pipeline.make_train_step``'s calling convention
+(client-stacked trees, ``(C, M, mb, ...)`` batches, per-client typed
+keys) so :class:`~split_learning_tpu.runtime.context.MeshContext` can
+swap it in without touching the round loop.  Microbatches are consumed
+by a ``lax.scan`` accumulating gradients into ONE synchronous update —
+the exact semantics of the pipelined step (same per-microbatch rng
+folding), so split-vs-unsplit equivalence keeps holding.
+"""
+
+from __future__ import annotations
+
+import types
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from split_learning_tpu.parallel.expert import moe_aux_loss
+
+
+def stacked_shardings(tree, mesh: Mesh, spec_fn, axis: str,
+                      client_axis: str = "client"):
+    """NamedShardings for a CLIENT-STACKED param tree: ``spec_fn``
+    (e.g. ``tensor.tp_spec`` / ``expert.ep_spec``) sees each leaf as if
+    unstacked; the client axis is prepended to its spec."""
+
+    def one(path, leaf):
+        shim = types.SimpleNamespace(ndim=max(0, np.ndim(leaf) - 1))
+        base = tuple(spec_fn(path, shim, axis))
+        return NamedSharding(mesh, P(client_axis, *base))
+
+    return jax.tree_util.tree_map_with_path(one, tree)
+
+
+def make_axes_train_step(model, optimizer: optax.GradientTransformation,
+                         mesh: Mesh, spec_fn, axis: str,
+                         aux_weight: float = 0.01,
+                         client_axis: str = "client",
+                         donate: bool = True) -> Callable:
+    """Jitted client-stacked train step with GSPMD sharding over ``axis``.
+
+    ``step(params_c, opt_c, stats_c, x, labels, rngs) ->
+    (params_c, opt_c, stats_c, loss[C])`` — x ``(C, M, mb, ...)``,
+    labels ``(C, M, mb[, ...])``, rngs typed keys ``(C,)``.
+    """
+
+    def per_client(params, opt_state, stats, xc, yc, rng):
+        M = xc.shape[0]
+
+        def mb_loss(p, st, xm, ym, i):
+            variables = {"params": p}
+            if st:
+                variables["batch_stats"] = st
+            out, mut = model.apply(
+                variables, xm, train=True,
+                mutable=["batch_stats", "intermediates"],
+                rngs={"dropout": jax.random.fold_in(rng, i)})
+            ce = optax.softmax_cross_entropy_with_integer_labels(
+                out.astype(jnp.float32), ym).mean()
+            loss = ce + aux_weight * moe_aux_loss(
+                mut.get("intermediates", {}))
+            return loss, (ce, mut.get("batch_stats", {}))
+
+        def scan_body(carry, inp):
+            g_acc, ce_acc, st = carry
+            xm, ym, i = inp
+            (_, (ce, new_st)), g = jax.value_and_grad(
+                mb_loss, has_aux=True)(params, st, xm, ym, i)
+            g_acc = jax.tree_util.tree_map(jnp.add, g_acc, g)
+            st = jax.tree_util.tree_map(lambda _, n: n, st, new_st) \
+                if st else st
+            return (g_acc, ce_acc + ce, st), None
+
+        g0 = jax.tree_util.tree_map(jnp.zeros_like, params)
+        (g, ce_sum, new_stats), _ = jax.lax.scan(
+            scan_body, (g0, jnp.zeros(()), stats),
+            (xc, yc, jnp.arange(M)))
+        g = jax.tree_util.tree_map(lambda a: a / M, g)
+        updates, new_opt = optimizer.update(g, opt_state, params)
+        new_params = optax.apply_updates(params, updates)
+        return new_params, new_opt, new_stats, ce_sum / M
+
+    def step(params_c, opt_c, stats_c, x, labels, rngs):
+        shardings = stacked_shardings(params_c, mesh, spec_fn, axis,
+                                      client_axis)
+        params_c = jax.lax.with_sharding_constraint(params_c, shardings)
+        data_sh = NamedSharding(mesh, P(client_axis))
+        x = jax.lax.with_sharding_constraint(x, data_sh)
+        new_p, new_opt, new_st, loss = jax.vmap(per_client)(
+            params_c, opt_c, stats_c, x, labels, rngs)
+        new_p = jax.lax.with_sharding_constraint(new_p, shardings)
+        return new_p, new_opt, new_st, loss
+
+    return jax.jit(step, donate_argnums=(0, 1, 2) if donate else ())

@@ -132,6 +132,74 @@ def ulysses_attention(q, k, v, axis_name: str = "seq",
     return out.astype(q.dtype)
 
 
+def make_sp_train_step(model, optimizer, mesh, seq_axis: str = "seq",
+                       client_axis: str = "client", donate: bool = True):
+    """Sequence-parallel client-stacked train step (ring attention).
+
+    ``topology.sequence_parallel`` routes here (VERDICT r2 item 4): the
+    mesh is ``(client, seq)``, ``model`` must be built with
+    ``seq_axis=seq_axis`` (its attention then calls :func:`ring_attention`
+    and offsets RoPE positions by the device's global block index), and
+    activations/labels are sharded on the sequence dim.  Params stay
+    replicated along ``seq``; each device differentiates its local-token
+    loss contribution and the ``psum`` over ``seq`` (riding the ring's
+    ppermute transpose) rebuilds exact full-sequence gradients.
+
+    Same calling convention as ``pipeline.make_train_step``:
+    ``step(params_c, opt_c, stats_c, x, labels, rngs)`` with
+    x ``(C, M, mb, S)``, labels ``(C, M, mb, S)``, S divisible by the
+    seq axis size.  Microbatch gradients accumulate into one update.
+    """
+    import optax
+    from jax.sharding import PartitionSpec as P
+
+    def body(params, opt_state, stats, x, labels, rngs):
+        strip = lambda t: jax.tree_util.tree_map(  # noqa: E731
+            lambda a: a[0], t)
+        params, opt_state, stats = map(strip, (params, opt_state, stats))
+        x, labels, rng = x[0], labels[0], rngs[0]
+        M = x.shape[0]
+        n = jax.lax.axis_size(seq_axis)
+
+        def mb_loss(p, xm, ym, i):
+            out = model.apply({"params": p}, xm, train=True,
+                              rngs={"dropout": jax.random.fold_in(rng, i)})
+            ce_local = optax.softmax_cross_entropy_with_integer_labels(
+                out.astype(jnp.float32), ym).mean()
+            # local token-block mean / n == this device's share of the
+            # global token mean (equal static blocks)
+            return ce_local / n
+
+        def scan_body(carry, inp):
+            g_acc, ce_acc = carry
+            xm, ym, i = inp
+            ce_share, g = jax.value_and_grad(mb_loss)(params, xm, ym, i)
+            return (jax.tree_util.tree_map(jnp.add, g_acc, g),
+                    ce_acc + ce_share), None
+
+        g0 = jax.tree_util.tree_map(jnp.zeros_like, params)
+        (g, ce_sum), _ = jax.lax.scan(scan_body, (g0, jnp.zeros(())),
+                                      (x, labels, jnp.arange(M)))
+        g = jax.tree_util.tree_map(
+            lambda a: jax.lax.psum(a, seq_axis) / M, g)
+        loss = jax.lax.psum(ce_sum, seq_axis) / M
+        updates, new_opt = optimizer.update(g, opt_state, params)
+        new_params = optax.apply_updates(params, updates)
+        restore = lambda t: jax.tree_util.tree_map(  # noqa: E731
+            lambda a: a[None], t)
+        return (restore(new_params), restore(new_opt), restore(stats),
+                loss[None])
+
+    spec_c = P(client_axis)
+    spec_x = P(client_axis, None, None, seq_axis)
+    mapped = jax.shard_map(
+        body, mesh=mesh,
+        in_specs=(spec_c, spec_c, spec_c, spec_x, spec_x, spec_c),
+        out_specs=(spec_c,) * 4,
+        check_vma=False)
+    return jax.jit(mapped, donate_argnums=(0, 1, 2) if donate else ())
+
+
 def make_ring_attention_fn(mesh, axis_name: str = "seq",
                            causal: bool = False, impl: str = "ring"):
     """shard_map-wrapped callable over full (B, S, H, D) arrays sharded

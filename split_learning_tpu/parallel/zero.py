@@ -1,0 +1,243 @@
+"""ZeRO-1 optimizer-state sharding + low-memory Adam moments.
+
+The pipelined train step (:mod:`split_learning_tpu.parallel.pipeline`)
+replicates parameters and optimizer state along the ``stage`` mesh axis —
+correct for arbitrary heterogeneous cuts, but for a billion-parameter
+model the *replicated AdamW moments* are what blow past one chip's HBM
+(the reference sidesteps this by giving every torch client only its own
+stage's layers, ``/root/reference/src/train/VGG16.py:24-41``; the mesh
+regime must solve it with sharding instead).
+
+Two tools, composable:
+
+* :func:`adamw_bf16_states` — drop-in optax AdamW whose first AND second
+  moments are stored bfloat16 (optax's ``mu_dtype`` only covers ``mu``).
+  Halves optimizer state at negligible quality cost (moments are
+  smooth EMAs; the update math still runs f32).
+* :func:`make_zero1_train_step` — a variant of
+  ``pipeline.make_train_step`` that keeps the moments **flattened,
+  padded, and sharded across the ``stage`` axis** (ZeRO stage 1,
+  Rajbhandari et al. 2019).  Each device:
+
+  1. computes its stage's gradients exactly as the dense step does
+     (scan-of-ppermute pipeline, psum over ``stage``),
+  2. slices the flat gradient vector to its own moment shard,
+  3. runs the elementwise AdamW update on that shard only (moments in
+     bf16),
+  4. all_gathers the updated parameter shards along ``stage`` to
+     rebuild the replicated params for the next forward.
+
+  Memory per device: params + grads + ``2 * bf16 * n_params / A``
+  moments, vs the dense step's ``2 * f32 * n_params`` — an ``A``-way
+  partition on exactly the state that is redundantly replicated.
+
+Both paths preserve the federated semantics: state is client-stacked and
+client-sharded; ZeRO partitioning happens along ``stage`` (within one
+logical client's pipeline group), never across clients.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.flatten_util import ravel_pytree
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from split_learning_tpu.parallel.pipeline import (
+    PipelineModel, _restore, _strip,
+)
+
+
+class ScaleByAdamBf16State(NamedTuple):
+    count: jnp.ndarray
+    mu: optax.Updates
+    nu: optax.Updates
+
+
+def _adam_direction(g, mu, nu, count, b1: float, b2: float, eps: float):
+    """One bf16-moment Adam step on a single array.
+
+    ``mu``/``nu`` arrive bf16, EMAs and the bias-corrected direction are
+    computed f32.  Returns ``(direction, mu32, nu32)`` — the SINGLE copy
+    of the moment math shared by :func:`scale_by_adam_bf16` (pytree) and
+    :func:`make_zero1_train_step` (flat shard); callers store the
+    moments back as bf16.
+    """
+    g32 = g.astype(jnp.float32)
+    mu32 = b1 * mu.astype(jnp.float32) + (1 - b1) * g32
+    nu32 = b2 * nu.astype(jnp.float32) + (1 - b2) * jnp.square(g32)
+    cf = count.astype(jnp.float32)
+    direction = (mu32 / (1 - b1 ** cf)) / (
+        jnp.sqrt(nu32 / (1 - b2 ** cf)) + eps)
+    return direction, mu32, nu32
+
+
+def scale_by_adam_bf16(b1: float = 0.9, b2: float = 0.999,
+                       eps: float = 1e-8) -> optax.GradientTransformation:
+    """Adam moment tracking with BOTH moments stored bfloat16.
+
+    The EMAs are computed in f32 and rounded to bf16 for storage; the
+    bias-corrected update is computed in f32.  ``optax.scale_by_adam``
+    only exposes ``mu_dtype`` — ``nu`` (the larger numerical range of
+    the two) stays f32 there, which is exactly the buffer that no
+    longer fits for a 1B-parameter model on one chip.
+    """
+
+    def init_fn(params):
+        zeros = lambda p: jnp.zeros_like(p, dtype=jnp.bfloat16)
+        return ScaleByAdamBf16State(
+            count=jnp.zeros([], jnp.int32),
+            mu=jax.tree_util.tree_map(zeros, params),
+            nu=jax.tree_util.tree_map(zeros, params))
+
+    def update_fn(updates, state, params=None):
+        del params
+        count = state.count + 1
+        stepped = jax.tree_util.tree_map(
+            lambda g, m, v: _adam_direction(g, m, v, count, b1, b2, eps),
+            updates, state.mu, state.nu,
+            is_leaf=lambda x: isinstance(x, jnp.ndarray))
+        pick = lambda i: jax.tree_util.tree_map(
+            lambda t: t[i], stepped, is_leaf=lambda x: isinstance(x, tuple))
+        to_bf16 = lambda t: jax.tree_util.tree_map(
+            lambda a: a.astype(jnp.bfloat16), t)
+        return pick(0), ScaleByAdamBf16State(
+            count=count, mu=to_bf16(pick(1)), nu=to_bf16(pick(2)))
+
+    return optax.GradientTransformation(init_fn, update_fn)
+
+
+def adamw_bf16_states(learning_rate, b1: float = 0.9, b2: float = 0.999,
+                      eps: float = 1e-8, weight_decay: float = 0.0
+                      ) -> optax.GradientTransformation:
+    """AdamW with bf16 moments (drop-in for ``optax.adamw``)."""
+    txs = [scale_by_adam_bf16(b1, b2, eps)]
+    if weight_decay:
+        txs.append(optax.add_decayed_weights(weight_decay))
+    txs.append(optax.scale_by_learning_rate(learning_rate))
+    return optax.chain(*txs)
+
+
+# --------------------------------------------------------------------------
+# ZeRO-1: moments flattened + sharded along the `stage` mesh axis
+# --------------------------------------------------------------------------
+
+def _flat_geometry(params_host, stage_axis: int) -> tuple[int, int]:
+    """(n_params, shard_len) with shard_len * A >= n_params (padded)."""
+    n = sum(int(np.prod(l.shape))
+            for l in jax.tree_util.tree_leaves(params_host))
+    shard = -(-n // stage_axis)  # ceil div
+    return n, shard
+
+
+def init_zero1_opt_state(params_host, n_clients: int,
+                         stage_axis: int) -> dict:
+    """Client-stacked ZeRO-1 AdamW state for ``params_host`` (unstacked).
+
+    ``mu``/``nu`` are bf16 vectors of shape ``(C, A * shard_len)`` —
+    flattened over all parameters, zero-padded to a multiple of the
+    ``stage`` axis so the mesh can shard dim 1 evenly.
+    """
+    _, shard = _flat_geometry(params_host, stage_axis)
+    padded = shard * stage_axis
+    return {
+        "mu": jnp.zeros((n_clients, padded), jnp.bfloat16),
+        "nu": jnp.zeros((n_clients, padded), jnp.bfloat16),
+        "count": jnp.zeros((n_clients,), jnp.int32),
+    }
+
+
+def shard_zero1_to_mesh(opt_state: dict, mesh: Mesh) -> dict:
+    """Place ZeRO-1 state: moments sharded (client, stage); count
+    client-sharded, stage-replicated."""
+    mom = NamedSharding(mesh, P("client", "stage"))
+    rep = NamedSharding(mesh, P("client"))
+    return {
+        "mu": jax.device_put(opt_state["mu"], mom),
+        "nu": jax.device_put(opt_state["nu"], mom),
+        "count": jax.device_put(opt_state["count"], rep),
+    }
+
+
+def make_zero1_train_step(pipe: PipelineModel, mesh: Mesh,
+                          learning_rate: float, b1: float = 0.9,
+                          b2: float = 0.999, eps: float = 1e-8,
+                          weight_decay: float = 0.0,
+                          train: bool = True,
+                          donate: bool = True) -> Callable:
+    """Pipelined train step with ZeRO-1 sharded bf16 AdamW moments.
+
+    Same calling convention as ``pipeline.make_train_step`` except
+    ``opt_state`` must come from :func:`init_zero1_opt_state` /
+    :func:`shard_zero1_to_mesh`:
+
+    ``step(params_c, opt_c, stats_c, x, labels, rngs) ->
+    (params_c, opt_c, stats_c, loss[C])``
+    """
+    stage_axis = int(mesh.shape["stage"])
+
+    def body(params, opt_state, stats, x, labels, rngs):
+        # opt moments arrive SHARDED: local block (1, shard_len)
+        mu, nu = opt_state["mu"][0], opt_state["nu"][0]
+        count = opt_state["count"][0]
+        params, stats = _strip(params), _strip(stats)
+        x, labels, rng = x[0], labels[0], rngs[0]
+        shard_len = mu.shape[0]
+
+        def loss_fn(p):
+            local, aux = pipe.device_loss(p, stats, x, labels, rng,
+                                          train=train,
+                                          stage_axis_size=stage_axis)
+            return local, aux
+
+        (_, (loss, new_stats)), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(params)
+        grads = jax.tree_util.tree_map(
+            lambda g: jax.lax.psum(g, "stage"), grads)
+
+        # flatten params+grads in one canonical ravel order; slice my shard
+        pflat, unravel = ravel_pytree(params)
+        gflat, _ = ravel_pytree(grads)
+        n = pflat.shape[0]
+        dev = jax.lax.axis_index("stage")
+        start = dev * shard_len
+        pad = shard_len * stage_axis - n
+        ppad = jnp.pad(pflat, (0, pad))
+        gpad = jnp.pad(gflat, (0, pad))
+        p_sh = jax.lax.dynamic_slice(ppad, (start,), (shard_len,))
+        g_sh = jax.lax.dynamic_slice(gpad, (start,), (shard_len,))
+
+        # elementwise AdamW on the shard (moments stored bf16, math f32;
+        # same optax.adamw ordering as adamw_bf16_states: direction +
+        # decoupled decay, then lr)
+        count = count + 1
+        upd, mu32, nu32 = _adam_direction(g_sh, mu, nu, count, b1, b2,
+                                          eps)
+        if weight_decay:
+            upd = upd + weight_decay * p_sh
+        new_p_sh = p_sh - learning_rate * upd
+
+        # rebuild replicated params: all_gather shards along `stage`
+        gathered = jax.lax.all_gather(new_p_sh, "stage")  # (A, shard_len)
+        new_params = unravel(gathered.reshape(-1)[:n])
+
+        new_opt = {"mu": mu32.astype(jnp.bfloat16)[None],
+                   "nu": nu32.astype(jnp.bfloat16)[None],
+                   "count": count[None]}
+        return (_restore(new_params), new_opt, _restore(new_stats),
+                loss[None])
+
+    spec_c = P("client")
+    spec_opt = {"mu": P("client", "stage"), "nu": P("client", "stage"),
+                "count": P("client")}
+    mapped = jax.shard_map(
+        body, mesh=mesh,
+        in_specs=(spec_c, spec_opt, spec_c, spec_c, spec_c, spec_c),
+        out_specs=(spec_c, spec_opt, spec_c, spec_c),
+        check_vma=False,
+    )
+    return jax.jit(mapped, donate_argnums=(0, 1, 2) if donate else ())

@@ -34,7 +34,9 @@ from __future__ import annotations
 
 import argparse
 import dataclasses
+import time
 import uuid
+import zlib
 from typing import Any
 
 import jax
@@ -317,11 +319,27 @@ class ProtocolClient:
         lose its registration to the server's startup queue purge
         (``src/Utils.py:8-32`` hygiene — the reference simply requires
         clients to start after the server, README.md:144-171)."""
+        from split_learning_tpu.runtime.bus import QueueClosed
         self.register()
         q = reply_queue(self.client_id)
         started = False
         while True:
-            raw = self.bus.get(q, timeout=None if started else 3.0)
+            try:
+                raw = self.bus.get(q, timeout=None if started else 3.0)
+            except (QueueClosed, ConnectionError, OSError) as e:
+                # Transport gone while idle BETWEEN rounds: after at
+                # least one START this is almost always the STOP fan-out
+                # racing the broker teardown (server exits right after
+                # publishing it) — exit cleanly instead of dying with a
+                # traceback.  During registration (no START yet) a dead
+                # transport is a real deployment failure: stay loud so
+                # the operator sees more than a server-side timeout.
+                # Mid-round transport loss surfaces inside the hot loops
+                # and still raises.
+                if not started:
+                    raise
+                self.log.warning(f"transport closed ({e}); shutting down")
+                return
             if raw is None:
                 if not started:
                     self.register()
@@ -358,6 +376,10 @@ class ProtocolClient:
         # 2LS fixed edge<->head pairing: route this client's forward
         # data plane through its pair-indexed queue (None = shared)
         self.pair = extra.get("pair")
+        # DCSL dispatch topology: next-stage client ids whose per-device
+        # queues this client scatters successive batches across,
+        # round-robin (other/DCSL/src/Scheduler.py:21-26, :110-133)
+        self.sda_peers = extra.get("sda_peers")
         if msg.params is None:
             # FLEX non-reseed round (other/FLEX/src/Server.py:220-226):
             # START without weights — keep the locally persisted shard
@@ -376,7 +398,8 @@ class ProtocolClient:
                     self.cfg.model_key, msg.start_layer, msg.end_layer,
                     msg.learning,
                     model_kwargs=dict(self.cfg.model_kwargs or {}),
-                    seed=self.cfg.seed + hash(self.client_id) % 100000)
+                    seed=self.cfg.seed
+                    + zlib.crc32(self.client_id.encode()) % 100000)
                 self.opt_state = self.runner.optimizer.init(self.trainable)
                 self.log.info("hyperparams changed: rebuilt runner "
                               "(weights kept)")
@@ -387,7 +410,8 @@ class ProtocolClient:
         self.runner = ShardRunner(
             self.cfg.model_key, msg.start_layer, msg.end_layer,
             msg.learning, model_kwargs=model_kwargs,
-            seed=self.cfg.seed + hash(self.client_id) % 100000)
+            seed=self.cfg.seed
+            + zlib.crc32(self.client_id.encode()) % 100000)
         params = jax.tree_util.tree_map(jnp.asarray, msg.params)
         self.stats = jax.tree_util.tree_map(
             jnp.asarray, msg.batch_stats or {})
@@ -522,7 +546,7 @@ class ProtocolClient:
         r = self.runner
         inflight: dict[str, _Inflight] = {}
         grad_q = gradient_queue(self.stage, self.client_id)
-        out_q = intermediate_queue(self.stage, self.cluster, self.pair)
+        out_qs = self._out_queues()
         cap = max(1, r.learning.control_count)
         n_fwd = n_bwd = 0
 
@@ -575,7 +599,8 @@ class ProtocolClient:
                 inflight[data_id] = _Inflight(x=x, rng=rng,
                                               trace=[self.client_id],
                                               n=len(labels))
-                self.bus.publish(out_q, encode(Activation(
+                self.bus.publish(out_qs[n_fwd % len(out_qs)],
+                                 encode(Activation(
                     data_id=data_id,
                     data=_to_wire_tree(out, self.wire_dtype),
                     labels=np.asarray(labels, np.int32),
@@ -588,10 +613,27 @@ class ProtocolClient:
         self.log.info(f"[>>>] NOTIFY fwd={n_fwd} bwd={n_bwd}")
         return self._wait_pause()
 
+    def _out_queues(self) -> list[str]:
+        """Forward-dispatch queues: the next stage's per-device queues
+        (DCSL round-robin scatter) when ``sda_peers`` is set, else the
+        single shared/pair-indexed cluster queue.
+
+        The rotation start is staggered by a stable hash of this
+        client's id: with a small in-flight cap, producers all starting
+        at peer 0 would convoy onto the same head each turn instead of
+        load-balancing across heads."""
+        if self.sda_peers:
+            qs = [intermediate_queue(self.stage, self.cluster, p)
+                  for p in self.sda_peers]
+            off = zlib.crc32(self.client_id.encode()) % len(qs)
+            return qs[off:] + qs[:off]
+        return [intermediate_queue(self.stage, self.cluster, self.pair)]
+
     def _train_middle(self) -> Pause:
         r = self.runner
         in_q = intermediate_queue(self.stage - 1, self.cluster, self.pair)
-        out_q = intermediate_queue(self.stage, self.cluster, self.pair)
+        out_qs = self._out_queues()
+        n_fwd = 0
         grad_q = gradient_queue(self.stage, self.client_id)
         inflight: dict[str, _Inflight] = {}
         while True:
@@ -634,11 +676,12 @@ class ProtocolClient:
             inflight[act.data_id] = _Inflight(x=x, rng=rng,
                                               trace=list(act.trace),
                                               n=len(act.labels))
-            self.bus.publish(out_q, encode(Activation(
+            self.bus.publish(out_qs[n_fwd % len(out_qs)], encode(Activation(
                 data_id=act.data_id,
                 data=_to_wire_tree(out, self.wire_dtype),
                 labels=act.labels, trace=list(act.trace) + [self.client_id],
                 cluster=self.cluster, round_idx=self.fence)))
+            n_fwd += 1
 
     def _train_last(self) -> Pause:
         """Loss + backward + routed input-gradient return
@@ -647,28 +690,70 @@ class ProtocolClient:
         (DCSL SDA, ``other/DCSL/src/Scheduler.py:152-191``)."""
         r = self.runner
         in_q = intermediate_queue(self.stage - 1, self.cluster, self.pair)
-        window: list[Activation] = []
+        # DCSL window semantics (other/DCSL/src/Scheduler.py:152-191):
+        # one batch from each of ``sda_size`` DISTINCT origins.  pending
+        # holds per-origin FIFOs — a second batch from an origin already
+        # represented waits for the NEXT window instead of widening this
+        # one, mirroring the reference's per-device queues.
+        pending: dict[str, list[Activation]] = {}
+        idle_flush_s = 0.25
+        idle_since: float | None = None
+        # The barrier width ADAPTS: it starts at sda_size, and an
+        # idle-triggered partial flush (a feeder ran dry — uneven
+        # non-IID loaders make that the common case, not just the round
+        # tail) lowers it to the surviving feeder count so each
+        # subsequent burst doesn't re-pay the idle stall; it rises back
+        # toward sda_size the moment more distinct origins are live
+        # again (e.g. the next local epoch refills a short loader).
+        target = max(1, self.sda_size)
+
+        def live() -> list[str]:
+            return [o for o, q in pending.items() if q]
+
+        def pop_window(require_full: bool) -> list[Activation] | None:
+            origins = live()
+            if not origins or (require_full and len(origins) < target):
+                return None
+            return [pending[o].pop(0)
+                    for o in origins[:max(1, self.sda_size)]]
+
         while True:
             pause = self._check_pause()
             if pause is not None:
-                if window:
-                    self._sda_step(window)
-                    window = []
+                while True:   # drain everything buffered before PAUSE
+                    w = pop_window(require_full=False)
+                    if not w:
+                        break
+                    self._sda_step(w)
                 self.log.info("[<<<] PAUSE")
                 return pause
             raw = self.bus.get(in_q, timeout=0.001)
             if raw is None:
-                if window:  # partial window: flush rather than starve
-                    self._sda_step(window)
-                    window = []
+                # the window is a BARRIER in steady state, but a
+                # starved barrier must not deadlock stage-1's gradient
+                # wait — flush a partial window after a real idle spell
+                # and adapt the barrier down to what is actually alive
+                now = time.monotonic()
+                idle_since = idle_since if idle_since is not None else now
+                if now - idle_since >= idle_flush_s:
+                    w = pop_window(require_full=False)
+                    if w:
+                        target = max(1, len(w))
+                        self._sda_step(w)
                 continue
             act = decode(raw)
             if act.round_idx != self.fence:
                 continue   # activation from a dropped round: discard
-            window.append(act)
-            if len(window) >= self.sda_size:
-                self._sda_step(window)
-                window = []
+            # reset the idle clock only for CURRENT-round traffic — a
+            # stream of stale activations must not starve the tail flush
+            idle_since = None
+            pending.setdefault(act.trace[-1], []).append(act)
+            n_live = len(live())
+            if n_live > target:
+                target = min(max(1, self.sda_size), n_live)
+            w = pop_window(require_full=True)
+            if w:
+                self._sda_step(w)
 
     def _sda_step(self, window: list[Activation]):
         r = self.runner

@@ -161,6 +161,17 @@ class MeshContext(TrainContext):
                 for l in jax.tree_util.tree_leaves(shapes["params"])))
         return self._n_params
 
+    def _parallel_axis(self) -> tuple[str, int] | None:
+        """Config-selected intra-client axis: ("model"|"seq"|"expert", n)."""
+        t = self.cfg.topology
+        if t.tensor_parallel > 1:
+            return ("model", t.tensor_parallel)
+        if t.sequence_parallel > 1:
+            return ("seq", t.sequence_parallel)
+        if t.expert_parallel > 1:
+            return ("expert", t.expert_parallel)
+        return None
+
     def _geometry(self, plan: ClusterPlan, n_active: int):
         """(C_phys, S_phys, physical cuts) fitted to the device budget.
 
@@ -170,6 +181,18 @@ class MeshContext(TrainContext):
         that fits and stages are chained on-device as virtual pipeline
         stages (same split semantics, microbatch gradient accumulation,
         no cross-device stage collectives at axis width 1)."""
+        par = self._parallel_axis()
+        if par is not None:
+            # intra-client axis first; remaining devices form the client
+            # axis.  Cuts stay virtual (full model per TP/seq/expert
+            # group — split semantics live in shard extraction).
+            name, n = par
+            D = len(self.devices)
+            if n > D:
+                raise ValueError(
+                    f"topology.{name}-parallel={n} exceeds the "
+                    f"{D}-device budget")
+            return max(1, min(n_active, D // n)), 1, list(plan.cuts)
         S = len(plan.cuts) + 1
         D = len(self.devices)
         budget = min(S, D)
@@ -181,9 +204,64 @@ class MeshContext(TrainContext):
         c_phys = max(1, min(n_active, D // s_phys))
         return c_phys, s_phys, list(plan.cuts)
 
+    def _compiled_axes(self, plan: ClusterPlan, c_phys: int,
+                       par: tuple[str, int], lr: float | None):
+        """Step for the config-surface TP/SP/EP axes (VERDICT r2 item 4):
+        mesh (client, model|seq|expert), full model per group, same
+        calling convention as the pipelined step."""
+        import types
+        from jax.sharding import Mesh
+
+        name, n = par
+        lrn = self.cfg.learning
+        if lrn.lora_rank > 0:
+            raise ValueError(
+                "lora_rank > 0 is not supported together with "
+                "tensor/sequence/expert-parallel axes")
+        key = (plan.cluster_id, c_phys, name, n, lr, "axes")
+        if key in self._step_cache:
+            return self._step_cache[key]
+        mesh = Mesh(
+            np.array(self.devices[:c_phys * n]).reshape(c_phys, n),
+            ("client", name))
+        optimizer = make_optimizer(lrn, lr)
+        mk = dict(self.model_kwargs)
+        if name == "seq":
+            mk["seq_axis"] = "seq"
+        try:
+            model = build_model(self.cfg.model_key, **mk)
+        except TypeError as e:
+            raise ValueError(
+                f"model {self.cfg.model_key} does not support "
+                f"{name}-parallel (builder rejected {mk}): {e}") from e
+        if name == "seq":
+            from split_learning_tpu.parallel.sequence import (
+                make_sp_train_step,
+            )
+            step = make_sp_train_step(model, optimizer, mesh)
+        else:
+            from split_learning_tpu.parallel.axes import (
+                make_axes_train_step,
+            )
+            if name == "model":
+                from split_learning_tpu.parallel.tensor import tp_spec
+                step = make_axes_train_step(model, optimizer, mesh,
+                                            tp_spec, "model")
+            else:
+                from split_learning_tpu.parallel.expert import ep_spec
+                step = make_axes_train_step(model, optimizer, mesh,
+                                            ep_spec, "expert")
+        pipe = types.SimpleNamespace(num_microbatches=lrn.control_count,
+                                     mb_size=lrn.batch_size)
+        self._step_cache[key] = (mesh, pipe, optimizer, step)
+        return self._step_cache[key]
+
     def _compiled(self, plan: ClusterPlan, c_phys: int, s_phys: int,
                   cuts_phys: list, lr: float | None,
                   sync_map_key: tuple, client_sync: dict | None):
+        par = self._parallel_axis()
+        if par is not None:
+            return self._compiled_axes(plan, c_phys, par, lr)
         lrn = self.cfg.learning
         use_lora = lrn.lora_rank > 0
         key = (plan.cluster_id, c_phys, s_phys, tuple(cuts_phys), lr,
@@ -288,8 +366,13 @@ class MeshContext(TrainContext):
         for chunk_i in range(n_chunks):
             chunk = stage1[chunk_i * c_phys:(chunk_i + 1) * c_phys]
             pad = c_phys - len(chunk)
-            client_sync, sync_key = self._sync_map(
-                plan, c_phys, len(chunk), sync_all_later_stages)
+            if self._parallel_axis() is not None:
+                # axes path: columns train independently (no grouped
+                # gradient means); shared later stages meet at FedAvg
+                client_sync, sync_key = None, ()
+            else:
+                client_sync, sync_key = self._sync_map(
+                    plan, c_phys, len(chunk), sync_all_later_stages)
             mesh, pipe, optimizer, step = self._compiled(
                 plan, c_phys, s_phys, cuts_phys, lr, sync_key, client_sync)
             M, mb = pipe.num_microbatches, pipe.mb_size
@@ -325,7 +408,16 @@ class MeshContext(TrainContext):
             rngs = jax.vmap(jax.random.key)(jnp.arange(c_phys)
                                             + round_idx * 1000)
             loss = None
+            # data_count semantics (src/train/VGG16.py:109): FedAvg weights
+            # count DISTINCT samples consumed.  A loader shorter than the
+            # M-batch draw restarts mid-step, and those redraws must not
+            # inflate the client's aggregation weight — cap each column at
+            # its loader's own epoch (and dataset) size.
             consumed = np.zeros(c_phys, dtype=np.int64)
+            for i, ld in enumerate(loaders):
+                consumed[i] = epochs * min(steps_per_epoch * M * mb,
+                                           ld.samples_per_epoch,
+                                           len(ld.dataset))
             for _ in range(epochs):
                 iters = [iter(ld) for ld in loaders]
                 for _ in range(steps_per_epoch):
@@ -351,7 +443,6 @@ class MeshContext(TrainContext):
                     else:
                         params_c, opt_c, stats_c, loss = step(
                             params_c, opt_c, stats_c, x, labels, rngs)
-                    consumed += M * mb
             loss_h = (np.asarray(loss) if loss is not None
                       else np.zeros(c_phys))
             if use_lora:

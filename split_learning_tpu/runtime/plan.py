@@ -90,6 +90,20 @@ def plan_clusters(cfg: Config,
                 f"got {len(by_stage[s])}")
 
     stage1 = by_stage[1]
+    if cfg.topology.mode == "auto" and cfg.topology.require_profiles:
+        # fail-fast contract (reference client.py:52-62: clients refuse
+        # to start without profiling.json): auto partitioning must not
+        # silently degrade to an even split
+        missing = [r.client_id for r in stage1
+                   if not (r.profile and "exe_time" in r.profile
+                           and "size_data" in r.profile)]
+        if missing:
+            raise ValueError(
+                "topology.require_profiles: auto partitioning needs a "
+                "profile (exe_time + size_data) from every stage-1 "
+                f"client; missing from {missing} — run "
+                "`python -m split_learning_tpu.profiler` on each client "
+                "or disable require-profiles")
     n_classes = _num_classes(cfg)
     dist = cfg.distribution
     label_counts = synthesize_label_counts(

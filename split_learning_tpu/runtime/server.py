@@ -70,6 +70,17 @@ class ProtocolContext(MeshContext):
                  client_timeout: float = 600.0,
                  ready_timeout: float | None = None):
         super().__init__(cfg)
+        if self._parallel_axis() is not None:
+            # fail fast like require_profiles: protocol clients build
+            # plain unsharded ShardRunners — silently dropping the
+            # configured TP/SP/EP axis would train in a different regime
+            # than the YAML states (and OOM at real model scale)
+            name, n = self._parallel_axis()
+            raise ValueError(
+                f"topology.{name}-parallel={n} is only supported by the "
+                "in-process mesh backend (python -m split_learning_tpu"
+                ".run); the multi-process protocol deployment does not "
+                "shard client models yet")
         self.bus = transport
         self.log = logger or Logger(cfg.log_path, debug=cfg.debug,
                                     console=False, name="server")
@@ -202,9 +213,6 @@ class ProtocolContext(MeshContext):
         learning = dataclasses.asdict(self.cfg.learning)
         if lr is not None:
             learning["learning_rate"] = lr
-        sda = (self.cfg.aggregation.sda_size
-               if sync_all_later_stages else 1)
-
         self._ready.clear()
         self._notified.clear()
         self._updates = []
@@ -233,6 +241,39 @@ class ProtocolContext(MeshContext):
                     f"in_clusters={n_in} but {len(heads)} heads for "
                     f"{len(groups)} in-groups: keeping shared queues")
 
+        # window never wider than the feeders a head actually HEARS:
+        # origins are trace[-1] (the previous stage's clients), and with
+        # 2LS pairing each head's queue receives only its own group —
+        # a wider sda_size could never assemble a distinct-origin window
+        # and every batch would crawl through the idle-flush path
+        if plan.n_stages == 2:
+            if pair_of:
+                group_sizes = {}
+                for cid in stage1:
+                    g = pair_of.get(cid)
+                    group_sizes[g] = group_sizes.get(g, 0) + 1
+                n_feeders = min(group_sizes.values())
+            else:
+                n_feeders = len(stage1)
+        elif plan.n_stages > 2:
+            n_feeders = max(1, len(plan.clients[-2]))
+        else:
+            n_feeders = 1
+        sda = (min(self.cfg.aggregation.sda_size, n_feeders)
+               if sync_all_later_stages else 1)
+
+        # DCSL dispatch topology (other/DCSL/src/Scheduler.py:21-26,
+        # :110-133): with SDA active, feeding clients scatter successive
+        # batches round-robin across the next stage's PER-DEVICE queues
+        # (per-device ``intermediate_queue_..._p{client_id}``) instead of
+        # the shared cluster queue, and every later-stage device consumes
+        # its own queue.
+        sda_route = sda > 1 and plan.n_stages >= 2 and not pair_of
+        if sda_route:
+            for s in range(2, plan.n_stages + 1):
+                for cid in plan.clients[s - 1]:
+                    pair_of[cid] = cid
+
         for cid, s in active:
             a, b = ranges[s - 1]
             sp = (send_params.get(s, True)
@@ -257,6 +298,9 @@ class ProtocolContext(MeshContext):
                 extra={"epochs": epochs, "sda_size": sda,
                        "n_stages": plan.n_stages,
                        "pair": pair_of.get(cid),
+                       "sda_peers": (list(plan.clients[s])
+                                     if sda_route and s < plan.n_stages
+                                     else None),
                        "gen": self._cur_gen})))
             self.log.sent(f"START -> {cid} layers=[{a}, {end_layer}]"
                           + ("" if sp else " (no weights)"))

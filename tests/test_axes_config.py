@@ -1,0 +1,66 @@
+"""YAML-surface TP/SP/EP: a config alone turns each axis on (VERDICT r2
+item 4) and run_local trains end-to-end on the virtual 8-device mesh.
+
+The mesh becomes (client, model|seq|expert); each logical client's
+replica is sharded over the second axis (GSPMD rules from
+parallel/tensor.py / parallel/expert.py, ring attention from
+parallel/sequence.py) while clients stay federated over ``client``.
+"""
+
+import numpy as np
+import pytest
+
+from split_learning_tpu.config import ConfigError, from_dict
+from split_learning_tpu.run import run_local
+from split_learning_tpu.runtime.log import Logger
+
+pytestmark = pytest.mark.slow  # compiles real sharded programs
+
+TINY_LLAMA = {"hidden_size": 32, "num_heads": 2, "num_kv_heads": 2,
+              "intermediate_size": 64, "n_block": 1}
+
+
+def axis_cfg(tmp_path, tag, model="TinyLlama", extra_kwargs=None,
+             **topology):
+    return from_dict(dict(
+        model=model, dataset="TINYSTORIES", clients=[2],
+        global_rounds=1, synthetic_size=24, val_max_batches=1,
+        val_batch_size=2, compute_dtype="float32",
+        model_kwargs={**TINY_LLAMA, **(extra_kwargs or {})},
+        log_path=str(tmp_path / f"logs_{tag}"),
+        learning={"batch_size": 2, "control_count": 2,
+                  "optimizer": "adamw", "learning_rate": 1e-3},
+        distribution={"num_samples": 8},
+        checkpoint={"directory": str(tmp_path / f"ckpt_{tag}"),
+                    "save": False},
+        topology=topology,
+    ))
+
+
+def _run(cfg):
+    res = run_local(cfg, logger=Logger(cfg.log_path, console=False))
+    rec = res.history[-1]
+    assert rec.ok, "round failed"
+    assert rec.val_accuracy is not None
+    assert np.isfinite(rec.val_loss)
+    return res
+
+
+def test_tensor_parallel_from_yaml(tmp_path, eight_devices):
+    _run(axis_cfg(tmp_path, "tp", tensor_parallel=2))
+
+
+def test_sequence_parallel_from_yaml(tmp_path, eight_devices):
+    _run(axis_cfg(tmp_path, "sp", sequence_parallel=2))
+
+
+def test_expert_parallel_from_yaml(tmp_path, eight_devices):
+    _run(axis_cfg(tmp_path, "ep", model="TinyLlamaMoE",
+                  extra_kwargs={"num_experts": 2, "k": 1},
+                  expert_parallel=2))
+
+
+def test_axes_are_mutually_exclusive():
+    with pytest.raises(ConfigError):
+        from_dict({"topology": {"tensor-parallel": 2,
+                                "sequence-parallel": 2}})

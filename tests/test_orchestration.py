@@ -341,3 +341,24 @@ def test_fedasync_default_groups_keep_all_heads(tmp_path):
     # which must include BOTH heads: layer2 = (10+30)/2 = 20
     np.testing.assert_allclose(out.params["layer1"], np.full(2, 2.0))
     np.testing.assert_allclose(out.params["layer2"], np.full(2, 20.0))
+
+
+def test_require_profiles_fail_fast(tmp_path):
+    """Reference clients refuse to start without profiling.json
+    (client.py:52-62); topology.require_profiles restores that contract
+    server-side: auto partitioning rejects unprofiled registrations
+    instead of silently even-splitting (VERDICT r2 item 9)."""
+    cfg = tiny_cfg(tmp_path, topology={"mode": "auto", "cut_layers": [2],
+                                       "require_profiles": True})
+    regs = synthesize_registrations(cfg)  # no profiles
+    with pytest.raises(ValueError, match="require_profiles"):
+        plan_clusters(cfg, regs)
+    # full profiles satisfy the gate
+    n_layer = 17
+    profile = {"exe_time": [1.0] * n_layer,
+               "size_data": [100.0] * n_layer,
+               "speed": 1.0, "network": 1e6}
+    regs = synthesize_registrations(
+        cfg, profiles={"client_1_0": profile, "client_1_1": profile})
+    plans = plan_clusters(cfg, regs)
+    assert plans and plans[0].cuts

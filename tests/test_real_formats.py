@@ -1,0 +1,126 @@
+"""Real on-disk format ingestion (VERDICT r2 item 7).
+
+Each dataset provider's real-data branch (``data/datasets.py``) parses
+the format the reference's torchvision/torchaudio loaders consume
+(``/root/reference/src/dataset/dataloader.py:61-122``); these tests
+write tiny byte-exact fixtures into a temp SLT_DATA_DIR and drive every
+branch in CI — a format bug must not wait for a real deployment.
+"""
+
+import pickle
+import struct
+import wave
+
+import numpy as np
+import pytest
+
+from split_learning_tpu.data.datasets import get_dataset
+
+
+@pytest.fixture()
+def data_dir(tmp_path, monkeypatch):
+    monkeypatch.setenv("SLT_DATA_DIR", str(tmp_path))
+    return tmp_path
+
+
+def test_cifar10_pickle_batches(data_dir):
+    root = data_dir / "cifar-10-batches-py"
+    root.mkdir()
+    rng = np.random.default_rng(0)
+
+    def write(name, n, label0):
+        data = rng.integers(0, 256, size=(n, 3072), dtype=np.uint8)
+        labels = [(label0 + i) % 10 for i in range(n)]
+        with open(root / name, "wb") as f:
+            pickle.dump({b"data": data, b"labels": labels}, f)
+        return data, labels
+
+    per_batch = 2
+    train_parts = [write(f"data_batch_{i}", per_batch, i)
+                   for i in range(1, 6)]
+    write("test_batch", 3, 7)
+
+    ds = get_dataset("CIFAR10", train=True)
+    assert len(ds) == 5 * per_batch
+    assert ds.inputs.shape == (10, 32, 32, 3)        # NHWC
+    assert ds.inputs.dtype == np.float32
+    # normalization applied: values no longer in [0, 255]
+    assert float(np.abs(ds.inputs).max()) < 10.0
+    # first sample round-trips the CHW->HWC transpose exactly
+    raw0 = train_parts[0][0][0].reshape(3, 32, 32).transpose(1, 2, 0)
+    mean = np.array([0.4914, 0.4822, 0.4465], np.float32)
+    std = np.array([0.2470, 0.2435, 0.2616], np.float32)
+    np.testing.assert_allclose(
+        ds.inputs[0], (raw0.astype(np.float32) / 255.0 - mean) / std,
+        rtol=1e-5, atol=1e-6)
+    np.testing.assert_array_equal(ds.labels[:2], [1, 2])
+
+    val = get_dataset("CIFAR10", train=False)
+    assert len(val) == 3
+    np.testing.assert_array_equal(val.labels, [7, 8, 9])
+
+
+def test_mnist_idx_pair(data_dir):
+    root = data_dir / "MNIST" / "raw"
+    root.mkdir(parents=True)
+    rng = np.random.default_rng(1)
+    for stem, n in (("train", 4), ("t10k", 2)):
+        imgs = rng.integers(0, 256, size=(n, 28, 28), dtype=np.uint8)
+        labels = np.arange(n, dtype=np.uint8)
+        with open(root / f"{stem}-images-idx3-ubyte", "wb") as f:
+            f.write(struct.pack(">IIII", 2051, n, 28, 28))
+            f.write(imgs.tobytes())
+        with open(root / f"{stem}-labels-idx1-ubyte", "wb") as f:
+            f.write(struct.pack(">II", 2049, n))
+            f.write(labels.tobytes())
+    ds = get_dataset("MNIST", train=True)
+    assert ds.inputs.shape == (4, 28, 28, 1)
+    assert ds.inputs.dtype == np.float32
+    np.testing.assert_array_equal(ds.labels, [0, 1, 2, 3])
+    val = get_dataset("MNIST", train=False)
+    assert len(val) == 2
+
+
+def _write_wav(path, seconds=1.0, freq=440.0):
+    n = int(16000 * seconds)
+    t = np.arange(n) / 16000.0
+    sig = (np.sin(2 * np.pi * freq * t) * 0.3 * 32767).astype(np.int16)
+    with wave.open(str(path), "wb") as w:
+        w.setnchannels(1)
+        w.setsampwidth(2)
+        w.setframerate(16000)
+        w.writeframes(sig.tobytes())
+
+
+def test_speechcommands_wav_walk_and_split_lists(data_dir):
+    root = data_dir / "SpeechCommands" / "speech_commands_v0.02"
+    (root / "yes").mkdir(parents=True)
+    (root / "no").mkdir()
+    _write_wav(root / "yes" / "a.wav")
+    _write_wav(root / "yes" / "b.wav", seconds=0.5)   # needs padding
+    _write_wav(root / "no" / "c.wav", freq=880.0)
+    # b.wav is held out to the validation split
+    (root / "validation_list.txt").write_text("yes/b.wav\n")
+    ds = get_dataset("SPEECHCOMMANDS", train=True)
+    assert ds.inputs.shape == (2, 40, 98)             # MFCC features
+    assert sorted(ds.labels.tolist()) == [0, 1]       # yes=0, no=1
+    val = get_dataset("SPEECHCOMMANDS", train=False)
+    assert val.inputs.shape == (1, 40, 98)
+    assert val.labels.tolist() == [0]
+
+
+def test_emotion_on_disk_semicolon_format(data_dir):
+    root = data_dir / "emotion"
+    root.mkdir()
+    (root / "train.txt").write_text(
+        "i didnt feel humiliated;sadness\n"
+        "i feel great about it; all of it;joy\n"   # ; inside text
+        "im grabbing a minute to post i feel greedy wrong;3\n")
+    (root / "test.txt").write_text("i am feeling calm;joy\n")
+    ds = get_dataset("EMOTION", train=True)
+    assert len(ds) == 3
+    assert ds.inputs.shape[1] == 128
+    assert ds.inputs[0, 0] == 101                      # [CLS]
+    np.testing.assert_array_equal(ds.labels, [0, 1, 3])
+    val = get_dataset("EMOTION", train=False)
+    assert val.labels.tolist() == [1]
