@@ -223,25 +223,30 @@ def _measure_pipe_step(model_name: str, cuts, example_shape, example_dtype,
 
 
 def measure_matmul_roofline() -> float:
-    """Measured bf16 matmul TFLOP/s on this chip (empirical roofline)."""
+    """Measured bf16 matmul TFLOP/s on this chip (empirical roofline).
+
+    All ``steps`` matmuls chain inside ONE jitted ``fori_loop`` so a
+    single dispatch covers the whole timed region — per-call tunnel
+    latency otherwise deflates the roofline below what real fused
+    programs sustain (observed: headline VGG TFLOP/s ABOVE the
+    "roofline" measured with per-step dispatch)."""
+    import functools
     import jax
     import jax.numpy as jnp
     import numpy as np
 
     on_cpu = jax.default_backend() == "cpu"
     n = 1024 if on_cpu else 8192
-    steps = 2 if on_cpu else 10
-    a = jnp.ones((n, n), jnp.bfloat16)
+    steps = 2 if on_cpu else 50
 
-    @jax.jit
-    def mm(a):
-        return a @ a
+    @functools.partial(jax.jit, static_argnums=1)
+    def chain(a, k):
+        return jax.lax.fori_loop(0, k, lambda _, b: b @ b, a)
 
-    b = mm(a)
-    float(np.asarray(b[0, 0], np.float32))
+    a = jnp.full((n, n), 1.0 / n, jnp.bfloat16)  # fixed point of b @ b
+    float(np.asarray(chain(a, steps)[0, 0], np.float32))  # warm/compile
     t0 = time.perf_counter()
-    for _ in range(steps):
-        b = mm(b)
+    b = chain(a, steps)
     float(np.asarray(b[0, 0], np.float32))
     dt = time.perf_counter() - t0
     return 2 * n ** 3 * steps / dt / 1e12
@@ -260,9 +265,14 @@ def measure_round() -> dict:
     from split_learning_tpu.runtime.log import Logger
 
     on_cpu = jax.default_backend() == "cpu"
-    rounds = 2 if on_cpu else 6
+    rounds = 2 if on_cpu else 8
     ckpt = "/tmp/slt_bench_round"
     shutil.rmtree(ckpt, ignore_errors=True)
+    # lr: the reference's default 5e-4 SGD moves a from-scratch 52-layer
+    # VGG too slowly to show learning inside a bench budget (~100 steps);
+    # 0.05 with momentum is the standard VGG/bs-256 operating point and
+    # makes the reported accuracy trajectory meaningful (the geometry —
+    # cut 7, clients [1,1], bs 256 — stays the reference default).
     cfg = cfgmod.from_dict({
         "model": "VGG16", "dataset": "CIFAR10",
         "clients": [1, 1], "global-rounds": rounds,
@@ -277,7 +287,8 @@ def measure_round() -> dict:
         "learning": {"batch-size": 8 if on_cpu else 256,
                      "control-count": 2 if on_cpu else 4,
                      "optimizer": "sgd",
-                     "learning-rate": 5e-4, "momentum": 0.9},
+                     "learning-rate": 5e-4 if on_cpu else 0.05,
+                     "momentum": 0.9},
         "checkpoint": {"directory": ckpt},
         "log-path": "/tmp/slt_bench_round_logs",
     })
@@ -445,6 +456,17 @@ def _sec_llama(ctx: dict) -> dict:
             "tiny_overrides": bool(llama_kw.get("vocab_size"))}
 
 
+def _sec_test_ok(ctx: dict) -> dict:
+    """Hidden test section: trivially succeeds (watchdog CI coverage)."""
+    return {"ok": True}
+
+
+def _sec_test_wedge(ctx: dict) -> dict:
+    """Hidden test section: wedges forever (watchdog CI coverage)."""
+    time.sleep(3600)
+    return {}
+
+
 SECTIONS = {
     "headline": _sec_headline,
     "mfu": _sec_mfu,
@@ -453,6 +475,8 @@ SECTIONS = {
     "resnet50_cifar100_3way_cut_3_6": _sec_resnet,
     "vit_s16_cifar10_cut_block6": _sec_vit,
     "tinyllama_tinystories_4stage": _sec_llama,
+    "_test_ok": _sec_test_ok,
+    "_test_wedge": _sec_test_wedge,
 }
 
 # (section, watchdog seconds on TPU).  CPU runs get the same deadline —
@@ -461,7 +485,7 @@ SECTION_PLAN = [
     ("headline", 900),
     ("mfu", 600),
     ("split_cut7", 900),
-    ("round", 1500),
+    ("round", 1800),
     ("resnet50_cifar100_3way_cut_3_6", 900),
     ("vit_s16_cifar10_cut_block6", 900),
     ("tinyllama_tinystories_4stage", 1500),
@@ -590,6 +614,81 @@ def run_section(name: str, timeout: float, ctx: dict) -> tuple[dict | None, str 
         return payload, None
 
 
+CFG_SECTIONS = frozenset({"resnet50_cifar100_3way_cut_3_6",
+                          "vit_s16_cifar10_cut_block6",
+                          "tinyllama_tinystories_4stage"})
+
+_MIDBENCH_PROBE_PLAN = [(120, 0), (180, 60), (240, 120)]
+
+
+def run_plan(plan, ctx, mode, reliability, cfgs, extra,
+             runner=None, prober=None) -> dict:
+    """Drive the section plan with wedge recovery.
+
+    On a TPU watchdog kill: re-probe patiently (the tunnel wedge can
+    take minutes to clear); on recovery retry the wedged section ONCE —
+    the first attempt's compile work is in the persistent cache, so a
+    healthy retry runs much faster.  The wedge budget is 2 events: a
+    retry that wedges again, a failed re-probe, or a THIRD wedge event
+    (counting retries) sends the remaining sections to CPU — each event
+    costs watchdog + probe + retry wall-clock, and a tunnel that keeps
+    wedging stays flaky.  A retry that fails for a non-wedge reason
+    (child rc != 0) records the error but keeps the TPU: the failure is
+    deterministic and would recur on CPU too.  ``runner``/``prober``
+    are injectable for tests.
+    """
+    runner = runner or run_section
+    prober = prober or probe_accelerator
+    results: dict = {}
+    wedges = 0
+    for name, timeout in plan:
+        payload, err = runner(name, timeout, ctx)
+        if err is not None and "watchdog" in err and ctx["mode"] == "tpu":
+            wedges += 1
+            fall_back = False
+            if wedges > 2:
+                # budget exhausted: the probe result could not change
+                # the decision (no retry left) — skip straight to CPU
+                fall_back = True
+            else:
+                ok, _ = prober(_MIDBENCH_PROBE_PLAN,
+                               reliability["probe_history"])
+                if not ok:
+                    fall_back = True
+                else:
+                    log(f"[bench] accelerator recovered; retrying {name}")
+                    reliability.setdefault("retried_sections",
+                                           []).append(name)
+                    payload, err = runner(name, timeout, ctx)
+                    if err is not None and "watchdog" in err:
+                        wedges += 1
+                        fall_back = True  # retry wedged again
+            if fall_back:
+                log("[bench] accelerator wedged mid-bench; remaining "
+                    "sections fall back to CPU")
+                reliability["midbench_fallback_at"] = name
+                ctx["mode"] = "cpu"
+        if err is not None:
+            log(f"[bench] section {name}: {err}")
+            target = cfgs if name in CFG_SECTIONS else extra
+            target[name] = {"error": err}
+            continue
+        result = payload["result"]
+        results[name] = result
+        if name == "headline":
+            ctx["headline"] = result
+            ctx["headline_backend"] = payload.get("backend")
+        if payload.get("backend") == "cpu" and mode == "tpu":
+            result["fallback"] = "cpu (mid-bench wedge)"
+        if name in CFG_SECTIONS:
+            cfgs[name] = result
+        elif name == "headline":
+            pass  # reported as the top-level metric
+        else:
+            extra[name] = result
+    return results
+
+
 def main():
     baseline = get_baseline()
     log(f"[bench] torch-CPU VGG16 baseline: {baseline:.1f} samples/s")
@@ -616,44 +715,9 @@ def main():
     log(f"[bench] mode={mode} chip={kind}")
 
     ctx: dict = {"mode": mode}
-    results: dict = {}
-    cfg_sections = {"resnet50_cifar100_3way_cut_3_6",
-                    "vit_s16_cifar10_cut_block6",
-                    "tinyllama_tinystories_4stage"}
     cfgs: dict = {}
     extra["configs"] = cfgs
-
-    for name, timeout in SECTION_PLAN:
-        payload, err = run_section(name, timeout, ctx)
-        if err is not None:
-            log(f"[bench] section {name}: {err}")
-            target = cfgs if name in cfg_sections else extra
-            target[name] = {"error": err}
-            if "watchdog" in err and ctx["mode"] == "tpu":
-                # mid-bench wedge: re-probe briefly; if still wedged,
-                # finish the remaining sections on CPU (marked) rather
-                # than losing them.
-                ok, _ = probe_accelerator([(120, 0), (120, 30)],
-                                          reliability["probe_history"])
-                if not ok:
-                    log("[bench] accelerator wedged mid-bench; remaining "
-                        "sections fall back to CPU")
-                    reliability["midbench_fallback_at"] = name
-                    ctx["mode"] = "cpu"
-            continue
-        result = payload["result"]
-        results[name] = result
-        if name == "headline":
-            ctx["headline"] = result
-            ctx["headline_backend"] = payload.get("backend")
-        if payload.get("backend") == "cpu" and mode == "tpu":
-            result["fallback"] = "cpu (mid-bench wedge)"
-        if name in cfg_sections:
-            cfgs[name] = result
-        elif name == "headline":
-            pass  # reported as the top-level metric
-        else:
-            extra[name] = result
+    results = run_plan(SECTION_PLAN, ctx, mode, reliability, cfgs, extra)
 
     if "headline" not in results and ctx["mode"] == "cpu" and mode == "tpu":
         # the headline IS the top-level metric: if its TPU run wedged,
