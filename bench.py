@@ -424,6 +424,29 @@ def _sec_vit(ctx: dict) -> dict:
     return {"samples_per_sec": round(sps, 1)}
 
 
+def _flash_attention_compiles() -> bool:
+    """Probe-compile the Pallas flash kernel on THIS backend (small
+    shape, seconds) so the full-model build can pick it safely — a
+    Pallas lowering failure must cost nothing but this probe.  Probes
+    the GRADIENT: training compiles the custom-VJP backward kernels
+    (dKV/dQ pallas_calls), not just the forward."""
+    try:
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+        from split_learning_tpu.ops.flash_attention import flash_attention
+        q = jnp.ones((1, 256, 2, 64), jnp.bfloat16)
+        g = jax.jit(jax.grad(
+            lambda q: flash_attention(q, q, q, causal=True)
+            .astype(jnp.float32).sum()))(q)
+        float(np.asarray(g[0, 0, 0, 0], np.float32))
+        return True
+    except Exception as e:
+        log(f"[bench] flash attention probe failed ({type(e).__name__}); "
+            "using the XLA einsum path")
+        return False
+
+
 def _sec_llama(ctx: dict) -> dict:
     import jax.numpy as jnp
     import optax
@@ -435,6 +458,11 @@ def _sec_llama(ctx: dict) -> dict:
                      num_kv_heads=2, intermediate_size=128, n_block=4)
                 if on_cpu else {})
     llama_kw.update(dtype_kw)
+    # fused Pallas attention on real TPU when the kernel compiles here
+    # (CPU keeps the einsum path: the interpreter would dominate timing)
+    use_flash = (not on_cpu) and _flash_attention_compiles()
+    if use_flash:
+        llama_kw["use_flash"] = True
     llama_cuts = [2, 3, 4] if on_cpu else [7, 13, 19]
     lb = 1 if on_cpu else 2
     vocab = llama_kw.get("vocab_size", 32000)
@@ -449,8 +477,10 @@ def _sec_llama(ctx: dict) -> dict:
         lb, 4, max(1, steps // 2), opt,
         model_kwargs=llama_kw, label_shape=(seq,), n_classes=vocab,
         n_vocab=vocab)
-    log(f"[bench] TinyLlama 4-stage: {sps * seq:.0f} tokens/s")
+    log(f"[bench] TinyLlama 4-stage: {sps * seq:.0f} tokens/s "
+        f"({'pallas flash' if use_flash else 'einsum'} attention)")
     return {"tokens_per_sec": round(sps * seq, 1), "seq_len": seq,
+            "attention": ("pallas flash" if use_flash else "xla einsum"),
             "optimizer": "adamw (bf16 moments; ZeRO-1 shards states "
                          "across the client axis when clients > 1)",
             "tiny_overrides": bool(llama_kw.get("vocab_size"))}

@@ -52,11 +52,16 @@ def dataset_registry() -> dict[str, Callable]:
 
 
 def get_dataset(name: str, train: bool = True,
-                synthetic_size: int | None = None) -> ArrayDataset:
+                synthetic_size: int | None = None,
+                **dataset_kwargs) -> ArrayDataset:
+    """``dataset_kwargs`` forward to the provider (e.g. ``vocab`` for
+    token datasets, so a model with an overridden ``vocab_size`` draws
+    in-range ids — out-of-range ids NaN-fill in ``nn.Embed``)."""
     if name not in _PROVIDERS:
         raise KeyError(f"unknown dataset {name!r}; known: "
                        f"{sorted(_PROVIDERS)}")
-    return _PROVIDERS[name](train=train, synthetic_size=synthetic_size)
+    return _PROVIDERS[name](train=train, synthetic_size=synthetic_size,
+                            **dataset_kwargs)
 
 
 # --------------------------------------------------------------------------
@@ -229,15 +234,16 @@ def _tokenize(texts: list[str], seq_len: int, vocab: int) -> np.ndarray:
 
 
 @register_dataset("AGNEWS")
-def agnews(train: bool = True, synthetic_size: int | None = None):
+def agnews(train: bool = True, synthetic_size: int | None = None,
+           vocab: int = _BERT_VOCAB):
     raw = _agnews_csv(data_dir() / "ag_news"
                       / ("train.csv" if train else "test.csv"))
     if raw is not None:
         texts, labels = raw
-        ids = _tokenize(texts, _AGNEWS_SEQ_LEN, _BERT_VOCAB)
+        ids = _tokenize(texts, _AGNEWS_SEQ_LEN, vocab)
         return ArrayDataset(ids, labels)
     n = synthetic_size or (8000 if train else 1600)
-    return _synthetic_tokens(n, _AGNEWS_SEQ_LEN, _BERT_VOCAB, 4,
+    return _synthetic_tokens(n, _AGNEWS_SEQ_LEN, vocab, 4,
                              seed=300 + (0 if train else 1))
 
 
@@ -278,7 +284,8 @@ def _emotion_file(path: pathlib.Path) -> tuple | None:
 
 
 @register_dataset("EMOTION")
-def emotion(train: bool = True, synthetic_size: int | None = None):
+def emotion(train: bool = True, synthetic_size: int | None = None,
+            vocab: int = _BERT_VOCAB):
     """6-label emotion set (Vanilla_SL BERT_EMOTION variant).
 
     On-disk: ``data/emotion/{train,test}.{txt,csv}`` in the dair-ai
@@ -292,10 +299,10 @@ def emotion(train: bool = True, synthetic_size: int | None = None):
         raw = _emotion_file(data_dir() / "emotion" / f"{stem}.{ext}")
         if raw is not None:
             texts, labels = raw
-            ids = _tokenize(texts, _AGNEWS_SEQ_LEN, _BERT_VOCAB)
+            ids = _tokenize(texts, _AGNEWS_SEQ_LEN, vocab)
             return ArrayDataset(ids, labels)
     n = synthetic_size or (8000 if train else 1600)
-    return _synthetic_tokens(n, _AGNEWS_SEQ_LEN, _BERT_VOCAB, 6,
+    return _synthetic_tokens(n, _AGNEWS_SEQ_LEN, vocab, 6,
                              seed=400 + (0 if train else 1))
 
 
@@ -314,9 +321,12 @@ def tinystories(train: bool = True, synthetic_size: int | None = None,
     else:
         n = synthetic_size or (4000 if train else 400)
         rng = np.random.default_rng(500 + (0 if train else 1))
-        # band-structured transitions so a real LM can reduce loss
-        starts = rng.integers(0, vocab - 64, size=(n, 1))
-        steps = rng.integers(-32, 33, size=(n, seq_len - 1)).cumsum(axis=1)
+        # band-structured transitions so a real LM can reduce loss; the
+        # band width scales down for tiny test vocabs
+        band = max(1, min(32, vocab // 4))
+        starts = rng.integers(0, max(1, vocab - 2 * band), size=(n, 1))
+        steps = rng.integers(-band, band + 1,
+                             size=(n, seq_len - 1)).cumsum(axis=1)
         ids = np.clip(starts + np.concatenate(
             [np.zeros((n, 1), np.int64), steps], axis=1), 0, vocab - 1)
         ids = ids.astype(np.int32)
@@ -378,10 +388,12 @@ def _read_wav_mono(path: pathlib.Path) -> np.ndarray:
 def make_data_loader(name: str, batch_size: int,
                      distribution: np.ndarray | None = None,
                      train: bool = True, seed: int = 0,
-                     synthetic_size: int | None = None) -> DataLoader:
+                     synthetic_size: int | None = None,
+                     dataset_kwargs: dict | None = None) -> DataLoader:
     """``distribution`` is the per-label sample-count vector a client was
     assigned (``src/Server.py:87-101``); None = the full set."""
-    ds = get_dataset(name, train=train, synthetic_size=synthetic_size)
+    ds = get_dataset(name, train=train, synthetic_size=synthetic_size,
+                     **(dataset_kwargs or {}))
     if distribution is not None:
         rng = np.random.default_rng(seed)
         if np.ndim(ds.labels) > 1:

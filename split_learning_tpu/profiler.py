@@ -84,9 +84,13 @@ def profile_model(model_key: str, batch_size: int = 32,
     kw = dict(model_kwargs or {})
     if example is None:
         from split_learning_tpu.data import make_data_loader
-        from split_learning_tpu.runtime.validation import dataset_for_model
+        from split_learning_tpu.runtime.validation import (
+            dataset_for_model, dataset_kwargs_for_model,
+        )
         ds = make_data_loader(dataset_for_model(model_key), 1, train=False,
-                              synthetic_size=8)
+                              synthetic_size=8,
+                              dataset_kwargs=dataset_kwargs_for_model(
+                                  model_key, model_kwargs))
         x0, _ = next(iter(ds))
         arr = np.asarray(x0)
         example = jax.ShapeDtypeStruct((batch_size,) + arr.shape[1:],

@@ -425,11 +425,16 @@ class ProtocolClient:
                 "training full shard parameters instead")
         self.opt_state = self.runner.optimizer.init(self.trainable)
         if self.stage == 1 and msg.label_counts is not None:
+            from split_learning_tpu.runtime.validation import (
+                dataset_kwargs_for_model,
+            )
             self.loader = make_data_loader(
                 dataset_for_model(self.cfg.model_key),
                 self.runner.learning.batch_size,
                 distribution=np.asarray(msg.label_counts), train=True,
-                seed=self.cfg.seed, synthetic_size=self.cfg.synthetic_size)
+                seed=self.cfg.seed, synthetic_size=self.cfg.synthetic_size,
+                dataset_kwargs=dataset_kwargs_for_model(
+                    self.cfg.model_key, self.cfg.model_kwargs))
 
     def _on_syn(self, msg: Syn):
         self.log.info(f"[<<<] SYN round={msg.round_idx}")

@@ -46,7 +46,7 @@ from split_learning_tpu.parallel.pipeline import (
 from split_learning_tpu.runtime.plan import ClusterPlan
 from split_learning_tpu.runtime.protocol import Update
 from split_learning_tpu.runtime.validation import (
-    ValResult, dataset_for_model,
+    ValResult, dataset_for_model, dataset_kwargs_for_model,
 )
 
 
@@ -113,6 +113,8 @@ class MeshContext(TrainContext):
             cfg.model_key, **self.model_kwargs)
         self.specs = self.full_model.specs
         self.dataset = dataset_for_model(cfg.model_key)
+        self.dataset_kwargs = dataset_kwargs_for_model(
+            cfg.model_key, self.model_kwargs)
         self._step_cache: dict = {}
         self._loader_cache: dict = {}
         self._example = self._example_struct()
@@ -122,7 +124,8 @@ class MeshContext(TrainContext):
     def _example_struct(self) -> jax.ShapeDtypeStruct:
         mb = self.cfg.learning.batch_size
         ds = make_data_loader(self.dataset, 1, train=False,
-                              synthetic_size=self.cfg.synthetic_size or 64)
+                              synthetic_size=self.cfg.synthetic_size or 64,
+                              dataset_kwargs=self.dataset_kwargs)
         x, _ = next(iter(ds))
         arr = np.asarray(x)
         return jax.ShapeDtypeStruct((mb,) + arr.shape[1:], arr.dtype)
@@ -141,7 +144,8 @@ class MeshContext(TrainContext):
             self._loader_cache[key] = make_data_loader(
                 self.dataset, self.cfg.learning.batch_size,
                 distribution=np.asarray(label_counts), train=True,
-                seed=seed, synthetic_size=self.cfg.synthetic_size)
+                seed=seed, synthetic_size=self.cfg.synthetic_size,
+                dataset_kwargs=self.dataset_kwargs)
         return self._loader_cache[key]
 
     # params above this, on the CPU backend, force a 1-wide stage axis
@@ -342,6 +346,156 @@ class MeshContext(TrainContext):
 
     # -- the round ----------------------------------------------------------
 
+    def _drive_columns(self, step, loaders, c_phys, M, mb, epochs,
+                       round_idx, params_c, opt_c, stats_c, *,
+                       frozen_c=None):
+        """Feed host batches through the compiled step for ``epochs``.
+
+        Returns (params_c, opt_c, stats_c, loss_host, consumed):
+        device trees after the last step, the final per-column loss as a
+        host array (the round's NaN sentinel), and per-column DISTINCT
+        sample counts — data_count semantics (src/train/VGG16.py:109): a
+        loader shorter than the M-batch draw restarts mid-step, and
+        those redraws must not inflate the client's aggregation weight,
+        so each column is capped at its loader's own epoch (and dataset)
+        size.
+        """
+        steps_per_epoch = max(1, min(len(ld) for ld in loaders) // M)
+        rngs = jax.vmap(jax.random.key)(jnp.arange(c_phys)
+                                        + round_idx * 1000)
+        loss = None
+        consumed = np.zeros(c_phys, dtype=np.int64)
+        for i, ld in enumerate(loaders):
+            consumed[i] = epochs * min(steps_per_epoch * M * mb,
+                                       ld.samples_per_epoch,
+                                       len(ld.dataset))
+        for _ in range(epochs):
+            iters = [iter(ld) for ld in loaders]
+            for _ in range(steps_per_epoch):
+                xs, ys = [], []
+                for it_i, it in enumerate(iters):
+                    bx, by = [], []
+                    for _ in range(M):
+                        try:
+                            b = next(it)
+                        except StopIteration:
+                            it = iters[it_i] = iter(loaders[it_i])
+                            b = next(it)
+                        bx.append(np.asarray(b[0]))
+                        by.append(np.asarray(b[1]))
+                    xs.append(np.stack(bx))
+                    ys.append(np.stack(by))
+                x = jnp.asarray(np.stack(xs))
+                labels = jnp.asarray(np.stack(ys).astype(np.int32))
+                if frozen_c is not None:
+                    params_c, opt_c, stats_c, loss = step(
+                        frozen_c, params_c, opt_c, stats_c, x,
+                        labels, rngs)
+                else:
+                    params_c, opt_c, stats_c, loss = step(
+                        params_c, opt_c, stats_c, x, labels, rngs)
+        loss_h = (np.asarray(loss) if loss is not None
+                  else np.zeros(c_phys))
+        return params_c, opt_c, stats_c, loss_h, consumed
+
+    def train_cluster_resident(self, plan: ClusterPlan, params, stats, *,
+                               round_idx: int = 0, epochs: int = 1,
+                               lr: float | None = None,
+                               sync_all_later_stages: bool = False):
+        """Device-resident FedAvg round: params/optimizer/stats stay on
+        the mesh between rounds and the round barrier is the on-mesh
+        weighted ``fedavg_psum`` (:func:`make_fedavg_step`) — no
+        per-round host restack/upload/pull of the full model, which on a
+        tunneled chip dominates round wall-clock.  Numerically identical
+        to the host fold: stage-1 columns enter the weighted mean with
+        their own ``data_count``; sync-grouped later-stage columns hold
+        identical shards whose weights sum to the group weight.
+
+        Returns ``None`` when this plan needs the general host path
+        (parallel axes, LoRA, column chunking); otherwise a
+        ``RoundOutcome``-shaped namespace ``(params, stats, num_samples,
+        ok)`` whose trees are device-resident (checkpointing pulls them
+        once; ``validate`` consumes them in place).  Reuse across rounds
+        keys on the IDENTITY of the params tree returned last round — a
+        rollback or NaN skip in the round loop passes a different tree
+        and transparently rebuilds from host.
+        """
+        import types
+
+        if self._parallel_axis() is not None:
+            return None
+        if self.cfg.learning.lora_rank > 0:
+            return None
+        stage1 = plan.stage1_clients
+        if not stage1:
+            return None
+        c_phys, s_phys, cuts_phys = self._geometry(plan, len(stage1))
+        if len(stage1) > c_phys:
+            return None  # column chunking: host path interleaves chunks
+        counts = {c: plan.label_counts[plan.stage1_clients.index(c)]
+                  for c in stage1}
+        client_sync, sync_key = self._sync_map(
+            plan, c_phys, len(stage1), sync_all_later_stages)
+        mesh, pipe, optimizer, step = self._compiled(
+            plan, c_phys, s_phys, cuts_phys, lr, sync_key, client_sync)
+        M, mb = pipe.num_microbatches, pipe.mb_size
+
+        key = (plan.cluster_id, c_phys, s_phys, tuple(cuts_phys), lr,
+               sync_key, epochs)
+        cache = getattr(self, "_resident", None)
+        if (cache is not None and cache["key"] == key
+                and cache["token"] == id(params)):
+            params_c, stats_c = cache["params_c"], cache["stats_c"]
+            opt_init, fedavg, strip = (cache["opt_init"],
+                                       cache["fedavg"], cache["strip"])
+        else:
+            from split_learning_tpu.parallel.pipeline import (
+                make_fedavg_step,
+            )
+            params_c = shard_to_mesh(stack_for_clients(params, c_phys),
+                                     mesh)
+            stats_c = shard_to_mesh(stack_for_clients(stats, c_phys),
+                                    mesh)
+
+            def _opt_init(p_c):
+                p0 = jax.tree_util.tree_map(lambda a: a[0], p_c)
+                return stack_for_clients(optimizer.init(p0), c_phys)
+
+            opt_init = jax.jit(_opt_init)
+            fedavg = make_fedavg_step(mesh)
+            strip = jax.jit(
+                lambda t: jax.tree_util.tree_map(lambda a: a[0], t))
+            cache = {"key": key, "opt_init": opt_init, "fedavg": fedavg,
+                     "strip": strip}
+        # fresh optimizer state every round — the host path's semantics
+        # (optimizer.init per round); built ON DEVICE from the resident
+        # params, no host zeros upload
+        opt_c = shard_to_mesh(opt_init(params_c), mesh)
+
+        loaders = [self._loader(c, counts[c]) for c in stage1]
+        params_c, opt_c, stats_c, loss_h, consumed = self._drive_columns(
+            step, loaders, c_phys, M, mb, epochs, round_idx,
+            params_c, opt_c, stats_c)
+
+        if not np.all(np.isfinite(loss_h)):
+            # reference: any diverged client fails the whole round
+            # (src/Server.py:162-166); resident state is now garbage
+            self._resident = None
+            return types.SimpleNamespace(params=params, stats=stats,
+                                         num_samples=0, ok=False)
+
+        weights = jnp.asarray(np.maximum(consumed, 1).astype(np.float32))
+        avg_params_c = fedavg(params_c, weights)
+        avg_stats_c = fedavg(stats_c, weights)
+        ret_params = strip(avg_params_c)
+        ret_stats = strip(avg_stats_c)
+        cache.update(params_c=avg_params_c, stats_c=avg_stats_c,
+                     token=id(ret_params), ret=(ret_params, ret_stats))
+        self._resident = cache
+        return types.SimpleNamespace(params=ret_params, stats=ret_stats,
+                                     num_samples=int(consumed.sum()),
+                                     ok=True)
+
     def train_cluster(self, plan: ClusterPlan, params, stats, *,
                       round_idx: int = 0, epochs: int = 1,
                       client_subset: list | None = None,
@@ -403,48 +557,10 @@ class MeshContext(TrainContext):
                 frozen_c = shard_to_mesh(frozen_c, mesh)
 
             loaders = [self._loader(c, counts[c]) for c in cols]
-            steps_per_epoch = max(
-                1, min(len(ld) for ld in loaders) // M)
-            rngs = jax.vmap(jax.random.key)(jnp.arange(c_phys)
-                                            + round_idx * 1000)
-            loss = None
-            # data_count semantics (src/train/VGG16.py:109): FedAvg weights
-            # count DISTINCT samples consumed.  A loader shorter than the
-            # M-batch draw restarts mid-step, and those redraws must not
-            # inflate the client's aggregation weight — cap each column at
-            # its loader's own epoch (and dataset) size.
-            consumed = np.zeros(c_phys, dtype=np.int64)
-            for i, ld in enumerate(loaders):
-                consumed[i] = epochs * min(steps_per_epoch * M * mb,
-                                           ld.samples_per_epoch,
-                                           len(ld.dataset))
-            for _ in range(epochs):
-                iters = [iter(ld) for ld in loaders]
-                for _ in range(steps_per_epoch):
-                    xs, ys = [], []
-                    for it_i, it in enumerate(iters):
-                        bx, by = [], []
-                        for _ in range(M):
-                            try:
-                                b = next(it)
-                            except StopIteration:
-                                it = iters[it_i] = iter(loaders[it_i])
-                                b = next(it)
-                            bx.append(np.asarray(b[0]))
-                            by.append(np.asarray(b[1]))
-                        xs.append(np.stack(bx))
-                        ys.append(np.stack(by))
-                    x = jnp.asarray(np.stack(xs))
-                    labels = jnp.asarray(np.stack(ys).astype(np.int32))
-                    if use_lora:
-                        params_c, opt_c, stats_c, loss = step(
-                            frozen_c, params_c, opt_c, stats_c, x,
-                            labels, rngs)
-                    else:
-                        params_c, opt_c, stats_c, loss = step(
-                            params_c, opt_c, stats_c, x, labels, rngs)
-            loss_h = (np.asarray(loss) if loss is not None
-                      else np.zeros(c_phys))
+            params_c, opt_c, stats_c, loss_h, consumed = (
+                self._drive_columns(
+                    step, loaders, c_phys, M, mb, epochs, round_idx,
+                    params_c, opt_c, stats_c, frozen_c=frozen_c))
             if use_lora:
                 # bake adapters into dense weights per column before shard
                 # extraction (merge_and_unload parity)
@@ -479,8 +595,15 @@ class MeshContext(TrainContext):
                 batch_stats=shard_params(col_tree(stats_h, i), self.specs,
                                          a, b),
                 num_samples=int(consumed[i]), ok=ok))
-        # later stages: one update per sync group (columns in a group hold
-        # identical shard params by construction)
+        # later stages: one update per sync group.  Columns in a group
+        # hold identical shard PARAMS by construction (grouped gradient
+        # sync); their batch STATS diverge (each column normalizes its
+        # own batches), so the group's stats are their consumed-weighted
+        # mean — the closest emulation of the reference's one shared
+        # later-stage client seeing every feeder's batches
+        # (src/train/VGG16.py:154), and the same fold the on-mesh
+        # resident path computes.
+        from split_learning_tpu.ops.fedavg import fedavg_trees
         for s in range(2, len(ranges) + 1):
             a, b = ranges[s - 1]
             layer_names = [sp.name for sp in self.specs[a:b] if sp.make]
@@ -497,12 +620,18 @@ class MeshContext(TrainContext):
                 rep = real[0]
                 cid = logical[min(gi, len(logical) - 1)]
                 ok = bool(np.all(np.isfinite(loss_h[real])))
+                group_stats = shard_params(col_tree(stats_h, rep),
+                                           self.specs, a, b)
+                if group_stats and len(real) > 1:
+                    group_stats = fedavg_trees(
+                        [shard_params(col_tree(stats_h, i), self.specs,
+                                      a, b) for i in real],
+                        [max(1, int(consumed[i])) for i in real])
                 out.append(Update(
                     client_id=cid, stage=s, cluster=plan.cluster_id,
                     params=shard_params(col_tree(params_h, rep),
                                         self.specs, a, b),
-                    batch_stats=shard_params(col_tree(stats_h, rep),
-                                             self.specs, a, b),
+                    batch_stats=group_stats,
                     num_samples=int(consumed[real].sum()), ok=ok))
         return out
 
@@ -518,7 +647,8 @@ class MeshContext(TrainContext):
             model = build_model(self.cfg.model_key, **self.model_kwargs)
             loader = make_data_loader(
                 self.dataset, self.cfg.val_batch_size, train=False,
-                synthetic_size=self.cfg.synthetic_size)
+                synthetic_size=self.cfg.synthetic_size,
+                dataset_kwargs=self.dataset_kwargs)
             self._val_cache = (loader, make_eval_step(model, bool(stats)))
         loader, step = self._val_cache
         total_loss, total_correct, n = 0.0, 0, 0

@@ -64,6 +64,9 @@ class ProtocolContext(MeshContext):
     """
 
     clients_hold_state = True   # remote shards persist between rounds
+    # the in-process device-resident fast path MUST NOT hijack protocol
+    # rounds — training happens on remote clients, not the server's mesh
+    train_cluster_resident = None
 
     def __init__(self, cfg: Config, transport: Transport,
                  logger: Logger | None = None,

@@ -124,6 +124,23 @@ class FedAvgStrategy(RoundStrategy):
         return 1
 
     def run_round(self, ctx, plans, round_idx, params, stats):
+        if len(plans) == 1:
+            # device-resident fast path (MeshContext, plain FedAvg
+            # geometry): weights stay on the mesh between rounds, the
+            # round barrier is an on-mesh weighted psum — numerically
+            # the same fold, none of the per-round host<->device traffic
+            resident = getattr(ctx, "train_cluster_resident", None)
+            if resident is not None:
+                res = resident(
+                    plans[0], params, stats, round_idx=round_idx,
+                    epochs=self._epochs(), lr=self._lr(round_idx),
+                    sync_all_later_stages=self.sync_all_later_stages)
+                if res is not None:
+                    if not res.ok:
+                        return RoundOutcome(params, stats, ok=False,
+                                            validate=False)
+                    return RoundOutcome(res.params, res.stats,
+                                        num_samples=res.num_samples)
         cluster_params, cluster_stats = [], []
         total, ok = 0, True
         for plan in plans:

@@ -40,6 +40,26 @@ def dataset_for_model(model_key: str) -> str:
     return model_key.rsplit("_", 1)[-1]
 
 
+# datasets whose providers accept a ``vocab`` kwarg (token data)
+_TOKEN_DATASETS = {"TINYSTORIES", "AGNEWS", "EMOTION"}
+
+
+def dataset_kwargs_for_model(model_key: str,
+                             model_kwargs: dict | None) -> dict:
+    """Dataset-provider kwargs implied by the model's build kwargs.
+
+    A model with an overridden ``vocab_size`` must draw token ids inside
+    its own embedding table: out-of-range ids NaN-fill in ``nn.Embed``
+    (jnp.take fill mode), which surfaces as every round failing with
+    "NaN detected".  Threading the vocab here makes tiny-model YAMLs
+    valid end-to-end."""
+    mk = model_kwargs or {}
+    if (dataset_for_model(model_key) in _TOKEN_DATASETS
+            and mk.get("vocab_size")):
+        return {"vocab": int(mk["vocab_size"])}
+    return {}
+
+
 @dataclasses.dataclass
 class ValResult:
     loss: float
@@ -70,8 +90,10 @@ def evaluate(model_key: str, variables: dict, batch_size: int = 200,
     """Full-model test-set evaluation; ``variables`` holds host or device
     pytrees for params (+ batch_stats)."""
     model = build_model(model_key, **(model_kwargs or {}))
-    loader = make_data_loader(dataset_for_model(model_key), batch_size,
-                              train=False, synthetic_size=synthetic_size)
+    loader = make_data_loader(
+        dataset_for_model(model_key), batch_size, train=False,
+        synthetic_size=synthetic_size,
+        dataset_kwargs=dataset_kwargs_for_model(model_key, model_kwargs))
     step = make_eval_step(model, "batch_stats" in variables)
     total_loss = 0.0
     total_correct = 0

@@ -106,3 +106,37 @@ class TestProviders:
         tr = get_dataset("CIFAR10", train=True, synthetic_size=32)
         te = get_dataset("CIFAR10", train=False, synthetic_size=32)
         assert not np.array_equal(tr.inputs[:8], te.inputs[:8])
+
+
+class TestVocabPlumbing:
+    """A model with overridden vocab_size must draw in-range token ids —
+    out-of-range ids NaN-fill in nn.Embed (the bug: tiny-vocab llama
+    YAMLs failed every round with 'NaN detected')."""
+
+    def test_tinystories_vocab_kwarg_bounds_ids(self):
+        from split_learning_tpu.data import get_dataset
+        ds = get_dataset("TINYSTORIES", train=True, synthetic_size=16,
+                         vocab=128)
+        assert int(np.max(ds.inputs)) < 128
+        assert int(np.max(ds.labels)) < 128
+
+    def test_dataset_kwargs_for_model(self):
+        from split_learning_tpu.runtime.validation import (
+            dataset_kwargs_for_model,
+        )
+        assert dataset_kwargs_for_model(
+            "TinyLlama_TINYSTORIES", {"vocab_size": 128}) == {"vocab": 128}
+        assert dataset_kwargs_for_model(
+            "BERT_AGNEWS", {"vocab_size": 99}) == {"vocab": 99}
+        # image models and default-vocab models get no override
+        assert dataset_kwargs_for_model("VGG16_CIFAR10",
+                                        {"dtype": "x"}) == {}
+        assert dataset_kwargs_for_model("TinyLlama_TINYSTORIES", {}) == {}
+
+    def test_loader_threads_dataset_kwargs(self):
+        from split_learning_tpu.data import make_data_loader
+        ld = make_data_loader("TINYSTORIES", 4, train=True,
+                              synthetic_size=16,
+                              dataset_kwargs={"vocab": 64})
+        x, y = next(iter(ld))
+        assert int(np.max(x)) < 64 and int(np.max(y)) < 64

@@ -1,0 +1,141 @@
+"""Device-resident FedAvg rounds == the host-fold path, numerically.
+
+The resident fast path (``MeshContext.train_cluster_resident``) keeps
+weights on the mesh between rounds and aggregates with the on-mesh
+weighted psum; the host path restacks/uploads/pulls and folds on host.
+Same data, same step program — the histories and final trees must agree
+(psum vs host fold may reorder float adds, hence allclose, not equal).
+"""
+
+import numpy as np
+import pytest
+
+from split_learning_tpu.config import from_dict
+from split_learning_tpu.run import run_local
+from split_learning_tpu.runtime.context import MeshContext
+from split_learning_tpu.runtime.log import Logger
+
+TINY_KWT = {"embed_dim": 16, "num_heads": 2, "mlp_dim": 32}
+
+
+def _cfg(tmp_path, tag):
+    return from_dict(dict(
+        model="KWT", dataset="SPEECHCOMMANDS",
+        clients=[2, 1],              # shared stage-2: sync-group weights
+        global_rounds=2, synthetic_size=64, val_max_batches=2,
+        val_batch_size=16, compute_dtype="float32",
+        model_kwargs=TINY_KWT, log_path=str(tmp_path / f"logs_{tag}"),
+        learning={"batch_size": 4, "control_count": 2,
+                  "optimizer": "adamw", "learning_rate": 1e-3},
+        distribution={"num_samples": 16},
+        topology={"cut_layers": [2]},
+        checkpoint={"directory": str(tmp_path / f"ckpt_{tag}"),
+                    "save": False},
+    ))
+
+
+def test_resident_matches_host_fold(tmp_path, monkeypatch):
+    res_fast = run_local(_cfg(tmp_path, "fast"),
+                         logger=Logger(str(tmp_path / "lf"),
+                                       console=False))
+    # force the host path: resident reports ineligible
+    monkeypatch.setattr(MeshContext, "train_cluster_resident",
+                        lambda self, *a, **k: None)
+    res_slow = run_local(_cfg(tmp_path, "slow"),
+                         logger=Logger(str(tmp_path / "ls"),
+                                       console=False))
+
+    assert len(res_fast.history) == len(res_slow.history) == 2
+    for a, b in zip(res_fast.history, res_slow.history):
+        assert a.ok and b.ok
+        assert a.num_samples == b.num_samples
+        assert a.val_loss == pytest.approx(b.val_loss, rel=1e-4)
+        assert a.val_accuracy == pytest.approx(b.val_accuracy, abs=1e-6)
+
+    flat_f, _ = np.asarray, None
+    fast_leaves = [np.asarray(x) for x in
+                   __import__("jax").tree_util.tree_leaves(res_fast.params)]
+    slow_leaves = [np.asarray(x) for x in
+                   __import__("jax").tree_util.tree_leaves(res_slow.params)]
+    assert len(fast_leaves) == len(slow_leaves)
+    for fa, sl in zip(fast_leaves, slow_leaves):
+        np.testing.assert_allclose(fa, sl, rtol=2e-5, atol=2e-6)
+
+
+def test_extract_updates_group_stats_weighted_mean(tmp_path):
+    """Shared later-stage batch stats are the group's consumed-weighted
+    mean (not the representative column's), matching both the on-mesh
+    resident fold and the reference's one shared client seeing every
+    feeder's batches."""
+    from split_learning_tpu.runtime.plan import ClusterPlan
+
+    cfg = _cfg(tmp_path, "stats")
+    ctx = MeshContext(cfg)   # KWT specs: layers layer1..layerN, cut at 2
+    plan = ClusterPlan(cluster_id=0, cuts=[2],
+                       clients=[["c1", "c2", "c3"], ["h"]],
+                       label_counts=np.ones((3, 10), int), rejected=[])
+    n_layers = len(ctx.specs)
+    later_layer = ctx.specs[2].name       # first stage-2 layer
+    cols = ["c1", "c2", "c3"]
+    stacked = lambda *vals: np.asarray(vals, np.float32)  # noqa: E731
+    params_h = {later_layer: {"w": stacked(10.0, 20.0, 30.0)}}
+    stats_h = {later_layer: {"bn": {"mean": stacked(0.0, 1.0, 2.0)}}}
+    loss_h = np.zeros(3)
+    consumed = np.asarray([10, 30, 60])
+    client_sync = {ctx.specs[i].name: [[0, 1, 2]]
+                   for i in range(2, n_layers)}
+
+    ups = ctx._extract_updates(plan, cols, cols, params_h, stats_h,
+                               loss_h, consumed, client_sync)
+    stage2 = [u for u in ups if u.stage == 2]
+    assert len(stage2) == 1
+    u = stage2[0]
+    # params: representative column (identical across the group anyway)
+    assert u.params[later_layer]["w"] == pytest.approx(10.0)
+    # stats: (0*10 + 1*30 + 2*60) / 100
+    assert u.batch_stats[later_layer]["bn"]["mean"] == pytest.approx(1.5)
+    assert u.num_samples == 100
+
+
+def test_protocol_context_never_resident(tmp_path):
+    """ProtocolContext inherits from MeshContext; the resident fast path
+    must stay disabled there — protocol rounds train on REMOTE clients,
+    not the server's local mesh."""
+    from split_learning_tpu.runtime.bus import InProcTransport
+    from split_learning_tpu.runtime.server import ProtocolContext
+
+    cfg = _cfg(tmp_path, "proto")
+    ctx = ProtocolContext(cfg, transport=InProcTransport())
+    assert getattr(ctx, "train_cluster_resident") is None
+
+
+def test_resident_cache_reused_and_rebuilt(tmp_path):
+    """Round 2 reuses the device cache (token match); passing a copied
+    tree (rollback shape) transparently rebuilds and still trains."""
+    import jax
+
+    from split_learning_tpu.run import synthesize_registrations
+    from split_learning_tpu.runtime.plan import plan_clusters
+    from split_learning_tpu.runtime.strategies import make_strategy
+
+    cfg = _cfg(tmp_path, "cache")
+    ctx = MeshContext(cfg)
+    plans = plan_clusters(cfg, synthesize_registrations(cfg))
+    strategy = make_strategy(cfg)
+    variables = ctx.init_variables()
+    params, stats = variables["params"], variables.get("batch_stats", {})
+
+    out1 = strategy.run_round(ctx, plans, 0, params, stats)
+    assert out1.ok and ctx._resident is not None
+    tok1 = ctx._resident["token"]
+    assert tok1 == id(out1.params)
+
+    out2 = strategy.run_round(ctx, plans, 1, out1.params, out1.stats)
+    assert out2.ok
+    # cache advanced to round 2's result
+    assert ctx._resident["token"] == id(out2.params)
+
+    # a rollback passes a DIFFERENT tree object: must rebuild, not crash
+    copied = jax.tree_util.tree_map(np.asarray, out1.params)
+    out3 = strategy.run_round(ctx, plans, 2, copied, out1.stats)
+    assert out3.ok and out3.num_samples == out2.num_samples
