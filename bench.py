@@ -267,7 +267,11 @@ def measure_round() -> dict:
     on_cpu = jax.default_backend() == "cpu"
     rounds = 2 if on_cpu else 8
     ckpt = "/tmp/slt_bench_round"
+    logdir = "/tmp/slt_bench_round_logs"
     shutil.rmtree(ckpt, ignore_errors=True)
+    # fresh metrics sidecar: it appends, and the phase scan below must
+    # never pick up a previous invocation's record
+    shutil.rmtree(logdir, ignore_errors=True)
     # lr: the reference's default 5e-4 SGD moves a from-scratch 52-layer
     # VGG too slowly to show learning inside a bench budget (~100 steps);
     # 0.05 with momentum is the standard VGG/bs-256 operating point and
@@ -290,7 +294,7 @@ def measure_round() -> dict:
                      "learning-rate": 5e-4 if on_cpu else 0.05,
                      "momentum": 0.9},
         "checkpoint": {"directory": ckpt},
-        "log-path": "/tmp/slt_bench_round_logs",
+        "log-path": logdir,
     })
     t0 = time.perf_counter()
     # console=False: the round loop's progress lines would land on
@@ -300,10 +304,23 @@ def measure_round() -> dict:
     rec = result.history[-1]  # last round = steady state (no compile)
     acc_traj = [round(r.val_accuracy, 4) for r in result.history
                 if r.val_accuracy is not None]
+    # steady-round phase split (train/validate/checkpoint-wait) from the
+    # loop's metrics sidecar — makes the wall-clock auditable
+    phases = {}
+    try:
+        metrics = pathlib.Path(cfg.log_path) / "metrics.jsonl"
+        for line in metrics.read_text().splitlines():
+            rec_j = json.loads(line)
+            if rec_j.get("round_idx") == rounds - 1 and "phases" in rec_j:
+                phases = {k: round(v["total_s"], 2)
+                          for k, v in rec_j["phases"].items()}
+    except Exception:
+        pass
     return {
         "rounds": rounds,
         "total_wall_s_incl_compile": round(wall, 2),
         "steady_round_wall_s": round(rec.wall_s, 2),
+        "steady_round_phases_s": phases,
         "train_samples_per_round": rec.num_samples,
         "samples_per_sec": round(rec.num_samples / max(rec.wall_s, 1e-9), 1),
         "val_accuracy": rec.val_accuracy,

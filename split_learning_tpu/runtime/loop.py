@@ -11,6 +11,7 @@ round begins; resume loads the checkpoint and continues
 
 from __future__ import annotations
 
+import concurrent.futures
 import dataclasses
 import time
 from typing import Any
@@ -75,50 +76,70 @@ def run_training(cfg: Config, ctx: TrainContext,
     history: list[RoundRecord] = []
     timer = StepTimer()
     t_start = time.perf_counter()
-    for r in range(start_round, cfg.global_rounds):
-        t0 = time.perf_counter()
-        with timer.phase("train"):
-            outcome = strategy.run_round(ctx, plans, r, params, stats)
-        wall = time.perf_counter() - t0
-        rec = RoundRecord(round_idx=r, ok=outcome.ok,
-                          num_samples=outcome.num_samples, wall_s=wall)
-        if not outcome.ok:
-            logger.error(f"Round {r}: Training failed! "
-                         f"(NaN detected; aggregation skipped)")
-            history.append(rec)
-            logger.metric(**dataclasses.asdict(rec))
-            continue
-        prev_params, prev_stats = params, stats
-        params, stats = outcome.params, outcome.stats
-        if outcome.validate and cfg.checkpoint.validate:
-            with timer.phase("validate"):
-                val = ctx.validate(params, stats)
-            rec.val_loss, rec.val_accuracy = val.loss, val.accuracy
-            rec.ok = val.ok
-            logger.info(
-                f"Round {r}: samples={outcome.num_samples} "
-                f"val_loss={val.loss:.4f} val_acc={val.accuracy:.4f} "
-                f"({wall:.1f}s)", "green" if val.ok else "red")
-            if not val.ok:
-                # reference aborts on an exploded round
-                # (src/Server.py:185-187); keep the last good weights
-                # rather than training on from garbage
+    # one-slot async checkpoint writer: the save overlaps the next
+    # round's training instead of blocking the loop (params trees are
+    # immutable host/device arrays, safe to serialize from a thread);
+    # one slot bounds memory and keeps saves ordered
+    ck_pool = concurrent.futures.ThreadPoolExecutor(max_workers=1)
+    ck_future: concurrent.futures.Future | None = None
+    try:
+        for r in range(start_round, cfg.global_rounds):
+            t0 = time.perf_counter()
+            with timer.phase("train"):
+                outcome = strategy.run_round(ctx, plans, r, params, stats)
+            wall = time.perf_counter() - t0
+            rec = RoundRecord(round_idx=r, ok=outcome.ok,
+                              num_samples=outcome.num_samples, wall_s=wall)
+            if not outcome.ok:
                 logger.error(f"Round {r}: Training failed! "
-                             f"(validation loss exploded)")
-                params, stats = prev_params, prev_stats
-        else:
-            logger.info(f"Round {r}: samples={outcome.num_samples} "
-                        f"({wall:.1f}s)", "green")
-        if rec.ok and cfg.checkpoint.save:
-            with timer.phase("checkpoint"):
-                save_checkpoint(cfg.checkpoint.directory, cfg.model_key,
-                                params, stats, round_idx=r + 1)
-        history.append(rec)
-        logger.metric(**dataclasses.asdict(rec), phases=timer.summary())
-        timer.reset()
-        if cfg.limited_time and (time.perf_counter() - t_start
-                                 > cfg.limited_time):
-            logger.warning(f"Wall-clock budget {cfg.limited_time}s "
-                           f"exhausted at round {r}.")
-            break
+                             f"(NaN detected; aggregation skipped)")
+                history.append(rec)
+                logger.metric(**dataclasses.asdict(rec),
+                              phases=timer.summary())
+                timer.reset()  # don't leak this round's time onward
+                continue
+            prev_params, prev_stats = params, stats
+            params, stats = outcome.params, outcome.stats
+            if outcome.validate and cfg.checkpoint.validate:
+                with timer.phase("validate"):
+                    val = ctx.validate(params, stats)
+                rec.val_loss, rec.val_accuracy = val.loss, val.accuracy
+                rec.ok = val.ok
+                logger.info(
+                    f"Round {r}: samples={outcome.num_samples} "
+                    f"val_loss={val.loss:.4f} val_acc={val.accuracy:.4f} "
+                    f"({wall:.1f}s)", "green" if val.ok else "red")
+                if not val.ok:
+                    # reference aborts on an exploded round
+                    # (src/Server.py:185-187); keep the last good weights
+                    # rather than training on from garbage
+                    logger.error(f"Round {r}: Training failed! "
+                                 f"(validation loss exploded)")
+                    params, stats = prev_params, prev_stats
+            else:
+                logger.info(f"Round {r}: samples={outcome.num_samples} "
+                            f"({wall:.1f}s)", "green")
+            if rec.ok and cfg.checkpoint.save:
+                with timer.phase("checkpoint"):
+                    if ck_future is not None:
+                        ck_future.result()  # surface errors; keep order
+                    ck_future = ck_pool.submit(
+                        save_checkpoint, cfg.checkpoint.directory,
+                        cfg.model_key, params, stats, round_idx=r + 1)
+            history.append(rec)
+            logger.metric(**dataclasses.asdict(rec),
+                          phases=timer.summary())
+            timer.reset()
+            if cfg.limited_time and (time.perf_counter() - t_start
+                                     > cfg.limited_time):
+                logger.warning(f"Wall-clock budget {cfg.limited_time}s "
+                               f"exhausted at round {r}.")
+                break
+    finally:
+        # drain on EVERY exit: a crash mid-round must still surface a
+        # failed background save and join the worker thread (the
+        # protocol server calls run_training repeatedly in-process)
+        if ck_future is not None:
+            ck_future.result()  # the last checkpoint must be durable
+        ck_pool.shutdown(wait=True)
     return TrainResult(params=params, stats=stats, history=history)
