@@ -476,8 +476,10 @@ def _sec_llama(ctx: dict) -> dict:
                 if on_cpu else {})
     llama_kw.update(dtype_kw)
     # fused Pallas attention on real TPU when the kernel compiles here
-    # (CPU keeps the einsum path: the interpreter would dominate timing)
-    use_flash = (not on_cpu) and _flash_attention_compiles()
+    # (CPU keeps the einsum path: the interpreter would dominate timing;
+    # SLT_BENCH_NO_FLASH=1 forces einsum for A/B comparisons)
+    use_flash = (not on_cpu and not os.environ.get("SLT_BENCH_NO_FLASH")
+                 and _flash_attention_compiles())
     if use_flash:
         llama_kw["use_flash"] = True
     llama_cuts = [2, 3, 4] if on_cpu else [7, 13, 19]

@@ -34,6 +34,7 @@ def _cfg(tmp_path, tag):
     ))
 
 
+@pytest.mark.slow  # two full run_local deployments
 def test_resident_matches_host_fold(tmp_path, monkeypatch):
     res_fast = run_local(_cfg(tmp_path, "fast"),
                          logger=Logger(str(tmp_path / "lf"),
