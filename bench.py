@@ -477,7 +477,7 @@ def _sec_llama(ctx: dict) -> dict:
     llama_kw.update(dtype_kw)
     # fused Pallas attention on real TPU when the kernel compiles here
     # (CPU keeps the einsum path: the interpreter would dominate timing;
-    # SLT_BENCH_NO_FLASH=1 forces einsum for A/B comparisons)
+    # set SLT_BENCH_NO_FLASH — any value — to force einsum for A/B runs)
     use_flash = (not on_cpu and not os.environ.get("SLT_BENCH_NO_FLASH")
                  and _flash_attention_compiles())
     if use_flash:

@@ -232,3 +232,39 @@ def test_virtual_stages_match_full_mesh(eight_devices, n_stage_devs):
             jax.tree_util.tree_leaves_with_path(ref_p)):
         np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-6,
                                    err_msg=str(path))
+
+
+def test_wire_packing_roundtrip_pytree_boundary():
+    """_to_wire/_from_wire must be exact for multi-leaf pytree
+    boundaries with mixed dtypes (BERT's (hidden, bool mask) wire) and
+    pad to the widest boundary without corrupting narrower ones."""
+    pipe = PipelineModel(
+        "BERT_AGNEWS", cuts=[3],
+        example_input=jax.ShapeDtypeStruct((2, 16), jnp.int32),
+        num_microbatches=2,
+        model_kwargs=dict(hidden_size=32, num_heads=2,
+                          intermediate_size=64, vocab_size=128,
+                          max_position_embeddings=16, n_block=2))
+    rng = np.random.default_rng(0)
+    for struct in pipe.boundary:
+        leaves, treedef = jax.tree_util.tree_flatten(struct)
+        data = [
+            (rng.random(l.shape) < 0.5) if l.dtype == jnp.bool_
+            else rng.integers(0, 100, l.shape).astype(l.dtype)
+            if jnp.issubdtype(l.dtype, jnp.integer)
+            else rng.standard_normal(l.shape).astype(l.dtype)
+            for l in leaves
+        ]
+        tree = jax.tree_util.tree_unflatten(
+            treedef, [jnp.asarray(d) for d in data])
+        wire = pipe._to_wire(tree)
+        assert wire.shape == (leaves[0].shape[0], pipe.max_flat)
+        assert wire.dtype == pipe.wire_dtype
+        back = jax.tree_util.tree_unflatten(
+            treedef, jax.tree_util.tree_leaves(
+                pipe._from_wire(wire, struct)))
+        for orig, rt in zip(jax.tree_util.tree_leaves(tree),
+                            jax.tree_util.tree_leaves(back)):
+            assert orig.dtype == rt.dtype and orig.shape == rt.shape
+            np.testing.assert_array_equal(np.asarray(orig),
+                                          np.asarray(rt))
