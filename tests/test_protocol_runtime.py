@@ -117,6 +117,74 @@ def test_sda_strategy_over_protocol(tmp_path):
     assert result.history[0].num_samples > 0
 
 
+class _StartAuditTransport(InProcTransport):
+    """Records every Start message's target queue and a fingerprint of
+    its params payload (None when the Start ships no weights)."""
+
+    def __init__(self):
+        super().__init__()
+        self.starts: list = []   # (queue, params_fingerprint | None)
+
+    def publish(self, queue, payload):
+        from split_learning_tpu.runtime import protocol
+        try:
+            msg = protocol.decode(payload)
+            if type(msg).__name__ == "Start":
+                fp = None
+                if msg.params is not None:
+                    import hashlib
+                    h = hashlib.sha1()
+                    import jax
+                    for leaf in jax.tree_util.tree_leaves(msg.params):
+                        h.update(np.ascontiguousarray(leaf).tobytes())
+                    fp = h.hexdigest()
+                self.starts.append((queue, fp))
+        except Exception:
+            pass
+        super().publish(queue, payload)
+
+
+def test_relay_strategy_over_protocol(tmp_path):
+    """Vanilla_SL sequential relay over the protocol backend: stage-1
+    clients train ONE AT A TIME (client_subset START cycles), the later
+    stage trains continuously, final later-stage FedAvg
+    (other/Vanilla_SL/src/Server.py:130-146)."""
+    bus = _StartAuditTransport()
+    cfg = proto_cfg(tmp_path, clients=[2, 1], global_rounds=2,
+                    aggregation={"strategy": "relay"})
+    result = run_deployment(cfg, lambda: bus, bus)
+    assert len(result.history) == 2
+    for rec in result.history:
+        assert rec.ok
+        assert rec.num_samples > 0
+    # the discriminator vs concurrent FedAvg: relay runs one
+    # train_cluster per stage-1 client, each STARTing that client plus
+    # the stage-2 head -> 2 clients x 2 STARTs x 2 rounds = 8 (FedAvg
+    # would START all three once per round = 6)
+    assert len(bus.starts) == 8, [q for q, _ in bus.starts]
+
+
+def test_cluster_relay_strategy_over_protocol(tmp_path):
+    """Cluster_FSL cluster-sequential relay over the protocol backend:
+    clusters train in sequence and cluster i's aggregated weights seed
+    cluster i+1 (other/Cluster_FSL/src/Server.py:151-167)."""
+    bus = _StartAuditTransport()
+    cfg = proto_cfg(tmp_path, clients=[2, 2],
+                    topology={"cut_layers": [2], "num_clusters": 2},
+                    aggregation={"strategy": "cluster_relay"})
+    result = run_deployment(cfg, lambda: bus, bus)
+    assert result.history[0].ok
+    assert result.history[0].num_samples > 0
+    # seeding discriminator: the second cluster's stage-1 START must
+    # carry DIFFERENT weights from the first cluster's (trained carry);
+    # concurrent FedAvg would seed both clusters with identical params
+    s1_fps = [fp for q, fp in bus.starts
+              if q.endswith(("client_1_0", "client_1_1")) and fp]
+    assert len(s1_fps) == 2
+    assert s1_fps[0] != s1_fps[1], (
+        "second cluster was not seeded by the first cluster's result")
+
+
 class _RecordingTransport(InProcTransport):
     """Decodes every published control message to audit weight traffic."""
 
