@@ -1,1 +1,10 @@
-"""Numerical ops: aggregation, pipelined collectives, attention kernels."""
+"""Numerical ops: aggregation, attention kernels, LoRA adapters."""
+
+from split_learning_tpu.ops.fedavg import fedavg_psum, fedavg_trees
+from split_learning_tpu.ops.flash_attention import flash_attention
+from split_learning_tpu.ops.lora import lora_init, lora_merge, split_frozen
+
+__all__ = [
+    "fedavg_psum", "fedavg_trees", "flash_attention",
+    "lora_init", "lora_merge", "split_frozen",
+]

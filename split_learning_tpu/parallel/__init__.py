@@ -11,10 +11,17 @@ from split_learning_tpu.parallel.sequence import (
 from split_learning_tpu.parallel.tensor import (
     make_tp_train_step, shard_params_tp, tp_shardings, tp_spec,
 )
+from split_learning_tpu.parallel.expert import (
+    make_ep_train_step, shard_params_ep,
+)
+from split_learning_tpu.parallel.zero import (
+    adamw_bf16_states, init_zero1_opt_state, make_zero1_train_step,
+)
 
 __all__ = [
     "make_mesh", "stage_ranges", "PipelineModel", "make_train_step",
     "make_fedavg_step", "ring_attention", "ulysses_attention",
     "make_ring_attention_fn", "make_tp_train_step", "shard_params_tp",
-    "tp_shardings", "tp_spec",
+    "tp_shardings", "tp_spec", "make_ep_train_step", "shard_params_ep",
+    "adamw_bf16_states", "init_zero1_opt_state", "make_zero1_train_step",
 ]
