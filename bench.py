@@ -491,14 +491,37 @@ def _sec_llama(ctx: dict) -> dict:
     # config (VERDICT r2 item 3).
     from split_learning_tpu.parallel.zero import adamw_bf16_states
     opt = adamw_bf16_states(1e-4)
-    sps, _ = _measure_pipe_step(
-        "TinyLlama_TINYSTORIES", llama_cuts, (seq,), jnp.int32,
-        lb, 4, max(1, steps // 2), opt,
-        model_kwargs=llama_kw, label_shape=(seq,), n_classes=vocab,
-        n_vocab=vocab)
+    # OOM ladder: the full geometry has never fit-checked on this chip
+    # generation; rather than lose the section to RESOURCE_EXHAUSTED,
+    # step down batch then sequence, reporting what actually ran
+    ladder = [(lb, seq)] if on_cpu else [(lb, seq), (1, seq),
+                                         (1, seq // 2)]
+    last_err = None
+    for lb_try, seq_try in ladder:
+        try:
+            sps, _ = _measure_pipe_step(
+                "TinyLlama_TINYSTORIES", llama_cuts, (seq_try,),
+                jnp.int32, lb_try, 4, max(1, steps // 2), opt,
+                model_kwargs=llama_kw, label_shape=(seq_try,),
+                n_classes=vocab, n_vocab=vocab)
+            lb, seq = lb_try, seq_try
+            break
+        except Exception as e:
+            # only a capacity failure steps the ladder down; anything
+            # else (compile bug, lowering error) must surface loudly
+            is_oom = (isinstance(e, MemoryError)
+                      or "RESOURCE_EXHAUSTED" in str(e))
+            if not is_oom:
+                raise
+            log(f"[bench] llama geometry (mb={lb_try}, seq={seq_try}) "
+                f"OOM; stepping down")
+            last_err = e
+    else:
+        raise last_err
     log(f"[bench] TinyLlama 4-stage: {sps * seq:.0f} tokens/s "
         f"({'pallas flash' if use_flash else 'einsum'} attention)")
     return {"tokens_per_sec": round(sps * seq, 1), "seq_len": seq,
+            "microbatch": lb,
             "attention": ("pallas flash" if use_flash else "xla einsum"),
             "optimizer": "adamw (bf16 moments; ZeRO-1 shards states "
                          "across the client axis when clients > 1)",
