@@ -307,6 +307,7 @@ def measure_round() -> dict:
     # steady-round phase split (train/validate/checkpoint-wait) from the
     # loop's metrics sidecar — makes the wall-clock auditable
     phases = {}
+    train_detail = {}
     try:
         metrics = pathlib.Path(cfg.log_path) / "metrics.jsonl"
         for line in metrics.read_text().splitlines():
@@ -314,6 +315,7 @@ def measure_round() -> dict:
             if rec_j.get("round_idx") == rounds - 1 and "phases" in rec_j:
                 phases = {k: round(v["total_s"], 2)
                           for k, v in rec_j["phases"].items()}
+                train_detail = rec_j.get("train_detail", {})
     except Exception:
         pass
     return {
@@ -321,6 +323,7 @@ def measure_round() -> dict:
         "total_wall_s_incl_compile": round(wall, 2),
         "steady_round_wall_s": round(rec.wall_s, 2),
         "steady_round_phases_s": phases,
+        "steady_round_train_detail_s": train_detail,
         "train_samples_per_round": rec.num_samples,
         "samples_per_sec": round(rec.num_samples / max(rec.wall_s, 1e-9), 1),
         "val_accuracy": rec.val_accuracy,

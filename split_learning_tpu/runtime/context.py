@@ -27,6 +27,7 @@ interface.
 from __future__ import annotations
 
 import math
+import time
 import zlib
 
 import jax
@@ -348,7 +349,7 @@ class MeshContext(TrainContext):
 
     def _drive_columns(self, step, loaders, c_phys, M, mb, epochs,
                        round_idx, params_c, opt_c, stats_c, *,
-                       frozen_c=None):
+                       frozen_c=None, timings: dict | None = None):
         """Feed host batches through the compiled step for ``epochs``.
 
         Returns (params_c, opt_c, stats_c, loss_host, consumed):
@@ -359,6 +360,11 @@ class MeshContext(TrainContext):
         those redraws must not inflate the client's aggregation weight,
         so each column is capped at its loader's own epoch (and dataset)
         size.
+
+        ``timings``, when given, accumulates wall-clock attribution:
+        ``host_data_s`` (batch build + host->device handoff),
+        ``dispatch_s`` (async step-call returns), ``device_sync_s``
+        (final loss fetch — absorbs queued device execution).
         """
         steps_per_epoch = max(1, min(len(ld) for ld in loaders) // M)
         rngs = jax.vmap(jax.random.key)(jnp.arange(c_phys)
@@ -369,9 +375,11 @@ class MeshContext(TrainContext):
             consumed[i] = epochs * min(steps_per_epoch * M * mb,
                                        ld.samples_per_epoch,
                                        len(ld.dataset))
+        t_data = t_dispatch = 0.0
         for _ in range(epochs):
             iters = [iter(ld) for ld in loaders]
             for _ in range(steps_per_epoch):
+                t0 = time.perf_counter()
                 xs, ys = [], []
                 for it_i, it in enumerate(iters):
                     bx, by = [], []
@@ -387,6 +395,7 @@ class MeshContext(TrainContext):
                     ys.append(np.stack(by))
                 x = jnp.asarray(np.stack(xs))
                 labels = jnp.asarray(np.stack(ys).astype(np.int32))
+                t1 = time.perf_counter()
                 if frozen_c is not None:
                     params_c, opt_c, stats_c, loss = step(
                         frozen_c, params_c, opt_c, stats_c, x,
@@ -394,8 +403,16 @@ class MeshContext(TrainContext):
                 else:
                     params_c, opt_c, stats_c, loss = step(
                         params_c, opt_c, stats_c, x, labels, rngs)
+                t2 = time.perf_counter()
+                t_data += t1 - t0
+                t_dispatch += t2 - t1
+        t3 = time.perf_counter()
         loss_h = (np.asarray(loss) if loss is not None
                   else np.zeros(c_phys))
+        if timings is not None:
+            timings["host_data_s"] = round(t_data, 3)
+            timings["dispatch_s"] = round(t_dispatch, 3)
+            timings["device_sync_s"] = round(time.perf_counter() - t3, 3)
         return params_c, opt_c, stats_c, loss_h, consumed
 
     def train_cluster_resident(self, plan: ClusterPlan, params, stats, *,
@@ -472,10 +489,11 @@ class MeshContext(TrainContext):
         # params, no host zeros upload
         opt_c = shard_to_mesh(opt_init(params_c), mesh)
 
+        timings: dict = {}
         loaders = [self._loader(c, counts[c]) for c in stage1]
         params_c, opt_c, stats_c, loss_h, consumed = self._drive_columns(
             step, loaders, c_phys, M, mb, epochs, round_idx,
-            params_c, opt_c, stats_c)
+            params_c, opt_c, stats_c, timings=timings)
 
         if not np.all(np.isfinite(loss_h)):
             # reference: any diverged client fails the whole round
@@ -484,17 +502,19 @@ class MeshContext(TrainContext):
             return types.SimpleNamespace(params=params, stats=stats,
                                          num_samples=0, ok=False)
 
+        t0 = time.perf_counter()
         weights = jnp.asarray(np.maximum(consumed, 1).astype(np.float32))
         avg_params_c = fedavg(params_c, weights)
         avg_stats_c = fedavg(stats_c, weights)
         ret_params = strip(avg_params_c)
         ret_stats = strip(avg_stats_c)
+        timings["fedavg_dispatch_s"] = round(time.perf_counter() - t0, 3)
         cache.update(params_c=avg_params_c, stats_c=avg_stats_c,
                      token=id(ret_params), ret=(ret_params, ret_stats))
         self._resident = cache
         return types.SimpleNamespace(params=ret_params, stats=ret_stats,
                                      num_samples=int(consumed.sum()),
-                                     ok=True)
+                                     ok=True, timings=timings)
 
     def train_cluster(self, plan: ClusterPlan, params, stats, *,
                       round_idx: int = 0, epochs: int = 1,

@@ -128,7 +128,9 @@ def run_training(cfg: Config, ctx: TrainContext,
                         cfg.model_key, params, stats, round_idx=r + 1)
             history.append(rec)
             logger.metric(**dataclasses.asdict(rec),
-                          phases=timer.summary())
+                          phases=timer.summary(),
+                          **({"train_detail": outcome.metrics}
+                             if outcome.metrics else {}))
             timer.reset()
             if cfg.limited_time and (time.perf_counter() - t_start
                                      > cfg.limited_time):

@@ -139,8 +139,10 @@ class FedAvgStrategy(RoundStrategy):
                     if not res.ok:
                         return RoundOutcome(params, stats, ok=False,
                                             validate=False)
-                    return RoundOutcome(res.params, res.stats,
-                                        num_samples=res.num_samples)
+                    return RoundOutcome(
+                        res.params, res.stats,
+                        num_samples=res.num_samples,
+                        metrics=getattr(res, "timings", {}) or {})
         cluster_params, cluster_stats = [], []
         total, ok = 0, True
         for plan in plans:
