@@ -42,8 +42,13 @@ on a tiny matmul).  So:
   are kept; the accelerator is re-probed, and if it stays wedged the
   remaining sections fall back to CPU (clearly marked) instead of
   losing the artifact.
-* probe/attempt history and any mid-bench fallback are recorded under
-  ``extra.reliability`` so the record is auditable.
+* after the plan lands (artifact safe), a LATE RECOVERY pass re-probes
+  a tunnel that had forced any CPU fallback — wedges often clear in
+  minutes — and re-runs the lost sections on silicon, replacing their
+  CPU stand-ins (one watchdogged attempt each; a fresh wedge aborts).
+* probe/attempt history, any mid-bench fallback, and the late-recovery
+  outcome are recorded under ``extra.reliability`` so the record is
+  auditable.
 
 Timing note: every measurement syncs by FETCHING a device value, not
 ``block_until_ready`` — on tunneled backends block_until_ready can
@@ -366,12 +371,13 @@ def _sec_mfu(ctx: dict) -> dict:
     if flops_step and sps and mb:
         tflops = flops_step * sps / mb / 1e12
         mfu["headline_tflops"] = round(tflops, 1)
-        if peak:
-            mfu["mfu_vs_datasheet"] = round(tflops / peak, 3)
         if ctx.get("headline_backend") in (None, jax.default_backend()):
-            # only meaningful against a roofline measured on the SAME
-            # backend as the headline (mid-bench wedge -> CPU fallback
-            # would otherwise divide TPU tflops by a CPU roofline)
+            # both denominators (datasheet peak for THIS device_kind,
+            # this backend's measured roofline) describe the headline's
+            # silicon only when the headline ran on the same backend —
+            # a wedge fallback or late recovery can split the two
+            if peak:
+                mfu["mfu_vs_datasheet"] = round(tflops / peak, 3)
             mfu["frac_of_measured_roofline"] = round(tflops / roofline, 3)
     log(f"[bench] MFU: {mfu}")
     return mfu
@@ -754,20 +760,98 @@ def run_plan(plan, ctx, mode, reliability, cfgs, extra,
             target = cfgs if name in CFG_SECTIONS else extra
             target[name] = {"error": err}
             continue
-        result = payload["result"]
-        results[name] = result
-        if name == "headline":
-            ctx["headline"] = result
-            ctx["headline_backend"] = payload.get("backend")
+        result = _store_result(name, payload, ctx, results, cfgs, extra)
         if payload.get("backend") == "cpu" and mode == "tpu":
             result["fallback"] = "cpu (mid-bench wedge)"
-        if name in CFG_SECTIONS:
-            cfgs[name] = result
-        elif name == "headline":
-            pass  # reported as the top-level metric
-        else:
-            extra[name] = result
     return results
+
+
+def _store_result(name, payload, ctx, results, cfgs, extra) -> dict:
+    """Route one section's result into the artifact maps (shared by
+    run_plan and late_recovery_pass so the two paths cannot drift)."""
+    result = payload["result"]
+    results[name] = result
+    if name == "headline":
+        ctx["headline"] = result
+        ctx["headline_backend"] = payload.get("backend")
+        # a wedged first attempt may have left {"error": ...} here
+        extra.pop("headline", None)
+    if name in CFG_SECTIONS:
+        cfgs[name] = result
+    elif name != "headline":
+        extra[name] = result
+    return result
+
+
+def _late_probe_plan() -> list[tuple[float, float]]:
+    if os.environ.get("SLT_BENCH_FAST_PROBE"):  # test hook
+        return [(20, 0)]
+    return [(120, 0), (180, 120)]
+
+
+def late_recovery_pass(plan, ctx, results, reliability, cfgs, extra,
+                       runner=None, prober=None) -> None:
+    """One last chance at silicon after a CPU fallback.
+
+    Tunnel wedges often clear within minutes, but by then the plan has
+    moved on: a mid-bench wedge sends the remaining sections to CPU,
+    and a dead tunnel at startup sends the WHOLE run to CPU (the round-2
+    artifact).  Once the CPU pass has landed (the artifact is safe
+    whatever happens next), re-probe once and re-run the lost sections
+    on the TPU, replacing their CPU stand-ins.  Bounded: one probe plan,
+    one watchdogged attempt per section, no retries — and a fresh wedge
+    aborts the pass, keeping the CPU numbers already recorded.
+    """
+    runner = runner or run_section
+    prober = prober or probe_accelerator
+    names = [n for n, _ in plan]
+    start = reliability.get("midbench_fallback_at")
+    if start in names:
+        lost = plan[names.index(start):]
+    elif extra.get("tpu_unreachable"):
+        lost = list(plan)
+    else:
+        return
+    ok, kind = prober(_late_probe_plan(), reliability["probe_history"])
+    rec = reliability["late_recovery"] = {
+        "probed_ok": ok, "recovered": [], "failed": []}
+    if not ok:
+        return
+    log("[bench] accelerator recovered late; re-running "
+        f"{len(lost)} CPU-fallback section(s) on {kind}")
+    ctx["mode"] = "tpu"
+    for name, timeout in lost:
+        payload, err = runner(name, timeout, ctx)
+        if err is not None:
+            rec["failed"].append({"section": name, "error": err})
+            log(f"[bench] late recovery {name}: {err}")
+            if "watchdog" in err:
+                break  # wedged again: stop, keep the CPU numbers
+            continue
+        if payload.get("backend") == "cpu":
+            # the accelerator detached between probe and child start:
+            # every further re-run would be wasted CPU work — stop
+            rec["failed"].append({"section": name,
+                                  "error": "child ran on cpu"})
+            break
+        rec["recovered"].append(name)
+        _store_result(name, payload, ctx, results, cfgs, extra)
+    if rec["recovered"]:
+        # every lost section is now either a silicon number or tagged:
+        # relabeling the record (chip name, unreachable flag) must not
+        # let an unrecovered CPU stand-in read as a TPU measurement
+        recovered = set(rec["recovered"])
+        for name, _ in lost:
+            stale = results.get(name)
+            if name not in recovered and isinstance(stale, dict):
+                stale.setdefault("fallback", "cpu (late recovery "
+                                             "incomplete)")
+        extra["chip"] = kind
+        extra.pop("tpu_unreachable", None)
+        extra["late_recovery"] = True
+    # let main()'s CPU-headline rescue still fire if headline is missing
+    if "headline" not in results:
+        ctx["mode"] = "cpu"
 
 
 def main():
@@ -799,15 +883,17 @@ def main():
     cfgs: dict = {}
     extra["configs"] = cfgs
     results = run_plan(SECTION_PLAN, ctx, mode, reliability, cfgs, extra)
+    late_recovery_pass(SECTION_PLAN, ctx, results, reliability, cfgs,
+                       extra)
 
     if "headline" not in results and ctx["mode"] == "cpu" and mode == "tpu":
         # the headline IS the top-level metric: if its TPU run wedged,
         # still land a (clearly-marked) CPU number rather than nothing
         payload, err = run_section("headline", 900, ctx)
         if err is None:
-            results["headline"] = payload["result"]
-            results["headline"]["fallback"] = "cpu (headline wedged)"
-            ctx["headline"] = payload["result"]
+            result = _store_result("headline", payload, ctx, results,
+                                   cfgs, extra)
+            result["fallback"] = "cpu (headline wedged)"
         else:
             log(f"[bench] headline CPU retry failed: {err}")
 

@@ -163,6 +163,109 @@ def test_non_watchdog_error_is_recorded_without_fallback():
     assert ctx["mode"] == "tpu" and "midbench_fallback_at" not in rel
 
 
+def _late(script, verdicts, plan, reliability, ctx=None, extra=None):
+    ctx = ctx if ctx is not None else {"mode": "cpu"}
+    cfgs = {}
+    extra = extra if extra is not None else {}
+    results = {}
+    runner = _fake_runner(script)
+    bench.late_recovery_pass(plan, ctx, results, reliability, cfgs, extra,
+                             runner=runner, prober=_fake_prober(verdicts))
+    return results, ctx, cfgs, extra, runner
+
+
+def test_late_recovery_reruns_lost_tail_on_tpu():
+    # headline landed on TPU, wedge at `round` sent the tail to CPU;
+    # the tunnel recovered by the end -> tail re-runs on silicon
+    plan = [("headline", 1), ("round", 1), ("mfu", 1)]
+    rel = {"probe_history": [], "midbench_fallback_at": "round"}
+    script = {"round": [{"rounds": 3}], "mfu": [{"tflops": 2.0}]}
+    results, ctx, _, extra, runner = _late(script, [True], plan, rel)
+    assert runner.calls == [("round", "tpu"), ("mfu", "tpu")]
+    assert rel["late_recovery"]["recovered"] == ["round", "mfu"]
+    assert results["round"] == {"rounds": 3}
+    assert extra["round"] == {"rounds": 3} and extra["mfu"] == {
+        "tflops": 2.0}
+    assert extra["late_recovery"] is True
+
+
+def test_late_recovery_rescues_fully_unreachable_run():
+    # round-2 scenario: TPU dead at startup, whole plan ran on CPU;
+    # the tunnel recovered by the end -> everything re-runs, the
+    # unreachable flag clears, and the chip name is corrected
+    plan = [("headline", 1), ("round", 1)]
+    rel = {"probe_history": []}
+    extra = {"tpu_unreachable": True, "chip": "cpu"}
+    script = {"headline": [{"samples_per_sec": 9.0, "batch": 2}],
+              "round": [{"rounds": 1}]}
+    results, ctx, _, extra, runner = _late(script, [True], plan, rel,
+                                           extra=extra)
+    assert [n for n, _ in runner.calls] == ["headline", "round"]
+    assert results["headline"]["samples_per_sec"] == 9.0
+    assert ctx["headline"]["samples_per_sec"] == 9.0
+    assert "tpu_unreachable" not in extra
+    assert extra["chip"] == "TPU fake"
+    assert ctx["mode"] == "tpu"
+
+
+def test_late_recovery_partial_tags_unrecovered_cpu_standins():
+    # whole run fell to CPU; late pass recovers headline but round's
+    # re-run fails -> round's CPU stand-in must be TAGGED, the stale
+    # headline error record cleared, and the chip relabel still honest
+    plan = [("headline", 1), ("round", 1)]
+    rel = {"probe_history": []}
+    extra = {"tpu_unreachable": True, "chip": "cpu",
+             "headline": {"error": "watchdog: old wedge"},
+             "round": {"rounds": 1, "acc": 0.5}}
+    ctx = {"mode": "cpu"}
+    results = {"round": extra["round"]}
+    script = {"headline": [{"samples_per_sec": 9.0, "batch": 2}],
+              "round": ["rc=1 after 2.0s"]}
+    runner = _fake_runner(script)
+    bench.late_recovery_pass(plan, ctx, results, rel, {}, extra,
+                             runner=runner, prober=_fake_prober([True]))
+    assert rel["late_recovery"]["recovered"] == ["headline"]
+    assert rel["late_recovery"]["failed"] == [
+        {"section": "round", "error": "rc=1 after 2.0s"}]
+    # stale headline error record replaced by the recovery
+    assert "headline" not in extra
+    # the CPU round numbers survive but cannot read as TPU
+    assert extra["round"]["fallback"] == "cpu (late recovery incomplete)"
+    assert extra["chip"] == "TPU fake"
+    assert "tpu_unreachable" not in extra
+
+
+def test_late_recovery_probe_failure_keeps_cpu_numbers():
+    plan = [("headline", 1)]
+    rel = {"probe_history": [], "midbench_fallback_at": "headline"}
+    script = {"headline": [{"samples_per_sec": 9.0}]}
+    results, ctx, _, extra, runner = _late(script, [False], plan, rel)
+    assert runner.calls == []  # never touched the sections
+    assert rel["late_recovery"] == {"probed_ok": False, "recovered": [],
+                                    "failed": []}
+    assert results == {} and "late_recovery" not in extra
+
+
+def test_late_recovery_aborts_on_fresh_wedge():
+    plan = [("round", 1), ("mfu", 1)]
+    rel = {"probe_history": [], "midbench_fallback_at": "round"}
+    script = {"round": ["watchdog: wedged again"],
+              "mfu": [{"tflops": 2.0}]}
+    results, ctx, _, extra, runner = _late(script, [True], plan, rel)
+    # aborted after the wedge: mfu never re-ran, CPU numbers stand
+    assert runner.calls == [("round", "tpu")]
+    assert rel["late_recovery"]["failed"] == [
+        {"section": "round", "error": "watchdog: wedged again"}]
+    assert results == {} and "late_recovery" not in extra
+
+
+def test_late_recovery_noop_without_fallback():
+    rel = {"probe_history": []}
+    results, ctx, _, extra, runner = _late(
+        {"headline": [{"x": 1}]}, [True], [("headline", 1)], rel)
+    assert runner.calls == [] and "late_recovery" not in rel
+
+
 def test_real_watchdog_kills_wedged_section(monkeypatch):
     monkeypatch.setenv("SLT_BENCH_SECTION_TIMEOUT", "3")
     monkeypatch.setenv("JAX_PLATFORMS", "cpu")
