@@ -54,11 +54,11 @@ def test_identical_runs_identical_histories(tmp_path):
 
 
 def test_consumed_counts_distinct_samples_only(tmp_path):
-    """8 samples/client, batch 4, control_count (M) 4: each step draws
-    16 samples from an 8-sample loader — the loader wraps, and the
-    update weight must still be 8 (distinct), not 16 (drawn)."""
-    cfg = tiny_cfg(tmp_path, "c", distribution={"num_samples": 8},
-                   learning={"batch_size": 4, "control_count": 4})
+    """4 samples/client, batch 4, control_count (M) 3: each step draws
+    12 samples from a 4-sample loader — the loader wraps twice over, and
+    the update weight must still be 4 (distinct), not 12 (drawn)."""
+    cfg = tiny_cfg(tmp_path, "c", distribution={"num_samples": 4},
+                   learning={"batch_size": 4, "control_count": 3})
     regs = synthesize_registrations(cfg)
     plans = plan_clusters(cfg, regs)
     ctx = MeshContext(cfg)
@@ -72,5 +72,5 @@ def test_consumed_counts_distinct_samples_only(tmp_path):
     stage1 = [u for u in updates if u.stage == 1]
     assert stage1
     for u in stage1:
-        assert u.num_samples == 8, (
-            f"{u.client_id}: counted {u.num_samples}, expected 8 distinct")
+        assert u.num_samples == 4, (
+            f"{u.client_id}: counted {u.num_samples}, expected 4 distinct")

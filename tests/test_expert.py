@@ -171,8 +171,11 @@ class TestMoEThroughPipeline:
         from split_learning_tpu.parallel.mesh import make_mesh
 
         mb, M = 2, 2
+        # one MoE block (the router lives in stage 1 either way): this
+        # test compiles TWO full pipeline programs (aux weight is
+        # static), so model size directly doubles its wall-clock
         kw = dict(vocab_size=64, hidden_size=16, num_heads=2,
-                  num_kv_heads=2, intermediate_size=32, n_block=2,
+                  num_kv_heads=2, intermediate_size=32, n_block=1,
                   num_experts=4, k=1)
         struct = jax.ShapeDtypeStruct((mb, 8), jnp.int32)
         pipe = PipelineModel("TinyLlamaMoE_TINYSTORIES", [2], struct,
