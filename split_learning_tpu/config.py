@@ -186,15 +186,17 @@ class TransportConfig:
     port: int = 5672
     # Activation/gradient float payload dtype on the data-plane wire.
     # float16/bfloat16 halve the per-hop bytes (the reference always
-    # ships fp32 pickles, src/train/VGG16.py:27); control-plane weights
-    # (START/UPDATE) always travel full precision.
-    wire_dtype: str = "float32"     # float32 | float16 | bfloat16
+    # ships fp32 pickles, src/train/VGG16.py:27); int8 absmax-quantizes
+    # each payload leaf for ~4x (runtime/protocol.py QuantLeaf);
+    # control-plane weights (START/UPDATE) always travel full precision.
+    wire_dtype: str = "float32"     # float32 | float16 | bfloat16 | int8
 
     def validate(self):
         _check(self.kind in ("inproc", "tcp"),
                f"transport must be inproc|tcp, got {self.kind!r}")
-        _check(self.wire_dtype in ("float32", "float16", "bfloat16"),
-               f"wire-dtype must be float32|float16|bfloat16, "
+        _check(self.wire_dtype in ("float32", "float16", "bfloat16",
+                                   "int8"),
+               f"wire-dtype must be float32|float16|bfloat16|int8, "
                f"got {self.wire_dtype!r}")
 
 

@@ -52,8 +52,8 @@ from split_learning_tpu.runtime.bus import Transport, make_transport
 from split_learning_tpu.runtime.log import Logger
 from split_learning_tpu.runtime.protocol import (
     Activation, Gradient, Notify, Pause, Ready, Register, Start, Stop, Syn,
-    Update, decode, encode, gradient_queue, intermediate_queue, reply_queue,
-    RPC_QUEUE,
+    QuantLeaf, Update, decode, encode, gradient_queue, intermediate_queue,
+    reply_queue, RPC_QUEUE,
 )
 from split_learning_tpu.runtime.validation import dataset_for_model
 
@@ -65,30 +65,54 @@ def _wire_np_dtype(name: str):
     return np.dtype(name)
 
 
+def _quant_int8(a: np.ndarray):
+    """Absmax int8 quantization of one float payload leaf.
+
+    A non-finite payload ships raw fp32 instead: quantizing NaN/inf is
+    undefined, and the diverged values must survive the hop so the
+    receiver's NaN sentinel (``src/train/VGG16.py:169-171``) fires."""
+    a32 = np.asarray(a, np.float32)
+    amax = float(np.max(np.abs(a32))) if a32.size else 0.0
+    if not np.isfinite(amax):
+        return a32
+    scale = (amax / 127.0) or 1.0   # all-zero payload: any scale works
+    return QuantLeaf(q=np.round(a32 / scale).astype(np.int8),
+                     scale=scale)
+
+
 def _to_wire_tree(tree, dtype=np.float32):
     """Device pytree -> numpy payload for Activation/Gradient messages.
 
     Stage boundaries may be pytrees (e.g. BERT's (hidden, mask),
     models/bert.py): float leaves travel as ``dtype``
     (``transport.wire-dtype``; fp16/bf16 halve the hop bytes vs the
-    reference's fp32 pickles), bool/int leaves keep their dtype, and
-    float0 gradient leaves (cotangents of non-differentiable inputs)
-    become zeros so they pickle."""
+    reference's fp32 pickles, int8 absmax-quantizes for ~4x), bool/int
+    leaves keep their dtype, and float0 gradient leaves (cotangents of
+    non-differentiable inputs) become zeros so they pickle."""
+    quantize = dtype == np.int8
+
     def conv(leaf):
         if getattr(leaf, "dtype", None) == jax.dtypes.float0:
-            return np.zeros(np.shape(leaf), dtype)
+            return np.zeros(np.shape(leaf),
+                            np.float32 if quantize else dtype)
         a = np.asarray(leaf)
         # jnp.issubdtype, NOT np.issubdtype: numpy's lattice does not
         # classify ml_dtypes (bfloat16 model activations) as floating,
         # which would silently skip the wire cast
         if jnp.issubdtype(a.dtype, jnp.floating):
-            return a.astype(dtype, copy=False)
+            return _quant_int8(a) if quantize else a.astype(dtype,
+                                                            copy=False)
         return a
     return jax.tree_util.tree_map(conv, tree)
 
 
 def _from_wire_tree(tree):
-    return jax.tree_util.tree_map(jnp.asarray, tree)
+    def conv(leaf):
+        if isinstance(leaf, QuantLeaf):
+            return jnp.asarray(leaf.q, jnp.float32) * np.float32(
+                leaf.scale)
+        return jnp.asarray(leaf)
+    return jax.tree_util.tree_map(conv, tree)
 
 
 def _wire_vdot(out_tree, ct_tree):
@@ -778,10 +802,14 @@ class ProtocolClient:
         self.trainable, self.opt_state = r.apply_update(
             self.trainable, self.opt_state, gt)
         self.num_samples += int(sum(sizes))
-        gx = _to_wire_tree(gx, self.wire_dtype)
         off = 0
         for act, n in zip(window, sizes):
-            part = jax.tree_util.tree_map(lambda a: a[off:off + n], gx)
+            # slice the raw cotangent, THEN wire-encode the part:
+            # int8 wrapper leaves don't slice, and per-part quantization
+            # scales are tighter than one window-wide scale anyway
+            part = _to_wire_tree(
+                jax.tree_util.tree_map(lambda a: a[off:off + n], gx),
+                self.wire_dtype)
             off += n
             origin = act.trace[-1]
             self.bus.publish(

@@ -168,9 +168,22 @@ class Gradient:
     round_idx: int = 0
 
 
+@dataclasses.dataclass
+class QuantLeaf:
+    """One int8 absmax-quantized float tensor on the data-plane wire
+    (``transport.wire-dtype: int8`` — ~4x smaller than the reference's
+    fp32 pickles, ``src/train/VGG16.py:27``): ``x ≈ q * scale`` with
+    ``scale = max|x| / 127``.  Deliberately NOT a registered pytree so
+    tree_maps over a wire payload treat it as a leaf."""
+    q: np.ndarray       # int8
+    scale: float        # dequantization factor
+
+
 CONTROL_TYPES = (Register, Ready, Notify, Update, Start, Syn, Pause, Stop)
 DATA_TYPES = (Activation, Gradient)
 _TYPE_BY_NAME = {t.__name__: t for t in CONTROL_TYPES + DATA_TYPES}
+#: nested wire-format helpers (never valid as a top-level message)
+_WIRE_HELPERS = {"QuantLeaf": QuantLeaf}
 
 
 # --------------------------------------------------------------------------
@@ -196,9 +209,11 @@ class _SafeUnpickler(pickle.Unpickler):
     }
 
     def find_class(self, module, name):
-        if module == "split_learning_tpu.runtime.protocol" \
-                and name in _TYPE_BY_NAME:
-            return _TYPE_BY_NAME[name]
+        if module == "split_learning_tpu.runtime.protocol":
+            if name in _TYPE_BY_NAME:
+                return _TYPE_BY_NAME[name]
+            if name in _WIRE_HELPERS:
+                return _WIRE_HELPERS[name]
         if (module, name) in self._ALLOWED:
             return super().find_class(module, name)
         raise pickle.UnpicklingError(

@@ -263,11 +263,14 @@ def test_2ls_two_level_over_protocol_pair_queues(tmp_path):
 _WIRE_BASELINE: dict = {}   # share the fp32 run across dtype params
 
 
-@pytest.mark.parametrize("dtype", ["float16", "bfloat16"])
-def test_wire_dtype_compression(tmp_path, dtype):
+@pytest.mark.parametrize("dtype,max_ratio", [("float16", 0.75),
+                                             ("bfloat16", 0.75),
+                                             ("int8", 0.5)])
+def test_wire_dtype_compression(tmp_path, dtype, max_ratio):
     """transport.wire-dtype fp16/bf16 halves activation/gradient bytes
-    on the data plane (the reference always ships fp32 pickles,
-    src/train/VGG16.py:27) and the round still trains."""
+    on the data plane, int8 absmax quantization roughly quarters them
+    (the reference always ships fp32 pickles, src/train/VGG16.py:27),
+    and the round still trains."""
     def run(wire):
         bus = InProcTransport()
         cfg = proto_cfg(tmp_path, clients=[1, 1],
@@ -285,7 +288,56 @@ def test_wire_dtype_compression(tmp_path, dtype):
     assert rc.history[0].ok
     assert rc.history[0].num_samples == r32.history[0].num_samples
     assert rc.history[0].val_accuracy is not None
-    assert bc < 0.75 * b32, (bc, b32)
+    assert bc < max_ratio * b32, (bc, b32)
+
+
+class TestInt8WireQuantization:
+    """Unit surface of the int8 wire codec (runtime/client.py
+    _quant_int8 / _to_wire_tree / _from_wire_tree)."""
+
+    def _roundtrip(self, tree):
+        from split_learning_tpu.runtime.client import (
+            _from_wire_tree, _to_wire_tree,
+        )
+        from split_learning_tpu.runtime.protocol import (
+            Activation, decode, encode,
+        )
+        wire = _to_wire_tree(tree, np.dtype("int8"))
+        # through the real codec: the restricted unpickler must admit
+        # the nested QuantLeaf
+        msg = decode(encode(Activation(data_id="d", data=wire,
+                                       labels=np.zeros(2, np.int32),
+                                       trace=["c"], cluster=0)))
+        return _from_wire_tree(msg.data)
+
+    def test_roundtrip_error_bounded_by_half_step(self):
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=(8, 16)).astype(np.float32) * 3.0
+        out = np.asarray(self._roundtrip(x))
+        step = np.abs(x).max() / 127.0
+        np.testing.assert_allclose(out, x, atol=step / 2 + 1e-7)
+
+    def test_mixed_pytree_keeps_nonfloat_leaves(self):
+        x = {"h": np.ones((2, 3), np.float32),
+             "mask": np.array([[True, False, True]] * 2)}
+        out = self._roundtrip(x)
+        assert np.asarray(out["mask"]).dtype == np.bool_
+        np.testing.assert_array_equal(np.asarray(out["mask"]), x["mask"])
+        np.testing.assert_allclose(np.asarray(out["h"]), x["h"],
+                                   atol=1e-2)
+
+    def test_nonfinite_payload_ships_raw_for_nan_sentinel(self):
+        from split_learning_tpu.runtime.client import _to_wire_tree
+        from split_learning_tpu.runtime.protocol import QuantLeaf
+        x = np.array([1.0, np.nan, 2.0], np.float32)
+        wire = _to_wire_tree(x, np.dtype("int8"))
+        assert not isinstance(wire, QuantLeaf)
+        out = np.asarray(self._roundtrip(x))
+        assert np.isnan(out[1]) and out[0] == 1.0
+
+    def test_all_zero_payload(self):
+        out = np.asarray(self._roundtrip(np.zeros((4, 4), np.float32)))
+        np.testing.assert_array_equal(out, 0.0)
 
 
 def test_dcsl_round_robin_dispatch_and_distinct_windows(tmp_path,
