@@ -227,4 +227,10 @@ def encode(msg) -> bytes:
 
 
 def decode(raw: bytes):
-    return _SafeUnpickler(io.BytesIO(raw)).load()
+    msg = _SafeUnpickler(io.BytesIO(raw)).load()
+    # wire helpers (QuantLeaf) are only valid NESTED in a payload — a
+    # bare one must fail here, not as an AttributeError in a hot loop
+    if not isinstance(msg, CONTROL_TYPES + DATA_TYPES):
+        raise pickle.UnpicklingError(
+            f"not a protocol message: {type(msg).__name__}")
+    return msg
