@@ -101,9 +101,16 @@ def profile_model(model_key: str, batch_size: int = 32,
     bounds, layer_models, full = _boundary_structs(model_key, example, kw)
     specs = full.specs
     # a boundary may be a pytree (e.g. BERT's (hidden, mask)): bytes sum
-    # over leaves, matching what actually crosses the wire per batch
+    # over leaves.  Float leaves are recorded at fp32 size whatever the
+    # model's native dtype: the wire codec casts every float payload to
+    # the configured wire dtype (fp32 default), so what crosses per hop
+    # is float_elems x wire_itemsize — the planner applies the
+    # wire-dtype ratio at plan time (runtime/plan.py) against this
+    # fp32-equivalent record
     size_data = [
-        sum(int(np.prod(l.shape)) * np.dtype(l.dtype).itemsize
+        sum(int(np.prod(l.shape))
+            * (4 if jnp.issubdtype(l.dtype, jnp.floating)
+               else np.dtype(l.dtype).itemsize)
             for l in jax.tree_util.tree_leaves(b))
         for b in bounds[1:]
     ]

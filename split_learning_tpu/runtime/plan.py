@@ -199,7 +199,14 @@ def _cluster_cuts(cfg: Config, cluster_id: int, stage1_regs: list,
     exe1 = [p["exe_time"] for p in profs]
     # `or`: an unprobed profile carries network=0.0 — treat as unconstrained
     net1 = [float(p.get("network") or 1e9) for p in profs]
-    size_data = profs[0]["size_data"]
+    # profiles record fp32 boundary bytes; a compressed data-plane wire
+    # (transport.wire-dtype) shrinks what actually crosses per hop, and
+    # the throughput-balance search must weigh the cut with the bytes it
+    # will really ship (non-float extras like masks are negligible)
+    wire_factor = {"float32": 1.0, "float16": 0.5,
+                   "bfloat16": 0.5, "int8": 0.25}[
+                       cfg.transport.wire_dtype]
+    size_data = [s * wire_factor for s in profs[0]["size_data"]]
     # later-stage devices are unprofiled at the server (the reference also
     # only keeps stage-1 size_data — src/Server.py:115-117); mirror group 1
     if n_cuts == 1:

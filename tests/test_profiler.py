@@ -59,6 +59,38 @@ def test_profile_feeds_auto_partition(tmp_path):
     assert 1 <= plans[0].cuts[0] < 17
 
 
+def test_auto_partition_sees_compressed_wire_bytes():
+    """A compressed data-plane wire changes what a cut costs: with a
+    slow link and one cheap early boundary, fp32 must cut at the small
+    boundary, while int8 (4x fewer bytes per hop) frees the search to
+    balance compute instead."""
+    from split_learning_tpu.config import from_dict
+    from split_learning_tpu.runtime.plan import Registration, plan_clusters
+
+    # 4 layers, uniform compute, net=100 B/s: at fp32 the 200-byte
+    # boundary after layer 2 costs 2 s of transfer, so the max-min
+    # search prefers the tiny boundary after layer 1 (rate 1/3.04 >
+    # 1/4); at int8 the same boundary ships 50 bytes (0.5 s), and the
+    # compute-balanced cut 2 wins (1/2.5 > 1/3.01)
+    prof = {"exe_time": [1.0, 1.0, 1.0, 1.0],
+            "size_data": [4.0, 200.0, 400.0],
+            "speed": 1.0, "network": 100.0}
+
+    def cut_for(wire):
+        cfg = from_dict(dict(
+            model="KWT", dataset="SPEECHCOMMANDS", clients=[1, 1],
+            model_kwargs=TINY_KWT, synthetic_size=32,
+            topology={"mode": "auto"},
+            transport={"wire_dtype": wire},
+            distribution={"num_samples": 16}))
+        regs = [Registration("c0", 1, profile=dict(prof)),
+                Registration("c_last", 2)]
+        return plan_clusters(cfg, regs)[0].cuts[0]
+
+    assert cut_for("float32") == 1
+    assert cut_for("int8") > 1
+
+
 def test_profile_network_inproc():
     bus = InProcTransport()
     bw = profile_network(bus, sizes_mb=[1], repeats=2)
