@@ -1,0 +1,20 @@
+#!/usr/bin/env bash
+# Two-process multi-host demo: both processes join one jax.distributed
+# runtime (the same control surface a DCN deployment uses) and run a
+# compiled pipelined split train step plus the weighted FedAvg psum over
+# ONE global (client=2, stage=2) mesh — the client axis spans the
+# process boundary (tests/_multihost_child.py pins the topology to
+# 2 processes x 2 virtual CPU devices; real pods use
+# parallel/multihost.py's ensure_initialized/global_mesh directly with
+# their own axis sizes).
+#
+# Delegates to the pytest harness, which already provides a dynamically
+# picked coordinator port, a watchdog timeout, sibling-process cleanup,
+# and the cross-process agreement assertions (identical global loss on
+# both ranks; FedAvg probe == the host-computed weighted mean).
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+python -m pytest -q tests/test_multihost_trace.py \
+    -k two_process_distributed "$@"
+echo "multi-host demo: both processes agreed on the global step + FedAvg"
