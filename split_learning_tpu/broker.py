@@ -9,6 +9,8 @@ TCP broker instead.  Prefers the native C++ broker when it can be built
 from __future__ import annotations
 
 import argparse
+import signal
+import sys
 import time
 
 
@@ -32,10 +34,15 @@ def main(argv=None):
         from split_learning_tpu.runtime.bus import Broker
         broker = Broker(args.host, args.port)
         print(f"python broker on {args.host}:{broker.port}")
+    # SIGTERM (kill, process managers) must tear the native child down
+    # with us — a bare kill otherwise orphans it holding the port
+    signal.signal(signal.SIGTERM, lambda *_: sys.exit(0))
     try:
         while True:
             time.sleep(3600)
     except KeyboardInterrupt:
+        pass
+    finally:
         broker.close()
 
 

@@ -113,6 +113,12 @@ class TopologyConfig:
     # registrations without a usable profile instead of silently falling
     # back to an even layer split.
     require_profiles: bool = False
+    # Elastic membership BETWEEN rounds (extension; the reference fixes
+    # the client set at the registration barrier and a late client can
+    # never join, src/Server.py:111-135): clients that REGISTER after
+    # training started join the next round's plan, and clients that miss
+    # consecutive round barriers are pruned from it (protocol backend).
+    elastic_join: bool = False
     # Intra-client acceleration axes (fresh TPU surface, SURVEY.md §2.2):
     # shard each logical client's model over `model` (Megatron-style TP,
     # parallel/tensor.py), its sequence over `seq` (ring attention,

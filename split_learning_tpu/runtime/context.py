@@ -96,6 +96,13 @@ class TrainContext:
     def validate(self, params, stats) -> ValResult:
         raise NotImplementedError
 
+    def refresh_plans(self, plans: list[ClusterPlan]
+                      ) -> list[ClusterPlan] | None:
+        """Between-round membership hook: return replacement plans when
+        the live client set changed (elastic join/prune), else None.
+        The mesh backend's membership is fixed at planning time."""
+        return None
+
     def shutdown(self) -> None:
         pass
 

@@ -84,6 +84,18 @@ def run_training(cfg: Config, ctx: TrainContext,
     ck_future: concurrent.futures.Future | None = None
     try:
         for r in range(start_round, cfg.global_rounds):
+            if r > start_round:
+                # elastic membership (topology.elastic-join): late
+                # registrations join, repeatedly-silent clients leave
+                new_plans = ctx.refresh_plans(plans)
+                if new_plans is not None:
+                    plans = new_plans
+                    for plan in plans:
+                        logger.info(
+                            f"Cluster {plan.cluster_id} (re-planned): "
+                            f"cuts={plan.cuts} clients="
+                            f"{[len(ids) for ids in plan.clients]}",
+                            "cyan")
             t0 = time.perf_counter()
             with timer.phase("train"):
                 outcome = strategy.run_round(ctx, plans, r, params, stats)

@@ -71,9 +71,13 @@ def _num_classes(cfg: Config) -> int:
 
 
 def plan_clusters(cfg: Config,
-                  registrations: list[Registration]) -> list[ClusterPlan]:
+                  registrations: list[Registration],
+                  exact_counts: bool = True) -> list[ClusterPlan]:
     """Full planning pass. Registrations must cover ``cfg.clients`` counts
-    (stage s gets cfg.clients[s-1] clients)."""
+    (stage s gets cfg.clients[s-1] clients); with ``exact_counts=False``
+    (elastic re-planning between rounds) any membership works as long as
+    every stage keeps at least one client — a pipeline with an empty
+    stage cannot run."""
     n_stages = cfg.num_stages
     by_stage: dict[int, list[Registration]] = {s: [] for s in
                                                range(1, n_stages + 1)}
@@ -84,10 +88,12 @@ def plan_clusters(cfg: Config,
                 f"config has {n_stages} stages")
         by_stage[reg.stage].append(reg)
     for s in range(1, n_stages + 1):
-        if len(by_stage[s]) != cfg.clients[s - 1]:
+        if exact_counts and len(by_stage[s]) != cfg.clients[s - 1]:
             raise ValueError(
                 f"stage {s}: expected {cfg.clients[s - 1]} clients, "
                 f"got {len(by_stage[s])}")
+        if not by_stage[s]:
+            raise ValueError(f"stage {s}: no clients registered")
 
     stage1 = by_stage[1]
     if cfg.topology.mode == "auto" and cfg.topology.require_profiles:

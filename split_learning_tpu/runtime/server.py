@@ -97,6 +97,20 @@ class ProtocolContext(MeshContext):
         self._ready: set = set()
         self._notified: set = set()
         self._updates: list[Update] = []
+        # elastic membership (topology.elastic-join): ids the CURRENT
+        # plans were computed from; per-ROUND alive/silent bookkeeping
+        # (sequential strategies run several train_cluster invocations
+        # per round — a slow client must not accrue several misses in
+        # one round); consecutive missed ROUNDS per client (a fresh
+        # REGISTER forgives); clients whose next START must carry
+        # params whatever the strategy's wire economy says (joiners,
+        # and everyone after a re-plan moved the cuts)
+        self._planned_ids: set = set()
+        self._round_alive: set = set()
+        self._round_silent: set = set()
+        self._missed: dict[str, int] = {}
+        self._needs_params: set = set()
+        self._replan_failed_for: set | None = None
         # fence: messages are stamped with a per-train_cluster-invocation
         # generation (NOT the round index — sequential strategies run
         # several invocations with the same round_idx, and a straggler
@@ -112,6 +126,17 @@ class ProtocolContext(MeshContext):
             return False
         msg = decode(raw)
         if isinstance(msg, Register):
+            if (self.cfg.topology.elastic_join
+                    and not 1 <= msg.stage <= self.cfg.num_stages):
+                # elastic: a stored out-of-range registration would
+                # poison every later re-planning pass, so drop it.
+                # Non-elastic keeps the old fail-fast: it counts toward
+                # the barrier and planning immediately raises naming
+                # the misconfigured client.
+                self.log.warning(
+                    f"ignoring REGISTER {msg.client_id}: stage "
+                    f"{msg.stage} outside 1..{self.cfg.num_stages}")
+                return True
             # keyed by client_id: clients re-REGISTER until STARTed (the
             # server's startup purge may race a fast client's first one)
             if msg.client_id not in self._registrations:
@@ -120,6 +145,9 @@ class ProtocolContext(MeshContext):
             self._registrations[msg.client_id] = Registration(
                 client_id=msg.client_id, stage=msg.stage,
                 cluster=msg.cluster, profile=msg.profile)
+            # a REGISTER proves a live process: forgive barrier misses
+            # (a crashed-and-restarted client re-joins by re-registering)
+            self._missed.pop(msg.client_id, None)
         elif isinstance(msg, Ready):
             # fenced like Notify/Update: a late READY from a dropped
             # invocation must not let the server SYN a client that is
@@ -150,14 +178,20 @@ class ProtocolContext(MeshContext):
         return True
 
     def _pump_until(self, pred: Callable[[], bool],
-                    what: str, deadline: float | None = None) -> bool:
-        """Drain rpc_queue until ``pred()``; False if the deadline passes."""
+                    what: str | Callable[[], str],
+                    deadline: float | None = None) -> bool:
+        """Drain rpc_queue until ``pred()``; False if the deadline passes.
+
+        ``what`` may be a callable so the timeout warning names who is
+        missing AT the deadline (an eager f-string would snapshot the
+        missing set before any response arrived)."""
         deadline = (time.monotonic() + self.client_timeout
                     if deadline is None else deadline)
         while not pred():
             remain = deadline - time.monotonic()
             if remain <= 0:
-                self.log.warning(f"timeout waiting for {what}")
+                w = what() if callable(what) else what
+                self.log.warning(f"timeout waiting for {w}")
                 return False
             self._pump_one(timeout=min(remain, 0.25))
         return True
@@ -170,19 +204,137 @@ class ProtocolContext(MeshContext):
 
     def wait_for_registrations(self) -> list[Registration]:
         """Block until every configured client has registered
-        (``src/Server.py:111-135``)."""
+        (``src/Server.py:111-135``).
+
+        Under ``topology.elastic-join`` the barrier counts PER STAGE:
+        an elastic spare registering during startup must not mask a
+        missing configured client (a raw total would release early),
+        and extras beyond the configured counts are welcome — the
+        initial plan simply includes them.
+        """
         # full client_timeout here, NOT ready_timeout: registration covers
         # client process startup (jax import, transport connect) and a
         # miss is fatal rather than an elastic drop
-        total = sum(self.cfg.clients)
-        self._pump_until(lambda: len(self._registrations) >= total,
-                         f"{total} registrations",
+        need = list(self.cfg.clients)
+
+        def by_stage() -> list[int]:
+            counts = [0] * len(need)
+            for r in self._registrations.values():
+                counts[r.stage - 1] += 1
+            return counts
+
+        if self.cfg.topology.elastic_join:
+            enough = lambda: all(  # noqa: E731
+                c >= n for c, n in zip(by_stage(), need))
+            what = lambda: f"per-stage registrations {by_stage()}/{need}"
+        else:
+            total = sum(need)
+            enough = lambda: len(self._registrations) >= total  # noqa
+            what = f"{total} registrations"
+        self._pump_until(enough, what,
                          deadline=time.monotonic() + self.client_timeout)
-        if len(self._registrations) < total:
+        if not enough():
             raise RoundTimeout(
-                f"only {len(self._registrations)}/{total} clients "
-                f"registered within {self.client_timeout}s")
+                f"registrations incomplete within {self.client_timeout}s:"
+                f" per-stage {by_stage()} of {need}")
+        self._planned_ids = set(self._registrations)
         return self.registrations
+
+    _DEAD_AFTER = 2   # consecutive silent ROUNDS before pruning
+
+    def refresh_plans(self, plans):
+        """Elastic membership between rounds (topology.elastic-join).
+
+        Extension beyond the reference (its client set is frozen at the
+        registration barrier, ``src/Server.py:111-135``; a late client
+        can never join and a dead one stalls every barrier forever):
+        fold the finished round's alive/silent bookkeeping, drain
+        between-round mail, then re-plan when the live set moved.
+        Joiners (and everyone, when the re-plan moves the cuts) are
+        marked so their next START carries shard weights even under a
+        hold-weights strategy like FLEX.  When a full re-plan is
+        impossible (e.g. a fixed distribution matrix pinned to the
+        original membership), dead clients are still pruned surgically
+        from the current plans so later rounds stop paying their
+        barrier deadlines — only joining needs the planner.
+        """
+        if not self.cfg.topology.elastic_join:
+            return None
+        # fold the round: one miss per silent ROUND, not per invocation
+        for cid in self._round_silent - self._round_alive:
+            self._missed[cid] = self._missed.get(cid, 0) + 1
+        for cid in self._round_alive:
+            self._missed.pop(cid, None)
+        self._round_alive = set()
+        self._round_silent = set()
+        while self._pump_one(timeout=0.0):
+            pass
+        dead = {c for c, n in self._missed.items()
+                if n >= self._DEAD_AFTER}
+        live = set(self._registrations) - dead
+        if live == self._planned_ids:
+            return None
+        joined = sorted(live - self._planned_ids)
+        pruned = sorted(self._planned_ids - live)
+        regs = [r for c, r in self._registrations.items() if c in live]
+        try:
+            new_plans = plan_clusters(self.cfg, regs, exact_counts=False)
+        except ValueError as e:
+            if live != self._replan_failed_for:
+                self.log.warning(f"elastic re-plan impossible: {e}")
+                self._replan_failed_for = set(live)
+            new_plans = self._prune_plans(plans, set(pruned))
+            if new_plans is None:
+                return None   # nothing safely removable; keep plans
+            joined = []       # joining DOES need the planner
+            live = self._planned_ids - set(pruned)
+        else:
+            self._replan_failed_for = None
+            # a held shard survives only if the client keeps the SAME
+            # layer range: compare per client (a re-plan can move a
+            # client between clusters with different cuts even when no
+            # single cluster's cuts changed) — joiners fall out of the
+            # same comparison (no old range)
+            old_rng = self._client_ranges(plans)
+            new_rng = self._client_ranges(new_plans)
+            self._needs_params |= {cid for cid, rng in new_rng.items()
+                                   if old_rng.get(cid) != rng}
+        for cid in pruned:
+            self.bus.publish(reply_queue(cid), encode(Stop(
+                reason="pruned: missed consecutive round barriers")))
+        self.log.info(f"elastic re-plan: joined={joined} "
+                      f"pruned={pruned}", "cyan")
+        self._planned_ids = live
+        return new_plans
+
+    def _client_ranges(self, plans) -> dict:
+        """client_id -> the (start, end) layer range it owns."""
+        out = {}
+        for p in plans:
+            ranges = stage_ranges(len(self.specs), p.cuts)
+            for s in range(1, p.n_stages + 1):
+                for cid in p.clients[s - 1]:
+                    out[cid] = ranges[s - 1]
+        return out
+
+    @staticmethod
+    def _prune_plans(plans, pruned: set):
+        """Remove ``pruned`` clients from existing plans without
+        re-planning; None when any cluster would lose a whole stage
+        (an empty pipeline stage cannot run)."""
+        if not pruned:
+            return None
+        new_plans = []
+        for p in plans:
+            keep = [i for i, c in enumerate(p.stage1_clients)
+                    if c not in pruned]
+            clients = [[c for c in ids if c not in pruned]
+                       for ids in p.clients]
+            if any(not ids for ids in clients):
+                return None
+            new_plans.append(dataclasses.replace(
+                p, clients=clients, label_counts=p.label_counts[keep]))
+        return new_plans
 
     # -- the remote round ----------------------------------------------------
 
@@ -281,6 +433,12 @@ class ProtocolContext(MeshContext):
             a, b = ranges[s - 1]
             sp = (send_params.get(s, True)
                   if isinstance(send_params, dict) else bool(send_params))
+            if cid in self._needs_params:
+                # elastic joiner (no local shard yet) or a re-plan moved
+                # the cuts: a weight-less START would crash the client's
+                # shard reuse whatever the strategy's wire economy says
+                sp = True
+                self._needs_params.discard(cid)
             if sp:
                 base = (per_client_params or {}).get(cid, params)
                 shard_p = _np_tree(shard_params(base, self.specs, a, b))
@@ -311,7 +469,7 @@ class ProtocolContext(MeshContext):
         ids = {cid for cid, _ in active}
         if not self._pump_until(
                 lambda: ids <= self._ready,
-                f"READY from {ids - self._ready}",
+                lambda: f"READY from {ids - self._ready}",
                 deadline=time.monotonic() + self.ready_timeout):
             ids &= self._ready  # drop unresponsive clients mid-round
         for cid in ids:
@@ -333,10 +491,20 @@ class ProtocolContext(MeshContext):
         self.log.sent(f"PAUSE -> {sorted(ids)}")
 
         got = lambda: {u.client_id for u in self._updates} >= ids  # noqa
-        self._pump_until(got, "UPDATE from cluster clients",
-                         deadline=time.monotonic() + self.client_timeout)
+        self._pump_until(
+            got,
+            lambda: (f"UPDATE from "
+                     f"{ids - {u.client_id for u in self._updates}}"),
+            deadline=time.monotonic() + self.client_timeout)
         updates = list(self._updates)
         self._updates = []
+        # elastic liveness bookkeeping, folded per ROUND at the next
+        # refresh_plans: any UPDATE during the round marks a client
+        # alive even if it sat out other invocations of a sequential
+        # strategy (topology.elastic-join)
+        responded = {u.client_id for u in updates}
+        self._round_alive |= responded
+        self._round_silent |= {cid for cid, _ in active} - responded
         # wire audit: CUMULATIVE transport-wide publish bytes by queue
         # kind (reply_* = server control/weights down; rpc = client
         # control/weights up; data = activation/gradient plane).  On the
@@ -390,7 +558,11 @@ class ProtocolServer:
         )
         ensure_initialized()
         regs = self.ctx.wait_for_registrations()
-        plans = plan_clusters(self.cfg, regs)
+        # elastic deployments may have spares beyond the configured
+        # counts at startup; plan whoever is there
+        plans = plan_clusters(
+            self.cfg, regs,
+            exact_counts=not self.cfg.topology.elastic_join)
         try:
             result = run_training(self.cfg, self.ctx, plans, self.log)
         finally:

@@ -260,6 +260,164 @@ def test_2ls_two_level_over_protocol_pair_queues(tmp_path):
     assert not shared_queues, shared_queues
 
 
+def test_elastic_join_between_rounds(tmp_path):
+    """topology.elastic-join: a client that registers AFTER training
+    started joins the next round's plan and contributes samples (the
+    reference freezes membership at the registration barrier,
+    src/Server.py:111-135)."""
+    import time as _time
+
+    bus = InProcTransport()
+    cfg = proto_cfg(tmp_path, clients=[1, 1], global_rounds=2,
+                    topology={"cut_layers": [2], "elastic_join": True})
+
+    def late_joiner():
+        # wait for round 0's aggregation (both UPDATEs published),
+        # then register as a second stage-1 client
+        deadline = _time.monotonic() + 240
+        while _time.monotonic() < deadline:
+            if bus.bytes_out.get("rpc_queue", 0) > 0 and any(
+                    q.startswith("reply_") for q in bus.bytes_out):
+                # round 0 underway; join once the first round's data
+                # plane has moved (both directions seen)
+                if bus.bytes_out.get("gradient_queue_1_client_1_0", 0):
+                    break
+            _time.sleep(0.05)
+        ProtocolClient(cfg, "late_edge", 1, transport=bus).run()
+
+    t = threading.Thread(target=late_joiner, daemon=True)
+    t.start()
+    result = run_deployment(cfg, lambda: bus, bus)
+    t.join(timeout=30)
+    assert not t.is_alive(), "late joiner never got STOP"
+
+    assert [r.ok for r in result.history] == [True, True]
+    r0, r1 = result.history
+    # round 0: one stage-1 client's data; round 1: the joiner doubles it
+    assert r0.num_samples > 0
+    assert r1.num_samples == 2 * r0.num_samples, (r0.num_samples,
+                                                  r1.num_samples)
+    log_text = (tmp_path / "app.log").read_text()
+    assert "joined=['late_edge']" in log_text
+
+
+def test_elastic_join_under_flex_hold_strategy(tmp_path):
+    """A joiner under FLEX's weight-holding economy: non-reseed rounds
+    send param-less STARTs to holding clients, but the joiner has no
+    local shard yet — its first START must carry params anyway."""
+    import time as _time
+
+    bus = InProcTransport()
+    cfg = proto_cfg(tmp_path, clients=[1, 1], global_rounds=3,
+                    aggregation={"strategy": "periodic", "t_client": 3,
+                                 "t_global": 3},
+                    topology={"cut_layers": [2], "elastic_join": True})
+
+    def late_joiner():
+        deadline = _time.monotonic() + 240
+        while _time.monotonic() < deadline:
+            if bus.bytes_out.get("gradient_queue_1_client_1_0", 0):
+                break
+            _time.sleep(0.05)
+        ProtocolClient(cfg, "late_edge", 1, transport=bus).run()
+
+    t = threading.Thread(target=late_joiner, daemon=True)
+    t.start()
+    result = run_deployment(cfg, lambda: bus, bus)
+    t.join(timeout=30)
+    assert not t.is_alive(), "late joiner crashed or never got STOP"
+
+    r0, r1, r2 = result.history
+    assert r0.ok and r1.ok and r2.ok
+    # the joiner contributed from round 1 on (rounds 1-2 are
+    # non-reseed: without the needs-params override its weight-less
+    # START would have killed it)
+    assert r1.num_samples == 2 * r0.num_samples, (r0.num_samples,
+                                                  r1.num_samples)
+    assert r2.num_samples == r1.num_samples
+    log_text = (tmp_path / "app.log").read_text()
+    assert "joined=['late_edge']" in log_text
+    assert "no matching local shard" not in log_text
+
+
+def test_elastic_startup_spare_registers_without_crashing_planning(
+        tmp_path):
+    """An elastic spare registering DURING the startup barrier must
+    neither mask a missing configured client (per-stage counting) nor
+    crash initial planning (exact counts are waived under
+    elastic-join)."""
+    from split_learning_tpu.runtime.plan import plan_clusters
+    from split_learning_tpu.runtime.protocol import Register, encode
+    from split_learning_tpu.runtime.server import (
+        ProtocolContext, RoundTimeout,
+    )
+
+    cfg = proto_cfg(tmp_path, clients=[1, 1],
+                    topology={"cut_layers": [2], "elastic_join": True})
+
+    # two stage-1 registrations reach the OLD raw total of 2, but the
+    # configured stage-2 client is missing: the barrier must time out
+    bus = InProcTransport()
+    ctx = ProtocolContext(cfg, bus, client_timeout=1.0)
+    for cid in ("spare", "edge_a"):
+        bus.publish("rpc_queue", encode(Register(client_id=cid,
+                                                 stage=1)))
+    with pytest.raises(RoundTimeout, match="per-stage"):
+        ctx.wait_for_registrations()
+
+    # with the head present, the spare rides along and planning with
+    # waived exact counts accepts 2 stage-1 clients for a [1, 1] config
+    bus2 = InProcTransport()
+    ctx2 = ProtocolContext(cfg, bus2, client_timeout=10.0)
+    for cid, st in [("spare", 1), ("edge_a", 1), ("head", 2)]:
+        bus2.publish("rpc_queue", encode(Register(client_id=cid,
+                                                  stage=st)))
+    regs = ctx2.wait_for_registrations()
+    assert {r.client_id for r in regs} == {"spare", "edge_a", "head"}
+    plans = plan_clusters(cfg, regs,
+                          exact_counts=not cfg.topology.elastic_join)
+    assert sorted(plans[0].stage1_clients) == ["edge_a", "spare"]
+
+
+def test_elastic_prune_of_silent_client(tmp_path):
+    """topology.elastic-join prunes a registered-but-dead client after
+    it misses consecutive round barriers, so later rounds stop paying
+    its barrier deadline (the reference hangs forever on it)."""
+    from split_learning_tpu.runtime.protocol import Register, encode
+
+    bus = InProcTransport()
+    cfg = proto_cfg(tmp_path, clients=[2, 1], global_rounds=3,
+                    topology={"cut_layers": [2], "elastic_join": True})
+    # server FIRST: its startup purge would wipe an earlier REGISTER
+    server = ProtocolServer(cfg, transport=bus, client_timeout=120,
+                            ready_timeout=3.0)
+    # a ghost: registers like a real client, then never answers START
+    bus.publish("rpc_queue", encode(Register(client_id="ghost",
+                                             stage=1)))
+    threads = []
+    for cid, stage in [("client_1_0", 1), ("client_2_0", 2)]:
+        c = ProtocolClient(cfg, cid, stage, transport=bus)
+        th = threading.Thread(target=c.run, daemon=True)
+        th.start()
+        threads.append(th)
+    result = server.serve()
+    for th in threads:
+        th.join(timeout=30)
+        assert not th.is_alive()
+
+    # all rounds trained with the survivor; ghost contributed nothing
+    assert [r.ok for r in result.history] == [True, True, True]
+    assert all(r.num_samples == result.history[0].num_samples > 0
+               for r in result.history)
+    log_text = (tmp_path / "app.log").read_text()
+    # ghost pruned after missing rounds 0 and 1 (= _DEAD_AFTER), so
+    # exactly those two rounds stalled their READY barrier deadline and
+    # round 2 did not
+    assert "pruned=['ghost']" in log_text
+    assert log_text.count("timeout waiting for READY") == 2, log_text[
+        -2000:]
+
+
 _WIRE_BASELINE: dict = {}   # share the fp32 run across dtype params
 
 
