@@ -379,6 +379,31 @@ def test_elastic_startup_spare_registers_without_crashing_planning(
     assert sorted(plans[0].stage1_clients) == ["edge_a", "spare"]
 
 
+def test_client_ranges_track_per_cluster_cuts(tmp_path):
+    """The elastic needs-params decision diffs each client's layer
+    range: two clusters with different cuts must yield different ranges
+    for their members (a client moving between them needs re-seeding
+    even though neither cluster's cuts changed)."""
+    from split_learning_tpu.runtime.plan import ClusterPlan
+    from split_learning_tpu.runtime.server import ProtocolContext
+
+    cfg = proto_cfg(tmp_path, clients=[1, 1],
+                    topology={"cut_layers": [2], "elastic_join": True})
+    ctx = ProtocolContext(cfg, InProcTransport())
+    lc = np.ones((1, 10), int)
+    plans = [
+        ClusterPlan(0, [2], [["a"], ["h0"]], lc, []),
+        ClusterPlan(1, [4], [["b"], ["h1"]], lc, []),
+    ]
+    r = ctx._client_ranges(plans)
+    n = len(ctx.specs)
+    assert r["a"] == (0, 2) and r["h0"] == (2, n)
+    assert r["b"] == (0, 4) and r["h1"] == (4, n)
+    # the same client under the other cluster's cuts -> changed range
+    moved = [ClusterPlan(1, [4], [["a"], ["h1"]], lc, [])]
+    assert ctx._client_ranges(moved)["a"] != r["a"]
+
+
 def test_elastic_prune_of_silent_client(tmp_path):
     """topology.elastic-join prunes a registered-but-dead client after
     it misses consecutive round barriers, so later rounds stop paying
