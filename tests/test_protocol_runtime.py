@@ -260,36 +260,44 @@ def test_2ls_two_level_over_protocol_pair_queues(tmp_path):
     assert not shared_queues, shared_queues
 
 
+def _launch_late_joiner(cfg, ready, make_transport,
+                        client_id="late_edge", stage=1):
+    """Spawn a thread that waits for ``ready()`` (with a 240 s cap),
+    then runs an extra protocol client — the elastic-join scaffold
+    shared by the join tests."""
+    import time as _time
+
+    def late_joiner():
+        deadline = _time.monotonic() + 240
+        while _time.monotonic() < deadline and not ready():
+            _time.sleep(0.05)
+        ProtocolClient(cfg, client_id, stage,
+                       transport=make_transport()).run()
+
+    t = threading.Thread(target=late_joiner, daemon=True)
+    t.start()
+    return t
+
+
+def _join_or_fail(t, what="late joiner"):
+    t.join(timeout=30)
+    assert not t.is_alive(), f"{what} crashed or never got STOP"
+
+
 def test_elastic_join_between_rounds(tmp_path):
     """topology.elastic-join: a client that registers AFTER training
     started joins the next round's plan and contributes samples (the
     reference freezes membership at the registration barrier,
     src/Server.py:111-135)."""
-    import time as _time
-
     bus = InProcTransport()
     cfg = proto_cfg(tmp_path, clients=[1, 1], global_rounds=2,
                     topology={"cut_layers": [2], "elastic_join": True})
-
-    def late_joiner():
-        # wait for round 0's aggregation (both UPDATEs published),
-        # then register as a second stage-1 client
-        deadline = _time.monotonic() + 240
-        while _time.monotonic() < deadline:
-            if bus.bytes_out.get("rpc_queue", 0) > 0 and any(
-                    q.startswith("reply_") for q in bus.bytes_out):
-                # round 0 underway; join once the first round's data
-                # plane has moved (both directions seen)
-                if bus.bytes_out.get("gradient_queue_1_client_1_0", 0):
-                    break
-            _time.sleep(0.05)
-        ProtocolClient(cfg, "late_edge", 1, transport=bus).run()
-
-    t = threading.Thread(target=late_joiner, daemon=True)
-    t.start()
+    # join once round 0's data plane has moved in both directions
+    t = _launch_late_joiner(
+        cfg, lambda: bus.bytes_out.get("gradient_queue_1_client_1_0", 0),
+        lambda: bus)
     result = run_deployment(cfg, lambda: bus, bus)
-    t.join(timeout=30)
-    assert not t.is_alive(), "late joiner never got STOP"
+    _join_or_fail(t)
 
     assert [r.ok for r in result.history] == [True, True]
     r0, r1 = result.history
@@ -305,27 +313,16 @@ def test_elastic_join_under_flex_hold_strategy(tmp_path):
     """A joiner under FLEX's weight-holding economy: non-reseed rounds
     send param-less STARTs to holding clients, but the joiner has no
     local shard yet — its first START must carry params anyway."""
-    import time as _time
-
     bus = InProcTransport()
     cfg = proto_cfg(tmp_path, clients=[1, 1], global_rounds=3,
                     aggregation={"strategy": "periodic", "t_client": 3,
                                  "t_global": 3},
                     topology={"cut_layers": [2], "elastic_join": True})
-
-    def late_joiner():
-        deadline = _time.monotonic() + 240
-        while _time.monotonic() < deadline:
-            if bus.bytes_out.get("gradient_queue_1_client_1_0", 0):
-                break
-            _time.sleep(0.05)
-        ProtocolClient(cfg, "late_edge", 1, transport=bus).run()
-
-    t = threading.Thread(target=late_joiner, daemon=True)
-    t.start()
+    t = _launch_late_joiner(
+        cfg, lambda: bus.bytes_out.get("gradient_queue_1_client_1_0", 0),
+        lambda: bus)
     result = run_deployment(cfg, lambda: bus, bus)
-    t.join(timeout=30)
-    assert not t.is_alive(), "late joiner crashed or never got STOP"
+    _join_or_fail(t)
 
     r0, r1, r2 = result.history
     assert r0.ok and r1.ok and r2.ok
@@ -377,6 +374,40 @@ def test_elastic_startup_spare_registers_without_crashing_planning(
     plans = plan_clusters(cfg, regs,
                           exact_counts=not cfg.topology.elastic_join)
     assert sorted(plans[0].stage1_clients) == ["edge_a", "spare"]
+
+
+def test_elastic_join_over_tcp_broker(tmp_path):
+    """Elastic join over the REAL TCP broker (the manual-deployment
+    shape): per-process transports, no shared in-proc state — the
+    joiner registers DURING round 0 (triggered by the server's SYN log
+    line) so both later rounds' re-plan points can pick it up."""
+    from split_learning_tpu.runtime.bus import TcpTransport
+
+    broker = Broker("127.0.0.1", 0)
+    try:
+        cfg = proto_cfg(
+            tmp_path, clients=[1, 1], global_rounds=3,
+            distribution={"num_samples": 12},
+            topology={"cut_layers": [2], "elastic_join": True},
+            transport={"kind": "tcp", "host": "127.0.0.1",
+                       "port": broker.port})
+        log = tmp_path / "app.log"
+        t = _launch_late_joiner(
+            cfg, lambda: log.exists() and "SYN ->" in log.read_text(),
+            lambda: TcpTransport("127.0.0.1", broker.port))
+        result = run_deployment(
+            cfg, lambda: TcpTransport("127.0.0.1", broker.port),
+            TcpTransport("127.0.0.1", broker.port))
+        _join_or_fail(t)
+
+        assert all(r.ok for r in result.history)
+        # registered during round 0 -> planned in for round 1 (round 2
+        # at the very latest)
+        assert result.history[-1].num_samples == \
+            2 * result.history[0].num_samples
+        assert "joined=['late_edge']" in log.read_text()
+    finally:
+        broker.close()
 
 
 def test_client_ranges_track_per_cluster_cuts(tmp_path):
