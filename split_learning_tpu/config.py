@@ -82,6 +82,11 @@ class DistributionConfig:
     num_samples: int = 2500         # samples per stage-1 client
     matrix: tuple | None = None     # fixed per-client label counts (FLEX)
     seed: int | None = None
+    # reference data-distribution.refresh (src/Server.py:48, consumed at
+    # src/RpcClient.py:108): True -> every round re-samples each
+    # client's label-count subset (loader rebuilt per START); False ->
+    # the subset is drawn once and reused all training
+    refresh: bool = False
 
     def validate(self):
         _check(self.mode in ("iid", "dirichlet", "fixed"),

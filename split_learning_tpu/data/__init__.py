@@ -6,14 +6,14 @@ deterministic synthetic fallbacks for the zero-egress environment.
 """
 
 from split_learning_tpu.data.loader import (
-    ArrayDataset, DataLoader, cifar_augment, label_count_subset,
+    ArrayDataset, DataLoader, cifar_augment, label_count_subset, subset_seed,
 )
 from split_learning_tpu.data.datasets import (
     get_dataset, make_data_loader, register_dataset, dataset_registry,
 )
 
 __all__ = [
-    "ArrayDataset", "DataLoader", "cifar_augment", "label_count_subset",
+    "ArrayDataset", "DataLoader", "cifar_augment", "label_count_subset", "subset_seed",
     "get_dataset", "make_data_loader", "register_dataset",
     "dataset_registry",
 ]

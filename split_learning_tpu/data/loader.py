@@ -18,9 +18,26 @@ TPU-first differences:
 from __future__ import annotations
 
 import dataclasses
+import zlib
 from typing import Callable, Iterator
 
 import numpy as np
+
+
+def subset_seed(base_seed: int, client_key: str, round_idx: int = 0,
+                refresh: bool = False) -> int:
+    """Loader seed for one client's label-count subset draw.
+
+    crc32, not ``hash()`` (salted per process): two clients with
+    identical label counts must still draw DISTINCT subsets, and the
+    same deployment must draw the same ones on every run.  With
+    ``refresh`` (the reference's ``data-distribution.refresh`` —
+    clients rebuild their loader every round, ``src/RpcClient.py:108``)
+    the seed also varies per round, re-sampling the subset."""
+    s = (zlib.crc32(client_key.encode()) ^ base_seed) % (2 ** 31)
+    if refresh:
+        s = (s ^ (0x9E3779B1 * (round_idx + 1))) % (2 ** 31)
+    return s
 
 
 def label_count_subset(labels: np.ndarray, counts: np.ndarray,

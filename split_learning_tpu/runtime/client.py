@@ -45,7 +45,7 @@ import numpy as np
 import optax
 
 from split_learning_tpu.config import Config, LearningConfig, from_yaml
-from split_learning_tpu.data import make_data_loader
+from split_learning_tpu.data import make_data_loader, subset_seed
 from split_learning_tpu.models import build_model
 from split_learning_tpu.ops.lora import lora_init, lora_merge, split_frozen
 from split_learning_tpu.runtime.bus import Transport, make_transport
@@ -429,6 +429,12 @@ class ProtocolClient:
                               "(weights kept)")
             else:
                 self.log.info("keeping local shard weights (no re-seed)")
+            if (msg.extra or {}).get("refresh"):
+                # distribution.refresh re-samples the subset every
+                # round even on hold (weight-less) STARTs — the
+                # reference rebuilds its loader on every START when
+                # refresh is on (src/RpcClient.py:108)
+                self._build_loader(msg)
             return
         model_kwargs = dict(self.cfg.model_kwargs or {})
         self.runner = ShardRunner(
@@ -448,6 +454,15 @@ class ProtocolClient:
                 "lora_rank set but no target kernels in this shard; "
                 "training full shard parameters instead")
         self.opt_state = self.runner.optimizer.init(self.trainable)
+        self._build_loader(msg)
+
+    def _build_loader(self, msg: Start):
+        """(Re)build the stage-1 data loader from a START's label
+        counts: per-client subset seed (clients with identical label
+        counts must not train on identical samples), re-salted per
+        round under ``distribution.refresh`` — the reference rebuilds
+        its loader every START when refresh is on
+        (``src/RpcClient.py:108``)."""
         if self.stage == 1 and msg.label_counts is not None:
             from split_learning_tpu.runtime.validation import (
                 dataset_kwargs_for_model,
@@ -456,7 +471,10 @@ class ProtocolClient:
                 dataset_for_model(self.cfg.model_key),
                 self.runner.learning.batch_size,
                 distribution=np.asarray(msg.label_counts), train=True,
-                seed=self.cfg.seed, synthetic_size=self.cfg.synthetic_size,
+                seed=subset_seed(self.cfg.seed, self.client_id,
+                                 msg.round_idx,
+                                 (msg.extra or {}).get("refresh", False)),
+                synthetic_size=self.cfg.synthetic_size,
                 dataset_kwargs=dataset_kwargs_for_model(
                     self.cfg.model_key, self.cfg.model_kwargs))
 

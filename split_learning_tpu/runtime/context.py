@@ -28,7 +28,6 @@ from __future__ import annotations
 
 import math
 import time
-import zlib
 
 import jax
 import jax.numpy as jnp
@@ -36,7 +35,7 @@ import numpy as np
 import optax
 
 from split_learning_tpu.config import Config
-from split_learning_tpu.data import make_data_loader
+from split_learning_tpu.data import make_data_loader, subset_seed
 from split_learning_tpu.models import build_model, shard_params
 from split_learning_tpu.models.split import SplitModel
 from split_learning_tpu.parallel.mesh import make_mesh, stage_ranges
@@ -143,12 +142,20 @@ class MeshContext(TrainContext):
         x = jnp.zeros(self._example.shape, self._example.dtype)
         return self.full_model.init(rng, x, train=False)
 
-    def _loader(self, client_key: str, label_counts: np.ndarray):
-        key = (client_key, tuple(np.asarray(label_counts).tolist()))
+    def _loader(self, client_key: str, label_counts: np.ndarray,
+                round_idx: int = 0):
+        refresh = self.cfg.distribution.refresh
+        key = (client_key, tuple(np.asarray(label_counts).tolist()),
+               round_idx if refresh else 0)
         if key not in self._loader_cache:
-            # stable per-client seed (hash() is salted per process)
-            seed = (zlib.crc32(client_key.encode()) ^ self.cfg.seed) \
-                % (2 ** 31)
+            if refresh:
+                # evict this client's prior-round loaders: each holds a
+                # materialized subset copy and is never reused
+                for k in [k for k in self._loader_cache
+                          if k[0] == client_key]:
+                    del self._loader_cache[k]
+            seed = subset_seed(self.cfg.seed, client_key, round_idx,
+                               refresh)
             self._loader_cache[key] = make_data_loader(
                 self.dataset, self.cfg.learning.batch_size,
                 distribution=np.asarray(label_counts), train=True,
@@ -497,7 +504,8 @@ class MeshContext(TrainContext):
         opt_c = shard_to_mesh(opt_init(params_c), mesh)
 
         timings: dict = {}
-        loaders = [self._loader(c, counts[c]) for c in stage1]
+        loaders = [self._loader(c, counts[c], round_idx)
+                   for c in stage1]
         params_c, opt_c, stats_c, loss_h, consumed = self._drive_columns(
             step, loaders, c_phys, M, mb, epochs, round_idx,
             params_c, opt_c, stats_c, timings=timings)
@@ -583,7 +591,8 @@ class MeshContext(TrainContext):
             if frozen_c is not None:
                 frozen_c = shard_to_mesh(frozen_c, mesh)
 
-            loaders = [self._loader(c, counts[c]) for c in cols]
+            loaders = [self._loader(c, counts[c], round_idx)
+                       for c in cols]
             params_c, opt_c, stats_c, loss_h, consumed = (
                 self._drive_columns(
                     step, loaders, c_phys, M, mb, epochs, round_idx,

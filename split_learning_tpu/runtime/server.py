@@ -462,6 +462,7 @@ class ProtocolContext(MeshContext):
                        "sda_peers": (list(plan.clients[s])
                                      if sda_route and s < plan.n_stages
                                      else None),
+                       "refresh": self.cfg.distribution.refresh,
                        "gen": self._cur_gen})))
             self.log.sent(f"START -> {cid} layers=[{a}, {end_layer}]"
                           + ("" if sp else " (no weights)"))

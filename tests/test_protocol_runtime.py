@@ -410,6 +410,43 @@ def test_elastic_join_over_tcp_broker(tmp_path):
         broker.close()
 
 
+def test_refresh_rebuilds_loader_on_weightless_start(tmp_path):
+    """distribution.refresh must re-sample the subset even on a FLEX
+    hold round's weight-less START (the reference rebuilds its loader
+    on every START when refresh is on, src/RpcClient.py:108)."""
+    import jax
+    import jax.numpy as jnp
+
+    from split_learning_tpu.models import build_model, shard_params
+    from split_learning_tpu.runtime.protocol import Start
+
+    cfg = proto_cfg(tmp_path, clients=[1, 1], synthetic_size=400,
+                    distribution={"refresh": True})
+    client = ProtocolClient(cfg, "edge", 1,
+                            transport=InProcTransport())
+    model = build_model(cfg.model_key, **(cfg.model_kwargs or {}))
+    x = jnp.zeros((1, 40, 98), jnp.float32)
+    params = model.init(jax.random.key(0), x, train=False)["params"]
+    shard = shard_params(params, model.specs, 0, 2)
+    counts = np.full(10, 4)
+    extra = {"refresh": True, "gen": 1}
+
+    client._on_start(Start(start_layer=0, end_layer=2, cluster=0,
+                           params=shard, learning={},
+                           label_counts=counts, round_idx=0,
+                           extra=extra))
+    first = client.loader
+    a = np.asarray(first.dataset.inputs)
+    # round 1: FLEX hold round — no weights, same learning dict
+    client._on_start(Start(start_layer=0, end_layer=2, cluster=0,
+                           params=None, learning={},
+                           label_counts=counts, round_idx=1,
+                           extra=extra))
+    assert client.loader is not first
+    assert not np.array_equal(np.asarray(client.loader.dataset.inputs),
+                              a), "hold START did not re-sample"
+
+
 def test_client_ranges_track_per_cluster_cuts(tmp_path):
     """The elastic needs-params decision diffs each client's layer
     range: two clusters with different cuts must yield different ranges
