@@ -266,6 +266,177 @@ def test_late_recovery_noop_without_fallback():
     assert runner.calls == [] and "late_recovery" not in rel
 
 
+class _FakeBudget:
+    """Budget stub with scripted remaining() values (last one sticks)."""
+
+    def __init__(self, remainings, total=100.0):
+        self.seq = list(remainings)
+        self.total = total
+
+    def remaining(self):
+        return self.seq.pop(0) if len(self.seq) > 1 else self.seq[0]
+
+    def elapsed(self):
+        return self.total - self.seq[0]
+
+
+def test_budget_exhaustion_skips_remaining_sections():
+    # first section fits; the budget is gone before the second — it and
+    # everything after must be recorded as skipped, never started
+    plan = [("headline", 50), ("round", 50),
+            ("resnet50_cifar100_3way_cut_3_6", 50)]
+    script = {"headline": [{"samples_per_sec": 5.0, "batch": 1}],
+              "round": [{"rounds": 1}],
+              "resnet50_cifar100_3way_cut_3_6": [{"samples_per_sec": 1.0}]}
+    flushes = []
+    ctx = {"mode": "tpu"}
+    rel = {"probe_history": []}
+    cfgs, extra = {}, {}
+    runner = _fake_runner(script)
+    results = bench.run_plan(
+        plan, ctx, "tpu", rel, cfgs, extra, runner=runner,
+        prober=_fake_prober([True]),
+        budget=_FakeBudget([200.0, 10.0]),
+        on_section=lambda: flushes.append(True))
+    assert [n for n, _ in runner.calls] == ["headline"]
+    assert results == {"headline": {"samples_per_sec": 5.0, "batch": 1}}
+    assert extra["round"] == {"error": "skipped (budget)"}
+    assert cfgs["resnet50_cifar100_3way_cut_3_6"] == {
+        "error": "skipped (budget)"}
+    assert rel["budget_skipped"] == ["round",
+                                     "resnet50_cifar100_3way_cut_3_6"]
+    # flushed after the completed section AND after marking the skips
+    assert len(flushes) == 2
+
+
+def test_budget_clips_section_watchdog():
+    plan = [("headline", 900)]
+    seen = []
+
+    def runner(name, timeout, ctx):
+        seen.append(timeout)
+        return {"result": {"samples_per_sec": 1.0, "batch": 1},
+                "backend": "tpu"}, None
+
+    bench.run_plan(plan, {"mode": "tpu"}, "tpu", {"probe_history": []},
+                   {}, {}, runner=runner, prober=_fake_prober([True]),
+                   budget=_FakeBudget([300.0]))
+    assert seen == [300.0]
+
+
+def test_budget_clipped_watchdog_is_not_a_wedge():
+    # a kill at a budget-clipped deadline is budget exhaustion, not
+    # tunnel evidence: no probe, no CPU fallback, honest error label
+    plan = [("headline", 900), ("round", 50)]
+
+    def runner(name, timeout, ctx):
+        if name == "headline":
+            return None, ("watchdog: section wedged, killed after "
+                          f"{timeout:.0f}s")
+        return {"result": {"rounds": 1}, "backend": "tpu"}, None
+
+    probes = []
+
+    def probe(attempts, history):
+        probes.append(True)
+        return True, "TPU fake"
+
+    ctx = {"mode": "tpu"}
+    rel = {"probe_history": []}
+    extra = {}
+    bench.run_plan(plan, ctx, "tpu", rel, {}, extra, runner=runner,
+                   prober=probe, budget=_FakeBudget([300.0]))
+    assert "budget-clip" in extra["headline"]["error"]
+    assert probes == [] and "midbench_fallback_at" not in rel
+    assert ctx["mode"] == "tpu"
+
+
+def test_late_recovery_skipped_when_budget_tight():
+    plan = [("headline", 1)]
+    rel = {"probe_history": [], "midbench_fallback_at": "headline"}
+    runner = _fake_runner({"headline": [{"samples_per_sec": 9.0}]})
+    bench.late_recovery_pass(plan, {"mode": "cpu"}, {}, rel, {}, {},
+                             runner=runner, prober=_fake_prober([True]),
+                             budget=_FakeBudget([50.0]))
+    assert runner.calls == []
+    assert rel["late_recovery"] == {"skipped": "budget"}
+
+
+def test_cap_probe_plan_bounds_spend_but_keeps_first_attempt():
+    plan = [(180, 0), (240, 60), (300, 90), (300, 120)]
+    capped = bench._cap_probe_plan(plan, 500)
+    assert capped == [(180, 0), (240, 60)]
+    # even an absurdly tight cap keeps one attempt — probing zero times
+    # would silently condemn a healthy TPU to a CPU run
+    assert bench._cap_probe_plan(plan, 1) == [(180, 0)]
+
+
+def _run_bench_main(env_extra, tmp_path, kill_when_started=False,
+                    timeout=120):
+    import json as _json
+    import signal as _signal
+    import subprocess
+    import time as _time
+
+    partial = tmp_path / "partial.json"
+    env = os.environ.copy()
+    env.update({"JAX_PLATFORMS": "cpu", "SLT_BENCH_FAKE_BASELINE": "100",
+                "SLT_BENCH_FAST_PROBE": "1",
+                "SLT_BENCH_PARTIAL_PATH": str(partial)})
+    env.update(env_extra)
+    proc = subprocess.Popen(
+        [sys.executable, str(HERE.parent / "bench.py")],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+        text=True)
+    if kill_when_started:
+        # the first partial flush proves the handler is installed — a
+        # SIGTERM during interpreter startup can't be caught by anyone
+        deadline = _time.monotonic() + 60
+        while not partial.exists() and _time.monotonic() < deadline:
+            _time.sleep(0.2)
+        assert partial.exists(), "orchestrator never flushed a partial"
+        _time.sleep(1.0)  # let it get into the section
+        proc.send_signal(_signal.SIGTERM)
+    out, _ = proc.communicate(timeout=timeout)
+    lines = [ln for ln in out.strip().splitlines() if ln.startswith("{")]
+    assert lines, f"no JSON line on stdout: {out!r}"
+    return _json.loads(lines[-1]), proc.returncode
+
+
+def test_artifact_lands_under_tiny_budget(tmp_path):
+    # VERDICT r3 item 1's prescribed test: a budget too small for ANY
+    # section must still produce one valid JSON line (rc=0 path)
+    rec, rc = _run_bench_main({"SLT_BENCH_BUDGET_S": "1",
+                               "SLT_BENCH_PLAN": "_test_ok"}, tmp_path)
+    assert rc == 0
+    assert rec["value"] is None and rec["unit"] == "samples/sec/chip"
+    assert rec["extra"]["_test_ok"] == {"error": "skipped (budget)"}
+    assert rec["extra"]["reliability"]["budget_skipped"] == ["_test_ok"]
+
+
+def test_orchestrator_exception_still_emits_artifact(tmp_path):
+    # an orchestrator bug must not lose the artifact: the record lands
+    # on stdout with the error noted, and the rc stays nonzero
+    rec, rc = _run_bench_main({"SLT_BENCH_BUDGET_S": "60",
+                               "SLT_BENCH_FAKE_BASELINE": "notafloat",
+                               "SLT_BENCH_PLAN": "_test_ok"}, tmp_path)
+    assert rc != 0
+    assert rec["value"] is None
+    assert "ValueError" in rec["extra"]["reliability"]["orchestrator_error"]
+
+
+@pytest.mark.slow
+def test_sigterm_mid_section_still_emits_artifact(tmp_path):
+    # the round-3 failure mode: the driver kills the bench mid-section.
+    # The SIGTERM handler must print the partial record before dying.
+    rec, rc = _run_bench_main({"SLT_BENCH_BUDGET_S": "600",
+                               "SLT_BENCH_PLAN": "_test_wedge:600"},
+                              tmp_path, kill_when_started=True)
+    assert rec["value"] is None
+    assert rec["extra"]["reliability"]["killed_by_signal"] == "SIGTERM"
+    assert rc == 128 + 15  # killed runs must not read as clean successes
+
+
 def test_real_watchdog_kills_wedged_section(monkeypatch):
     monkeypatch.setenv("SLT_BENCH_SECTION_TIMEOUT", "3")
     monkeypatch.setenv("JAX_PLATFORMS", "cpu")
