@@ -429,11 +429,16 @@ class ProtocolClient:
                               "(weights kept)")
             else:
                 self.log.info("keeping local shard weights (no re-seed)")
-            if (msg.extra or {}).get("refresh"):
-                # distribution.refresh re-samples the subset every
-                # round even on hold (weight-less) STARTs — the
-                # reference rebuilds its loader on every START when
-                # refresh is on (src/RpcClient.py:108)
+            # rebuild the loader on a hold START when (a) refresh
+            # re-samples every round (the reference rebuilds its loader
+            # on every START when refresh is on, src/RpcClient.py:108)
+            # or (b) an elastic re-plan moved this client's data
+            # distribution without moving its layer range — otherwise
+            # the server's plan and the trained subset silently diverge
+            if (self.stage == 1 and msg.label_counts is not None
+                    and ((msg.extra or {}).get("refresh")
+                         or [int(c) for c in msg.label_counts]
+                         != getattr(self, "_loader_counts", None))):
                 self._build_loader(msg)
             return
         model_kwargs = dict(self.cfg.model_kwargs or {})
@@ -477,6 +482,9 @@ class ProtocolClient:
                 synthetic_size=self.cfg.synthetic_size,
                 dataset_kwargs=dataset_kwargs_for_model(
                     self.cfg.model_key, self.cfg.model_kwargs))
+            # remembered so a weight-less (hold) START whose plan moved
+            # this client's data distribution still rebuilds the loader
+            self._loader_counts = [int(c) for c in msg.label_counts]
 
     def _on_syn(self, msg: Syn):
         self.log.info(f"[<<<] SYN round={msg.round_idx}")

@@ -218,9 +218,14 @@ class ProtocolContext(MeshContext):
         need = list(self.cfg.clients)
 
         def by_stage() -> list[int]:
+            # out-of-range stages are deliberately kept registered in
+            # non-elastic mode for fail-fast planning; they must not
+            # crash (stage > len) or miscount (stage 0) the timeout
+            # message that reports them
             counts = [0] * len(need)
             for r in self._registrations.values():
-                counts[r.stage - 1] += 1
+                if 1 <= r.stage <= len(need):
+                    counts[r.stage - 1] += 1
             return counts
 
         if self.cfg.topology.elastic_join:

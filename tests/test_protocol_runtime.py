@@ -447,6 +447,67 @@ def test_refresh_rebuilds_loader_on_weightless_start(tmp_path):
                               a), "hold START did not re-sample"
 
 
+def test_registration_timeout_reports_out_of_range_stage(tmp_path):
+    """A non-elastic out-of-range registration is kept for fail-fast
+    planning, but the registration-timeout message must survive it:
+    by_stage() used to IndexError on stage > num_stages (and silently
+    miscount stage 0), masking the intended RoundTimeout."""
+    from split_learning_tpu.runtime.protocol import Register, encode
+    from split_learning_tpu.runtime.server import (
+        ProtocolContext, RoundTimeout,
+    )
+
+    cfg = proto_cfg(tmp_path, clients=[1, 1])
+    bus = InProcTransport()
+    ctx = ProtocolContext(cfg, bus, client_timeout=1.0)
+    bus.publish("rpc_queue", encode(Register(client_id="weird",
+                                             stage=5)))
+    with pytest.raises(RoundTimeout, match=r"per-stage \[0, 0\]"):
+        ctx.wait_for_registrations()
+
+
+def test_hold_start_with_changed_label_counts_rebuilds_loader(tmp_path):
+    """An elastic re-plan can change a stage-1 client's data
+    distribution without moving its layer range: the weight-less (hold)
+    START carrying the NEW label_counts must rebuild the loader even
+    without distribution.refresh, or the client keeps training on the
+    old subset while the server's plan records the new one."""
+    import jax
+    import jax.numpy as jnp
+
+    from split_learning_tpu.models import build_model, shard_params
+    from split_learning_tpu.runtime.protocol import Start
+
+    cfg = proto_cfg(tmp_path, clients=[1, 1], synthetic_size=400)
+    client = ProtocolClient(cfg, "edge", 1,
+                            transport=InProcTransport())
+    model = build_model(cfg.model_key, **(cfg.model_kwargs or {}))
+    x = jnp.zeros((1, 40, 98), jnp.float32)
+    params = model.init(jax.random.key(0), x, train=False)["params"]
+    shard = shard_params(params, model.specs, 0, 2)
+    extra = {"gen": 1}
+
+    client._on_start(Start(start_layer=0, end_layer=2, cluster=0,
+                           params=shard, learning={},
+                           label_counts=np.full(10, 4), round_idx=0,
+                           extra=extra))
+    first = client.loader
+    # hold START, same counts: the loader must be KEPT (no refresh)
+    client._on_start(Start(start_layer=0, end_layer=2, cluster=0,
+                           params=None, learning={},
+                           label_counts=np.full(10, 4), round_idx=1,
+                           extra=extra))
+    assert client.loader is first
+    # hold START, moved distribution: the loader must follow it
+    new_counts = np.concatenate([np.full(5, 8), np.zeros(5, int)])
+    client._on_start(Start(start_layer=0, end_layer=2, cluster=0,
+                           params=None, learning={},
+                           label_counts=new_counts, round_idx=2,
+                           extra=extra))
+    assert client.loader is not first
+    assert np.asarray(client.loader.dataset.labels).max() < 5
+
+
 def test_client_ranges_track_per_cluster_cuts(tmp_path):
     """The elastic needs-params decision diffs each client's layer
     range: two clusters with different cuts must yield different ranges
