@@ -17,15 +17,31 @@ from jax.sharding import Mesh
 
 
 def make_mesh(n_clients: int, n_stages: int,
-              devices: Sequence | None = None) -> Mesh:
-    """Mesh of shape (client, stage) over the first n_clients*n_stages
-    devices."""
+              devices: Sequence | None = None,
+              tensor_parallel: int = 1) -> Mesh:
+    """Mesh of shape (client, stage[, model]) over the first
+    n_clients*n_stages*tensor_parallel devices.
+
+    With ``tensor_parallel > 1`` a third ``model`` axis is appended:
+    each (client, stage) cell becomes a TP group whose parameters shard
+    over ``model`` under the GSPMD rules in
+    :mod:`split_learning_tpu.parallel.tensor` — pipeline collectives
+    stay manual over ``stage`` while XLA derives the TP collectives
+    (the PP x TP composition the reference's per-stage torch clients
+    cannot express, ``src/Server.py:222-228``)."""
     devs = list(devices if devices is not None else jax.devices())
-    need = n_clients * n_stages
+    need = n_clients * n_stages * tensor_parallel
     if len(devs) < need:
         raise ValueError(
             f"need {need} devices for mesh (client={n_clients}, "
-            f"stage={n_stages}), have {len(devs)}")
+            f"stage={n_stages}"
+            + (f", model={tensor_parallel}" if tensor_parallel > 1
+               else "")
+            + f"), have {len(devs)}")
+    if tensor_parallel > 1:
+        grid = np.array(devs[:need]).reshape(n_clients, n_stages,
+                                             tensor_parallel)
+        return Mesh(grid, ("client", "stage", "model"))
     grid = np.array(devs[:need]).reshape(n_clients, n_stages)
     return Mesh(grid, ("client", "stage"))
 

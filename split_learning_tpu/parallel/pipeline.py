@@ -321,6 +321,21 @@ def _restore(tree):
 
 
 
+def _shmap_kwargs(mesh: Mesh) -> dict:
+    """Extra ``jax.shard_map`` kwargs for this mesh.
+
+    On a (client, stage) mesh every axis is manual (the default).  When
+    the mesh carries a ``model`` tensor-parallel axis, only client/stage
+    stay manual — ``model`` is left to GSPMD, so parameters sharded
+    under :func:`split_learning_tpu.parallel.tensor.tp_spec` get their
+    TP collectives (all-gather after column-parallel, psum after
+    row-parallel) derived by XLA *inside* the manual pipeline body.
+    """
+    if "model" in mesh.axis_names:
+        return {"axis_names": frozenset({"client", "stage"})}
+    return {}
+
+
 def _make_grad_sync(client_sync: dict | None, mesh: Mesh):
     """Shared grouped-gradient-mean closure for the dense and LoRA steps.
 
@@ -422,6 +437,7 @@ def make_train_step(pipe: PipelineModel, optimizer: optax.GradientTransformation
         in_specs=(spec_c,) * 6,
         out_specs=(spec_c,) * 4,
         check_vma=False,
+        **_shmap_kwargs(mesh),
     )
     return jax.jit(mapped, donate_argnums=(0, 1, 2) if donate else ())
 
@@ -493,7 +509,8 @@ def make_fedavg_step(mesh: Mesh) -> Callable:
 
     mapped = jax.shard_map(
         body, mesh=mesh, in_specs=(P("client"), P("client")),
-        out_specs=P("client"), check_vma=False)
+        out_specs=P("client"), check_vma=False,
+        **_shmap_kwargs(mesh))
     return jax.jit(mapped)
 
 
@@ -517,8 +534,26 @@ def stack_for_clients(tree, n_clients: int):
 
 
 def shard_to_mesh(tree, mesh: Mesh):
-    """Place a client-stacked pytree onto the mesh (client-sharded,
-    stage-replicated)."""
+    """Place a client-stacked pytree onto the mesh: client-sharded,
+    stage-replicated — and, when the mesh carries a ``model`` axis,
+    tensor-sharded per leaf under the Megatron-style rules of
+    :func:`split_learning_tpu.parallel.tensor.tp_spec` (the path-based
+    rules see through opt-state wrappers; non-matching leaves simply
+    replicate)."""
+    if "model" in mesh.axis_names:
+        import types
+
+        from split_learning_tpu.parallel.tensor import tp_spec
+
+        def put(path, leaf):
+            # tp_spec sizes its spec to the UNSTACKED leaf; the client
+            # axis is dim 0 here
+            sub = tp_spec(path, types.SimpleNamespace(
+                ndim=jnp.ndim(leaf) - 1))
+            sharding = NamedSharding(mesh, P("client", *tuple(sub)))
+            return jax.device_put(leaf, sharding)
+
+        return jax.tree_util.tree_map_with_path(put, tree)
     sharding = NamedSharding(mesh, P("client"))
     return jax.tree_util.tree_map(
         lambda a: jax.device_put(a, sharding), tree)

@@ -48,7 +48,7 @@ from jax.flatten_util import ravel_pytree
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from split_learning_tpu.parallel.pipeline import (
-    PipelineModel, _restore, _strip,
+    PipelineModel, _make_grad_sync, _restore, _shmap_kwargs, _strip,
 )
 
 
@@ -140,15 +140,35 @@ def init_zero1_opt_state(params_host, n_clients: int,
 
     ``mu``/``nu`` are bf16 vectors of shape ``(C, A * shard_len)`` —
     flattened over all parameters, zero-padded to a multiple of the
-    ``stage`` axis so the mesh can shard dim 1 evenly.
+    ``stage`` axis so the mesh can shard dim 1 evenly.  The per-client
+    layout is defined once in :func:`zero1_init_facade`; this is just
+    its client-stacking.
     """
-    _, shard = _flat_geometry(params_host, stage_axis)
-    padded = shard * stage_axis
-    return {
-        "mu": jnp.zeros((n_clients, padded), jnp.bfloat16),
-        "nu": jnp.zeros((n_clients, padded), jnp.bfloat16),
-        "count": jnp.zeros((n_clients,), jnp.int32),
-    }
+    one = zero1_init_facade(stage_axis).init(params_host)
+    return jax.tree_util.tree_map(
+        lambda a: jnp.broadcast_to(a[None], (n_clients,) + a.shape), one)
+
+
+def zero1_init_facade(stage_axis: int):
+    """optax-lookalike whose ``init(params)`` returns ONE client's
+    ZeRO-1 AdamW state (unstacked: bf16 ``mu``/``nu`` vectors padded to
+    a multiple of ``stage_axis``, scalar ``count``).
+
+    The runtime's generic call sites build optimizer state as
+    ``stack_for_clients(optimizer.init(p0), c_phys)`` — handing them
+    this facade yields exactly :func:`init_zero1_opt_state`'s layout
+    without special-casing (``learning.optimizer: adamw-zero1`` from
+    YAML, VERDICT r3 item 3)."""
+    import types
+
+    def init(params):
+        _, shard = _flat_geometry(params, stage_axis)
+        padded = shard * stage_axis
+        return {"mu": jnp.zeros((padded,), jnp.bfloat16),
+                "nu": jnp.zeros((padded,), jnp.bfloat16),
+                "count": jnp.zeros((), jnp.int32)}
+
+    return types.SimpleNamespace(init=init)
 
 
 def shard_zero1_to_mesh(opt_state: dict, mesh: Mesh) -> dict:
@@ -168,7 +188,8 @@ def make_zero1_train_step(pipe: PipelineModel, mesh: Mesh,
                           b2: float = 0.999, eps: float = 1e-8,
                           weight_decay: float = 0.0,
                           train: bool = True,
-                          donate: bool = True) -> Callable:
+                          donate: bool = True,
+                          client_sync: dict | None = None) -> Callable:
     """Pipelined train step with ZeRO-1 sharded bf16 AdamW moments.
 
     Same calling convention as ``pipeline.make_train_step`` except
@@ -177,8 +198,14 @@ def make_zero1_train_step(pipe: PipelineModel, mesh: Mesh,
 
     ``step(params_c, opt_c, stats_c, x, labels, rngs) ->
     (params_c, opt_c, stats_c, loss[C])``
+
+    ``client_sync`` applies the same per-layer grouped gradient mean as
+    the dense step (shared later-stage clients), BEFORE the flat shard
+    slice — the moments then track the synced gradient, keeping group
+    columns bit-identical exactly as the dense path does.
     """
     stage_axis = int(mesh.shape["stage"])
+    grad_sync = _make_grad_sync(client_sync, mesh)
 
     def body(params, opt_state, stats, x, labels, rngs):
         # opt moments arrive SHARDED: local block (1, shard_len)
@@ -198,6 +225,8 @@ def make_zero1_train_step(pipe: PipelineModel, mesh: Mesh,
             loss_fn, has_aux=True)(params)
         grads = jax.tree_util.tree_map(
             lambda g: jax.lax.psum(g, "stage"), grads)
+        if grad_sync is not None:
+            grads = grad_sync(grads, jax.lax.axis_index("client"))
 
         # flatten params+grads in one canonical ravel order; slice my shard
         pflat, unravel = ravel_pytree(params)
@@ -239,5 +268,6 @@ def make_zero1_train_step(pipe: PipelineModel, mesh: Mesh,
         in_specs=(spec_c, spec_opt, spec_c, spec_c, spec_c, spec_c),
         out_specs=(spec_c, spec_opt, spec_c, spec_c),
         check_vma=False,
+        **_shmap_kwargs(mesh),
     )
     return jax.jit(mapped, donate_argnums=(0, 1, 2) if donate else ())

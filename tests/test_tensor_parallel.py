@@ -81,3 +81,56 @@ def test_tp_dp_train_step(eight_devices):
     assert losses[-1] < losses[0]
     k = params["layer2"]["attention"]["q_proj"]["kernel"]
     assert len(k.sharding.device_set) >= 4
+
+
+@pytest.mark.slow
+def test_pp_tp_pipeline_matches_pp_only(eight_devices):
+    """PP x TP in ONE mesh (VERDICT r3 item 2): the pipelined train step
+    on a (client=2, stage=2, model=2) mesh — manual ppermute pipeline
+    over `stage`, GSPMD tensor sharding over `model` — must produce the
+    same losses and updated params as the plain (client=2, stage=2)
+    pipeline, with TP params genuinely distributed."""
+    from split_learning_tpu.parallel.pipeline import (
+        PipelineModel, init_pipeline_variables, make_train_step,
+        shard_to_mesh, stack_for_clients,
+    )
+
+    kw = dict(TINY_LLAMA, n_block=2)
+    mb, m = 2, 2
+    struct = jax.ShapeDtypeStruct((mb, 16), jnp.int32)
+    pipe = PipelineModel("TinyLlama_TINYSTORIES", cuts=[2],
+                         example_input=struct, num_microbatches=m,
+                         model_kwargs=kw)
+    variables = init_pipeline_variables(pipe, jax.random.key(0), struct)
+    params, stats = variables["params"], variables.get("batch_stats", {})
+    opt = optax.sgd(1e-2)
+    opt_state = opt.init(params)
+    x = jax.random.randint(jax.random.key(2), (2, m, mb, 16), 0,
+                           kw["vocab_size"], jnp.int32)
+    y = jax.random.randint(jax.random.key(3), (2, m, mb, 16), 0,
+                           kw["vocab_size"], jnp.int32)
+    rngs = jax.vmap(jax.random.key)(jnp.arange(2))
+
+    def run(mesh):
+        pc = shard_to_mesh(stack_for_clients(params, 2), mesh)
+        oc = shard_to_mesh(stack_for_clients(opt_state, 2), mesh)
+        sc = shard_to_mesh(stack_for_clients(stats, 2), mesh)
+        step = make_train_step(pipe, opt, mesh)
+        return step(pc, oc, sc, x, y, rngs)
+
+    mesh_pp = Mesh(np.array(eight_devices[:4]).reshape(2, 2),
+                   ("client", "stage"))
+    p2, _, _, loss2 = run(mesh_pp)
+
+    mesh_pptp = Mesh(np.array(eight_devices).reshape(2, 2, 2),
+                     ("client", "stage", "model"))
+    p3, _, _, loss3 = run(mesh_pptp)
+
+    np.testing.assert_allclose(np.asarray(loss2), np.asarray(loss3),
+                               rtol=2e-4)
+    for l2, l3 in zip(jax.tree_util.tree_leaves(p2),
+                      jax.tree_util.tree_leaves(p3)):
+        np.testing.assert_allclose(np.asarray(l2), np.asarray(l3),
+                                   rtol=2e-3, atol=1e-5)
+    k = p3["layer2"]["attention"]["q_proj"]["kernel"]
+    assert "model" in tuple(k.sharding.spec)

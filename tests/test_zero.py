@@ -109,3 +109,74 @@ def test_zero1_step_matches_dense_adamw(eight_devices):
                         stack_for_clients(params, C), mesh)))):
         np.testing.assert_allclose(a - p, b - p, rtol=3e-2, atol=1e-4,
                                    err_msg=str(path))
+
+
+@pytest.mark.slow
+def test_zero1_from_yaml_runs_end_to_end(tmp_path, eight_devices):
+    """learning.optimizer: adamw-zero1 from pure YAML (VERDICT r3 item
+    3): run_local trains a cut BERT with stage-sharded bf16 moments —
+    including the shared-stage-2 sync group the [2, 1] client shape
+    creates — and the round succeeds with finite validation."""
+    from split_learning_tpu.config import from_dict
+    from split_learning_tpu.run import run_local
+    from split_learning_tpu.runtime.log import Logger
+
+    cfg = from_dict(dict(
+        model="BERT", dataset="AGNEWS", clients=[2, 1],
+        global_rounds=1, synthetic_size=16, val_max_batches=1,
+        val_batch_size=4, compute_dtype="float32",
+        model_kwargs={"hidden_size": 32, "num_heads": 2,
+                      "intermediate_size": 64, "n_block": 2},
+        log_path=str(tmp_path / "logs"),
+        learning={"batch_size": 2, "control_count": 2,
+                  "optimizer": "adamw-zero1", "learning-rate": 1e-3},
+        distribution={"num_samples": 8},
+        checkpoint={"save": False},
+        topology={"cut_layers": [2], "force_pipeline": True},
+    ))
+    res = run_local(cfg, logger=Logger(cfg.log_path, console=False))
+    rec = res.history[-1]
+    assert rec.ok
+    assert rec.val_accuracy is not None
+    assert np.isfinite(rec.val_loss)
+
+
+def test_zero1_rejected_with_clip_or_lora():
+    from split_learning_tpu.config import ConfigError, from_dict
+
+    with pytest.raises(ConfigError):
+        from_dict({"learning": {"optimizer": "adamw-zero1",
+                                "clip_grad_norm": 1.0}})
+    with pytest.raises(ConfigError):
+        from_dict({"learning": {"optimizer": "adamw-zero1",
+                                "lora_rank": 4}})
+
+
+def test_zero1_rejected_with_tensor_parallel(tmp_path, eight_devices):
+    """adamw-zero1 + tensor-parallel must fail fast: the flat moment
+    shards are sized to unsharded params, so silently forfeiting TP
+    (or mis-sharding moments) is worse than an error."""
+    from split_learning_tpu.config import from_dict
+    from split_learning_tpu.runtime.context import MeshContext
+    from split_learning_tpu.runtime.plan import plan_clusters, Registration
+
+    cfg = from_dict(dict(
+        model="TinyLlama", dataset="TINYSTORIES", clients=[2, 2],
+        synthetic_size=8, log_path=str(tmp_path),
+        model_kwargs={"hidden_size": 32, "num_heads": 2,
+                      "num_kv_heads": 2, "intermediate_size": 64,
+                      "n_block": 2},
+        learning={"batch_size": 2, "control_count": 2,
+                  "optimizer": "adamw-zero1", "learning_rate": 1e-3},
+        distribution={"num_samples": 8},
+        checkpoint={"save": False},
+        topology={"cut_layers": [2], "tensor_parallel": 2,
+                  "force_pipeline": True}))
+    regs = [Registration(client_id=f"c{s}_{i}", stage=s)
+            for s in (1, 2) for i in range(2)]
+    plan = plan_clusters(cfg, regs)[0]
+    ctx = MeshContext(cfg)
+    c, s, cuts, tp = ctx._geometry(plan, 2)
+    assert tp == 2
+    with pytest.raises(ValueError, match="tensor-parallel"):
+        ctx._compiled(plan, c, s, cuts, None, (), None, tp=tp)
