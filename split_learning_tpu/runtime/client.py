@@ -51,9 +51,9 @@ from split_learning_tpu.ops.lora import lora_init, lora_merge, split_frozen
 from split_learning_tpu.runtime.bus import Transport, make_transport
 from split_learning_tpu.runtime.log import Logger
 from split_learning_tpu.runtime.protocol import (
-    Activation, Gradient, Notify, Pause, Ready, Register, Start, Stop, Syn,
-    QuantLeaf, Update, decode, encode, gradient_queue, intermediate_queue,
-    reply_queue, RPC_QUEUE,
+    Activation, EpochEnd, Gradient, Notify, Pause, Ready, Register, Start,
+    Stop, Syn, QuantLeaf, Update, decode, encode, gradient_queue,
+    intermediate_queue, reply_queue, RPC_QUEUE,
 )
 from split_learning_tpu.runtime.validation import dataset_for_model
 
@@ -323,6 +323,8 @@ class ProtocolClient:
         self.loader = None
         self.epochs = 1
         self.sda_size = 1
+        self.sda_strict = False
+        self.sda_feeders = None
         self.round_ok = True
         self.num_samples = 0
         self.wire_dtype = _wire_np_dtype(cfg.transport.wire_dtype)
@@ -404,6 +406,8 @@ class ProtocolClient:
         # queues this client scatters successive batches across,
         # round-robin (other/DCSL/src/Scheduler.py:21-26, :110-133)
         self.sda_peers = extra.get("sda_peers")
+        self.sda_strict = bool(extra.get("sda_strict", False))
+        self.sda_feeders = extra.get("sda_feeders")
         if msg.params is None:
             # FLEX non-reseed round (other/FLEX/src/Server.py:220-226):
             # START without weights — keep the locally persisted shard
@@ -605,9 +609,29 @@ class ProtocolClient:
         cap = max(1, r.learning.control_count)
         n_fwd = n_bwd = 0
 
+        def fence_epoch():
+            # strict-SDA epoch fence: the head's hard window drains
+            # leftovers only on this marker.  Published right AFTER the
+            # final activation (per-queue FIFO orders it last) and
+            # BEFORE this client's gradient wait — the leftover
+            # batches' gradients are exactly what that wait needs, so
+            # fencing any later would deadlock the barrier.
+            if self.sda_strict and self.sda_size > 1:
+                for q in out_qs:
+                    self.bus.publish(q, encode(EpochEnd(
+                        client_id=self.client_id,
+                        round_idx=self.fence)))
+
         for _ in range(self.epochs):
             data_iter = iter(self.loader)
-            exhausted = False
+            # prefetch one batch: exhaustion must be known at the LAST
+            # dispatch, not when the in-flight cap next frees — with a
+            # strict head holding this feeder's batches, the cap never
+            # frees until the fence goes out
+            next_item = next(data_iter, None)
+            exhausted = next_item is None
+            if exhausted:
+                fence_epoch()   # empty loader: fence immediately
             while not (exhausted and n_fwd == n_bwd):
                 raw = self.bus.get(grad_q, timeout=0.0005)
                 if raw is not None:
@@ -641,11 +665,8 @@ class ProtocolClient:
                             f"PAUSE mid-loop with {len(inflight)} in flight")
                         return pause
                     continue
-                try:
-                    x, labels = next(data_iter)
-                except StopIteration:
-                    exhausted = True
-                    continue
+                x, labels = next_item
+                next_item = next(data_iter, None)
                 x = jnp.asarray(x)
                 rng = r.next_rng()
                 out = r.fwd(self.frozen, self.trainable, self.stats, x,
@@ -662,6 +683,9 @@ class ProtocolClient:
                     trace=[self.client_id], cluster=self.cluster,
                     round_idx=self.fence)))
                 n_fwd += 1
+                if next_item is None:
+                    exhausted = True
+                    fence_epoch()
         self.bus.publish(RPC_QUEUE, encode(Notify(
             client_id=self.client_id, cluster=self.cluster,
             round_idx=self.fence)))
@@ -753,14 +777,31 @@ class ProtocolClient:
         pending: dict[str, list[Activation]] = {}
         idle_flush_s = 0.25
         idle_since: float | None = None
-        # The barrier width ADAPTS: it starts at sda_size, and an
-        # idle-triggered partial flush (a feeder ran dry — uneven
-        # non-IID loaders make that the common case, not just the round
-        # tail) lowers it to the surviving feeder count so each
-        # subsequent burst doesn't re-pay the idle stall; it rises back
-        # toward sda_size the moment more distinct origins are live
-        # again (e.g. the next local epoch refills a short loader).
+        # aggregation.sda-strict picks the barrier discipline:
+        #
+        # * ELASTIC (default): the width ADAPTS — it starts at
+        #   sda_size, and an idle-triggered partial flush (a feeder ran
+        #   dry — uneven non-IID loaders make that the common case, not
+        #   just the round tail) lowers it to the surviving feeder
+        #   count so each subsequent burst doesn't re-pay the idle
+        #   stall; it rises back toward sda_size the moment more
+        #   distinct origins are live again.
+        # * STRICT (DCSL parity, other/DCSL/src/Scheduler.py:152-191):
+        #   a HARD sda_size distinct-origin barrier — a slow-but-alive
+        #   feeder is waited for, and leftovers drain only when every
+        #   origin still holding batches has fenced its epoch
+        #   (EpochEnd marker) or the round PAUSEs.
+        strict = self.sda_strict
         target = max(1, self.sda_size)
+        n_epochs = max(1, self.epochs)
+        # per-origin epoch-fence counts: an origin is out of the game
+        # only once it has fenced EVERY epoch of the round — a feeder
+        # that fenced epoch k < n still sends epoch k+1 batches, and
+        # cross-epoch windows are legitimate (the reference's scheduler
+        # pairs whatever distinct devices' batches are queued)
+        fences: dict[str, int] = {}
+        self._sda_fences = fences   # observability (tests assert the
+                                    # strict drain is fence-gated)
 
         def live() -> list[str]:
             return [o for o, q in pending.items() if q]
@@ -771,6 +812,26 @@ class ProtocolClient:
                 return None
             return [pending[o].pop(0)
                     for o in origins[:max(1, self.sda_size)]]
+
+        def drain_dead_barrier():
+            # strict: leftovers drain exactly when a full window can
+            # NEVER form again — the origins that could still
+            # contribute (feeders with unfenced epochs left, plus
+            # anything already buffered) no longer reach the barrier
+            # width.  Waiting longer would deadlock the feeders'
+            # gradient waits; draining sooner would break the barrier
+            # for a slow-but-alive feeder (the whole point of strict).
+            feeders = set(self.sda_feeders or ()) or set(pending)
+            while True:
+                possible = ({o for o in feeders
+                             if fences.get(o, 0) < n_epochs}
+                            | set(live()))
+                if len(possible) >= target:
+                    return
+                w = pop_window(require_full=False)
+                if not w:
+                    return
+                self._sda_step(w)
 
         while True:
             pause = self._check_pause()
@@ -784,6 +845,9 @@ class ProtocolClient:
                 return pause
             raw = self.bus.get(in_q, timeout=0.001)
             if raw is None:
+                if strict:
+                    continue   # hard barrier: block until traffic,
+                               # an epoch fence, or PAUSE
                 # the window is a BARRIER in steady state, but a
                 # starved barrier must not deadlock stage-1's gradient
                 # wait — flush a partial window after a real idle spell
@@ -798,7 +862,12 @@ class ProtocolClient:
                 continue
             act = decode(raw)
             if act.round_idx != self.fence:
-                continue   # activation from a dropped round: discard
+                continue   # message from a dropped round: discard
+            if isinstance(act, EpochEnd):
+                fences[act.client_id] = fences.get(act.client_id, 0) + 1
+                if strict:
+                    drain_dead_barrier()
+                continue
             # reset the idle clock only for CURRENT-round traffic — a
             # stream of stale activations must not starve the tail flush
             idle_since = None
@@ -809,6 +878,11 @@ class ProtocolClient:
             w = pop_window(require_full=True)
             if w:
                 self._sda_step(w)
+            elif strict:
+                # a batch buffered behind a dead barrier (every other
+                # feeder fully fenced) must not wait for a fence that
+                # already happened
+                drain_dead_barrier()
 
     def _sda_step(self, window: list[Activation]):
         r = self.runner

@@ -169,6 +169,20 @@ class Gradient:
 
 
 @dataclasses.dataclass
+class EpochEnd:
+    """stage k → stage k+1 (strict-SDA only): the feeder has dispatched
+    its last batch of this epoch.  DCSL's hard ``sda_size`` window
+    drains its leftovers only at epoch end
+    (``other/DCSL/src/Scheduler.py:152-191`` processes full windows,
+    then the epoch boundary clears the queues); this marker is how the
+    head learns the boundary without the server round-trip.  Rides the
+    data-plane queues so per-queue FIFO ordering guarantees it arrives
+    AFTER every activation it fences."""
+    client_id: str
+    round_idx: int = 0
+
+
+@dataclasses.dataclass
 class QuantLeaf:
     """One int8 absmax-quantized float tensor on the data-plane wire
     (``transport.wire-dtype: int8`` — ~4x smaller than the reference's
@@ -180,7 +194,7 @@ class QuantLeaf:
 
 
 CONTROL_TYPES = (Register, Ready, Notify, Update, Start, Syn, Pause, Stop)
-DATA_TYPES = (Activation, Gradient)
+DATA_TYPES = (Activation, Gradient, EpochEnd)
 _TYPE_BY_NAME = {t.__name__: t for t in CONTROL_TYPES + DATA_TYPES}
 #: nested wire-format helpers (never valid as a top-level message)
 _WIRE_HELPERS = {"QuantLeaf": QuantLeaf}

@@ -428,6 +428,10 @@ class ProtocolContext(MeshContext):
         # (per-device ``intermediate_queue_..._p{client_id}``) instead of
         # the shared cluster queue, and every later-stage device consumes
         # its own queue.
+        # snapshot BEFORE the sda_route mutation below: strict-SDA
+        # feeder sets must reflect the 2LS edge<->head pairing only —
+        # the per-device routing entries are not a feeder partition
+        pair_groups = dict(pair_of)
         sda_route = sda > 1 and plan.n_stages >= 2 and not pair_of
         if sda_route:
             for s in range(2, plan.n_stages + 1):
@@ -462,6 +466,23 @@ class ProtocolContext(MeshContext):
                 batch_stats=shard_s, learning=learning,
                 label_counts=label_counts, round_idx=round_idx,
                 extra={"epochs": epochs, "sda_size": sda,
+                       # strict barriers need the feeders themselves to
+                       # fence their epochs (EpochEnd): only direct
+                       # stage-1 feeders can — a middle stage never
+                       # knows its stream ended, so >2-stage plans keep
+                       # the elastic window (DCSL itself is 2-stage)
+                       "sda_strict": (self.cfg.aggregation.sda_strict
+                                      and plan.n_stages == 2),
+                       # the strict head must know its FULL feeder set:
+                       # draining leftovers is only safe once every
+                       # feeder that could still extend a window has
+                       # fenced its epoch — "everyone currently
+                       # buffered is done" is not enough (a quiet
+                       # feeder may still be mid-batch)
+                       "sda_feeders": (
+                           [c for c in stage1
+                            if pair_groups.get(c) == pair_groups.get(cid)]
+                           if pair_groups else list(stage1)),
                        "n_stages": plan.n_stages,
                        "pair": pair_of.get(cid),
                        "sda_peers": (list(plan.clients[s])
