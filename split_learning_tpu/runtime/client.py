@@ -50,6 +50,7 @@ from split_learning_tpu.models import build_model
 from split_learning_tpu.ops.lora import lora_init, lora_merge, split_frozen
 from split_learning_tpu.runtime.bus import Transport, make_transport
 from split_learning_tpu.runtime.log import Logger
+from split_learning_tpu.runtime.memo import bounded_setdefault
 from split_learning_tpu.runtime.protocol import (
     Activation, EpochEnd, Gradient, Notify, Pause, Ready, Register, Start,
     Stop, Syn, QuantLeaf, Update, decode, encode, gradient_queue,
@@ -144,6 +145,19 @@ def make_optimizer_from_dict(learning: dict | None) -> tuple[
     return make_optimizer(cfg), cfg
 
 
+def _ops_cache_key(model_key, start_layer, end_layer, learning,
+                   model_kwargs) -> tuple:
+    return (model_key, start_layer, end_layer,
+            repr(sorted((learning or {}).items())),
+            repr(sorted((model_kwargs or {}).items())))
+
+
+#: jitted-op bundles shared across ShardRunner instances with identical
+#: (model, layer range, learning, kwargs) — see runtime/memo.py
+_OPS_CACHE: dict = {}
+_OPS_CACHE_MAX = 64
+
+
 class ShardRunner:
     """Jitted forward / recompute-backward / optimizer ops for one shard.
 
@@ -169,6 +183,19 @@ class ShardRunner:
         self._counter = 0
         lrn = self.learning
         self.lora_rank, self.lora_alpha = lrn.lora_rank, lrn.lora_alpha
+
+        cache_key = _ops_cache_key(model_key, start_layer, end_layer,
+                                   learning, model_kwargs)
+        ops = bounded_setdefault(_OPS_CACHE, _OPS_CACHE_MAX, cache_key,
+                                 self._build_ops)
+        (self.fwd, self.bwd, self.last_step, self.whole_step,
+         self.apply_update, self._merged) = ops
+
+    def _build_ops(self) -> tuple:
+        """The five jitted ops + merged-params helper.  Closes over the
+        (stateless) model/optimizer only — everything instance-specific
+        (rng stream, params, stats) is passed per call, which is what
+        makes the bundle shareable through ``_OPS_CACHE``."""
 
         def merged(frozen, t):
             base = {**frozen, **t["head"]}
@@ -250,10 +277,8 @@ class ShardRunner:
             updates, new_opt = self.optimizer.update(grads, opt_state, t)
             return optax.apply_updates(t, updates), new_opt
 
-        self.fwd, self.bwd = fwd, bwd
-        self.last_step, self.whole_step = last_step, whole_step
-        self.apply_update = apply_update
-        self._merged = jax.jit(merged)
+        return (fwd, bwd, last_step, whole_step, apply_update,
+                jax.jit(merged))
 
     def partition_params(self, params, is_final_shard: bool):
         """(frozen, trainable) split of the shard's params.

@@ -26,6 +26,7 @@ interface.
 
 from __future__ import annotations
 
+import dataclasses
 import math
 import time
 
@@ -43,6 +44,7 @@ from split_learning_tpu.parallel.pipeline import (
     PipelineModel, make_lora_train_step, make_train_step, shard_to_mesh,
     stack_for_clients,
 )
+from split_learning_tpu.runtime.memo import bounded_setdefault
 from split_learning_tpu.runtime.plan import ClusterPlan
 from split_learning_tpu.runtime.protocol import Update
 from split_learning_tpu.runtime.validation import (
@@ -81,6 +83,12 @@ def client_groups(n_columns: int, n_logical: int) -> list[list[int]]:
               for i in range(n_logical + 1)]
     return [list(range(bounds[i], bounds[i + 1]))
             for i in range(n_logical)]
+
+
+#: process-wide compiled-step memo (see MeshContext._cache_scope);
+#: bounded FIFO — entries hold compiled executables
+_GLOBAL_STEP_CACHE: dict = {}
+_GLOBAL_STEP_CACHE_MAX = 32
 
 
 class TrainContext:
@@ -132,9 +140,29 @@ class MeshContext(TrainContext):
         self.dataset = dataset_for_model(cfg.model_key)
         self.dataset_kwargs = dataset_kwargs_for_model(
             cfg.model_key, self.model_kwargs)
-        self._step_cache: dict = {}
         self._loader_cache: dict = {}
         self._example = self._example_struct()
+        # compiled steps are memoized PROCESS-wide: a fresh MeshContext
+        # per round/run (the normal pattern — and every test) would
+        # otherwise re-trace identical programs, seconds of pure Python
+        # each on a 1-core host.  The scope tuple captures everything a
+        # step closure reads from this context besides the per-call key.
+        self._cache_scope = (
+            cfg.model_key,
+            repr(sorted(self.model_kwargs.items(), key=repr)),
+            repr(dataclasses.asdict(cfg.learning)),
+            tuple(self._example.shape), str(self._example.dtype),
+            tuple(str(d) for d in self.devices),
+        )
+
+    def _step_cached(self, key: tuple):
+        return _GLOBAL_STEP_CACHE.get(self._cache_scope + key)
+
+    def _step_store(self, key: tuple, value):
+        # one shared eviction/race implementation (runtime/memo.py)
+        return bounded_setdefault(_GLOBAL_STEP_CACHE,
+                                  _GLOBAL_STEP_CACHE_MAX,
+                                  self._cache_scope + key, lambda: value)
 
     # -- model/data geometry ------------------------------------------------
 
@@ -261,8 +289,9 @@ class MeshContext(TrainContext):
                 "lora_rank > 0 is not supported together with "
                 "tensor/sequence/expert-parallel axes")
         key = (plan.cluster_id, c_phys, name, n, lr, "axes")
-        if key in self._step_cache:
-            return self._step_cache[key]
+        cached = self._step_cached(key)
+        if cached is not None:
+            return cached
         mesh = Mesh(
             np.array(self.devices[:c_phys * n]).reshape(c_phys, n),
             ("client", name))
@@ -295,8 +324,7 @@ class MeshContext(TrainContext):
                                             ep_spec, "expert")
         pipe = types.SimpleNamespace(num_microbatches=lrn.control_count,
                                      mb_size=lrn.batch_size)
-        self._step_cache[key] = (mesh, pipe, optimizer, step)
-        return self._step_cache[key]
+        return self._step_store(key, (mesh, pipe, optimizer, step))
 
     def _compiled(self, plan: ClusterPlan, c_phys: int, s_phys: int,
                   cuts_phys: list, lr: float | None,
@@ -314,8 +342,9 @@ class MeshContext(TrainContext):
                 "tensor-parallel (adapter kernels have no TP rules)")
         key = (plan.cluster_id, c_phys, s_phys, tuple(cuts_phys), lr,
                sync_map_key, use_lora, tp, use_zero)
-        if key in self._step_cache:
-            return self._step_cache[key]
+        cached = self._step_cached(key)
+        if cached is not None:
+            return cached
         mesh = make_mesh(c_phys, s_phys, self.devices,
                          tensor_parallel=tp)
         pipe = PipelineModel(
@@ -357,8 +386,7 @@ class MeshContext(TrainContext):
             optimizer = make_optimizer(lrn, lr)
             step = make_train_step(pipe, optimizer, mesh,
                                    client_sync=client_sync)
-        self._step_cache[key] = (mesh, pipe, optimizer, step)
-        return self._step_cache[key]
+        return self._step_store(key, (mesh, pipe, optimizer, step))
 
     def _lora_partition(self, tree):
         """(frozen, trainable) for one client's base tree: adapters over

@@ -1,0 +1,33 @@
+"""Bounded process-wide memo for compiled/jitted bundles.
+
+Shared by :mod:`split_learning_tpu.runtime.client` (ShardRunner jitted
+ops) and :mod:`split_learning_tpu.runtime.context` (MeshContext
+compiled steps): re-tracing an identical program costs seconds of pure
+Python per rebuild on a 1-core host, and every re-plan / round / test
+with the same geometry would otherwise repay it for the same HLO.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+
+def bounded_setdefault(cache: dict, max_size: int, key, build: Callable):
+    """Return ``cache[key]``, building it with ``build()`` on a miss.
+
+    FIFO-bounded and thread-tolerant: concurrent builders race benignly
+    (``setdefault`` keeps one winner; the loser's build is wasted work,
+    not an error) and eviction never raises — a racing evictor may
+    already have removed the oldest key, or the dict may mutate under
+    ``next(iter(...))``.
+    """
+    hit = cache.get(key)
+    if hit is not None:
+        return hit
+    value = build()
+    while len(cache) >= max_size:
+        try:
+            cache.pop(next(iter(cache)), None)
+        except (StopIteration, RuntimeError):
+            break
+    return cache.setdefault(key, value)
