@@ -1,0 +1,68 @@
+"""runtime/memo.py: the process-wide bounded memo behind the ShardRunner
+ops cache and the MeshContext compiled-step cache."""
+
+import threading
+
+from split_learning_tpu.runtime.memo import bounded_setdefault
+
+
+def test_hit_does_not_rebuild():
+    cache: dict = {}
+    builds = []
+    v1 = bounded_setdefault(cache, 4, "k", lambda: builds.append(1) or "a")
+    v2 = bounded_setdefault(cache, 4, "k", lambda: builds.append(1) or "b")
+    assert v1 == v2 == "a"
+    assert builds == [1]
+
+
+def test_fifo_eviction_bounds_size():
+    cache: dict = {}
+    for i in range(10):
+        bounded_setdefault(cache, 3, i, lambda i=i: i * 10)
+    assert len(cache) <= 3
+    assert 9 in cache          # newest always survives
+    assert 0 not in cache      # oldest evicted
+
+
+def test_concurrent_builders_one_winner():
+    cache: dict = {}
+    winners = set()
+    barrier = threading.Barrier(8)
+
+    def worker(i):
+        barrier.wait()
+        v = bounded_setdefault(cache, 4, "shared", lambda: i)
+        winners.add(v)
+
+    threads = [threading.Thread(target=worker, args=(i,))
+               for i in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    # every caller observed the SAME winning value
+    assert len(winners) == 1
+    assert cache["shared"] in range(8)
+
+
+def test_concurrent_eviction_never_raises():
+    # the round-4 review finding: two threads evicting the same oldest
+    # key must not KeyError (pop with default) — hammer insertions over
+    # a tiny bound from many threads
+    cache: dict = {}
+    errors = []
+
+    def worker(base):
+        try:
+            for i in range(200):
+                bounded_setdefault(cache, 2, (base, i), lambda: i)
+        except Exception as e:   # pragma: no cover - the regression
+            errors.append(e)
+
+    threads = [threading.Thread(target=worker, args=(b,))
+               for b in range(6)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors
