@@ -101,13 +101,18 @@ CPU_SECTION_FLOOR_S = 600.0
 
 
 def host_cache_tag() -> str:
-    """Fingerprint of this host's CPU for the compile-cache namespace.
+    """Fingerprint of this host's CPU + XLA flags for the compile-cache
+    namespace.
 
     The persistent XLA cache stores CPU AOT results compiled for a
-    specific machine; loading them on a different host spams SIGILL
-    warnings and risks real illegal-instruction faults (observed in the
-    round-3 driver tail).  Namespacing the cache dir by a CPU-feature
-    hash makes a host change start a fresh cache instead."""
+    specific target machine; loading them in a different context spams
+    SIGILL warnings and risks real illegal-instruction faults.  Two
+    observed mixing modes: a different HOST (the round-3 driver tail —
+    builder/judge/driver machines share this checkout) and different
+    XLA_FLAGS on the SAME host (the 8-virtual-device test env compiles
+    with multi-device target tuning like ``prefer-no-gather`` that a
+    single-device bench child then warns about on load).  Both fold
+    into the namespace."""
     feats = ""
     try:
         for line in pathlib.Path("/proc/cpuinfo").read_text().splitlines():
@@ -117,7 +122,8 @@ def host_cache_tag() -> str:
     except OSError:
         pass
     import platform as _platform
-    raw = _platform.machine() + ":" + feats
+    raw = (_platform.machine() + ":" + feats + ":"
+           + os.environ.get("XLA_FLAGS", ""))
     return hashlib.sha1(raw.encode()).hexdigest()[:12]
 
 
@@ -773,8 +779,11 @@ def child_main(section: str, ctx_path: str, out_path: str) -> int:
         # on the axon image).
         jax.config.update("jax_platforms", "cpu")
     # persistent compile cache: repeat runs/sections only pay execution.
-    # Namespaced by host CPU fingerprint — CPU AOT entries from another
-    # machine SIGILL-warn on load and can fault (round-3 driver tail).
+    # Namespaced by host CPU fingerprint + XLA_FLAGS (see
+    # host_cache_tag) — mixed-context AOT entries warn on load and can
+    # fault.  Intentionally NOT preserving pre-namespace caches: the
+    # shared dirs are exactly the polluted ones; one cold run per
+    # context rebuilds clean.
     try:
         jax.config.update("jax_compilation_cache_dir",
                           str(HERE / ".jax_cache" / host_cache_tag()))

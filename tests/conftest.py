@@ -16,11 +16,14 @@ os.environ.setdefault("JAX_ENABLE_X64", "0")
 # Persistent XLA compilation cache: the suite is compile-dominated on the
 # single-core CI host; caching compiled executables across runs cuts repeat
 # wall-clock by ~1/3 (a cold run still compiles everything once).
-# Namespaced per host-CPU fingerprint: builder/judge/driver machines share
-# this checkout, and loading another host's CPU AOT entries spams SIGILL
-# warnings and risks real faults (seen in the round-3 driver tail).
-# The fingerprint lives in bench.py (stdlib-only at module level) so the
-# two consumers cannot drift into different namespaces.
+# Namespaced per host-CPU fingerprint + XLA_FLAGS: builder/judge/driver
+# machines share this checkout (cross-host CPU AOT loads SIGILL-warn and
+# risk faults — round-3 driver tail), and on ONE host the 8-virtual-
+# device test env compiles with multi-device target tuning a flagless
+# bench child would warn about on load.  The test env and a plain bench
+# run therefore get DIFFERENT namespaces by design.  The fingerprint
+# lives in bench.py (stdlib-only at module level) so every consumer
+# computes it the same way.
 
 
 def _host_cache_tag():
