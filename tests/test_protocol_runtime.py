@@ -784,3 +784,50 @@ def test_sda_strict_barrier_vs_elastic_window(tmp_path, monkeypatch):
     # idle flushes, emitted while the strict barrier would still wait
     # (both feeders unfenced at every partial)
     assert all(not f for _, f in elastic_partials)
+
+
+def test_elastic_join_with_strict_sda_barrier(tmp_path, monkeypatch):
+    """Cross-feature: aggregation.sda-strict under topology.elastic-join.
+    A feeder that joins between rounds enters the next round's
+    sda_feeders set, so the strict head's dead-barrier rule accounts for
+    it — the joined round completes with both feeders' samples, every
+    full window stays distinct-origin, and nothing deadlocks even
+    though the feeder population changed under the hard barrier."""
+    from split_learning_tpu.runtime.client import ProtocolClient
+
+    windows: list = []
+    orig_sda = ProtocolClient._sda_step
+
+    def recording(self, window):
+        windows.append([a.trace[-1] for a in window])
+        return orig_sda(self, window)
+
+    monkeypatch.setattr(ProtocolClient, "_sda_step", recording)
+
+    bus = InProcTransport()
+    cfg = proto_cfg(tmp_path, clients=[1, 1], global_rounds=2,
+                    aggregation={"strategy": "sda", "sda_size": 2,
+                                 "sda_strict": True, "local_rounds": 1},
+                    topology={"cut_layers": [2], "elastic_join": True})
+    t = _launch_late_joiner(
+        cfg, lambda: bus.bytes_out.get("gradient_queue_1_client_1_0", 0),
+        lambda: bus)
+    result = run_deployment(cfg, lambda: bus, bus)
+    _join_or_fail(t)
+
+    assert [r.ok for r in result.history] == [True, True]
+    r0, r1 = result.history
+    assert r0.num_samples > 0
+    # the joiner contributed in round 1 (no strict-barrier deadlock on
+    # the grown feeder set)
+    assert r1.num_samples == 2 * r0.num_samples, (r0.num_samples,
+                                                  r1.num_samples)
+    # round 1's full windows pair the two distinct feeders; with only
+    # one feeder in round 0 the server caps sda at the feeder count, so
+    # any 2-wide window can only come from the joined round
+    full = [w for w in windows if len(w) >= 2]
+    assert full, "joined round never assembled a 2-origin window"
+    for w in full:
+        assert len(set(w)) == len(w)
+    assert any("late_edge" in w for w in full), (
+        "the joiner never entered a strict window")
