@@ -652,6 +652,34 @@ class TestInt8WireQuantization:
         np.testing.assert_array_equal(out, 0.0)
 
 
+def _record_sda_windows(monkeypatch, with_fences=False):
+    """Patch ProtocolClient._sda_step to record each window's origins
+    (optionally with a snapshot of the head's epoch-fence counts) while
+    still running the real step.  Returns the growing record list;
+    monkeypatch teardown restores the original."""
+    from split_learning_tpu.runtime.client import ProtocolClient
+
+    windows: list = []
+    # wrap the TRUE original even when a previous recorder is still
+    # installed (a test calling this per sub-run must not chain
+    # recorders, or earlier runs' lists keep growing)
+    current = ProtocolClient._sda_step
+    orig = getattr(current, "_sda_orig", current)
+
+    def recording(self, window):
+        origins = [a.trace[-1] for a in window]
+        if with_fences:
+            windows.append((origins,
+                            dict(getattr(self, "_sda_fences", {}))))
+        else:
+            windows.append(origins)
+        return orig(self, window)
+
+    recording._sda_orig = orig
+    monkeypatch.setattr(ProtocolClient, "_sda_step", recording)
+    return windows
+
+
 def test_dcsl_round_robin_dispatch_and_distinct_windows(tmp_path,
                                                         monkeypatch):
     """DCSL dispatch fidelity (VERDICT r2 item 5): 4 stage-1 clients
@@ -676,14 +704,7 @@ def test_dcsl_round_robin_dispatch_and_distinct_windows(tmp_path,
                     pass
             super().publish(queue, payload)
 
-    windows: list = []
-    orig_sda = ProtocolClient._sda_step
-
-    def recording_sda(self, window):
-        windows.append([a.trace[-1] for a in window])
-        return orig_sda(self, window)
-
-    monkeypatch.setattr(ProtocolClient, "_sda_step", recording_sda)
+    windows = _record_sda_windows(monkeypatch)
 
     bus = _QueueRecorder()
     cfg = proto_cfg(tmp_path, clients=[4, 2],
@@ -732,15 +753,7 @@ def test_sda_strict_barrier_vs_elastic_window(tmp_path, monkeypatch):
               [1, 1, 1, 1, 0, 0, 0, 0, 0, 0]]   # client B: 4 samples
 
     def run(strict, local_rounds=1):
-        windows: list = []
-        orig_sda = ProtocolClient._sda_step
-
-        def recording(self, window):
-            fences = dict(getattr(self, "_sda_fences", {}))
-            windows.append(([a.trace[-1] for a in window], fences))
-            return orig_sda(self, window)
-
-        monkeypatch.setattr(ProtocolClient, "_sda_step", recording)
+        windows = _record_sda_windows(monkeypatch, with_fences=True)
         cfg = proto_cfg(tmp_path, clients=[2, 1],
                         log_path=str(tmp_path /
                                      f"strict_{strict}_{local_rounds}"),
@@ -750,7 +763,6 @@ def test_sda_strict_barrier_vs_elastic_window(tmp_path, monkeypatch):
                                      "local_rounds": local_rounds})
         bus = InProcTransport()
         result = run_deployment(cfg, lambda: bus, bus)
-        monkeypatch.setattr(ProtocolClient, "_sda_step", orig_sda)
         assert result.history[0].ok
         # nothing dropped, no deadlock
         assert result.history[0].num_samples == 16 * local_rounds
@@ -793,16 +805,7 @@ def test_elastic_join_with_strict_sda_barrier(tmp_path, monkeypatch):
     it — the joined round completes with both feeders' samples, every
     full window stays distinct-origin, and nothing deadlocks even
     though the feeder population changed under the hard barrier."""
-    from split_learning_tpu.runtime.client import ProtocolClient
-
-    windows: list = []
-    orig_sda = ProtocolClient._sda_step
-
-    def recording(self, window):
-        windows.append([a.trace[-1] for a in window])
-        return orig_sda(self, window)
-
-    monkeypatch.setattr(ProtocolClient, "_sda_step", recording)
+    windows = _record_sda_windows(monkeypatch)
 
     bus = InProcTransport()
     cfg = proto_cfg(tmp_path, clients=[1, 1], global_rounds=2,
