@@ -1,0 +1,206 @@
+"""Opportunistic TPU snapshot watcher (VERDICT r4 next-step #1).
+
+The axon TPU tunnel wedges for hours at a time; three rounds of driver
+benches have only ever caught it once.  This watcher gives the round
+many shots instead of one: it probes the tunnel cheaply every few
+minutes and, whenever the chip answers a real compile+execute, runs the
+FULL ``bench.py`` and commits the resulting artifact as
+``BENCH_tpu_r05.json`` so the round carries an in-repo silicon record
+even if the driver's scheduled run hits a wedge.
+
+Stages (run in order, each at most once — marker files in
+``.tpu_watch/``):
+
+* ``bench``     — full bench.py on TPU -> BENCH_tpu_r05.json
+* ``flagship``  — ``tools/flagship_tpu.sh`` if present (the multi-round
+                  learning run, dropped in later in the round)
+
+Design notes:
+* every probe/bench runs in a SUBPROCESS with a hard timeout — the
+  wedge hangs uninterruptibly inside jax, never in this process.
+* a probe is only "up" if a jitted matmul EXECUTES; ``jax.devices()``
+  listing the chip proves nothing (observed: chip listed, compile hung
+  6+ hours).
+* commits retry on index-lock races with the interactive build session.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import pathlib
+import subprocess
+import sys
+import time
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+STATE = REPO / ".tpu_watch"
+LOG = STATE / "watch.log"
+ARTIFACT = REPO / "BENCH_tpu_r05.json"
+PROBE_TIMEOUT_S = 300       # first TPU compile can take ~40s; wedge hangs
+BENCH_TIMEOUT_S = 4200
+PROBE_INTERVAL_S = 540
+DEADLINE_S = float(os.environ.get("SLT_WATCH_DEADLINE_S", 11.2 * 3600))
+
+_PROBE_SRC = (
+    "import jax, jax.numpy as jnp;"
+    "x = jnp.ones((512, 512), jnp.bfloat16);"
+    "v = jax.jit(lambda a: (a @ a).sum())(x);"
+    "v.block_until_ready();"
+    "print('KIND=' + jax.devices()[0].device_kind)"
+)
+
+
+def log(msg: str) -> None:
+    line = f"[{time.strftime('%H:%M:%S')}] {msg}"
+    with LOG.open("a") as f:
+        f.write(line + "\n")
+
+
+def probe() -> str | None:
+    """Device kind if a jitted matmul really executed on a non-CPU chip."""
+    try:
+        r = subprocess.run([sys.executable, "-c", _PROBE_SRC],
+                           capture_output=True, text=True,
+                           timeout=PROBE_TIMEOUT_S)
+    except subprocess.TimeoutExpired:
+        log(f"probe: hung >{PROBE_TIMEOUT_S}s (tunnel wedged)")
+        return None
+    if r.returncode != 0:
+        log(f"probe: rc={r.returncode} {r.stderr.strip()[-200:]}")
+        return None
+    kind = next((ln[5:] for ln in r.stdout.splitlines()
+                 if ln.startswith("KIND=")), "")
+    if not kind or "cpu" in kind.lower():
+        log(f"probe: backend is {kind or 'unknown'!r}, not a TPU")
+        return None
+    return kind
+
+
+def git_commit(paths: list[str], message: str) -> bool:
+    for attempt in range(10):
+        add = subprocess.run(["git", "-C", str(REPO), "add", *paths],
+                             capture_output=True, text=True)
+        if add.returncode == 0:
+            c = subprocess.run(
+                ["git", "-C", str(REPO), "commit", "-m", message,
+                 "--only", *paths],
+                capture_output=True, text=True)
+            if c.returncode == 0:
+                return True
+            if "nothing to commit" in c.stdout + c.stderr:
+                return True
+            log(f"commit attempt {attempt}: {c.stderr.strip()[-200:]}")
+        else:
+            log(f"add attempt {attempt}: {add.stderr.strip()[-200:]}")
+        time.sleep(20)  # index.lock race with the build session
+    return False
+
+
+def stage_bench(kind: str, history: list) -> bool:
+    env = dict(os.environ)
+    env["SLT_BENCH_PARTIAL_PATH"] = str(STATE / "bench_partial.json")
+    env.setdefault("SLT_BENCH_BUDGET_S", "3600")
+    log(f"bench: launching full bench.py on {kind}")
+    try:
+        r = subprocess.run([sys.executable, str(REPO / "bench.py")],
+                           capture_output=True, text=True,
+                           timeout=BENCH_TIMEOUT_S, cwd=str(REPO), env=env)
+        out = r.stdout
+    except subprocess.TimeoutExpired as e:
+        log("bench: timed out; falling back to partial artifact")
+        out = ""
+    payload = None
+    for ln in reversed(out.splitlines()):
+        ln = ln.strip()
+        if ln.startswith("{"):
+            try:
+                payload = json.loads(ln)
+                break
+            except json.JSONDecodeError:
+                continue
+    if payload is None:
+        partial = STATE / "bench_partial.json"
+        if partial.exists():
+            try:
+                payload = json.loads(partial.read_text())
+            except json.JSONDecodeError:
+                payload = None
+    if payload is None:
+        log("bench: no parseable artifact")
+        return False
+    chip = payload.get("extra", {}).get("chip", "")
+    if "cpu" in str(chip).lower() or payload.get("extra", {}).get(
+            "tpu_unreachable"):
+        log(f"bench: ran but landed on chip={chip!r} (wedged mid-run?); "
+            "not committing as a TPU artifact")
+        return False
+    payload.setdefault("extra", {})["watcher"] = {
+        "probe_history": history[-20:],
+        "captured_at_s": round(time.time()),
+        "source": "opportunistic in-round watcher (tools/tpu_watch.py)",
+    }
+    ARTIFACT.write_text(json.dumps(payload, indent=1) + "\n")
+    ok = git_commit([ARTIFACT.name],
+                    "Record opportunistic TPU bench snapshot")
+    log(f"bench: artifact chip={chip} value={payload.get('value')} "
+        f"committed={ok}")
+    return ok
+
+
+def stage_flagship(kind: str, history: list) -> bool:
+    script = REPO / "tools" / "flagship_tpu.sh"
+    if not script.exists():
+        return False  # not ready yet; retry on a later window
+    log(f"flagship: launching {script} on {kind}")
+    try:
+        r = subprocess.run(["bash", str(script)], cwd=str(REPO),
+                           capture_output=True, text=True,
+                           timeout=3 * 3600)
+    except subprocess.TimeoutExpired:
+        log("flagship: timed out")
+        return False
+    log(f"flagship: rc={r.returncode} tail={r.stdout.strip()[-200:]}")
+    return r.returncode == 0
+
+
+STAGES = [("bench", stage_bench), ("flagship", stage_flagship)]
+
+
+def main() -> None:
+    STATE.mkdir(exist_ok=True)
+    pidfile = STATE / "watch.pid"
+    if pidfile.exists():
+        try:
+            os.kill(int(pidfile.read_text()), 0)
+            print("watcher already running"); return
+        except (OSError, ValueError):
+            pass
+    pidfile.write_text(str(os.getpid()))
+    log(f"watcher started, pid={os.getpid()}, deadline {DEADLINE_S/3600:.1f}h")
+    t0 = time.time()
+    history: list = []
+    while time.time() - t0 < DEADLINE_S:
+        pending = [(n, fn) for n, fn in STAGES
+                   if not (STATE / f"done_{n}").exists()]
+        if not pending:
+            log("all stages done; exiting")
+            break
+        kind = probe()
+        history.append({"t": round(time.time() - t0),
+                        "up": bool(kind), "kind": kind})
+        if kind:
+            log(f"tunnel UP ({kind}); pending stages: "
+                f"{[n for n, _ in pending]}")
+            for name, fn in pending:
+                if fn(kind, history):
+                    (STATE / f"done_{name}").write_text("ok")
+                else:
+                    break  # chip likely wedged mid-stage; re-probe
+        time.sleep(PROBE_INTERVAL_S)
+    log("watcher exiting")
+    pidfile.unlink(missing_ok=True)
+
+
+if __name__ == "__main__":
+    main()
