@@ -350,6 +350,7 @@ class ProtocolClient:
         self.sda_size = 1
         self.sda_strict = False
         self.sda_feeders = None
+        self.sda_fence_quorum = 1
         self.round_ok = True
         self.num_samples = 0
         self.wire_dtype = _wire_np_dtype(cfg.transport.wire_dtype)
@@ -431,6 +432,7 @@ class ProtocolClient:
         # queues this client scatters successive batches across,
         # round-robin (other/DCSL/src/Scheduler.py:21-26, :110-133)
         self.sda_peers = extra.get("sda_peers")
+        self.sda_fence_quorum = int(extra.get("sda_fence_quorum", 1))
         self.sda_strict = bool(extra.get("sda_strict", False))
         self.sda_feeders = extra.get("sda_feeders")
         if msg.params is None:
@@ -634,7 +636,7 @@ class ProtocolClient:
         cap = max(1, r.learning.control_count)
         n_fwd = n_bwd = 0
 
-        def fence_epoch():
+        def fence_epoch(ep: int):
             # strict-SDA epoch fence: the head's hard window drains
             # leftovers only on this marker.  Published right AFTER the
             # final activation (per-queue FIFO orders it last) and
@@ -645,9 +647,9 @@ class ProtocolClient:
                 for q in out_qs:
                     self.bus.publish(q, encode(EpochEnd(
                         client_id=self.client_id,
-                        round_idx=self.fence)))
+                        round_idx=self.fence, epoch=ep)))
 
-        for _ in range(self.epochs):
+        for ep in range(self.epochs):
             data_iter = iter(self.loader)
             # prefetch one batch: exhaustion must be known at the LAST
             # dispatch, not when the in-flight cap next frees — with a
@@ -656,7 +658,7 @@ class ProtocolClient:
             next_item = next(data_iter, None)
             exhausted = next_item is None
             if exhausted:
-                fence_epoch()   # empty loader: fence immediately
+                fence_epoch(ep)   # empty loader: fence immediately
             while not (exhausted and n_fwd == n_bwd):
                 raw = self.bus.get(grad_q, timeout=0.0005)
                 if raw is not None:
@@ -710,7 +712,7 @@ class ProtocolClient:
                 n_fwd += 1
                 if next_item is None:
                     exhausted = True
-                    fence_epoch()
+                    fence_epoch(ep)
         self.bus.publish(RPC_QUEUE, encode(Notify(
             client_id=self.client_id, cluster=self.cluster,
             round_idx=self.fence)))
@@ -738,6 +740,15 @@ class ProtocolClient:
         in_q = intermediate_queue(self.stage - 1, self.cluster, self.pair)
         out_qs = self._out_queues()
         n_fwd = 0
+        # strict-SDA fences crossing a middle stage: relay each
+        # (origin, epoch) marker downstream exactly once, and only at
+        # the full previous-stage quorum — every activation the marker
+        # fences has then ALREADY been forwarded (this loop forwards on
+        # receipt, per-queue FIFO), keeping the feeder→head ordering
+        # guarantee hop by hop even when parallel previous-stage
+        # devices relay at different speeds.
+        fence_copies: dict[tuple[str, int], int] = {}
+        quorum = max(1, self.sda_fence_quorum)
         grad_q = gradient_queue(self.stage, self.client_id)
         inflight: dict[str, _Inflight] = {}
         while True:
@@ -774,6 +785,13 @@ class ProtocolClient:
             act = decode(raw)
             if act.round_idx != self.fence:
                 continue   # activation from a dropped round: discard
+            if isinstance(act, EpochEnd):
+                key = (act.client_id, act.epoch)
+                fence_copies[key] = fence_copies.get(key, 0) + 1
+                if fence_copies[key] == quorum:
+                    for q in out_qs:   # fence ALL downstream devices
+                        self.bus.publish(q, raw)
+                continue
             x = _from_wire_tree(act.data)
             rng = r.next_rng()
             out = r.fwd(self.frozen, self.trainable, self.stats, x, rng)
@@ -827,6 +845,16 @@ class ProtocolClient:
         fences: dict[str, int] = {}
         self._sda_fences = fences   # observability (tests assert the
                                     # strict drain is fence-gated)
+        # (origin, epoch) -> copies received.  In >2-stage plans every
+        # stage-(n-1) device relays one deduplicated copy of each
+        # feeder's fence, so a fence is RECORDED only at the full
+        # quorum: the first copy can overtake activations relayed via a
+        # slower middle device, but the LAST copy's per-queue FIFO
+        # position proves every middle-routed batch it fences is
+        # already in.  Counting raw arrivals would both overshoot
+        # n_epochs and record fences early.
+        fence_copies: dict[tuple[str, int], int] = {}
+        quorum = max(1, self.sda_fence_quorum)
 
         def live() -> list[str]:
             return [o for o, q in pending.items() if q]
@@ -889,14 +917,34 @@ class ProtocolClient:
             if act.round_idx != self.fence:
                 continue   # message from a dropped round: discard
             if isinstance(act, EpochEnd):
-                fences[act.client_id] = fences.get(act.client_id, 0) + 1
+                key = (act.client_id, act.epoch)
+                fence_copies[key] = fence_copies.get(key, 0) + 1
+                if fence_copies[key] == quorum:
+                    fences[act.client_id] = fences.get(act.client_id,
+                                                       0) + 1
                 if strict:
+                    # full windows buffered at fence time must pop as
+                    # WINDOWS, not wait to be drained as dead-barrier
+                    # partials — keeps the code safe even if the
+                    # arrival-time pop policy changes (ADVICE r4); loop
+                    # until dry so a backlog can't strand windows
+                    while True:
+                        w = pop_window(require_full=True)
+                        if not w:
+                            break
+                        self._sda_step(w)
                     drain_dead_barrier()
                 continue
             # reset the idle clock only for CURRENT-round traffic — a
             # stream of stale activations must not starve the tail flush
             idle_since = None
-            pending.setdefault(act.trace[-1], []).append(act)
+            # window identity is the ROOT origin (trace[0], the stage-1
+            # feeder = the DCSL "device"), not the immediate sender: in
+            # a >2-stage plan trace[-1] is a middle device and every
+            # batch would share it, so a distinct-origin window could
+            # never widen past the middle-stage client count.  Gradient
+            # routing below still uses trace[-1] (hop-by-hop return).
+            pending.setdefault(act.trace[0], []).append(act)
             n_live = len(live())
             if n_live > target:
                 target = min(max(1, self.sda_size), n_live)

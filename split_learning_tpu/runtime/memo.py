@@ -11,6 +11,8 @@ from __future__ import annotations
 
 from typing import Callable
 
+_MISS = object()
+
 
 def bounded_setdefault(cache: dict, max_size: int, key, build: Callable):
     """Return ``cache[key]``, building it with ``build()`` on a miss.
@@ -19,10 +21,11 @@ def bounded_setdefault(cache: dict, max_size: int, key, build: Callable):
     (``setdefault`` keeps one winner; the loser's build is wasted work,
     not an error) and eviction never raises — a racing evictor may
     already have removed the oldest key, or the dict may mutate under
-    ``next(iter(...))``.
+    ``next(iter(...))``.  A legitimately-``None`` built value is a hit
+    too (sentinel miss check), not an every-call rebuild.
     """
-    hit = cache.get(key)
-    if hit is not None:
+    hit = cache.get(key, _MISS)
+    if hit is not _MISS:
         return hit
     value = build()
     while len(cache) >= max_size:

@@ -177,9 +177,17 @@ class EpochEnd:
     then the epoch boundary clears the queues); this marker is how the
     head learns the boundary without the server round-trip.  Rides the
     data-plane queues so per-queue FIFO ordering guarantees it arrives
-    AFTER every activation it fences."""
+    AFTER every activation it fences.
+
+    In >2-stage plans middle stages PROPAGATE the marker to every
+    downstream queue, but only once the full previous-stage quorum of
+    copies has arrived (``sda_fence_quorum``): a receiver hears one
+    copy per previous-stage device, and only the LAST copy proves —
+    via per-queue FIFO — that every activation the fence covers has
+    arrived, whichever previous-stage device relayed it."""
     client_id: str
     round_idx: int = 0
+    epoch: int = 0
 
 
 @dataclasses.dataclass

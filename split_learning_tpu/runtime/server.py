@@ -402,11 +402,12 @@ class ProtocolContext(MeshContext):
                     f"{len(groups)} in-groups: keeping shared queues")
 
         # window never wider than the feeders a head actually HEARS:
-        # origins are trace[-1] (the previous stage's clients), and with
-        # 2LS pairing each head's queue receives only its own group —
-        # a wider sda_size could never assemble a distinct-origin window
-        # and every batch would crawl through the idle-flush path
-        if plan.n_stages == 2:
+        # origins are trace[0] (the stage-1 feeders = DCSL "devices"),
+        # and with 2LS pairing each head's queue receives only its own
+        # group — a wider sda_size could never assemble a
+        # distinct-origin window and every batch would crawl through
+        # the idle-flush path
+        if plan.n_stages >= 2:
             if pair_of:
                 group_sizes = {}
                 for cid in stage1:
@@ -415,8 +416,6 @@ class ProtocolContext(MeshContext):
                 n_feeders = min(group_sizes.values())
             else:
                 n_feeders = len(stage1)
-        elif plan.n_stages > 2:
-            n_feeders = max(1, len(plan.clients[-2]))
         else:
             n_feeders = 1
         sda = (min(self.cfg.aggregation.sda_size, n_feeders)
@@ -466,13 +465,26 @@ class ProtocolContext(MeshContext):
                 batch_stats=shard_s, learning=learning,
                 label_counts=label_counts, round_idx=round_idx,
                 extra={"epochs": epochs, "sda_size": sda,
-                       # strict barriers need the feeders themselves to
-                       # fence their epochs (EpochEnd): only direct
-                       # stage-1 feeders can — a middle stage never
-                       # knows its stream ended, so >2-stage plans keep
-                       # the elastic window (DCSL itself is 2-stage)
-                       "sda_strict": (self.cfg.aggregation.sda_strict
-                                      and plan.n_stages == 2),
+                       # strict barriers work at ANY depth: stage-1
+                       # feeders fence their epochs (EpochEnd) and
+                       # middle stages propagate the marker downstream
+                       # after the activations it fences, so the head's
+                       # dead-barrier rule sees root-origin fences even
+                       # through a deep pipeline
+                       "sda_strict": self.cfg.aggregation.sda_strict,
+                       # copies of each (origin, epoch) fence this
+                       # client must collect before acting on it (head:
+                       # record; middle: relay downstream): every
+                       # previous-stage device sends/relays one copy,
+                       # and only the LAST copy's per-queue FIFO
+                       # position proves all activations it fences have
+                       # arrived — a single early copy can overtake
+                       # batches routed via a slower previous-stage
+                       # device.  Stage 2 hears each feeder directly
+                       # (one copy).
+                       "sda_fence_quorum": (
+                           1 if s <= 2
+                           else max(1, len(plan.clients[s - 2]))),
                        # the strict head must know its FULL feeder set:
                        # draining leftovers is only safe once every
                        # feeder that could still extend a window has

@@ -667,7 +667,10 @@ def _record_sda_windows(monkeypatch, with_fences=False):
     orig = getattr(current, "_sda_orig", current)
 
     def recording(self, window):
-        origins = [a.trace[-1] for a in window]
+        # window identity = ROOT origin (stage-1 feeder): same as
+        # trace[-1] in 2-stage plans, and the value the strict barrier
+        # actually keys on when a middle stage separates feeder and head
+        origins = [a.trace[0] for a in window]
         if with_fences:
             windows.append((origins,
                             dict(getattr(self, "_sda_fences", {}))))
@@ -796,6 +799,77 @@ def test_sda_strict_barrier_vs_elastic_window(tmp_path, monkeypatch):
     # idle flushes, emitted while the strict barrier would still wait
     # (both feeders unfenced at every partial)
     assert all(not f for _, f in elastic_partials)
+
+
+def test_sda_strict_barrier_three_stage(tmp_path, monkeypatch):
+    """Strict SDA through a middle stage (VERDICT r4 item 6): in a
+    3-stage plan the head's window keys on ROOT origins (trace[0]) and
+    the stage-2 device propagates each feeder's EpochEnd downstream
+    after the activations it fences, so the hard distinct-origin
+    barrier works at depth — full windows pair the two stage-1
+    feeders, partials only drain at a dead barrier, and the round
+    completes with every sample consumed (no fence lost in the relay,
+    no deadlock on the feeders' gradient waits)."""
+    matrix = [[2, 2, 2, 2, 2, 2, 0, 0, 0, 0],   # feeder A: 12 samples
+              [1, 1, 1, 1, 0, 0, 0, 0, 0, 0]]   # feeder B: 4 samples
+    windows = _record_sda_windows(monkeypatch, with_fences=True)
+    cfg = proto_cfg(tmp_path, clients=[2, 1, 1],
+                    topology={"cut_layers": [2, 4]},
+                    distribution={"mode": "fixed", "matrix": matrix},
+                    aggregation={"strategy": "sda", "sda_size": 2,
+                                 "sda_strict": True, "local_rounds": 2})
+    bus = InProcTransport()
+    result = run_deployment(cfg, lambda: bus, bus)
+    assert result.history[0].ok
+    assert result.history[0].num_samples == 16 * 2
+
+    feeders = {"client_1_0", "client_1_1"}
+    full = [w for w, _ in windows if len(w) == 2]
+    assert full, "no full window ever crossed the middle stage"
+    for w in full:
+        # distinct ROOT origins even though every batch shares the one
+        # middle device as its immediate sender
+        assert set(w) == feeders, w
+    partials = [(w, f) for w, f in windows if len(w) < 2]
+    assert partials, "uneven feeders must leave a tail to drain"
+    for origins, fences in partials:
+        # fences reached the head THROUGH the relay: a partial drains
+        # only once the barrier is dead at the root-origin level
+        # (local_rounds=2, so a feeder retires at 2 fences)
+        assert set(fences) <= feeders, fences
+        unfenced = {o for o in feeders if fences.get(o, 0) < 2}
+        assert len(unfenced | set(origins)) < 2, (origins, fences)
+
+
+def test_sda_strict_fence_quorum_two_middles(tmp_path, monkeypatch):
+    """Strict SDA with TWO parallel middle devices (clients=[2,2,1]):
+    each feeder's EpochEnd reaches the head once per middle device, and
+    the head records a fence only at the full 2-copy quorum — the last
+    copy's per-queue FIFO position is what proves every middle-routed
+    batch has arrived.  Over-counting copies would drain early (or
+    treat one round's fences as two epochs); requiring more copies
+    than middles would deadlock the round.  Full windows still pair
+    the two ROOT feeders."""
+    windows = _record_sda_windows(monkeypatch, with_fences=True)
+    cfg = proto_cfg(tmp_path, clients=[2, 2, 1],
+                    topology={"cut_layers": [2, 4]},
+                    distribution={"num_samples": 16},
+                    aggregation={"strategy": "sda", "sda_size": 2,
+                                 "sda_strict": True, "local_rounds": 1})
+    bus = InProcTransport()
+    result = run_deployment(cfg, lambda: bus, bus)
+    assert result.history[0].ok
+    # 16 samples per feeder (distribution.num-samples is per-client)
+    assert result.history[0].num_samples == 32
+
+    feeders = {"client_1_0", "client_1_1"}
+    full = [w for w, _ in windows if len(w) == 2]
+    assert full, "no full window formed through the parallel middles"
+    for w in full:
+        assert set(w) == feeders, w
+    for origins, fences in windows:
+        # fence counts stay per-epoch despite 2 copies per fence
+        assert all(v <= 1 for v in fences.values()), fences
 
 
 def test_elastic_join_with_strict_sda_barrier(tmp_path, monkeypatch):
