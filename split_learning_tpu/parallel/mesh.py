@@ -18,9 +18,11 @@ from jax.sharding import Mesh
 
 def make_mesh(n_clients: int, n_stages: int,
               devices: Sequence | None = None,
-              tensor_parallel: int = 1) -> Mesh:
-    """Mesh of shape (client, stage[, model]) over the first
-    n_clients*n_stages*tensor_parallel devices.
+              tensor_parallel: int = 1,
+              seq_parallel: int = 1,
+              expert_parallel: int = 1) -> Mesh:
+    """Mesh of shape (client, stage[, model|seq|expert]) over the first
+    n_clients*n_stages*(third-axis width) devices.
 
     With ``tensor_parallel > 1`` a third ``model`` axis is appended:
     each (client, stage) cell becomes a TP group whose parameters shard
@@ -28,20 +30,38 @@ def make_mesh(n_clients: int, n_stages: int,
     :mod:`split_learning_tpu.parallel.tensor` — pipeline collectives
     stay manual over ``stage`` while XLA derives the TP collectives
     (the PP x TP composition the reference's per-stage torch clients
-    cannot express, ``src/Server.py:222-228``)."""
+    cannot express, ``src/Server.py:222-228``).
+
+    With ``seq_parallel > 1`` the third axis is ``seq`` instead: each
+    (client, stage) cell becomes a ring-attention group — stage hops
+    (manual ppermute over ``stage``) move per-device SEQUENCE BLOCKS,
+    and attention inside every stage rotates K/V around ``seq``
+    (:func:`split_learning_tpu.parallel.sequence.ring_attention`).
+
+    With ``expert_parallel > 1`` it is ``expert``: MoE expert
+    parameters shard over the axis (GSPMD-auto, like ``model``) and
+    XLA derives the dispatch/combine all-to-alls inside each stage
+    (:mod:`split_learning_tpu.parallel.expert`)."""
     devs = list(devices if devices is not None else jax.devices())
-    need = n_clients * n_stages * tensor_parallel
+    widths = {"model": tensor_parallel, "seq": seq_parallel,
+              "expert": expert_parallel}
+    extra = [(k, v) for k, v in widths.items() if v > 1]
+    if len(extra) > 1:
+        raise ValueError(
+            f"only one intra-stage axis may exceed 1 in one pipeline "
+            f"mesh, got {dict(extra)}")
+    third = extra[0] if extra else None
+    need = n_clients * n_stages * (third[1] if third else 1)
     if len(devs) < need:
         raise ValueError(
             f"need {need} devices for mesh (client={n_clients}, "
             f"stage={n_stages}"
-            + (f", model={tensor_parallel}" if tensor_parallel > 1
-               else "")
+            + (f", {third[0]}={third[1]}" if third else "")
             + f"), have {len(devs)}")
-    if tensor_parallel > 1:
+    if third:
         grid = np.array(devs[:need]).reshape(n_clients, n_stages,
-                                             tensor_parallel)
-        return Mesh(grid, ("client", "stage", "model"))
+                                             third[1])
+        return Mesh(grid, ("client", "stage", third[0]))
     grid = np.array(devs[:need]).reshape(n_clients, n_stages)
     return Mesh(grid, ("client", "stage"))
 

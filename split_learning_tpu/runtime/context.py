@@ -230,8 +230,8 @@ class MeshContext(TrainContext):
         return None
 
     def _geometry(self, plan: ClusterPlan, n_active: int):
-        """(C_phys, S_phys, physical cuts, tp) fitted to the device
-        budget.
+        """(C_phys, S_phys, physical cuts, tp, sp, ep) fitted to the
+        device budget.
 
         Cuts are ALWAYS preserved: when the device budget (or the CPU
         rendezvous limit below) cannot give every stage its own device,
@@ -243,36 +243,47 @@ class MeshContext(TrainContext):
         ``tensor-parallel`` with cut layers COMPOSES with the pipeline
         (VERDICT r3 weak #3): the mesh grows a ``model`` axis and each
         (client, stage) cell becomes a TP group — ``tp`` in the return
-        is that axis width (1 when TP is off or routed to the cut-less
-        axes path).  sequence/expert-parallel keep the axes path (ring
-        attention / MoE dispatch don't thread through the wire-packed
-        stage boundary)."""
+        is that axis width.  ``sequence-parallel`` with cut layers
+        likewise COMPOSES (VERDICT r4 item 4): the mesh grows a ``seq``
+        axis, stage hops move per-device sequence blocks, and ring
+        attention runs over ``seq`` inside each stage — ``sp`` is that
+        axis width.  ``expert-parallel`` with cut layers ALSO composes
+        (VERDICT r4 item 5): the mesh grows an ``expert`` axis
+        (GSPMD-auto, like ``model``) and each stage's MoE dispatch/
+        combine all-to-alls are derived by XLA inside the manual
+        pipeline — ``ep`` is that width."""
         par = self._parallel_axis()
         D = len(self.devices)
-        tp = 1
+        tp = sp = ep = 1
         if par is not None:
             name, n = par
             if n > D:
                 raise ValueError(
                     f"topology.{name}-parallel={n} exceeds the "
                     f"{D}-device budget")
-            if not (name == "model" and plan.cuts):
+            if not plan.cuts:
                 # axes path: intra-client axis first, remaining devices
                 # form the client axis; cuts stay virtual (full model
                 # per TP/seq/expert group — split semantics live in
                 # shard extraction)
                 return (max(1, min(n_active, D // n)), 1,
-                        list(plan.cuts), 1)
-            tp = n   # PP x TP: each (client, stage) cell is a TP group
+                        list(plan.cuts), 1, 1, 1)
+            if name == "model":
+                tp = n   # PP x TP: each (client, stage) cell = TP group
+            elif name == "seq":
+                sp = n   # PP x SP: each cell = ring-attention group
+            else:
+                ep = n   # PP x EP: each cell = expert-dispatch group
         S = len(plan.cuts) + 1
-        budget = min(S, D // tp)
+        par_w = tp * sp * ep
+        budget = min(S, D // par_w)
         if (jax.default_backend() == "cpu"
                 and self._param_count() > self._CPU_PIPELINE_PARAM_LIMIT
                 and not self.cfg.topology.force_pipeline):
             budget = 1  # heavy stages on CPU: chain locally (see above)
         s_phys = max(a for a in range(1, budget + 1) if S % a == 0)
-        c_phys = max(1, min(n_active, D // (s_phys * tp)))
-        return c_phys, s_phys, list(plan.cuts), tp
+        c_phys = max(1, min(n_active, D // (s_phys * par_w)))
+        return c_phys, s_phys, list(plan.cuts), tp, sp, ep
 
     def _compiled_axes(self, plan: ClusterPlan, c_phys: int,
                        par: tuple[str, int], lr: float | None):
@@ -329,34 +340,68 @@ class MeshContext(TrainContext):
     def _compiled(self, plan: ClusterPlan, c_phys: int, s_phys: int,
                   cuts_phys: list, lr: float | None,
                   sync_map_key: tuple, client_sync: dict | None,
-                  tp: int = 1):
+                  tp: int = 1, sp: int = 1, ep: int = 1):
         par = self._parallel_axis()
-        if par is not None and tp == 1:
+        if par is not None and tp == 1 and sp == 1 and ep == 1:
             return self._compiled_axes(plan, c_phys, par, lr)
         lrn = self.cfg.learning
         use_lora = lrn.lora_rank > 0
         use_zero = lrn.optimizer == "adamw-zero1"
-        if use_lora and tp > 1:
+        if use_lora and (tp > 1 or sp > 1 or ep > 1):
             raise ValueError(
                 "lora_rank > 0 is not supported together with "
-                "tensor-parallel (adapter kernels have no TP rules)")
+                "tensor-parallel, sequence-parallel or expert-parallel "
+                "pipeline composition (adapter kernels have no "
+                "sharding rules)")
         key = (plan.cluster_id, c_phys, s_phys, tuple(cuts_phys), lr,
-               sync_map_key, use_lora, tp, use_zero)
+               sync_map_key, use_lora, tp, use_zero, sp, ep)
         cached = self._step_cached(key)
         if cached is not None:
             return cached
         mesh = make_mesh(c_phys, s_phys, self.devices,
-                         tensor_parallel=tp)
-        pipe = PipelineModel(
-            self.cfg.model_key, cuts=cuts_phys,
-            example_input=self._example,
-            num_microbatches=lrn.control_count,
-            model_kwargs=self.model_kwargs)
-        if use_zero and tp > 1:
+                         tensor_parallel=tp, seq_parallel=sp,
+                         expert_parallel=ep)
+        example, seq_axis = self._example, None
+        if sp > 1:
+            # PP x SP: the pipeline is built on the per-device sequence
+            # BLOCK; make_train_step shards x/labels over `seq`
+            if example.ndim != 2:
+                raise ValueError(
+                    "sequence-parallel with cut-layers needs a token "
+                    f"model (got example shape {example.shape})")
+            if example.shape[1] % sp:
+                raise ValueError(
+                    f"sequence length {example.shape[1]} not divisible "
+                    f"by sequence-parallel={sp}")
+            example = jax.ShapeDtypeStruct(
+                (example.shape[0], example.shape[1] // sp),
+                example.dtype)
+            seq_axis = "seq"
+        def build_pipe():
+            return PipelineModel(
+                self.cfg.model_key, cuts=cuts_phys,
+                example_input=example,
+                num_microbatches=lrn.control_count,
+                model_kwargs=self.model_kwargs, seq_axis=seq_axis)
+
+        if seq_axis is not None:
+            # scope the rewrite to the SP path: an unrelated TypeError
+            # (e.g. a typo'd model kwarg on plain PP) must keep its own
+            # message
+            try:
+                pipe = build_pipe()
+            except TypeError as e:
+                raise ValueError(
+                    f"model {self.cfg.model_key} does not support "
+                    f"sequence-parallel (no seq_axis): {e}") from e
+        else:
+            pipe = build_pipe()
+        if use_zero and (tp > 1 or sp > 1 or ep > 1):
             raise ValueError(
                 "adamw-zero1 is not supported together with "
-                "tensor-parallel (the flat moment shards are sized to "
-                "unsharded params; use adamw-bf16 with TP)")
+                "tensor-parallel, sequence-parallel or expert-parallel "
+                "pipeline composition (the flat moment shards are "
+                "sized to unsharded params; use adamw-bf16 instead)")
         if use_zero:
             # ZeRO-1 from YAML (VERDICT r3 item 3): moments flattened,
             # bf16, sharded over `stage`; the facade keeps the generic
@@ -538,14 +583,15 @@ class MeshContext(TrainContext):
         import types
 
         par = self._parallel_axis()
-        if par is not None and not (par[0] == "model" and plan.cuts):
+        if par is not None and not plan.cuts:
             return None  # axes-path steps have no resident equivalent
         if self.cfg.learning.lora_rank > 0:
             return None
         stage1 = plan.stage1_clients
         if not stage1:
             return None
-        c_phys, s_phys, cuts_phys, tp = self._geometry(plan, len(stage1))
+        c_phys, s_phys, cuts_phys, tp, sp, ep = self._geometry(
+            plan, len(stage1))
         if len(stage1) > c_phys:
             return None  # column chunking: host path interleaves chunks
         counts = {c: plan.label_counts[plan.stage1_clients.index(c)]
@@ -554,11 +600,11 @@ class MeshContext(TrainContext):
             plan, c_phys, len(stage1), sync_all_later_stages)
         mesh, pipe, optimizer, step = self._compiled(
             plan, c_phys, s_phys, cuts_phys, lr, sync_key, client_sync,
-            tp=tp)
+            tp=tp, sp=sp, ep=ep)
         M, mb = pipe.num_microbatches, pipe.mb_size
 
         key = (plan.cluster_id, c_phys, s_phys, tuple(cuts_phys), lr,
-               sync_key, epochs, tp)
+               sync_key, epochs, tp, sp, ep)
         cache = getattr(self, "_resident", None)
         if (cache is not None and cache["key"] == key
                 and cache["token"] == id(params)):
@@ -637,13 +683,15 @@ class MeshContext(TrainContext):
             return []
         counts = {c: plan.label_counts[plan.stage1_clients.index(c)]
                   for c in stage1}
-        c_phys, s_phys, cuts_phys, tp = self._geometry(plan, len(stage1))
+        c_phys, s_phys, cuts_phys, tp, sp, ep = self._geometry(
+            plan, len(stage1))
         updates: list[Update] = []
         n_chunks = math.ceil(len(stage1) / c_phys)
         for chunk_i in range(n_chunks):
             chunk = stage1[chunk_i * c_phys:(chunk_i + 1) * c_phys]
             pad = c_phys - len(chunk)
-            if self._parallel_axis() is not None and tp == 1:
+            if (self._parallel_axis() is not None and tp == 1
+                    and sp == 1 and ep == 1):
                 # axes path: columns train independently (no grouped
                 # gradient means); shared later stages meet at FedAvg
                 client_sync, sync_key = None, ()
@@ -652,7 +700,7 @@ class MeshContext(TrainContext):
                     plan, c_phys, len(chunk), sync_all_later_stages)
             mesh, pipe, optimizer, step = self._compiled(
                 plan, c_phys, s_phys, cuts_phys, lr, sync_key,
-                client_sync, tp=tp)
+                client_sync, tp=tp, sp=sp, ep=ep)
             M, mb = pipe.num_microbatches, pipe.mb_size
             cols = chunk + [chunk[-1]] * pad  # padded columns ignored below
             trees = [

@@ -82,6 +82,48 @@ def test_pp_tp_composition_from_yaml(tmp_path, eight_devices):
     regs = [Registration(client_id=f"c{s}_{i}", stage=s)
             for s in (1, 2) for i in range(2)]
     plan = plan_clusters(cfg, regs)[0]
-    c, s, cuts, tp = MeshContext(cfg)._geometry(plan, 2)
+    c, s, cuts, tp, _sp, _ep = MeshContext(cfg)._geometry(plan, 2)
     assert (c, s, cuts, tp) == (2, 2, [2], 2)  # real PP x TP, not virtual
+    _run(cfg)
+
+
+def test_pp_sp_composition_from_yaml(tmp_path, eight_devices):
+    """VERDICT r4 item 4: cut-layers + sequence-parallel in ONE config
+    compose as a (client, stage, seq) mesh — the pipeline keeps its real
+    cut instead of going virtual, stage hops move per-device sequence
+    blocks, and ring attention runs over `seq` inside each stage."""
+    from split_learning_tpu.runtime.context import MeshContext
+    from split_learning_tpu.runtime.plan import plan_clusters, Registration
+
+    cfg = axis_cfg(tmp_path, "ppsp", sequence_parallel=2,
+                   cut_layers=[2], force_pipeline=True,
+                   extra_kwargs={"n_block": 2})
+    cfg = dataclasses.replace(cfg, clients=(2, 2))
+    regs = [Registration(client_id=f"c{s}_{i}", stage=s)
+            for s in (1, 2) for i in range(2)]
+    plan = plan_clusters(cfg, regs)[0]
+    c, s, cuts, _tp, sp, _ep = MeshContext(cfg)._geometry(plan, 2)
+    assert (c, s, cuts, sp) == (2, 2, [2], 2)  # real PP x SP, not virtual
+    _run(cfg)
+
+
+def test_pp_ep_composition_from_yaml(tmp_path, eight_devices):
+    """VERDICT r4 item 5: cut-layers + expert-parallel in ONE config
+    compose as a (client, stage, expert) mesh — MoE expert parameters
+    shard over `expert` inside each pipeline stage (GSPMD-auto, like
+    the `model` axis) and XLA derives the dispatch/combine all-to-alls
+    from the routing einsums."""
+    from split_learning_tpu.runtime.context import MeshContext
+    from split_learning_tpu.runtime.plan import plan_clusters, Registration
+
+    cfg = axis_cfg(tmp_path, "ppep", model="TinyLlamaMoE",
+                   extra_kwargs={"num_experts": 2, "k": 1, "n_block": 2},
+                   expert_parallel=2, cut_layers=[2],
+                   force_pipeline=True)
+    cfg = dataclasses.replace(cfg, clients=(2, 2))
+    regs = [Registration(client_id=f"c{s}_{i}", stage=s)
+            for s in (1, 2) for i in range(2)]
+    plan = plan_clusters(cfg, regs)[0]
+    c, s, cuts, _tp, _sp, ep = MeshContext(cfg)._geometry(plan, 2)
+    assert (c, s, cuts, ep) == (2, 2, [2], 2)  # real PP x EP, not virtual
     _run(cfg)

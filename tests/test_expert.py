@@ -216,3 +216,66 @@ class TestMoEThroughPipeline:
         assert r0.size > 0
         # aux gradient must flow into the router params
         assert not np.allclose(r0, r1)
+
+
+@pytest.mark.slow
+def test_pp_ep_pipeline_matches_pp_only(eight_devices):
+    """PP x EP in ONE mesh (VERDICT r4 item 5, mirroring PP x TP): the
+    pipelined train step on a (client=2, stage=2, expert=2) mesh —
+    manual ppermute pipeline over `stage`, GSPMD expert sharding over
+    `expert` with XLA-derived dispatch/combine all-to-alls — must
+    produce the same losses and updated params as the plain
+    (client=2, stage=2) pipeline with replicated experts, and the
+    expert leaves must be genuinely distributed."""
+    import optax
+    from jax.sharding import Mesh
+
+    from split_learning_tpu.parallel.pipeline import (
+        PipelineModel, init_pipeline_variables, make_train_step,
+        shard_to_mesh, stack_for_clients,
+    )
+
+    tiny = dict(vocab_size=64, hidden_size=16, num_heads=2,
+                num_kv_heads=2, intermediate_size=32, n_block=2,
+                num_experts=2, k=1)
+    mb, m, S = 2, 2, 8
+    struct = jax.ShapeDtypeStruct((mb, S), jnp.int32)
+    pipe = PipelineModel("TinyLlamaMoE_TINYSTORIES", cuts=[2],
+                         example_input=struct, num_microbatches=m,
+                         model_kwargs=tiny)
+    variables = init_pipeline_variables(pipe, jax.random.key(0), struct)
+    params = variables["params"]
+    stats = variables.get("batch_stats", {})
+    opt = optax.sgd(1e-2)
+    opt_state = opt.init(params)
+    x = jax.random.randint(jax.random.key(2), (2, m, mb, S), 0,
+                           tiny["vocab_size"], jnp.int32)
+    y = jax.random.randint(jax.random.key(3), (2, m, mb, S), 0,
+                           tiny["vocab_size"], jnp.int32)
+    rngs = jax.vmap(jax.random.key)(jnp.arange(2))
+
+    def run(mesh):
+        pc = shard_to_mesh(stack_for_clients(params, 2), mesh)
+        oc = shard_to_mesh(stack_for_clients(opt_state, 2), mesh)
+        sc = shard_to_mesh(stack_for_clients(stats, 2), mesh)
+        step = make_train_step(pipe, opt, mesh)
+        return step(pc, oc, sc, x, y, rngs)
+
+    mesh_pp = Mesh(np.array(eight_devices[:4]).reshape(2, 2),
+                   ("client", "stage"))
+    p2, _, _, loss2 = run(mesh_pp)
+
+    mesh_ppep = Mesh(np.array(eight_devices).reshape(2, 2, 2),
+                     ("client", "stage", "expert"))
+    p3, _, _, loss3 = run(mesh_ppep)
+
+    np.testing.assert_allclose(np.asarray(loss2), np.asarray(loss3),
+                               rtol=2e-4)
+    for l2, l3 in zip(jax.tree_util.tree_leaves(p2),
+                      jax.tree_util.tree_leaves(p3)):
+        np.testing.assert_allclose(np.asarray(l2), np.asarray(l3),
+                                   rtol=2e-3, atol=1e-5)
+    # expert kernels really are distributed over the expert axis
+    moe = p3["layer2"]["moe"]["experts"]["gate_proj"]["kernel"]
+    assert "expert" in tuple(map(str, jax.tree_util.tree_leaves(
+        [moe.sharding.spec]))) or "expert" in str(moe.sharding.spec)

@@ -190,7 +190,7 @@ def test_cpu_geometry_collapses_heavy_pipeline(tmp_path):
         return MeshContext(cfg)._geometry(plan, cfg.clients[0])
 
     tiny = tiny_cfg(tmp_path)
-    c, s, cuts, _tp = geom(tiny)
+    c, s, cuts, _tp, _sp, _ep = geom(tiny)
     assert (s, cuts) == (2, [2])   # tiny: pipeline kept
 
     def vgg_cfg(**topo):
@@ -202,10 +202,10 @@ def test_cpu_geometry_collapses_heavy_pipeline(tmp_path):
             topology={"cut_layers": [7], **topo},
             checkpoint={"directory": str(tmp_path / "ckpt")}))
 
-    c, s, cuts, _tp = geom(vgg_cfg())
+    c, s, cuts, _tp, _sp, _ep = geom(vgg_cfg())
     assert (s, cuts) == (1, [7])   # heavy on CPU: chained, cuts kept
 
-    c, s, cuts, _tp = geom(vgg_cfg(force_pipeline=True))
+    c, s, cuts, _tp, _sp, _ep = geom(vgg_cfg(force_pipeline=True))
     assert (s, cuts) == (2, [7])   # explicit override keeps pipeline
 
 
@@ -237,7 +237,7 @@ def test_vgg16_cut7_real_pipeline_end_to_end(tmp_path):
     regs = [Registration(client_id=f"c{s}_{i}", stage=s)
             for s in (1, 2) for i in range(2)]
     plan = plan_clusters(cfg, regs)[0]
-    c, s, cuts, _tp = MeshContext(cfg)._geometry(plan, 2)
+    c, s, cuts, _tp, _sp, _ep = MeshContext(cfg)._geometry(plan, 2)
     assert (c, s, cuts) == (2, 2, [7])
 
     result = run_local(cfg)

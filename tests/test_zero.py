@@ -176,7 +176,7 @@ def test_zero1_rejected_with_tensor_parallel(tmp_path, eight_devices):
             for s in (1, 2) for i in range(2)]
     plan = plan_clusters(cfg, regs)[0]
     ctx = MeshContext(cfg)
-    c, s, cuts, tp = ctx._geometry(plan, 2)
+    c, s, cuts, tp, _sp, _ep = ctx._geometry(plan, 2)
     assert tp == 2
     with pytest.raises(ValueError, match="tensor-parallel"):
         ctx._compiled(plan, c, s, cuts, None, (), None, tp=tp)
