@@ -202,11 +202,19 @@ class AggregationConfig:
 
 @dataclasses.dataclass(frozen=True)
 class CheckpointConfig:
-    """Save/load/validate flags (``config.yaml:11-13``)."""
+    """Save/load/validate flags (``config.yaml:11-13``).
+
+    ``per_merge`` (2LS parity, ``other/2LS/src/Server.py:184``): under
+    ``aggregation.strategy: fedasync`` also checkpoint after EVERY
+    FedAsync in-cluster merge, not just at round end — the reference
+    persists each alpha-merge so a crash mid-round loses at most one
+    in-cluster's work.  Ignored by the other strategies (they have no
+    mid-round global-model updates to persist)."""
     save: bool = True
     load: bool = False
     validate: bool = True
     directory: str = "checkpoints"
+    per_merge: bool = False
 
 
 @dataclasses.dataclass(frozen=True)

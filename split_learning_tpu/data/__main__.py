@@ -1,0 +1,4 @@
+"""``python -m split_learning_tpu.data --fetch cifar10`` entry point."""
+from split_learning_tpu.data.fetch import main
+
+raise SystemExit(main())

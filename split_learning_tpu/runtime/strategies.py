@@ -395,6 +395,24 @@ class FedAsyncStrategy(RoundStrategy):
                 g_p = _lerp(g_p, _fill(g_p, p), alpha)
                 g_s = _fill(g_s, s)
                 total += n
+                if (ok and self.cfg.checkpoint.per_merge
+                        and self.cfg.checkpoint.save):
+                    # 2LS persists every alpha-merge
+                    # (other/2LS/src/Server.py:184): a crash mid-round
+                    # then loses at most one in-cluster's work.
+                    # Synchronous like the reference — per-merge
+                    # durability is the point; don't trade it for
+                    # overlap.  Gated on `ok` so far: once any update
+                    # was NaN-flagged the round will revert, and a
+                    # tainted merge must not overwrite the last good
+                    # checkpoint on disk (the round loop only saves
+                    # rec.ok rounds — same contract here)
+                    from split_learning_tpu.runtime.checkpoint import (
+                        save_checkpoint,
+                    )
+                    save_checkpoint(self.cfg.checkpoint.directory,
+                                    self.cfg.model_key, g_p, g_s,
+                                    round_idx=round_idx)
         if not ok:
             return RoundOutcome(params, stats, ok=False, validate=False)
         return RoundOutcome(g_p, g_s, num_samples=total)

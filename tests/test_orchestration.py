@@ -290,6 +290,55 @@ def test_2ls_two_level_fedasync_merge_math(tmp_path):
     np.testing.assert_allclose(out.params["layer2"], np.full(2, 20.0))
 
 
+def test_2ls_per_merge_checkpoint(tmp_path, monkeypatch):
+    """checkpoint.per-merge (2LS parity, other/2LS/src/Server.py:184):
+    the FedAsync strategy persists the global model after EVERY
+    in-cluster merge — 2 in-clusters => 2 mid-round saves, each
+    snapshotting the global params at that merge — and the flag stays
+    inert when off."""
+    from split_learning_tpu.runtime import checkpoint as ckpt_mod
+    from split_learning_tpu.runtime.context import TrainContext
+    from split_learning_tpu.runtime.plan import ClusterPlan
+    from split_learning_tpu.runtime.protocol import Update
+
+    saves = []
+    monkeypatch.setattr(
+        ckpt_mod, "save_checkpoint",
+        lambda d, mk, p, s, round_idx=0, extra=None: saves.append(
+            (round_idx, float(p["layer1"][0]))))
+
+    class FakeCtx(TrainContext):
+        def train_cluster(self, plan, params, stats, **kw):
+            return [Update(client_id=cid, stage=1,
+                           cluster=plan.cluster_id,
+                           params={"layer1": np.full(2, 4.0)},
+                           batch_stats={}, num_samples=10, ok=True)
+                    for cid in plan.stage1_clients]
+
+    plan = ClusterPlan(cluster_id=0, cuts=[2],
+                       clients=[["e0", "e1"], ["h0"]],
+                       label_counts=np.ones((2, 10)), rejected=[])
+    base = {"layer1": np.zeros(2), "layer2": np.zeros(2)}
+
+    def run(**ckpt_over):
+        saves.clear()
+        cfg = tiny_cfg(tmp_path,
+                       aggregation={"strategy": "fedasync"},
+                       topology={"in_clusters": 2, "cut_layers": [2]},
+                       checkpoint={"directory": str(tmp_path / "ck"),
+                                   **ckpt_over})
+        out = make_strategy(cfg).run_round(FakeCtx(), [plan], 3, base,
+                                           {})
+        assert out.ok
+        return list(saves)
+
+    assert run() == []                      # default: round-end only
+    got = run(per_merge=True)
+    # merge 1 (alpha=1): global layer1 -> 4; merge 2 (alpha=1/2): stays 4
+    assert got == [(3, 4.0), (3, 4.0)]
+    assert run(per_merge=True, save=False) == []   # save=False wins
+
+
 @pytest.mark.slow
 def test_2ls_two_level_end_to_end_mesh(tmp_path):
     """2 out-clusters x 2 in-clusters over the compiled mesh backend."""

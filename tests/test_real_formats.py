@@ -124,3 +124,92 @@ def test_emotion_on_disk_semicolon_format(data_dir):
     np.testing.assert_array_equal(ds.labels, [0, 1, 3])
     val = get_dataset("EMOTION", train=False)
     assert val.labels.tolist() == [1]
+
+
+def test_fetch_cifar10_installs_loader_layout(data_dir, monkeypatch):
+    """`python -m split_learning_tpu.data --fetch cifar10` (VERDICT r4
+    missing #4, RpcClient.py:64-88 self-download parity): the fetcher
+    downloads the upstream tar.gz, installs the exact layout the CIFAR
+    loader reads, and the loader then returns REAL bytes instead of the
+    synthetic fallback.  urlopen is injected with a local fixture so
+    the install/extract logic runs on this zero-egress host."""
+    import io
+    import pickle
+    import tarfile
+
+    from split_learning_tpu.data import fetch as fetch_mod
+
+    rng = np.random.default_rng(1)
+
+    def member(tar, name, payload):
+        raw = io.BytesIO()
+        pickle.dump(payload, raw)
+        data = raw.getvalue()
+        info = tarfile.TarInfo(f"cifar-10-batches-py/{name}")
+        info.size = len(data)
+        tar.addfile(info, io.BytesIO(data))
+
+    buf = io.BytesIO()
+    with tarfile.open(fileobj=buf, mode="w:gz") as tar:
+        for i in range(1, 6):
+            member(tar, f"data_batch_{i}", {
+                b"data": rng.integers(0, 256, size=(2, 3072),
+                                      dtype=np.uint8),
+                b"labels": [i % 10, (i + 1) % 10]})
+        member(tar, "test_batch", {
+            b"data": rng.integers(0, 256, size=(2, 3072),
+                                  dtype=np.uint8),
+            b"labels": [3, 4]})
+
+    seen = []
+
+    def fake_urlopen(url, timeout=0):
+        seen.append(url)
+        return io.BytesIO(buf.getvalue())
+
+    probe = fetch_mod.fetch("cifar10", urlopen=fake_urlopen,
+                            log=lambda *_: None)
+    assert probe.exists()
+    assert "cs.toronto.edu" in seen[0]
+    ds = get_dataset("CIFAR10", train=True)
+    assert len(ds) == 10          # real bytes, not the synthetic 10000
+    assert ds.inputs.shape == (10, 32, 32, 3)
+
+
+def test_fetch_zero_egress_fails_with_guidance(data_dir):
+    """On a no-network host the fetch fails with the staging guidance
+    instead of a bare stack trace, and never half-installs: a MID-fetch
+    network drop (two of four MNIST files served, then failure) leaves
+    the live layout untouched — real train files next to a synthetic
+    test split would silently validate against a different
+    distribution."""
+    import gzip as gz
+    import io
+
+    from split_learning_tpu.data import fetch as fetch_mod
+
+    def dead_urlopen(url, timeout=0):
+        raise OSError("Network is unreachable")
+
+    with pytest.raises(RuntimeError, match="No network egress"):
+        fetch_mod.fetch("mnist", urlopen=dead_urlopen,
+                        log=lambda *_: None)
+    assert not (data_dir / "MNIST" / "raw"
+                / "train-images-idx3-ubyte").exists()
+
+    served = []
+
+    def flaky_urlopen(url, timeout=0):
+        if len(served) >= 2:
+            raise OSError("Connection reset by peer")
+        served.append(url)
+        return io.BytesIO(gz.compress(b"\x00" * 32))
+
+    with pytest.raises(RuntimeError, match="No network egress"):
+        fetch_mod.fetch("mnist", urlopen=flaky_urlopen,
+                        log=lambda *_: None)
+    assert len(served) == 2          # two files really were downloaded
+    assert not (data_dir / "MNIST").exists()   # ...but none installed
+
+    with pytest.raises(KeyError, match="fetchable"):
+        fetch_mod.fetch("nope")

@@ -1,0 +1,14 @@
+#!/usr/bin/env bash
+# TPU flagship run, launched opportunistically by tools/tpu_watch.py
+# when the tunnel gives a window: full baseline1 scale (50 rounds,
+# 2500 samples/feeder/round) — minutes on the chip vs hours on CPU.
+# Artifacts land in artifacts/flagship_tpu/ and are committed by the
+# watcher loop's caller (or the end-of-round driver sweep).
+set -euo pipefail
+cd "$(dirname "$0")/.."
+PYTHONPATH="$PWD:${PYTHONPATH:-}" timeout 7200 python tools/flagship.py \
+  --rounds 50 --samples 2500 --synthetic-size 5000 \
+  --out artifacts/flagship_tpu --tag tpu
+git add artifacts/flagship_tpu/FLAGSHIP.json artifacts/flagship_tpu/metrics.jsonl 2>/dev/null || true
+git commit -m "Record TPU flagship multi-round learning trajectory" \
+  --only artifacts/flagship_tpu/FLAGSHIP.json artifacts/flagship_tpu/metrics.jsonl || true
