@@ -704,8 +704,8 @@ def _llama_memory_plan() -> dict:
     grad_b = n_params * 2                        # transient grad tree
     zero1_b = 2 * n_params * 2 // stage_w        # m+v bf16, stage-sharded
     # scan-carried wire buffer (mb, max_flat) fp32, x2 for the ppermute
-    # double buffer; max_flat is LOGITS-wide by design (the final
-    # boundary rides the same wire)
+    # double buffer; max_flat is HIDDEN-wide (the final logits return
+    # through their own exact-width switch slot, not the hop wire)
     wire_b = 2 * mb * pipe.max_flat * 4
     # logits collection buffer: (M, mb, n_out) fp32 on the last device
     outbuf_b = M * mb * pipe.n_out * 4

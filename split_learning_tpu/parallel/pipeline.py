@@ -147,7 +147,13 @@ class PipelineModel:
                 f"a {len(out_leaves)}-leaf pytree")
         self.out_struct = out_leaves[0]
         self.n_out = _flat_size(self.out_struct.shape)
-        self.max_flat = max(_tree_flat_size(b) for b in self.boundary)
+        # wire width: the widest boundary that actually RIDES the wire —
+        # the INPUT of every stage (boundary[0..S-1]).  The final output
+        # does not hop: it returns through a separate exact-width switch
+        # slot on the last device, so an LLM head's logits (S x vocab,
+        # ~16x wider than hidden for TinyLlama-1.1B) no longer inflate
+        # every ppermute buffer and scan carry.
+        self.max_flat = max(_tree_flat_size(b) for b in self.boundary[:-1])
         # wire dtype: float32 carries every boundary exactly (token ids
         # are < 2^24; bf16/f32 activations upcast losslessly; bool masks
         # ride as 0.0/1.0)
@@ -157,7 +163,8 @@ class PipelineModel:
     # A boundary may be any pytree (e.g. BERT's (hidden, attention_mask)
     # — models/bert.py threads the pad mask with the activations): leaves
     # are flattened per sample, concatenated, and padded to the widest
-    # boundary so every stage hop moves one (mb, max_flat) buffer.
+    # INTERIOR boundary so every stage hop moves one (mb, max_flat)
+    # buffer; the final output rides its own exact-width slot.
 
     def _to_wire(self, x) -> jnp.ndarray:
         leaves = jax.tree_util.tree_leaves(x)
@@ -187,7 +194,8 @@ class PipelineModel:
             return jnp.mean((logits - labels) ** 2)
         raise ValueError(f"unknown loss {self.loss_name!r}")
 
-    def _device_branch(self, d: int, k: int, train: bool):
+    def _device_branch(self, d: int, k: int, train: bool,
+                       last: bool = False):
         """Branch for mesh-axis position ``d`` holding stages
         ``[d*k, (d+1)*k)`` chained locally (virtual pipeline stages).
 
@@ -196,6 +204,13 @@ class PipelineModel:
         locally — same cut semantics and microbatch accumulation, no
         inter-device hop.  Activations between co-located stages stay in
         their native shape/dtype (no wire round-trip).
+
+        Every branch returns ``(wire, out_tail, stats, aux)`` with
+        identical shapes (lax.switch requirement): interior branches
+        pack their boundary onto the wire and zero the ``(mb, n_out)``
+        tail; the ``last`` branch zeros the wire and returns the final
+        output in the tail — exact width, so wide LLM logits never
+        inflate the hop buffer.
         """
         lo, hi = d * k, (d + 1) * k
         in_struct = self.boundary[lo]
@@ -238,7 +253,16 @@ class PipelineModel:
                                                     rng_data)
                 new_stats.update(mut_stats)
                 aux = aux + stage_aux
-            return self._to_wire(x), new_stats, aux
+            mb = jax.tree_util.tree_leaves(x)[0].shape[0]
+            if last:
+                tail = jnp.concatenate(
+                    [l.reshape(mb, -1).astype(self.wire_dtype)
+                     for l in jax.tree_util.tree_leaves(x)], axis=1)
+                return (jnp.zeros((mb, self.max_flat), self.wire_dtype),
+                        tail, new_stats, aux)
+            return (self._to_wire(x),
+                    jnp.zeros((mb, self.n_out), self.wire_dtype),
+                    new_stats, aux)
 
         return apply_device
 
@@ -264,7 +288,8 @@ class PipelineModel:
                 f"size {A}")
         k = S // A
         dev = jax.lax.axis_index("stage")
-        branches = [self._device_branch(d, k, train) for d in range(A)]
+        branches = [self._device_branch(d, k, train, last=(d == A - 1))
+                    for d in range(A)]
         stats0 = stats
 
         def tick(carry, t):
@@ -282,7 +307,7 @@ class PipelineModel:
                 rng_t = jax.random.fold_in(
                     rng_t, jax.lax.axis_index(self.seq_axis))
 
-            out_wire, new_stats, aux = jax.lax.switch(
+            out_wire, out_tail, new_stats, aux = jax.lax.switch(
                 dev, branches, params, stats, act_in,
                 jax.random.key_data(rng_t))
 
@@ -292,14 +317,14 @@ class PipelineModel:
                 lambda n, o: jnp.where(valid, n, o), new_stats, stats)
             aux_acc = aux_acc + jnp.where(valid, aux, 0.0)
 
-            # last device collects logits for microbatch t-(A-1)
+            # last device collects logits for microbatch t-(A-1) from
+            # the exact-width tail slot (zeros on interior devices)
             c_idx = jnp.clip(t - (A - 1), 0, M - 1)
             collect = (dev == A - 1) & (t >= A - 1)
-            logits_flat = out_wire[:, :self.n_out]
             out_buf = jnp.where(
                 collect,
                 jax.lax.dynamic_update_index_in_dim(
-                    out_buf, logits_flat, c_idx, 0),
+                    out_buf, out_tail, c_idx, 0),
                 out_buf)
 
             perm = [(i, i + 1) for i in range(A - 1)]

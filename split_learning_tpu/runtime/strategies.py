@@ -372,6 +372,7 @@ class FedAsyncStrategy(RoundStrategy):
         order = rng.permutation(len(plans))
         g_p, g_s = params, stats
         total, ok = 0, True
+        saved_any = False   # any per-merge checkpoint written this round
         for pi in order:
             plan = plans[pi]
             ups = ctx.train_cluster(plan, g_p, g_s, round_idx=round_idx,
@@ -413,7 +414,19 @@ class FedAsyncStrategy(RoundStrategy):
                     save_checkpoint(self.cfg.checkpoint.directory,
                                     self.cfg.model_key, g_p, g_s,
                                     round_idx=round_idx)
+                    saved_any = True
         if not ok:
+            if saved_any:
+                # a LATER plan's NaN reverts the round, but earlier
+                # clean merges already overwrote the checkpoint — put
+                # the round-entry state back so a crash never resumes
+                # from a state the run rejected
+                from split_learning_tpu.runtime.checkpoint import (
+                    save_checkpoint,
+                )
+                save_checkpoint(self.cfg.checkpoint.directory,
+                                self.cfg.model_key, params, stats,
+                                round_idx=round_idx)
             return RoundOutcome(params, stats, ok=False, validate=False)
         return RoundOutcome(g_p, g_s, num_samples=total)
 

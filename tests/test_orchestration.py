@@ -338,6 +338,40 @@ def test_2ls_per_merge_checkpoint(tmp_path, monkeypatch):
     assert got == [(3, 4.0), (3, 4.0)]
     assert run(per_merge=True, save=False) == []   # save=False wins
 
+    # cross-plan revert: plan A merges clean (saved), plan B NaN-flags
+    # and reverts the round — disk must be restored to the round-entry
+    # state, never left holding weights the run rejected
+    class MixedCtx(TrainContext):
+        def train_cluster(self, plan, params, stats, **kw):
+            good = plan.cluster_id == 0
+            return [Update(client_id=cid, stage=1,
+                           cluster=plan.cluster_id,
+                           params={"layer1": np.full(2, 4.0)},
+                           batch_stats={}, num_samples=10, ok=good)
+                    for cid in plan.stage1_clients]
+
+    plan_b = ClusterPlan(cluster_id=1, cuts=[2],
+                         clients=[["e2", "e3"], ["h1"]],
+                         label_counts=np.ones((2, 10)), rejected=[])
+    saves.clear()
+    cfg = tiny_cfg(tmp_path, aggregation={"strategy": "fedasync"},
+                   topology={"in_clusters": 2, "cut_layers": [2]},
+                   checkpoint={"directory": str(tmp_path / "ck"),
+                               "per_merge": True})
+    # the strategy shuffles plan order per round; pick a round where
+    # the CLEAN plan runs first so its merges hit disk before the bad
+    # plan taints the round
+    r_idx = next(r for r in range(20)
+                 if np.random.default_rng(cfg.seed + r)
+                 .permutation(2)[0] == 0)
+    out = make_strategy(cfg).run_round(MixedCtx(), [plan, plan_b],
+                                       r_idx, base, {})
+    assert not out.ok
+    np.testing.assert_array_equal(out.params["layer1"], base["layer1"])
+    assert saves, "plan A's clean merges should have checkpointed"
+    # the LAST save restores the round-entry params (layer1 == 0)
+    assert saves[-1] == (r_idx, 0.0), saves
+
 
 @pytest.mark.slow
 def test_2ls_two_level_end_to_end_mesh(tmp_path):

@@ -246,7 +246,9 @@ def test_wire_packing_roundtrip_pytree_boundary():
                           intermediate_size=64, vocab_size=128,
                           max_position_embeddings=16, n_block=2))
     rng = np.random.default_rng(0)
-    for struct in pipe.boundary:
+    # boundary[:-1]: only stage INPUTS ride the wire — the final output
+    # returns through its own exact-width switch slot
+    for struct in pipe.boundary[:-1]:
         leaves, treedef = jax.tree_util.tree_flatten(struct)
         data = [
             (rng.random(l.shape) < 0.5) if l.dtype == jnp.bool_
@@ -268,3 +270,21 @@ def test_wire_packing_roundtrip_pytree_boundary():
             assert orig.dtype == rt.dtype and orig.shape == rt.shape
             np.testing.assert_array_equal(np.asarray(orig),
                                           np.asarray(rt))
+
+
+def test_wire_width_excludes_final_logits():
+    """The hop wire is sized to the widest stage INPUT, not the final
+    logits: an LLM head (seq x vocab, ~16x wider than hidden) must not
+    inflate every ppermute buffer and scan carry (round-5 memory fix —
+    the config-5 plan showed the logits-wide wire costing ~2 GB/chip)."""
+    tiny = dict(vocab_size=512, hidden_size=16, num_heads=2,
+                num_kv_heads=2, intermediate_size=32, n_block=2)
+    pipe = PipelineModel(
+        "TinyLlama_TINYSTORIES", cuts=[2],
+        example_input=jax.ShapeDtypeStruct((2, 8), jnp.int32),
+        num_microbatches=2, model_kwargs=tiny)
+    # interior boundary = (mb, 8, 16) hidden -> 128/sample; logits =
+    # (mb, 8, 512) -> 4096/sample
+    assert pipe.n_out == 8 * 512
+    assert pipe.max_flat == 8 * 16
+    assert pipe.max_flat < pipe.n_out

@@ -62,11 +62,21 @@ def main(argv=None) -> int:
                          "jax backend name)")
     args = ap.parse_args(argv)
 
+    # a sitecustomize may have pinned a (possibly wedged) TPU platform
+    # via jax.config AFTER import — the env var alone does not win;
+    # re-apply it like the run CLI does
+    from split_learning_tpu.platform import apply_platform_env
+    apply_platform_env()
+
     from split_learning_tpu.config import from_dict
     from split_learning_tpu.run import run_local
     from split_learning_tpu.runtime.log import Logger
 
-    out = REPO / args.out
+    # stage into a sibling dir and swap only on success: a wedged TPU
+    # or a kill mid-run must not have already destroyed the previously
+    # committed artifact (the bench's unlosable-artifact principle)
+    final_out = REPO / args.out
+    out = final_out.with_name(final_out.name + ".tmp")
     shutil.rmtree(out, ignore_errors=True)
     out.mkdir(parents=True, exist_ok=True)
     cfg = from_dict({
@@ -74,7 +84,7 @@ def main(argv=None) -> int:
         "clients": [2, 2],                       # baseline1 geometry
         "global-rounds": args.rounds,
         "synthetic-size": args.synthetic_size,
-        "val-max-batches": 8, "val-batch-size": 125,
+        "val-max-batches": 4, "val-batch-size": 125,
         "compute-dtype": "float32",
         "topology": {"cut-layers": [7]},
         "distribution": {"mode": "iid", "num-samples": args.samples},
@@ -114,6 +124,8 @@ def main(argv=None) -> int:
     }
     (out / "FLAGSHIP.json").write_text(json.dumps(summary, indent=1)
                                        + "\n")
+    shutil.rmtree(final_out, ignore_errors=True)
+    out.rename(final_out)
     print(json.dumps({k: v for k, v in summary.items()
                       if k != "trajectory"}, indent=1))
     return 0
