@@ -1,0 +1,110 @@
+"""Unit coverage for the opportunistic TPU snapshot watcher
+(tools/tpu_watch.py) — the tool that turns a rare tunnel-up window into
+an in-repo silicon bench artifact.  The probe/bench subprocesses are
+faked; what's under test is the decision logic: artifact parsing and
+chip gating, the artifact-on-disk-is-the-prize rule, and probe-output
+parsing."""
+
+import importlib.util
+import json
+import pathlib
+import subprocess
+import sys
+import types
+
+import pytest
+
+ROOT = pathlib.Path(__file__).resolve().parent.parent
+
+
+@pytest.fixture()
+def watch(tmp_path, monkeypatch):
+    spec = importlib.util.spec_from_file_location(
+        "tpu_watch", ROOT / "tools" / "tpu_watch.py")
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    monkeypatch.setattr(mod, "STATE", tmp_path / ".tpu_watch")
+    monkeypatch.setattr(mod, "LOG", tmp_path / ".tpu_watch" / "watch.log")
+    monkeypatch.setattr(mod, "ARTIFACT", tmp_path / "BENCH_tpu_r05.json")
+    mod.STATE.mkdir()
+    return mod
+
+
+def _fake_run(payload_line="", rc=0):
+    def run(cmd, **kw):
+        return types.SimpleNamespace(returncode=rc, stdout=payload_line,
+                                     stderr="")
+    return run
+
+
+def test_probe_rejects_cpu_and_parses_kind(watch, monkeypatch):
+    monkeypatch.setattr(
+        watch.subprocess, "run",
+        _fake_run("garbage\nKIND=TPU v5e\n"))
+    assert watch.probe() == "TPU v5e"
+    monkeypatch.setattr(
+        watch.subprocess, "run", _fake_run("KIND=cpu\n"))
+    assert watch.probe() is None
+    monkeypatch.setattr(watch.subprocess, "run", _fake_run("", rc=1))
+    assert watch.probe() is None
+
+    def hang(cmd, **kw):
+        raise subprocess.TimeoutExpired(cmd, kw.get("timeout", 0))
+
+    monkeypatch.setattr(watch.subprocess, "run", hang)
+    assert watch.probe() is None
+
+
+def test_stage_bench_commits_tpu_artifact(watch, monkeypatch):
+    payload = {"metric": "m", "value": 123.0,
+               "extra": {"chip": "TPU v5e"}}
+    monkeypatch.setattr(
+        watch.subprocess, "run",
+        _fake_run("[bench] noise\n" + json.dumps(payload) + "\n"))
+    commits = []
+    monkeypatch.setattr(watch, "git_commit",
+                        lambda paths, msg: commits.append(paths) or True)
+    assert watch.stage_bench("TPU v5e", [{"up": True}])
+    saved = json.loads(watch.ARTIFACT.read_text())
+    assert saved["value"] == 123.0
+    assert saved["extra"]["watcher"]["probe_history"] == [{"up": True}]
+    assert commits == [["BENCH_tpu_r05.json"]]
+
+
+def test_stage_bench_rejects_cpu_fallback_artifact(watch, monkeypatch):
+    """A bench that fell back to CPU mid-run (wedge) must NOT be
+    committed as the round's TPU artifact — the stage stays pending so
+    a later window retries."""
+    payload = {"metric": "m", "value": 0.3,
+               "extra": {"chip": "cpu", "tpu_unreachable": True}}
+    monkeypatch.setattr(watch.subprocess, "run",
+                        _fake_run(json.dumps(payload) + "\n"))
+    monkeypatch.setattr(watch, "git_commit", lambda *a: True)
+    assert not watch.stage_bench("TPU v5e", [])
+    assert not watch.ARTIFACT.exists()
+
+
+def test_stage_bench_artifact_survives_failed_commit(watch, monkeypatch):
+    """The artifact ON DISK is the prize: a lost index.lock race must
+    not burn another scarce TPU window re-running the whole bench."""
+    payload = {"metric": "m", "value": 9.0, "extra": {"chip": "TPU v5e"}}
+    monkeypatch.setattr(watch.subprocess, "run",
+                        _fake_run(json.dumps(payload) + "\n"))
+    monkeypatch.setattr(watch, "git_commit", lambda *a: False)
+    assert watch.stage_bench("TPU v5e", [])   # stage DONE regardless
+    assert watch.ARTIFACT.exists()
+
+
+def test_stage_bench_falls_back_to_partial(watch, monkeypatch):
+    """A bench killed by its timeout leaves no stdout line; the partial
+    artifact file is the surviving record."""
+    partial = {"metric": "m", "value": 5.0, "extra": {"chip": "TPU v5e"}}
+    (watch.STATE / "bench_partial.json").write_text(json.dumps(partial))
+
+    def timed_out(cmd, **kw):
+        raise subprocess.TimeoutExpired(cmd, kw.get("timeout", 0))
+
+    monkeypatch.setattr(watch.subprocess, "run", timed_out)
+    monkeypatch.setattr(watch, "git_commit", lambda *a: True)
+    assert watch.stage_bench("TPU v5e", [])
+    assert json.loads(watch.ARTIFACT.read_text())["value"] == 5.0

@@ -56,6 +56,8 @@ def main(argv=None) -> int:
     ap.add_argument("--lr", type=float, default=5e-4,
                     help="reference default (config.yaml): 5e-4")
     ap.add_argument("--momentum", type=float, default=0.9)
+    ap.add_argument("--optimizer", default="sgd",
+                    help="sgd (reference default) | adamw | adamw-bf16")
     ap.add_argument("--out", default="artifacts/flagship_cpu")
     ap.add_argument("--tag", default=None,
                     help="label recorded in the artifact (default: "
@@ -90,7 +92,8 @@ def main(argv=None) -> int:
         "distribution": {"mode": "iid", "num-samples": args.samples},
         "aggregation": {"strategy": "fedavg"},
         "learning": {"batch-size": 32, "control-count": 4,
-                     "optimizer": "sgd", "learning-rate": args.lr,
+                     "optimizer": args.optimizer,
+                     "learning-rate": args.lr,
                      "momentum": args.momentum},
         "checkpoint": {"directory": str(out / "ckpt"), "save": False},
         "log-path": str(out),
@@ -110,7 +113,7 @@ def main(argv=None) -> int:
         "backend": backend,
         "rounds": args.rounds,
         "samples_per_round": 2 * args.samples,
-        "learning": {"optimizer": "sgd", "lr": args.lr,
+        "learning": {"optimizer": args.optimizer, "lr": args.lr,
                      "momentum": args.momentum, "batch": 32},
         "data": "synthetic CIFAR-10 stand-in (zero-egress image; "
                 "class-template Gaussians, data/datasets.py) — run "
