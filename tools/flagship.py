@@ -58,6 +58,8 @@ def main(argv=None) -> int:
     ap.add_argument("--momentum", type=float, default=0.9)
     ap.add_argument("--optimizer", default="sgd",
                     help="sgd (reference default) | adamw | adamw-bf16")
+    ap.add_argument("--clip", type=float, default=None,
+                    help="clip-grad-norm (Vanilla_SL parity knob)")
     ap.add_argument("--out", default="artifacts/flagship_cpu")
     ap.add_argument("--tag", default=None,
                     help="label recorded in the artifact (default: "
@@ -94,7 +96,9 @@ def main(argv=None) -> int:
         "learning": {"batch-size": 32, "control-count": 4,
                      "optimizer": args.optimizer,
                      "learning-rate": args.lr,
-                     "momentum": args.momentum},
+                     "momentum": args.momentum,
+                     **({"clip-grad-norm": args.clip}
+                        if args.clip else {})},
         "checkpoint": {"directory": str(out / "ckpt"), "save": False},
         "log-path": str(out),
     })
@@ -114,7 +118,8 @@ def main(argv=None) -> int:
         "rounds": args.rounds,
         "samples_per_round": 2 * args.samples,
         "learning": {"optimizer": args.optimizer, "lr": args.lr,
-                     "momentum": args.momentum, "batch": 32},
+                     "momentum": args.momentum, "batch": 32,
+                     "clip_grad_norm": args.clip},
         "data": "synthetic CIFAR-10 stand-in (zero-egress image; "
                 "class-template Gaussians, data/datasets.py) — run "
                 "`python -m split_learning_tpu.data --fetch cifar10` "
