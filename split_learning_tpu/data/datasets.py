@@ -78,9 +78,25 @@ def _synthetic_images(n: int, shape: tuple, n_classes: int,
     revision re-drew the templates per split, which made the val set
     statistically unrelated to training and pinned val accuracy at
     chance forever — the bug VERDICT r2 'what's missing #1' smoked out.)
-    """
+
+    Templates are SPATIALLY SMOOTH (a coarse 8x-block grid, like the
+    low-frequency content of natural images), not iid pixel noise: the
+    CIFAR train loader applies random-crop/flip augmentation
+    (``cifar_augment``), and a few-pixel shift of an iid-noise template
+    is nearly orthogonal to the original — training would see an
+    (effectively) different task than validation and accuracy would pin
+    at chance regardless of model or optimizer (round-5 flagship
+    post-mortem).  Block templates keep ~75%+ correlation under the
+    +-4 px crops, the property real images have that makes
+    augmentation help rather than destroy."""
     rng_templates = np.random.default_rng(seed)
-    templates = rng_templates.normal(0, 1, size=(n_classes,) + shape)
+    block = 8
+    coarse_sp = tuple(-(-s // block) for s in shape[:2])
+    coarse = rng_templates.normal(
+        0, 1, size=(n_classes,) + coarse_sp + shape[2:])
+    ones = np.ones((1,) + (block, block) + (1,) * len(shape[2:]))
+    templates = np.kron(coarse, ones)[
+        (slice(None),) + tuple(slice(0, s) for s in shape[:2])]
     rng = np.random.default_rng(seed * 7919 + (1 if train else 2))
     labels = rng.integers(0, n_classes, size=n)
     x = (templates[labels] * 0.5

@@ -60,6 +60,12 @@ def main(argv=None) -> int:
                     help="sgd (reference default) | adamw | adamw-bf16")
     ap.add_argument("--clip", type=float, default=None,
                     help="clip-grad-norm (Vanilla_SL parity knob)")
+    ap.add_argument("--batch", type=int, default=32)
+    ap.add_argument("--mb", type=int, default=4,
+                    help="control-count (microbatches per optimizer "
+                         "step — optimizer steps/round = samples/"
+                         "(batch*mb); keep it small on small rounds or "
+                         "adam resets every single step)")
     ap.add_argument("--out", default="artifacts/flagship_cpu")
     ap.add_argument("--tag", default=None,
                     help="label recorded in the artifact (default: "
@@ -93,7 +99,8 @@ def main(argv=None) -> int:
         "topology": {"cut-layers": [7]},
         "distribution": {"mode": "iid", "num-samples": args.samples},
         "aggregation": {"strategy": "fedavg"},
-        "learning": {"batch-size": 32, "control-count": 4,
+        "learning": {"batch-size": args.batch,
+                     "control-count": args.mb,
                      "optimizer": args.optimizer,
                      "learning-rate": args.lr,
                      "momentum": args.momentum,
@@ -107,29 +114,21 @@ def main(argv=None) -> int:
     t0 = time.time()
     result = run_local(cfg, logger=Logger(str(out), console=False))
     wall = time.time() - t0
-    traj = [{"round": r.round_idx, "ok": r.ok,
-             "samples": r.num_samples,
-             "val_accuracy": r.val_accuracy, "val_loss": r.val_loss,
-             "wall_s": round(r.wall_s, 2)} for r in result.history]
-    summary = {
-        "geometry": "baseline1: VGG16/CIFAR10 cut=7, clients [2,2], "
-                    "IID (configs/baseline1.yaml)",
-        "backend": backend,
-        "rounds": args.rounds,
-        "samples_per_round": 2 * args.samples,
-        "learning": {"optimizer": args.optimizer, "lr": args.lr,
-                     "momentum": args.momentum, "batch": 32,
-                     "clip_grad_norm": args.clip},
-        "data": "synthetic CIFAR-10 stand-in (zero-egress image; "
-                "class-template Gaussians, data/datasets.py) — run "
-                "`python -m split_learning_tpu.data --fetch cifar10` "
-                "for real bytes",
-        "total_wall_s": round(wall, 1),
-        "final_val_accuracy": traj[-1]["val_accuracy"] if traj else None,
-        "best_val_accuracy": max((t["val_accuracy"] or 0.0)
-                                 for t in traj) if traj else None,
-        "trajectory": traj,
-    }
+    # one summary builder (tools/flagship_summary.py) for completed and
+    # cut-short runs alike, so the two artifact shapes cannot drift;
+    # run-specific metadata layers on top
+    from flagship_summary import summarize
+    summary = summarize(out)
+    summary.update(
+        backend=backend,
+        rounds=args.rounds,
+        samples_per_round=2 * args.samples,
+        learning={"optimizer": args.optimizer, "lr": args.lr,
+                  "momentum": args.momentum, "batch": args.batch,
+                  "control_count": args.mb,
+                  "clip_grad_norm": args.clip},
+        total_wall_s=round(wall, 1),
+    )
     (out / "FLAGSHIP.json").write_text(json.dumps(summary, indent=1)
                                        + "\n")
     shutil.rmtree(final_out, ignore_errors=True)

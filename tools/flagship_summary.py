@@ -1,0 +1,78 @@
+"""Synthesize (or re-synthesize) FLAGSHIP.json from a flagship run's
+``metrics.jsonl`` — used when a run was cut short (budget, kill, round
+end) and `tools/flagship.py` never reached its own summary write; the
+per-round metrics sidecar is the surviving record.
+
+    python tools/flagship_summary.py artifacts/flagship_cpu.tmp \
+        --promote artifacts/flagship_cpu
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import pathlib
+import shutil
+
+
+def summarize(run_dir: pathlib.Path, note: str = "") -> dict:
+    rows = []
+    with open(run_dir / "metrics.jsonl") as f:
+        for line in f:
+            rec = json.loads(line)
+            if "num_samples" in rec and "wall_s" in rec:
+                rows.append(rec)
+    traj = [{"round": r["round_idx"], "ok": r.get("ok"),
+             "samples": r["num_samples"],
+             "val_accuracy": r.get("val_accuracy"),
+             "val_loss": r.get("val_loss"),
+             "wall_s": round(r["wall_s"], 2)} for r in rows]
+    accs = [t["val_accuracy"] for t in traj
+            if t["val_accuracy"] is not None]
+    return {
+        "geometry": "baseline1: VGG16/CIFAR10 cut=7, clients [2,2], "
+                    "IID (configs/baseline1.yaml)",
+        "data": "synthetic CIFAR-10 stand-in (zero-egress image; "
+                "class-template Gaussians, data/datasets.py) — run "
+                "`python -m split_learning_tpu.data --fetch cifar10` "
+                "for real bytes",
+        "rounds_recorded": len(traj),
+        "final_val_accuracy": accs[-1] if accs else None,
+        "best_val_accuracy": max(accs) if accs else None,
+        "total_wall_s": round(sum(t["wall_s"] for t in traj), 1),
+        **({"note": note} if note else {}),
+        "trajectory": traj,
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("run_dir")
+    ap.add_argument("--promote", default=None,
+                    help="also copy metrics + summary to this dir "
+                         "(replacing it)")
+    ap.add_argument("--note", default="")
+    args = ap.parse_args(argv)
+    run_dir = pathlib.Path(args.run_dir)
+    summary = summarize(run_dir, args.note)
+    (run_dir / "FLAGSHIP.json").write_text(
+        json.dumps(summary, indent=1) + "\n")
+    print(json.dumps({k: v for k, v in summary.items()
+                      if k != "trajectory"}, indent=1))
+    if args.promote:
+        dest = pathlib.Path(args.promote)
+        if dest.resolve() == run_dir.resolve():
+            print("already in place (promote dest == run dir)")
+            return 0
+        staged = [(n, (run_dir / n).read_bytes())
+                  for n in ("FLAGSHIP.json", "metrics.jsonl")
+                  if (run_dir / n).exists()]
+        shutil.rmtree(dest, ignore_errors=True)
+        dest.mkdir(parents=True)
+        for name, data in staged:
+            (dest / name).write_bytes(data)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
