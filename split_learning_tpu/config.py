@@ -63,6 +63,15 @@ class LearningConfig:
     optimizer: str = "sgd"
     control_count: int = 4          # in-flight cap -> num_microbatches
     clip_grad_norm: float | None = None  # Vanilla_SL Scheduler.py:204-205
+    # TPU-native extension (no reference equivalent): on device-resident
+    # FedAvg rounds, CARRY adaptive-optimizer state across the round
+    # barrier instead of re-initializing it each round.  The reference
+    # (and the default here) rebuilds the optimizer per round, which
+    # for Adam means the moments re-estimate from zero every few steps
+    # — on small rounds that is the dominant source of the sawtooth
+    # loss the flagship trajectory shows.  Params still FedAvg; moments
+    # stay per-client (the standard local-Adam federated variant).
+    opt_resident: bool = False
     lr_decay: float = 1.0           # DCSL Server.py:38-39
     lr_decay_every: int = 0         # rounds; 0 = off
     # LoRA adapters (reference peft wrap for BERT, RpcClient.py:61-66):

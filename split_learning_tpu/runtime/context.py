@@ -628,14 +628,34 @@ class MeshContext(TrainContext):
             fedavg = make_fedavg_step(mesh)
             strip = jax.jit(
                 lambda t: jax.tree_util.tree_map(lambda a: a[0], t))
+            old = getattr(self, "_resident", None)
             cache = {"key": key, "opt_init": opt_init, "fedavg": fedavg,
                      "strip": strip}
+            # lr decay changes the cache key every decay round (lr is
+            # key[4]); carried moments must survive an lr-ONLY change —
+            # the state is structurally identical, and resetting it on
+            # decay boundaries would reintroduce the Adam re-warmup
+            # sawtooth on exactly the runs that decay
+            if (self.cfg.learning.opt_resident and old is not None
+                    and old.get("token") == id(params)
+                    and "opt_c" in old
+                    and old["key"][:4] == key[:4]
+                    and old["key"][5:] == key[5:]):
+                cache["opt_c"] = old["opt_c"]
         # fresh optimizer state every round — the host path's semantics
         # (optimizer.init per round); built ON DEVICE from the resident
-        # params, no host zeros upload
+        # params, no host zeros upload.  With learning.opt-resident the
+        # PREVIOUS round's final state is reused instead (adaptive
+        # moments keep their estimates across the FedAvg barrier —
+        # kills the per-round Adam re-warmup sawtooth); a cache miss
+        # (re-plan, rollback, first round) still starts fresh.
         place_opt = getattr(optimizer, "shard_opt_to_mesh",
                             shard_to_mesh)
-        opt_c = place_opt(opt_init(params_c), mesh)
+        prev_opt = cache.get("opt_c")
+        if self.cfg.learning.opt_resident and prev_opt is not None:
+            opt_c = prev_opt
+        else:
+            opt_c = place_opt(opt_init(params_c), mesh)
 
         timings: dict = {}
         loaders = [self._loader(c, counts[c], round_idx)
@@ -660,6 +680,13 @@ class MeshContext(TrainContext):
         timings["fedavg_dispatch_s"] = round(time.perf_counter() - t0, 3)
         cache.update(params_c=avg_params_c, stats_c=avg_stats_c,
                      token=id(ret_params), ret=(ret_params, ret_stats))
+        if self.cfg.learning.opt_resident:
+            # only keep the state alive on device when it will be
+            # reused — for the default per-round re-init this would be
+            # a dead ~2x-params Adam tree squatting in HBM
+            cache["opt_c"] = opt_c
+        else:
+            cache.pop("opt_c", None)
         self._resident = cache
         return types.SimpleNamespace(params=ret_params, stats=ret_stats,
                                      num_samples=int(consumed.sum()),
