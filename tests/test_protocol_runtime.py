@@ -872,6 +872,41 @@ def test_sda_strict_fence_quorum_two_middles(tmp_path, monkeypatch):
         assert all(v <= 1 for v in fences.values()), fences
 
 
+def test_sda_strict_quorum_chain_four_stages(tmp_path, monkeypatch):
+    """Strict SDA through a 4-STAGE pipeline with parallel devices at
+    BOTH middle stages (clients=[2,2,2,1]): every stage-3 device must
+    collect a 2-copy quorum (one per stage-2 device) before relaying a
+    fence, and the head a 2-copy quorum (one per stage-3 device) before
+    recording it — the full hop-by-hop induction of the round-5 fence
+    protocol.  Over-relaying would double-fence; under-relaying or
+    over-requiring would deadlock the feeders' gradient waits."""
+    matrix = [[2, 2, 2, 2, 0, 0, 0, 0, 0, 0],   # feeder A: 8 samples
+              [1, 1, 1, 1, 0, 0, 0, 0, 0, 0]]   # feeder B: 4 samples
+    windows = _record_sda_windows(monkeypatch, with_fences=True)
+    cfg = proto_cfg(tmp_path, clients=[2, 2, 2, 1],
+                    topology={"cut_layers": [2, 4, 6]},
+                    distribution={"mode": "fixed", "matrix": matrix},
+                    aggregation={"strategy": "sda", "sda_size": 2,
+                                 "sda_strict": True, "local_rounds": 1})
+    bus = InProcTransport()
+    result = run_deployment(cfg, lambda: bus, bus)
+    assert result.history[0].ok
+    assert result.history[0].num_samples == 12
+
+    feeders = {"client_1_0", "client_1_1"}
+    full = [w for w, _ in windows if len(w) == 2]
+    assert full, "no full window crossed the two middle stages"
+    for w in full:
+        assert set(w) == feeders, w
+    for origins, fences in windows:
+        # fence counts stay per-epoch despite 2x2 relay copies
+        assert set(fences) <= feeders, fences
+        assert all(v <= 1 for v in fences.values()), fences
+        if len(origins) < 2:   # partials only at a dead barrier
+            unfenced = {o for o in feeders if fences.get(o, 0) < 1}
+            assert len(unfenced | set(origins)) < 2, (origins, fences)
+
+
 def test_elastic_join_with_strict_sda_barrier(tmp_path, monkeypatch):
     """Cross-feature: aggregation.sda-strict under topology.elastic-join.
     A feeder that joins between rounds enters the next round's
