@@ -1,0 +1,59 @@
+"""jax version bridge: modern ``jax.shard_map`` on older jax installs.
+
+The data plane is written against the current API — ``jax.shard_map``
+taking ``check_vma=`` and (for partially-auto meshes) ``axis_names=``.
+Some baked-in toolchains still ship jax 0.4.x, where the same machinery
+lives at ``jax.experimental.shard_map.shard_map`` with the older
+``check_rep=`` / ``auto=`` spelling:
+
+* ``check_vma``  -> ``check_rep`` (both disable the replication/varying
+  tracker whose false positives the pipeline avoids);
+* ``axis_names`` (the MANUAL axes) -> ``auto`` (its complement over the
+  mesh axes).
+
+:func:`install` publishes the bridge as ``jax.shard_map`` exactly when
+the attribute is missing, so on a modern jax this module is a no-op and
+the native implementation is always preferred.  Importing
+``split_learning_tpu`` installs it once per process.
+
+Known bridge limitation: partially-auto meshes (a ``model``/``expert``
+GSPMD axis next to manual ``client``/``stage``) hit jax 0.4.x's
+immature ``auto=`` support — XLA rejects the lowered ``PartitionId``
+("UNIMPLEMENTED ... SPMD partitioning").  The fully-manual paths (the
+whole (client, stage[, seq]) pipeline data plane, FedAvg, ZeRO-1,
+sliced params) bridge cleanly; TP/EP composition needs a modern jax.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+
+
+def _legacy_shard_map():
+    from jax.experimental.shard_map import shard_map as _sm
+
+    @functools.wraps(_sm)
+    def shard_map(f, *, mesh, in_specs, out_specs, check_vma: bool = True,
+                  axis_names=None):
+        kwargs = {"check_rep": bool(check_vma)}
+        if axis_names is not None:
+            kwargs["auto"] = frozenset(
+                set(mesh.axis_names) - set(axis_names))
+        return _sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                   **kwargs)
+
+    return shard_map
+
+
+def install() -> None:
+    """Install the modern API names the running jax may predate."""
+    if not hasattr(jax, "shard_map"):
+        jax.shard_map = _legacy_shard_map()
+    if not hasattr(jax.lax, "axis_size"):
+        # the classic spelling of a manual axis' size
+        jax.lax.axis_size = lambda axis_name: jax.lax.psum(1, axis_name)
+
+
+install()

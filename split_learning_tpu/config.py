@@ -79,8 +79,15 @@ class LearningConfig:
     lora_rank: int = 0
     lora_alpha: float = 16.0
     lora_targets: tuple = LORA_DEFAULT_TARGETS
+    # per-stage activation-recompute policy for the compiled pipeline
+    # (parallel/pipeline.py): "wide" (default) checkpoints only stages
+    # whose boundary exceeds the width threshold; "all" is the blanket
+    # recompute; "none" stores every stage's activations
+    remat: str = "wide"
 
     def validate(self):
+        _check(self.remat in ("all", "wide", "none"),
+               f"remat must be all|wide|none, got {self.remat!r}")
         _check(self.lora_rank >= 0, "lora-rank must be >= 0")
         _check(self.learning_rate > 0, "learning-rate must be > 0")
         _check(self.batch_size > 0, "batch-size must be > 0")

@@ -90,13 +90,22 @@ def _synthetic_images(n: int, shape: tuple, n_classes: int,
     +-4 px crops, the property real images have that makes
     augmentation help rather than destroy."""
     rng_templates = np.random.default_rng(seed)
-    block = 8
-    coarse_sp = tuple(-(-s // block) for s in shape[:2])
-    coarse = rng_templates.normal(
-        0, 1, size=(n_classes,) + coarse_sp + shape[2:])
-    ones = np.ones((1,) + (block, block) + (1,) * len(shape[2:]))
-    templates = np.kron(coarse, ones)[
-        (slice(None),) + tuple(slice(0, s) for s in shape[:2])]
+    if len(shape) < 2:
+        # the block-kron construction assumes >= 2 leading SPATIAL dims
+        # (its whole point is surviving 2-D crop/flip augmentation —
+        # see the correlation rationale above).  1-D shapes (e.g. raw
+        # audio) have no such augmentation here: fall back to iid
+        # templates instead of emitting a silently mis-shaped tensor.
+        templates = rng_templates.normal(0, 1,
+                                         size=(n_classes,) + tuple(shape))
+    else:
+        block = 8
+        coarse_sp = tuple(-(-s // block) for s in shape[:2])
+        coarse = rng_templates.normal(
+            0, 1, size=(n_classes,) + coarse_sp + shape[2:])
+        ones = np.ones((1,) + (block, block) + (1,) * len(shape[2:]))
+        templates = np.kron(coarse, ones)[
+            (slice(None),) + tuple(slice(0, s) for s in shape[:2])]
     rng = np.random.default_rng(seed * 7919 + (1 if train else 2))
     labels = rng.integers(0, n_classes, size=n)
     x = (templates[labels] * 0.5

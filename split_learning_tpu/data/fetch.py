@@ -19,6 +19,7 @@ when its downloads fail, minus the stack trace.
 from __future__ import annotations
 
 import gzip
+import hashlib
 import pathlib
 import shutil
 import tarfile
@@ -27,24 +28,45 @@ import urllib.request
 
 from split_learning_tpu.data.datasets import data_dir
 
-#: name -> (list of (url, archive kind, member-handling), probe path).
-#: kinds: "targz" (extract under dest), "gz-raw" (gunzip single file to
-#: the given relative path), "raw" (save as-is to the relative path).
+#: upstream archive sha256 pins (ADVICE round 5): verified against the
+#: published torchvision/TFDS checksums for these fixed-URL archives.
+#: A pin of None skips verification (the agnews CSVs live at a mutable
+#: git raw URL with no stable published digest — logged loudly).
+_MNIST_SHA256 = {
+    "train-images-idx3-ubyte":
+        "440fcabf73cc546fa21475e81ea370265605f56be210a4024d2ca8f203523609",
+    "train-labels-idx1-ubyte":
+        "3552534a0a558bbed6aed32b30c495cca23d567ec52cac8be1a0730e8010255c",
+    "t10k-images-idx3-ubyte":
+        "8d422c7b0a1c1c79245a5bcf07fe86e33eeafee792b84584aec276f5a2dbc4e6",
+    "t10k-labels-idx1-ubyte":
+        "f7ae60f92e00ec6debd23a6088c31dbd2371eca3ffa0defaefb259924204aec6",
+}
+
+#: name -> (list of (url, archive kind, member-handling, sha256), probe
+#: path).  kinds: "targz" (extract under dest), "gz-raw" (gunzip single
+#: file to the given relative path), "raw" (save as-is to the relative
+#: path).  The sha256 is of the DOWNLOADED bytes (the archive, not its
+#: extraction) and is checked before anything is unpacked.
 _SPECS: dict = {
     "cifar10": {
         "files": [("https://www.cs.toronto.edu/~kriz/"
-                   "cifar-10-python.tar.gz", "targz", None)],
+                   "cifar-10-python.tar.gz", "targz", None,
+                   "6d958be074577803d12ecdefd02955f3"
+                   "9262c83c16fe9348329d7fe0b5c001ce")],
         "probe": "cifar-10-batches-py/data_batch_1",
     },
     "cifar100": {
         "files": [("https://www.cs.toronto.edu/~kriz/"
-                   "cifar-100-python.tar.gz", "targz", None)],
+                   "cifar-100-python.tar.gz", "targz", None,
+                   "85cd44d02ba6437773c5bbd22e183051"
+                   "d648de2e7d6b014e1ef29b855ba677a7")],
         "probe": "cifar-100-python/train",
     },
     "mnist": {
         "files": [
             (f"https://ossci-datasets.s3.amazonaws.com/mnist/{stem}.gz",
-             "gz-raw", f"MNIST/raw/{stem}")
+             "gz-raw", f"MNIST/raw/{stem}", _MNIST_SHA256[stem])
             for stem in ("train-images-idx3-ubyte",
                          "train-labels-idx1-ubyte",
                          "t10k-images-idx3-ubyte",
@@ -56,19 +78,59 @@ _SPECS: dict = {
         "files": [
             ("https://raw.githubusercontent.com/mhjabreel/CharCnn_Keras/"
              f"master/data/ag_news_csv/{name}.csv", "raw",
-             f"ag_news/{name}.csv")
+             f"ag_news/{name}.csv", None)
             for name in ("train", "test")
         ],
         "probe": "ag_news/train.csv",
     },
     "speechcommands": {
-        "files": [("http://download.tensorflow.org/data/"
+        "files": [("https://download.tensorflow.org/data/"
                    "speech_commands_v0.02.tar.gz", "targz",
-                   "SpeechCommands/speech_commands_v0.02")],
+                   "SpeechCommands/speech_commands_v0.02",
+                   "af14739ee7dc311471de98f5f9d2c919"
+                   "1b18aedfe957f4a6ff791c709868ff58")],
         "probe": "SpeechCommands/speech_commands_v0.02/"
                  "validation_list.txt",
     },
 }
+
+
+def _verify_sha256(path: pathlib.Path, expected: str | None, url: str,
+                   log=print) -> None:
+    """Check a downloaded file against its pin BEFORE it is unpacked."""
+    if expected is None:
+        log(f"[fetch] WARNING: no pinned sha256 for {url}; "
+            "skipping integrity verification")
+        return
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    got = h.hexdigest()
+    if got != expected:
+        raise RuntimeError(
+            f"sha256 mismatch for {url}: expected {expected}, got "
+            f"{got}. The upstream file changed or the download was "
+            "tampered with; refusing to install it.")
+
+
+def _safe_members(tar: tarfile.TarFile) -> list:
+    """Pre-``filter=`` traversal guard: reject members (and link
+    targets) with absolute paths or ``..`` components so a tampered
+    archive cannot write outside the extraction root on interpreters
+    without ``extractall(filter='data')``."""
+    members = tar.getmembers()
+    for m in members:
+        paths = [("member", m.name)]
+        if m.issym() or m.islnk():
+            paths.append(("link target", m.linkname))
+        for label, name in paths:
+            p = pathlib.PurePosixPath(name)
+            if p.is_absolute() or ".." in p.parts:
+                raise RuntimeError(
+                    f"refusing to extract: {label} {name!r} escapes "
+                    "the extraction root (path traversal)")
+    return members
 
 
 def fetchable() -> list[str]:
@@ -96,7 +158,7 @@ def fetch(name: str, dest: str | pathlib.Path | None = None,
     staging = pathlib.Path(tempfile.mkdtemp(prefix=f"slt_fetch_{name}_",
                                             dir=root))
     try:
-        for url, kind, member in spec["files"]:
+        for url, kind, member, sha256 in spec["files"]:
             log(f"[fetch] {url}")
             try:
                 resp = urlopen(url, timeout=60)
@@ -111,6 +173,7 @@ def fetch(name: str, dest: str | pathlib.Path | None = None,
                 shutil.copyfileobj(resp, tmp)
                 tmp_path = pathlib.Path(tmp.name)
             try:
+                _verify_sha256(tmp_path, sha256, url, log=log)
                 if kind == "targz":
                     with tarfile.open(tmp_path, "r:gz") as tar:
                         target = staging
@@ -123,10 +186,11 @@ def fetch(name: str, dest: str | pathlib.Path | None = None,
                         try:
                             tar.extractall(target, filter="data")
                         except TypeError:
-                            # filter= needs >=3.10.12/3.11.4; these are
-                            # fixed-URL public archives, keep working
-                            # on stock older interpreters
-                            tar.extractall(target)
+                            # filter= needs >=3.10.12/3.11.4; reject
+                            # traversal-shaped members ourselves on
+                            # stock older interpreters
+                            tar.extractall(
+                                target, members=_safe_members(tar))
                 elif kind == "gz-raw":
                     out = staging / member
                     out.parent.mkdir(parents=True, exist_ok=True)

@@ -17,6 +17,14 @@ mirrors the server's UPDATE handling) and an in-mesh form
 (:func:`fedavg_psum`) that runs the same weighted mean as a ``psum`` over a
 mesh axis inside a jitted step — the TPU-native path where all clients of a
 stage live on devices of one slice.
+
+:func:`fedavg_psum` is layout-agnostic: the "tree" may equally be the
+flat stage-sliced parameter wire of
+:func:`split_learning_tpu.parallel.pipeline.make_sliced_train_step` —
+the psum stays over ``client`` and each device folds only its own
+stage slice (``make_fedavg_step(mesh, param_spec=P("client",
+"stage"))``), so the round barrier inherits the sliced layout's 1/A
+per-device traffic for free.
 """
 
 from __future__ import annotations

@@ -3,7 +3,9 @@ sequence/tensor parallel primitives."""
 
 from split_learning_tpu.parallel.mesh import make_mesh, stage_ranges
 from split_learning_tpu.parallel.pipeline import (
-    PipelineModel, make_train_step, make_fedavg_step,
+    PipelineModel, StageParamLayout, make_fedavg_step,
+    make_sliced_train_step, make_train_step, shard_sliced_opt_to_mesh,
+    slice_params_for_mesh,
 )
 from split_learning_tpu.parallel.sequence import (
     make_ring_attention_fn, ring_attention, ulysses_attention,
@@ -19,7 +21,9 @@ from split_learning_tpu.parallel.zero import (
 )
 
 __all__ = [
-    "make_mesh", "stage_ranges", "PipelineModel", "make_train_step",
+    "make_mesh", "stage_ranges", "PipelineModel", "StageParamLayout",
+    "make_train_step", "make_sliced_train_step", "slice_params_for_mesh",
+    "shard_sliced_opt_to_mesh",
     "make_fedavg_step", "ring_attention", "ulysses_attention",
     "make_ring_attention_fn", "make_tp_train_step", "shard_params_tp",
     "tp_shardings", "tp_spec", "make_ep_train_step", "shard_params_ep",

@@ -10,8 +10,8 @@ jitted SPMD program:
   ``stage`` mesh axis (ICI, inside the compiled step — no host round-trip);
 * the reference's ``control-count`` in-flight cap becomes the microbatch
   count of a static GPipe schedule (``num_microbatches``);
-* backward recomputation (``src/train/VGG16.py:89-92``) becomes
-  ``jax.checkpoint`` around each stage application;
+* backward recomputation (``src/train/VGG16.py:89-92``) becomes a
+  PER-STAGE ``jax.checkpoint`` policy (see *Remat policy* below);
 * the backward pipeline is not hand-written at all: differentiating through
   the scan-of-ppermute forward yields the reverse schedule automatically;
 * "clients" of the same stage are rows of the mesh's ``client`` axis —
@@ -22,12 +22,51 @@ jitted SPMD program:
 Heterogeneous stages (a VGG cut gives stages wildly different programs) are
 handled with ``lax.switch`` over per-stage branches; activations cross the
 wire flattened and padded to the largest boundary so every device runs the
-same collective.  Parameters are replicated along ``stage`` (each device
-holds the full model, uses only its stage's slice; gradients are psum'd
-over ``stage`` to keep replicas in sync) — the fully-general path for
-arbitrary heterogeneous cuts.  Big homogeneous transformer models should
-additionally shard parameters along ``model`` (tensor parallelism,
-:mod:`split_learning_tpu.parallel.tensor`) to cut per-device memory.
+same collective.
+
+**Streamed loss** (default, ``stream_loss=True``): the last stage's
+branch computes the per-microbatch loss INSIDE the stage block, every
+pipeline tick, and the scan carries one accumulating scalar.  The
+``(M, mb, n_out)`` collect-then-cross-entropy buffer of the
+materialized-logits path — ~3.9 GB/chip at the baseline5 TinyLlama
+geometry, 40% of one chip's HBM — never exists: an LLM head's logits are
+consumed in the tick that produces them.  When the final stage is
+rematerialized (which the ``wide`` policy picks automatically for
+wide-output heads), no per-tick logits residual survives to the backward
+pass either.  ``stream_loss=False`` keeps the materialized path as the
+parity oracle (``tests/test_pipeline_streamed.py``).
+
+**Remat policy** (``remat=``): ``"all"`` checkpoints every stage (the
+old blanket behavior — maximum recompute, minimum residency), ``"none"``
+stores every stage's activations, and ``"wide"`` (default) checkpoints
+exactly the stages whose per-sample boundary width (max of input and
+output) exceeds ``remat_threshold`` — narrow CIFAR-scale stages skip the
+~1.3x recompute tax entirely while transformer-scale stages keep the
+memory bound.  Booleans still work (``True`` == ``"all"``,
+``False`` == ``"none"``).
+
+**Tick-loop unroll** (``scan_unroll="auto"``): XLA:CPU runs a scan's
+while-loop body through its sequential thunk executor, where the
+conv/matmul kernels lose intra-op threading (measured ~3x on the VGG
+step — most of the round-5 "2.1x split overhead", which taxed the M=1
+unsplit baseline hardest).  ``auto`` fully unrolls short tick loops on
+CPU meshes and keeps the compact scan on accelerators, where the loop
+costs nothing and unrolling an A-branch switch per tick only bloats
+compile time.
+
+**Parameter residency**: by default parameters are replicated along
+``stage`` (each device holds the full model, uses only its stage's
+slice; gradients are psum'd over ``stage`` to keep replicas in sync) —
+the fully-general path for arbitrary heterogeneous cuts.
+:func:`make_sliced_train_step` instead keeps each device's OWN stage
+slice only, as a flat ``(client, stage)``-sharded parameter wire
+(:class:`StageParamLayout`): per-device params/grads/opt-state drop to
+~1/A of the model and the per-step full-tree gradient psum over
+``stage`` (A redundant copies of every gradient, every step)
+disappears; the full tree is reassembled only at FedAvg / validation /
+checkpoint boundaries.  Big homogeneous transformer models can also
+shard parameters along ``model`` (tensor parallelism,
+:mod:`split_learning_tpu.parallel.tensor`).
 
 Semantic note: the reference steps the optimizer once per in-flight batch
 with stale weights (async pipelining); here microbatch gradients are
@@ -69,11 +108,21 @@ class PipelineModel:
     Built once per (model, cuts, microbatch geometry); owns no parameters.
     """
 
+    #: per-sample boundary width (flattened elements) above which the
+    #: ``wide`` remat policy checkpoints a stage.  Sized so CIFAR-scale
+    #: CNN/ViT cuts (<= 2^16 elements/sample) run remat-free while
+    #: token-model stages (seq x hidden, millions/sample) keep the
+    #: memory-bounding recompute.
+    REMAT_WIDE_THRESHOLD = 65536
+
     def __init__(self, model_name: str, cuts: Sequence[int],
                  example_input: jax.ShapeDtypeStruct | jnp.ndarray,
                  num_microbatches: int = 4,
                  loss: str = "softmax_cross_entropy",
-                 remat: bool = True,
+                 remat: bool | str = "wide",
+                 remat_threshold: int | None = None,
+                 stream_loss: bool = True,
+                 scan_unroll: int | str = "auto",
                  model_kwargs: dict | None = None,
                  moe_aux_weight: float = 0.01,
                  seq_axis: str | None = None):
@@ -98,7 +147,22 @@ class PipelineModel:
         self.ranges = stage_ranges(self.n_layers, self.cuts)
         self.n_stages = len(self.ranges)
         self.num_microbatches = num_microbatches
+        # legacy bool spellings map onto the named policies
+        remat = {True: "all", False: "none"}.get(remat, remat)
+        if remat not in ("all", "wide", "none"):
+            raise ValueError(
+                f"remat must be 'all', 'wide', 'none' or a bool; got "
+                f"{remat!r}")
         self.remat = remat
+        self.remat_threshold = int(self.REMAT_WIDE_THRESHOLD
+                                   if remat_threshold is None
+                                   else remat_threshold)
+        self.stream_loss = bool(stream_loss)
+        if scan_unroll != "auto" and not isinstance(scan_unroll, int):
+            raise ValueError(
+                f"scan_unroll must be 'auto' or an int, got "
+                f"{scan_unroll!r}")
+        self.scan_unroll = scan_unroll
         self.loss_name = loss
 
         mk_stage = dict(self.model_kwargs)
@@ -158,6 +222,60 @@ class PipelineModel:
         # are < 2^24; bf16/f32 activations upcast losslessly; bool masks
         # ride as 0.0/1.0)
         self.wire_dtype = jnp.float32
+        # per-stage remat flags from the policy: 'wide' checkpoints a
+        # stage iff its widest per-sample boundary (input or output)
+        # exceeds the threshold — the blanket 'all' policy taxed every
+        # narrow stage with a full recompute it never needed
+        widths = [_tree_flat_size(b) for b in self.boundary]
+        if self.remat == "all":
+            self.stage_remat = [True] * self.n_stages
+        elif self.remat == "none":
+            self.stage_remat = [False] * self.n_stages
+        else:
+            self.stage_remat = [
+                max(widths[s], widths[s + 1]) > self.remat_threshold
+                for s in range(self.n_stages)
+            ]
+        # full-model param SHAPES (ShapeDtypeStructs) for the flat
+        # stage-sliced layout; owns no memory
+        self.param_shapes = var_shapes.get("params", {})
+        self._layout_cache: dict = {}
+
+    #: auto-unroll bound: tick loops at most this long are fully
+    #: unrolled on CPU backends
+    SCAN_UNROLL_MAX_TICKS = 16
+
+    def scan_unroll_for(self, mesh: Mesh) -> int:
+        """Tick-loop unroll factor for a step compiled on ``mesh``.
+
+        XLA:CPU executes a ``lax.scan``'s while-loop body through the
+        sequential thunk path — convolution/matmul kernels inside it
+        lose intra-op threading, which measured ~3x slower than the
+        identical straight-line code (the round-5 2.1x "split overhead"
+        was mostly this, taxing the M=1 unsplit baseline hardest).
+        ``auto`` therefore fully unrolls the tick loop on CPU meshes
+        when it is short (<= SCAN_UNROLL_MAX_TICKS ticks) and keeps the
+        compact scan elsewhere: on TPU the while loop costs nothing
+        and unrolling an A-branch switch per tick only bloats compile
+        time.  An int ``scan_unroll`` forces the factor everywhere.
+        """
+        if self.scan_unroll != "auto":
+            return max(1, int(self.scan_unroll))
+        A = int(mesh.shape["stage"]) if "stage" in mesh.axis_names else 1
+        ticks = self.num_microbatches + A - 1
+        on_cpu = next(iter(mesh.devices.flat)).platform == "cpu"
+        if on_cpu and ticks <= self.SCAN_UNROLL_MAX_TICKS:
+            return ticks
+        return 1
+
+    def stage_param_layout(self, stage_axis_size: int) -> "StageParamLayout":
+        """Memoized :class:`StageParamLayout` for an ``A``-wide stage
+        axis (virtual stages: each device owns ``n_stages/A``
+        consecutive stages)."""
+        if stage_axis_size not in self._layout_cache:
+            self._layout_cache[stage_axis_size] = StageParamLayout(
+                self, stage_axis_size)
+        return self._layout_cache[stage_axis_size]
 
     # -- wire packing ------------------------------------------------------
     # A boundary may be any pytree (e.g. BERT's (hidden, attention_mask)
@@ -195,7 +313,7 @@ class PipelineModel:
         raise ValueError(f"unknown loss {self.loss_name!r}")
 
     def _device_branch(self, d: int, k: int, train: bool,
-                       last: bool = False):
+                       last: bool = False, layout=None):
         """Branch for mesh-axis position ``d`` holding stages
         ``[d*k, (d+1)*k)`` chained locally (virtual pipeline stages).
 
@@ -205,36 +323,52 @@ class PipelineModel:
         inter-device hop.  Activations between co-located stages stay in
         their native shape/dtype (no wire round-trip).
 
-        Every branch returns ``(wire, out_tail, stats, aux)`` with
-        identical shapes (lax.switch requirement): interior branches
-        pack their boundary onto the wire and zero the ``(mb, n_out)``
-        tail; the ``last`` branch zeros the wire and returns the final
-        output in the tail — exact width, so wide LLM logits never
-        inflate the hop buffer.
+        Every branch has identical signature and output shapes
+        (lax.switch requirement): ``(params, stats, wire_in, rng_data,
+        labels_mb) -> (wire, slot, stats, aux)``.  Under streamed loss
+        (default) ``slot`` is a scalar: the ``last`` branch fuses the
+        final stage's apply WITH the microbatch's loss in one
+        (optionally rematerialized) block — the logits are consumed
+        where they are produced and never ride a buffer; interior
+        branches return ``0.0``.  With ``stream_loss=False`` ``slot``
+        is the exact-width ``(mb, n_out)`` output tail the scan
+        collects into the materialized logits buffer (parity oracle).
+
+        ``layout`` switches the parameter source: ``None`` reads the
+        stage slice out of the replicated full tree; a
+        :class:`StageParamLayout` unpacks it from this device's flat
+        stage-sliced segment.
         """
         lo, hi = d * k, (d + 1) * k
         in_struct = self.boundary[lo]
 
-        def apply_device(params, stats, wire_in, rng_data):
+        def stage_params_of(params, s):
+            if layout is not None:
+                return layout.unpack_stage(d, s, params)
+            a, b = self.ranges[s]
+            return shard_params(params, self.specs, a, b)
+
+        def apply_device(params, stats, wire_in, rng_data, labels_mb):
             x = self._from_wire(wire_in, in_struct)
             new_stats = dict(stats)
             aux = jnp.zeros(())
+            loss_mb = jnp.zeros(())
             for s in range(lo, hi):
                 model = self.stage_models[s]
                 a, b = self.ranges[s]
+                fuse_loss = (self.stream_loss and last and s == hi - 1)
 
                 # raw uint32 key data stays raw across the remat/switch
                 # boundary: typed PRNG key avals confuse lax.switch's
                 # residual unification under autodiff (observed MLIR
                 # verifier failure, jax 0.9)
-                def apply_one(params, st_in, x, rng_data,
-                              model=model, a=a, b=b):
+                def apply_one(sp, st_in, x, rng_data, labels,
+                              model=model, a=a, b=b, fuse=fuse_loss):
                     from split_learning_tpu.parallel.expert import (
                         moe_aux_loss,
                     )
                     rng = jax.random.wrap_key_data(rng_data)
-                    variables: dict = {
-                        "params": shard_params(params, self.specs, a, b)}
+                    variables: dict = {"params": sp}
                     st = shard_params(st_in, self.specs, a, b)
                     if st:
                         variables["batch_stats"] = st
@@ -242,18 +376,40 @@ class PipelineModel:
                         variables, x, train=train,
                         mutable=["batch_stats", "intermediates"],
                         rngs={"dropout": rng} if train else None)
+                    if fuse:
+                        # streamed loss: reduce the final output to the
+                        # microbatch loss INSIDE this block, so when the
+                        # block is rematerialized no logits-sized
+                        # residual survives a pipeline tick.  f32
+                        # scalar: a bf16 model's loss would otherwise
+                        # break lax.switch's identical-type requirement
+                        # against the interior branches' f32 zeros
+                        out = self.loss_from_logits(
+                            jax.tree_util.tree_leaves(out)[0],
+                            labels).astype(jnp.float32)
                     # sown MoE load-balance losses (zero for dense
                     # stages) join the objective on THIS device
                     return (out, mut.get("batch_stats", {}),
                             moe_aux_loss(mut.get("intermediates", {})))
 
-                if self.remat:
+                if self.stage_remat[s]:
                     apply_one = jax.checkpoint(apply_one)
-                x, mut_stats, stage_aux = apply_one(params, new_stats, x,
-                                                    rng_data)
+                out, mut_stats, stage_aux = apply_one(
+                    stage_params_of(params, s), new_stats, x, rng_data,
+                    labels_mb)
                 new_stats.update(mut_stats)
                 aux = aux + stage_aux
-            mb = jax.tree_util.tree_leaves(x)[0].shape[0]
+                if fuse_loss:
+                    loss_mb = out
+                else:
+                    x = out
+            mb = wire_in.shape[0]
+            if self.stream_loss:
+                if last:
+                    return (jnp.zeros((mb, self.max_flat),
+                                      self.wire_dtype),
+                            loss_mb, new_stats, aux)
+                return (self._to_wire(x), jnp.zeros(()), new_stats, aux)
             if last:
                 tail = jnp.concatenate(
                     [l.reshape(mb, -1).astype(self.wire_dtype)
@@ -269,11 +425,25 @@ class PipelineModel:
     def device_loss(self, params, stats, x_mb, labels, rng,
                     train: bool = True,
                     mesh_axes: tuple = ("client", "stage"),
-                    stage_axis_size: int | None = None):
+                    stage_axis_size: int | None = None,
+                    layout=None, scan_unroll: int = 1):
         """Per-device pipelined loss. Must run inside shard_map with a
         ``stage`` axis of size ``stage_axis_size`` (default: one device
         per stage).  When the axis is smaller than ``n_stages`` each
         device chains ``n_stages/axis`` consecutive stages locally.
+
+        Under streamed loss (default) the scan carry holds ONE
+        accumulating loss scalar: each tick the last device folds its
+        just-finished microbatch's loss in (cross-entropy computed
+        inside the final stage block on that tick's logits).  The
+        materialized path (``stream_loss=False``) instead collects every
+        microbatch's logits into an ``(M, mb, n_out)`` buffer and runs
+        one loss over the collapse — identical numerics, plus one
+        logits-sized buffer per device.
+
+        ``layout`` (a :class:`StageParamLayout`) makes ``params`` this
+        device's flat stage-sliced segment instead of the replicated
+        full tree (:func:`make_sliced_train_step`).
 
         Returns ``(local_loss, (loss, new_stats))``: ``local_loss`` is this
         device's (unsummed) contribution — the value to differentiate;
@@ -288,12 +458,13 @@ class PipelineModel:
                 f"size {A}")
         k = S // A
         dev = jax.lax.axis_index("stage")
-        branches = [self._device_branch(d, k, train, last=(d == A - 1))
+        branches = [self._device_branch(d, k, train, last=(d == A - 1),
+                                        layout=layout)
                     for d in range(A)]
         stats0 = stats
 
         def tick(carry, t):
-            act_wire, stats, out_buf, aux_acc = carry
+            act_wire, stats, acc, aux_acc = carry
             inj_idx = jnp.clip(t, 0, M - 1)
             x_inj = self._to_wire(
                 jax.lax.dynamic_index_in_dim(x_mb, inj_idx, 0,
@@ -307,9 +478,14 @@ class PipelineModel:
                 rng_t = jax.random.fold_in(
                     rng_t, jax.lax.axis_index(self.seq_axis))
 
-            out_wire, out_tail, new_stats, aux = jax.lax.switch(
+            # the microbatch the LAST device finishes this tick (bubble
+            # ticks clip to a garbage slot that `collect` masks off)
+            c_idx = jnp.clip(t - (A - 1), 0, M - 1)
+            labels_t = jax.lax.dynamic_index_in_dim(labels, c_idx, 0,
+                                                    keepdims=False)
+            out_wire, out_slot, new_stats, aux = jax.lax.switch(
                 dev, branches, params, stats, act_in,
-                jax.random.key_data(rng_t))
+                jax.random.key_data(rng_t), labels_t)
 
             # bubble ticks compute garbage: keep their stats out
             valid = (t >= dev) & (t < dev + M)
@@ -317,36 +493,57 @@ class PipelineModel:
                 lambda n, o: jnp.where(valid, n, o), new_stats, stats)
             aux_acc = aux_acc + jnp.where(valid, aux, 0.0)
 
-            # last device collects logits for microbatch t-(A-1) from
-            # the exact-width tail slot (zeros on interior devices)
-            c_idx = jnp.clip(t - (A - 1), 0, M - 1)
             collect = (dev == A - 1) & (t >= A - 1)
-            out_buf = jnp.where(
-                collect,
-                jax.lax.dynamic_update_index_in_dim(
-                    out_buf, out_tail, c_idx, 0),
-                out_buf)
+            if self.stream_loss:
+                # streamed: fold the finished microbatch's loss scalar
+                # (zeros on interior devices and bubble ticks)
+                acc = acc + jnp.where(collect, out_slot, 0.0)
+            else:
+                # materialized: collect logits for microbatch t-(A-1)
+                # from the exact-width tail slot
+                acc = jnp.where(
+                    collect,
+                    jax.lax.dynamic_update_index_in_dim(
+                        acc, out_slot, c_idx, 0),
+                    acc)
 
             perm = [(i, i + 1) for i in range(A - 1)]
             act_next = (jax.lax.ppermute(out_wire, "stage", perm)
                         if perm else out_wire)
-            return (act_next, new_stats, out_buf, aux_acc), None
+            return (act_next, new_stats, acc, aux_acc), None
 
         del mesh_axes  # only relevant under check_vma, which we disable
         act0 = jnp.zeros((self.mb_size, self.max_flat), self.wire_dtype)
-        out_buf0 = jnp.zeros((M, self.mb_size, self.n_out), self.wire_dtype)
-        (_, stats_f, out_buf, aux_acc), _ = jax.lax.scan(
-            tick, (act0, stats0, out_buf0, jnp.zeros(())),
-            jnp.arange(M + A - 1))
+        acc0 = (jnp.zeros(()) if self.stream_loss
+                else jnp.zeros((M, self.mb_size, self.n_out),
+                               self.wire_dtype))
+        # full unroll must be requested as an int >= 2: both unroll=1
+        # and unroll=True (which lax.scan resolves to unroll=length,
+        # i.e. 1 for a single-tick loop) take the while-loop path,
+        # keeping the XLA:CPU sequential-thunk tax the unroll exists
+        # to remove
+        ticks = M + A - 1
+        unroll = (max(2, ticks) if scan_unroll >= ticks
+                  else max(1, scan_unroll))
+        (_, stats_f, acc, aux_acc), _ = jax.lax.scan(
+            tick, (act0, stats0, acc0, jnp.zeros(())),
+            jnp.arange(ticks), unroll=unroll)
 
-        logits = out_buf.astype(self.out_struct.dtype).reshape(
-            (M * self.mb_size,) + tuple(self.out_struct.shape[1:]))
-        # collapse (M, mb, ...) -> (M*mb, ...): int labels stay 1-D for CE,
-        # vector targets keep their feature dims for MSE
-        labels_flat = labels.reshape((M * self.mb_size,) + labels.shape[2:])
-        ce_local = jnp.where(dev == A - 1,
-                             self.loss_from_logits(logits, labels_flat),
-                             0.0)
+        if self.stream_loss:
+            # equal microbatch sizes: the mean of per-microbatch means
+            # IS the flat (M*mb) mean of the materialized path
+            ce_local = jnp.where(dev == A - 1, acc / M, 0.0)
+        else:
+            logits = acc.astype(self.out_struct.dtype).reshape(
+                (M * self.mb_size,) + tuple(self.out_struct.shape[1:]))
+            # collapse (M, mb, ...) -> (M*mb, ...): int labels stay 1-D
+            # for CE, vector targets keep their feature dims for MSE
+            labels_flat = labels.reshape((M * self.mb_size,)
+                                         + labels.shape[2:])
+            ce_local = jnp.where(dev == A - 1,
+                                 self.loss_from_logits(logits,
+                                                       labels_flat),
+                                 0.0)
         # MoE load-balance aux (mean over microbatches, weighted) joins
         # the objective on whichever device computed it; dense models sow
         # nothing and aux_acc is identically 0.  Reported loss stays CE.
@@ -379,6 +576,86 @@ class PipelineModel:
         stats_out = jax.tree_util.tree_map(
             lambda i, d: i + jax.lax.psum(d, "stage"), stats0, delta)
         return local, (loss, stats_out)
+
+
+class StageParamLayout:
+    """Static flat layout of per-device stage-parameter segments.
+
+    Device ``d`` of an ``A``-wide stage axis owns stages
+    ``[d*k, (d+1)*k)``; its parameters ride as ONE flat fp32 segment —
+    the raveled leaves of its stages' subtrees, concatenated
+    stage-major, padded to the widest device segment — so a
+    ``(client, stage)``-sharded ``(C, A*seg_len)`` array gives every
+    device exactly (and only) its own slice of the model.  Compared to
+    the replicated layout this cuts per-device parameter, gradient and
+    optimizer-state residency by ~(A-1)/A and removes the per-step
+    full-tree gradient psum over ``stage``.
+
+    fp32 is a lossless carrier for fp32/bf16/int leaves; leaf dtypes are
+    restored on unpack from the recorded shapes.
+    """
+
+    def __init__(self, pipe: "PipelineModel", stage_axis_size: int):
+        S = pipe.n_stages
+        if stage_axis_size <= 0 or S % stage_axis_size:
+            raise ValueError(
+                f"n_stages={S} must be a multiple of the stage axis "
+                f"size {stage_axis_size}")
+        self.pipe = pipe
+        self.A = stage_axis_size
+        self.k = S // stage_axis_size
+        self.dtype = jnp.float32
+        #: (d, s) -> (treedef, [(shape, dtype, offset, size)])
+        self._meta: dict = {}
+        seg_lens = []
+        for d in range(self.A):
+            off = 0
+            for s in range(d * self.k, (d + 1) * self.k):
+                a, b = pipe.ranges[s]
+                sub = shard_params(pipe.param_shapes, pipe.specs, a, b)
+                leaves, treedef = jax.tree_util.tree_flatten(sub)
+                metas = []
+                for leaf in leaves:
+                    size = int(np.prod(leaf.shape))
+                    metas.append((tuple(leaf.shape), leaf.dtype, off,
+                                  size))
+                    off += size
+                self._meta[(d, s)] = (treedef, metas)
+            seg_lens.append(off)
+        self.seg_len = max(seg_lens) if seg_lens else 0
+
+    def pack(self, params) -> jnp.ndarray:
+        """Full layer-keyed param tree -> ``(A, seg_len)`` flat wire."""
+        rows = []
+        for d in range(self.A):
+            parts = []
+            for s in range(d * self.k, (d + 1) * self.k):
+                a, b = self.pipe.ranges[s]
+                sub = shard_params(params, self.pipe.specs, a, b)
+                parts += [jnp.ravel(leaf).astype(self.dtype)
+                          for leaf in jax.tree_util.tree_leaves(sub)]
+            v = (jnp.concatenate(parts) if parts
+                 else jnp.zeros((0,), self.dtype))
+            rows.append(jnp.pad(v, (0, self.seg_len - v.shape[0])))
+        return jnp.stack(rows)
+
+    def unpack_stage(self, d: int, s: int, seg) -> dict:
+        """Device ``d``'s flat segment -> stage ``s``'s param subtree."""
+        treedef, metas = self._meta[(d, s)]
+        leaves = [seg[off:off + size].reshape(shape).astype(dtype)
+                  for shape, dtype, off, size in metas]
+        return jax.tree_util.tree_unflatten(treedef, leaves)
+
+    def unpack(self, wire) -> dict:
+        """``(A, seg_len)`` (or flat ``(A*seg_len,)``) wire -> full
+        layer-keyed tree (host-side reassembly at FedAvg / validation /
+        checkpoint boundaries)."""
+        wire = jnp.asarray(wire).reshape(self.A, self.seg_len)
+        out: dict = {}
+        for d in range(self.A):
+            for s in range(d * self.k, (d + 1) * self.k):
+                out.update(self.unpack_stage(d, s, wire[d]))
+        return out
 
 
 def _strip(tree):
@@ -476,6 +753,7 @@ def make_train_step(pipe: PipelineModel, optimizer: optax.GradientTransformation
     """
     grad_sync = _make_grad_sync(client_sync, mesh)
     stage_axis = int(mesh.shape["stage"])
+    unroll = pipe.scan_unroll_for(mesh)
     # seq-sharded pipelines: grads are per-stage AND per-token-block
     # partial sums; one psum over both axes restores full gradients on
     # the (stage, seq)-replicated params
@@ -489,7 +767,8 @@ def make_train_step(pipe: PipelineModel, optimizer: optax.GradientTransformation
         def loss_fn(p):
             local, aux = pipe.device_loss(p, stats, x, labels, rng,
                                           train=train,
-                                          stage_axis_size=stage_axis)
+                                          stage_axis_size=stage_axis,
+                                          scan_unroll=unroll)
             return local, aux
 
         (_, (loss, new_stats)), grads = jax.value_and_grad(
@@ -523,9 +802,116 @@ def make_train_step(pipe: PipelineModel, optimizer: optax.GradientTransformation
     return jax.jit(mapped, donate_argnums=(0, 1, 2) if donate else ())
 
 
+def make_sliced_train_step(pipe: PipelineModel,
+                           optimizer: optax.GradientTransformation,
+                           mesh: Mesh, train: bool = True,
+                           donate: bool = True) -> Callable:
+    """Stage-sliced parameter residency variant of :func:`make_train_step`.
+
+    Parameters ride as the flat ``(C, A*seg_len)`` fp32 wire of
+    :meth:`PipelineModel.stage_param_layout` (build with
+    :func:`slice_params_for_mesh`), sharded ``(client, stage)``: each
+    device holds ONLY its own stages' parameters (~1/A of the model plus
+    padding) instead of a full replica.  Gradients come back for the
+    local slice alone, so the per-step full-tree gradient psum over
+    ``stage`` — A redundant copies of every gradient, every step —
+    disappears, and optimizer state shards identically for free.
+
+    Contract differences vs the replicated step:
+
+    * the optimizer must be elementwise (sgd / momentum / adam / adamw
+      families): it sees one flat vector, not the layer tree, so
+      per-layer transforms (masking, layerwise lr) don't apply;
+    * ``client_sync`` grouped gradient means are not supported (no
+      per-layer gradient access) — shared-later-stage plans keep the
+      replicated step;
+    * the returned params are the updated flat wire; reassemble the
+      full tree at round boundaries with
+      ``pipe.stage_param_layout(A).unpack(wire[c])``.  FedAvg over
+      clients works directly on the wire
+      (``make_fedavg_step(mesh, param_spec=P("client", "stage"))``).
+
+    Returns ``step(params_wire, opt_state, stats, x, labels, rngs) ->
+    (params_wire, opt_state, stats, loss[C])``.
+    """
+    stage_axis = int(mesh.shape["stage"])
+    layout = pipe.stage_param_layout(stage_axis)
+    unroll = pipe.scan_unroll_for(mesh)
+
+    def body(params, opt_state, stats, x, labels, rngs):
+        p = params[0]                      # (seg_len,) own-stage slice
+        opt_state, stats = map(_strip, (opt_state, stats))
+        x, labels, rng = x[0], labels[0], rngs[0]
+
+        def loss_fn(pv):
+            local, aux = pipe.device_loss(pv, stats, x, labels, rng,
+                                          train=train,
+                                          stage_axis_size=stage_axis,
+                                          layout=layout,
+                                          scan_unroll=unroll)
+            return local, aux
+
+        (_, (loss, new_stats)), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(p)
+        # grads are purely LOCAL (this device's slice): no stage psum.
+        # Seq-sharded pipelines still fold token-block partial sums.
+        if pipe.seq_axis is not None:
+            grads = jax.lax.psum(grads, pipe.seq_axis)
+        updates, new_opt = optimizer.update(grads, opt_state, p)
+        new_p = optax.apply_updates(p, updates)
+        return (new_p[None], _restore(new_opt), _restore(new_stats),
+                loss[None])
+
+    # optimizer-state specs mirror the flat param wire: vector leaves
+    # (moments) shard (client, stage); scalars (count) stay client-only
+    opt_struct = jax.eval_shape(
+        optimizer.init,
+        jax.ShapeDtypeStruct((stage_axis * layout.seg_len,),
+                             layout.dtype))
+    spec_opt = jax.tree_util.tree_map(
+        lambda leaf: (P("client", "stage") if leaf.ndim >= 1
+                      else P("client")),
+        opt_struct)
+    spec_c = P("client")
+    spec_x = (spec_c if pipe.seq_axis is None
+              else P("client", None, None, pipe.seq_axis))
+    mapped = jax.shard_map(
+        body, mesh=mesh,
+        in_specs=(P("client", "stage"), spec_opt, spec_c, spec_x,
+                  spec_x, spec_c),
+        out_specs=(P("client", "stage"), spec_opt, spec_c, spec_c),
+        check_vma=False,
+        **_shmap_kwargs(mesh),
+    )
+    return jax.jit(mapped, donate_argnums=(0, 1, 2) if donate else ())
+
+
+def slice_params_for_mesh(pipe: PipelineModel, params, n_clients: int,
+                          mesh: Mesh):
+    """Pack a full param tree into the client-stacked stage-sliced wire
+    and place it: ``(C, A*seg_len)`` sharded ``(client, stage)``."""
+    layout = pipe.stage_param_layout(int(mesh.shape["stage"]))
+    wire = layout.pack(params).reshape(-1)
+    stacked = jnp.broadcast_to(wire[None], (n_clients,) + wire.shape)
+    return jax.device_put(
+        stacked, NamedSharding(mesh, P("client", "stage")))
+
+
+def shard_sliced_opt_to_mesh(opt_state, mesh: Mesh):
+    """Place client-stacked optimizer state for the sliced step: vector
+    leaves (moments over the flat wire) shard ``(client, stage)``;
+    scalars (count) stay client-sharded only."""
+    def put(leaf):
+        spec = (P("client", "stage") if jnp.ndim(leaf) >= 2
+                else P("client"))
+        return jax.device_put(leaf, NamedSharding(mesh, spec))
+    return jax.tree_util.tree_map(put, opt_state)
+
+
 def make_lora_train_step(pipe: PipelineModel,
                          optimizer: optax.GradientTransformation,
                          mesh: Mesh, lora_alpha: float, lora_rank: int,
+                         donate: bool = True,
                          client_sync: dict | None = None) -> Callable:
     """LoRA variant of :func:`make_train_step`.
 
@@ -542,6 +928,7 @@ def make_lora_train_step(pipe: PipelineModel,
 
     grad_sync = _make_grad_sync(client_sync, mesh)
     stage_axis = int(mesh.shape["stage"])
+    unroll = pipe.scan_unroll_for(mesh)
 
     def body(frozen, t, opt_state, stats, x, labels, rngs):
         frozen, t, opt_state, stats = map(_strip,
@@ -553,7 +940,8 @@ def make_lora_train_step(pipe: PipelineModel,
                                 alpha=lora_alpha, rank=lora_rank)
             local, aux = pipe.device_loss(merged, stats, x, labels, rng,
                                           train=True,
-                                          stage_axis_size=stage_axis)
+                                          stage_axis_size=stage_axis,
+                                          scan_unroll=unroll)
             return local, aux
 
         (_, (loss, new_stats)), grads = jax.value_and_grad(
@@ -575,13 +963,21 @@ def make_lora_train_step(pipe: PipelineModel,
         out_specs=(spec_c,) * 4,
         check_vma=False,
     )
-    return jax.jit(mapped, donate_argnums=(1, 2, 3))
+    # frozen (arg 0) is returned unchanged and must NOT be donated; the
+    # trainable/opt/stats buffers are dead after the step and reused
+    return jax.jit(mapped, donate_argnums=(1, 2, 3) if donate else ())
 
 
-def make_fedavg_step(mesh: Mesh) -> Callable:
+def make_fedavg_step(mesh: Mesh, param_spec: P | None = None) -> Callable:
     """Jitted round barrier: weighted FedAvg of per-client params over the
     ``client`` mesh axis (weights = samples consumed, the reference's
-    ``data_count`` semantics at ``src/Server.py:169-179``)."""
+    ``data_count`` semantics at ``src/Server.py:169-179``).
+
+    ``param_spec`` overrides the parameter placement — pass
+    ``P("client", "stage")`` to average the stage-sliced flat wire of
+    :func:`make_sliced_train_step` in place (the psum stays over
+    ``client`` only; each device folds just its own slice)."""
+    param_spec = P("client") if param_spec is None else param_spec
 
     def body(params, weights):
         p, w = _strip(params), weights[0]
@@ -589,8 +985,8 @@ def make_fedavg_step(mesh: Mesh) -> Callable:
         return _restore(avg)
 
     mapped = jax.shard_map(
-        body, mesh=mesh, in_specs=(P("client"), P("client")),
-        out_specs=P("client"), check_vma=False,
+        body, mesh=mesh, in_specs=(param_spec, P("client")),
+        out_specs=param_spec, check_vma=False,
         **_shmap_kwargs(mesh))
     return jax.jit(mapped)
 

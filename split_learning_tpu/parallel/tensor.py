@@ -17,6 +17,13 @@ computes correctly, it just replicates.  The rules operate on param-path
 names, so they compose with the split-layer models (a shard's subtree
 annotates the same way) and stack with the (cluster, client, stage)
 mesh axes — TP is just one more axis in the mesh tuple.
+
+TP composes with the pipeline's REPLICATED parameter layout only: the
+stage-sliced flat wire (``pipeline.make_sliced_train_step``) erases the
+param-path names these rules key on, so a cut model picks one
+residency tool per axis — slice along ``stage`` (1/A of the model per
+device, elementwise optimizers) or shard along ``model`` (per-leaf
+Megatron specs, any optimizer), not both on the same leaves.
 """
 
 from __future__ import annotations

@@ -34,6 +34,16 @@ Two tools, composable:
 Both paths preserve the federated semantics: state is client-stacked and
 client-sharded; ZeRO partitioning happens along ``stage`` (within one
 logical client's pipeline group), never across clients.
+
+Relation to the stage-sliced step
+(:func:`split_learning_tpu.parallel.pipeline.make_sliced_train_step`):
+ZeRO-1 shards only the MOMENTS and keeps params + grads replicated (it
+all-gathers updated shards every step, and still pays the full-tree
+gradient psum over ``stage``).  The sliced step shards params, grads
+AND optimizer state along ``stage`` with no per-step gather/psum of
+either — strictly less traffic and residency — but requires an
+elementwise optimizer and no ``client_sync`` groups; ZeRO-1 remains the
+tool when those constraints don't hold.
 """
 
 from __future__ import annotations
@@ -206,6 +216,7 @@ def make_zero1_train_step(pipe: PipelineModel, mesh: Mesh,
     """
     stage_axis = int(mesh.shape["stage"])
     grad_sync = _make_grad_sync(client_sync, mesh)
+    unroll = pipe.scan_unroll_for(mesh)
 
     def body(params, opt_state, stats, x, labels, rngs):
         # opt moments arrive SHARDED: local block (1, shard_len)
@@ -218,7 +229,8 @@ def make_zero1_train_step(pipe: PipelineModel, mesh: Mesh,
         def loss_fn(p):
             local, aux = pipe.device_loss(p, stats, x, labels, rng,
                                           train=train,
-                                          stage_axis_size=stage_axis)
+                                          stage_axis_size=stage_axis,
+                                          scan_unroll=unroll)
             return local, aux
 
         (_, (loss, new_stats)), grads = jax.value_and_grad(

@@ -126,6 +126,8 @@ def profile_model(model_key: str, batch_size: int = 32,
         fn = jax.jit(lambda v, x, m=m: m.apply(v, x, train=False))
         if method == "flops":
             cost = fn.lower(sub, x_in).compile().cost_analysis()
+            if isinstance(cost, (list, tuple)):   # jax < 0.5 spelling
+                cost = cost[0] if cost else {}
             flops = float((cost or {}).get("flops", 0.0))
             # param-free reshapes report 0 flops; floor at bytes-touched
             # so no layer is free (the planner divides by these)

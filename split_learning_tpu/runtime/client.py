@@ -522,6 +522,13 @@ class ProtocolClient:
         self.round_ok = True
         self.round_idx = msg.round_idx
         self.num_samples = 0
+        # responsive-set overrides (server recomputes after the READY
+        # barrier): a dropped previous-stage client must not leave this
+        # client waiting on fence copies that will never arrive
+        if getattr(msg, "sda_fence_quorum", None) is not None:
+            self.sda_fence_quorum = int(msg.sda_fence_quorum)
+        if getattr(msg, "sda_feeders", None) is not None:
+            self.sda_feeders = list(msg.sda_feeders)
         whole = (self.runner.start_layer == 0
                  and self.runner.model.resolved_end
                  == len(self.runner.model.specs))

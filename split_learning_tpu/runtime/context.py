@@ -382,6 +382,7 @@ class MeshContext(TrainContext):
                 self.cfg.model_key, cuts=cuts_phys,
                 example_input=example,
                 num_microbatches=lrn.control_count,
+                remat=lrn.remat,
                 model_kwargs=self.model_kwargs, seq_axis=seq_axis)
 
         if seq_axis is not None:

@@ -118,8 +118,17 @@ class Start:
 
 @dataclasses.dataclass
 class Syn:
-    """server → client: begin training."""
+    """server → client: begin training.
+
+    ``sda_fence_quorum`` / ``sda_feeders``, when set, override the
+    static values sent in START: the server recomputes them from the
+    RESPONSIVE client set after the READY barrier, so a previous-stage
+    client dropped mid-round (whose fence copies will never arrive)
+    can't leave the strict-SDA drain waiting on a quorum that can no
+    longer be met (ADVICE round 5)."""
     round_idx: int = 0
+    sda_fence_quorum: int | None = None
+    sda_feeders: list | None = None
 
 
 @dataclasses.dataclass

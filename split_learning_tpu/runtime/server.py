@@ -511,15 +511,30 @@ class ProtocolContext(MeshContext):
                 lambda: f"READY from {ids - self._ready}",
                 deadline=time.monotonic() + self.ready_timeout):
             ids &= self._ready  # drop unresponsive clients mid-round
+        stage_of = dict(active)
         for cid in ids:
-            self.bus.publish(reply_queue(cid), encode(Syn(round_idx)))
+            s = stage_of[cid]
+            # strict-SDA liveness under client loss (ADVICE r5): the
+            # fence quorum / feeder set sent in START counted the
+            # STATIC plan, but a previous-stage client dropped at the
+            # READY barrier will never send its fence copies — the
+            # static quorum could never be met and the strict drain
+            # would stall to round timeout.  Recompute both from the
+            # RESPONSIVE set and rebroadcast them with SYN.
+            quorum = (1 if s <= 2 else max(1, sum(
+                1 for c in plan.clients[s - 2] if c in ids)))
+            feeders = [c for c in stage1 if c in ids
+                       and (not pair_groups
+                            or pair_groups.get(c) == pair_groups.get(cid))]
+            self.bus.publish(reply_queue(cid), encode(Syn(
+                round_idx, sda_fence_quorum=quorum,
+                sda_feeders=feeders)))
         self.log.sent(f"SYN -> {sorted(ids)}")
 
         s1_ids = set(stage1) & ids
         deadline = time.monotonic() + self.client_timeout
         self._pump_until(lambda: s1_ids <= self._notified,
                          "NOTIFY from stage-1 clients", deadline=deadline)
-        stage_of = dict(active)
         for cid in ids:
             if isinstance(send_weights, dict):
                 flag = bool(send_weights.get(stage_of[cid], True))

@@ -943,3 +943,78 @@ def test_elastic_join_with_strict_sda_barrier(tmp_path, monkeypatch):
         assert len(set(w)) == len(w)
     assert any("late_edge" in w for w in full), (
         "the joiner never entered a strict window")
+
+
+def test_syn_rebroadcasts_responsive_quorum(tmp_path):
+    """ADVICE r5 (server.py READY drop): sda_fence_quorum / sda_feeders
+    are recomputed from the RESPONSIVE set and carried by SYN; the
+    client adopts the overrides before its hot loop starts."""
+    cfg = proto_cfg(tmp_path, clients=[2, 1, 1],
+                    topology={"cut_layers": [2, 4]})
+    client = ProtocolClient(cfg, "client_3_0", 3,
+                            transport=InProcTransport())
+    client.sda_fence_quorum = 2          # static START value
+    client.sda_feeders = ["client_1_0", "client_1_1"]
+    import types
+    client.runner = types.SimpleNamespace(
+        start_layer=4, model=types.SimpleNamespace(
+            resolved_end=6, specs=(None,) * 6))
+
+    from split_learning_tpu.runtime.protocol import Syn
+
+    # _on_syn itself must apply the overrides before dispatching to the
+    # hot loop; stub the loop out
+    client._train_last = lambda: None
+    client.n_stages = 3
+    client._send_update = lambda *a, **k: None
+    client.stage = 3
+    client._on_syn(Syn(0, sda_fence_quorum=1,
+                       sda_feeders=["client_1_0"]))
+    assert client.sda_fence_quorum == 1
+    assert client.sda_feeders == ["client_1_0"]
+    # a legacy SYN without overrides leaves the START values alone
+    client._on_syn(Syn(0))
+    assert client.sda_fence_quorum == 1
+    assert client.sda_feeders == ["client_1_0"]
+
+
+def test_sda_strict_survives_feeder_dropped_at_ready(tmp_path):
+    """Strict-SDA liveness under client loss (ADVICE r5): one of two
+    feeders registers but never answers START, so the server drops it
+    at the READY barrier.  Pre-fix, the head's static sda_feeders still
+    named the ghost feeder: its epoch fence could never arrive, the
+    dead-barrier test never fired, and the strict drain stalled to
+    round timeout.  With the responsive-set SYN rebroadcast the round
+    completes with the surviving feeder's samples."""
+    from split_learning_tpu.runtime.protocol import (
+        RPC_QUEUE, Register, encode,
+    )
+
+    bus = InProcTransport()
+    cfg = proto_cfg(tmp_path, clients=[2, 1, 1],
+                    topology={"cut_layers": [2, 4]},
+                    distribution={"num_samples": 8},
+                    aggregation={"strategy": "sda", "sda_size": 2,
+                                 "sda_strict": True, "local_rounds": 1})
+    server = ProtocolServer(cfg, transport=bus, client_timeout=90.0,
+                            ready_timeout=3.0)
+
+    threads = []
+    for cid, stage in (("client_1_0", 1), ("client_2_0", 2),
+                       ("client_3_0", 3)):
+        client = ProtocolClient(cfg, cid, stage, transport=bus)
+        t = threading.Thread(target=client.run, daemon=True)
+        t.start()
+        threads.append(t)
+    # the ghost feeder: registers (so planning proceeds) and goes dark
+    bus.publish(RPC_QUEUE, encode(Register(client_id="client_1_1",
+                                           stage=1)))
+
+    result = server.serve()
+    for t in threads:
+        t.join(timeout=30)
+        assert not t.is_alive(), "client thread failed to stop"
+    rec = result.history[0]
+    assert rec.ok, "round failed instead of degrading to the live feeder"
+    # only the surviving feeder's samples count
+    assert rec.num_samples == 8, rec.num_samples

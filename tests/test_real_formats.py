@@ -167,6 +167,15 @@ def test_fetch_cifar10_installs_loader_layout(data_dir, monkeypatch):
         seen.append(url)
         return io.BytesIO(buf.getvalue())
 
+    # the fixture archive is not the upstream bytes: re-pin the spec's
+    # sha256 to the fixture's digest so verification RUNS and passes
+    # (the mismatch path has its own test below)
+    import hashlib
+    digest = hashlib.sha256(buf.getvalue()).hexdigest()
+    url0, kind0, member0, _ = fetch_mod._SPECS["cifar10"]["files"][0]
+    monkeypatch.setitem(fetch_mod._SPECS["cifar10"], "files",
+                        [(url0, kind0, member0, digest)])
+
     probe = fetch_mod.fetch("cifar10", urlopen=fake_urlopen,
                             log=lambda *_: None)
     assert probe.exists()
@@ -176,7 +185,81 @@ def test_fetch_cifar10_installs_loader_layout(data_dir, monkeypatch):
     assert ds.inputs.shape == (10, 32, 32, 3)
 
 
-def test_fetch_zero_egress_fails_with_guidance(data_dir):
+def test_fetch_rejects_sha256_mismatch(data_dir):
+    """A tampered (or upstream-changed) archive must be refused BEFORE
+    extraction and leave the live layout untouched (ADVICE r5: the
+    fetcher previously installed whatever bytes arrived)."""
+    import io
+
+    from split_learning_tpu.data import fetch as fetch_mod
+
+    def evil_urlopen(url, timeout=0):
+        return io.BytesIO(b"not the published archive")
+
+    with pytest.raises(RuntimeError, match="sha256 mismatch"):
+        fetch_mod.fetch("cifar10", urlopen=evil_urlopen,
+                        log=lambda *_: None)
+    assert not (data_dir / "cifar-10-batches-py").exists()
+
+
+def test_fetch_specs_pin_sha256_and_https():
+    """Every spec entry carries a sha256 pin (agnews' mutable git-raw
+    CSVs are the documented exception) and no URL is plain http —
+    the speechcommands URL was the MITM-able one (ADVICE r5)."""
+    from split_learning_tpu.data import fetch as fetch_mod
+
+    for name, spec in fetch_mod._SPECS.items():
+        for url, _kind, _member, sha in spec["files"]:
+            assert url.startswith("https://"), (name, url)
+            if name != "agnews":
+                assert isinstance(sha, str) and len(sha) == 64, (name,
+                                                                 url)
+
+
+def test_fetch_tar_fallback_rejects_traversal(data_dir, monkeypatch):
+    """On interpreters without extractall(filter=), a tampered archive
+    with '..' members must be rejected, not written outside the root."""
+    import io
+    import tarfile
+
+    from split_learning_tpu.data import fetch as fetch_mod
+
+    evil = io.BytesIO()
+    with tarfile.open(fileobj=evil, mode="w:gz") as tar:
+        data = b"owned"
+        info = tarfile.TarInfo("../../escape.txt")
+        info.size = len(data)
+        tar.addfile(info, io.BytesIO(data))
+    payload = evil.getvalue()
+
+    import hashlib
+    digest = hashlib.sha256(payload).hexdigest()
+    url0, kind0, member0, _ = fetch_mod._SPECS["cifar10"]["files"][0]
+    monkeypatch.setitem(fetch_mod._SPECS["cifar10"], "files",
+                        [(url0, kind0, member0, digest)])
+
+    # force the pre-filter= fallback path regardless of interpreter
+    real_extractall = tarfile.TarFile.extractall
+
+    def no_filter_extractall(self, path=".", members=None, *,
+                             numeric_owner=False, **kw):
+        if "filter" in kw:
+            raise TypeError("extractall() got an unexpected keyword "
+                            "argument 'filter'")
+        return real_extractall(self, path=path, members=members,
+                               numeric_owner=numeric_owner)
+
+    monkeypatch.setattr(tarfile.TarFile, "extractall",
+                        no_filter_extractall)
+
+    with pytest.raises(RuntimeError, match="path traversal"):
+        fetch_mod.fetch("cifar10",
+                        urlopen=lambda url, timeout=0: io.BytesIO(payload),
+                        log=lambda *_: None)
+    assert not (data_dir.parent / "escape.txt").exists()
+
+
+def test_fetch_zero_egress_fails_with_guidance(data_dir, monkeypatch):
     """On a no-network host the fetch fails with the staging guidance
     instead of a bare stack trace, and never half-installs: a MID-fetch
     network drop (two of four MNIST files served, then failure) leaves
@@ -198,12 +281,22 @@ def test_fetch_zero_egress_fails_with_guidance(data_dir):
                 / "train-images-idx3-ubyte").exists()
 
     served = []
+    payload = gz.compress(b"\x00" * 32)
+
+    # pin the fixture bytes so the first two files pass verification
+    # and the failure really is the third file's network drop
+    import hashlib
+    digest = hashlib.sha256(payload).hexdigest()
+    monkeypatch.setitem(
+        fetch_mod._SPECS["mnist"], "files",
+        [(url, kind, member, digest)
+         for url, kind, member, _ in fetch_mod._SPECS["mnist"]["files"]])
 
     def flaky_urlopen(url, timeout=0):
         if len(served) >= 2:
             raise OSError("Connection reset by peer")
         served.append(url)
-        return io.BytesIO(gz.compress(b"\x00" * 32))
+        return io.BytesIO(payload)
 
     with pytest.raises(RuntimeError, match="No network egress"):
         fetch_mod.fetch("mnist", urlopen=flaky_urlopen,
