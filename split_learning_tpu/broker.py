@@ -20,7 +20,24 @@ def main(argv=None):
     ap.add_argument("--port", type=int, default=5672)
     ap.add_argument("--python", action="store_true",
                     help="force the pure-Python broker")
+    ap.add_argument("--max-frame-gb", type=float, default=None,
+                    help="per-frame payload cap (default 8 GiB): a "
+                         "corrupt length prefix fails the connection "
+                         "instead of driving a huge allocation.  "
+                         "Enforced by the pure-Python broker only "
+                         "(implies --python); publishers fail-fast "
+                         "against their own process's cap, so lower "
+                         "it on both sides or oversized publishes "
+                         "die at the broker instead of the client")
     args = ap.parse_args(argv)
+
+    if args.max_frame_gb is not None:
+        from split_learning_tpu.runtime import bus
+        bus.MAX_FRAME_BYTES = int(args.max_frame_gb * (1 << 30))
+        if not args.python:
+            print("--max-frame-gb: native broker does not enforce the "
+                  "cap; using the Python broker")
+            args.python = True
 
     broker = None
     if not args.python:

@@ -247,6 +247,17 @@ class TransportConfig:
     # each payload leaf for ~4x (runtime/protocol.py QuantLeaf);
     # control-plane weights (START/UPDATE) always travel full precision.
     wire_dtype: str = "float32"     # float32 | float16 | bfloat16 | int8
+    # At-least-once in-order delivery (runtime/bus.py ReliableTransport)
+    # for queues matching ``reliable-queues``: sequence-numbered + ack'd
+    # frames with bounded redelivery, receiver-side dedup + resequencing.
+    # Default off — the plain queues are at-most-once, exactly the
+    # reference's semantics; turn on for lossy/restarting brokers and
+    # chaos runs.
+    reliable: bool = False
+    reliable_queues: tuple = ("intermediate_queue*", "gradient_queue*",
+                              "rpc_queue")
+    redeliver_s: float = 0.3        # first redelivery deadline (backoff x1.5)
+    max_redeliver: int = 20         # bounded redelivery, then give up
 
     def validate(self):
         _check(self.kind in ("inproc", "tcp"),
@@ -255,6 +266,54 @@ class TransportConfig:
                                    "int8"),
                f"wire-dtype must be float32|float16|bfloat16|int8, "
                f"got {self.wire_dtype!r}")
+        _check(self.redeliver_s > 0, "redeliver-s must be > 0")
+        _check(self.max_redeliver >= 1, "max-redeliver must be >= 1")
+
+
+@dataclasses.dataclass(frozen=True)
+class ChaosConfig:
+    """Deterministic fault injection (``runtime/chaos.py``).
+
+    Every fault decision is drawn from a per-queue RNG seeded by
+    ``(seed, queue)``, so a run's fault pattern is reproducible from the
+    single ``chaos.seed`` — per queue, independent of scheduling — and a
+    failure found in a chaos sweep replays exactly.  ``crash`` holds
+    scripted crash points, e.g.::
+
+        crash:
+          - {client: client_1_1, queue: "intermediate_queue*", after: 2}
+
+    meaning "client_1_1's process dies at its 2nd activation publish".
+    Probabilities apply per published message on matching ``queues``."""
+    enabled: bool = False
+    seed: int = 0
+    drop: float = 0.0               # message silently lost
+    duplicate: float = 0.0          # message delivered twice
+    reorder: float = 0.0            # message swapped behind its successor
+    corrupt: float = 0.0            # one payload byte flipped
+    delay: float = 0.0              # message held for delay-s
+    delay_s: float = 0.02
+    queues: tuple = ("intermediate_queue*", "gradient_queue*")
+    crash: tuple = ()               # scripted crash points (dicts)
+
+    def validate(self):
+        for name in ("drop", "duplicate", "reorder", "corrupt", "delay"):
+            v = getattr(self, name)
+            _check(0.0 <= v <= 1.0,
+                   f"chaos.{name} must be in [0, 1], got {v!r}")
+        _check(self.delay_s >= 0, "chaos.delay-s must be >= 0")
+        for spec in self.crash:
+            _check(isinstance(spec, dict) and "client" in spec,
+                   f"chaos.crash entries must be mappings with a "
+                   f"'client' key, got {spec!r}")
+            after = spec.get("after", 1)
+            try:
+                after = int(after)
+            except (TypeError, ValueError):
+                after = 0   # fall through to the clean error below
+            _check(after >= 1,
+                   f"chaos.crash 'after' must be an integer >= 1, "
+                   f"got {spec.get('after', 1)!r}")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -278,6 +337,7 @@ class Config:
     aggregation: AggregationConfig = AggregationConfig()
     checkpoint: CheckpointConfig = CheckpointConfig()
     transport: TransportConfig = TransportConfig()
+    chaos: ChaosConfig = ChaosConfig()
 
     @property
     def model_key(self) -> str:
@@ -296,7 +356,7 @@ class Config:
                f"compute-dtype must be bfloat16|float32, "
                f"got {self.compute_dtype!r}")
         for sub in (self.learning, self.distribution, self.topology,
-                    self.aggregation, self.transport):
+                    self.aggregation, self.transport, self.chaos):
             sub.validate()
         if self.topology.mode == "manual":
             cuts = self.topology.cluster_cut_layers or (
@@ -316,6 +376,7 @@ _SECTION_TYPES = {
     "aggregation": AggregationConfig,
     "checkpoint": CheckpointConfig,
     "transport": TransportConfig,
+    "chaos": ChaosConfig,
 }
 
 

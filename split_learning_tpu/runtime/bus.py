@@ -15,15 +15,32 @@ named-queue semantics live behind one small interface with two backends:
 
 Blocking ``get`` uses real waits (condition variables / socket blocking),
 not the reference's sleep-polling.
+
+Robustness layers (chaos-grade runtime):
+
+* :class:`TcpTransport` auto-reconnects with capped exponential backoff
+  when the broker restarts mid-run (``ConnectionError``/
+  ``BrokenPipeError`` used to kill the process);
+* :class:`ReliableTransport` upgrades matching queues from at-most-once
+  to **at-least-once, in-order** delivery: every published frame carries
+  a ``(sender_token, seq)`` envelope with its own checksum, receivers
+  ack each frame and deduplicate + resequence per ``(queue, sender)``,
+  and an unacked frame is redelivered with bounded backoff.  Under a
+  :class:`~split_learning_tpu.runtime.chaos.ChaosTransport` injecting
+  drops/duplicates/reordering/corruption, the application above sees the
+  exact sent byte stream, in order.
 """
 
 from __future__ import annotations
 
 import collections
+import fnmatch
 import socket
 import struct
 import threading
 import time
+import uuid
+import zlib
 from typing import Iterable
 
 
@@ -131,6 +148,11 @@ class InProcTransport(Transport):
 _OP_PUB, _OP_GET, _OP_PURGE, _OP_REPLY = b"P", b"G", b"X", b"R"
 _TIMEOUT_SENTINEL = 0xFFFFFFFFFFFFFFFF
 
+#: frame sanity caps — a corrupt length prefix must fail the connection,
+#: not drive the broker into a multi-terabyte allocation
+MAX_NAME_BYTES = 1 << 16
+MAX_FRAME_BYTES = 1 << 33          # 8 GiB; broker.py --max-frame-gb
+
 
 def _recv_exact(sock: socket.socket, n: int) -> bytes:
     buf = b""
@@ -151,21 +173,38 @@ def _send_frame(sock: socket.socket, op: bytes, name: bytes,
 def _recv_frame(sock: socket.socket) -> tuple[bytes, bytes, bytes]:
     op = _recv_exact(sock, 1)
     (nlen,) = struct.unpack(">I", _recv_exact(sock, 4))
+    if nlen > MAX_NAME_BYTES:
+        raise ConnectionError(f"corrupt frame: queue-name length {nlen}")
     name = _recv_exact(sock, nlen)
     (plen,) = struct.unpack(">Q", _recv_exact(sock, 8))
     if plen == _TIMEOUT_SENTINEL:
         return op, name, None  # type: ignore[return-value]
+    if plen > MAX_FRAME_BYTES:
+        raise ConnectionError(f"corrupt frame: payload length {plen}")
     return op, name, _recv_exact(sock, plen)
 
 
 class Broker:
     """Threaded TCP message broker (one thread per connection)."""
 
-    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 bind_timeout: float = 10.0):
         self._store = InProcTransport()
-        self._sock = socket.create_server((host, port))
+        # a RESTARTED broker re-binds the same port while the previous
+        # incarnation's connections may still be draining (FIN_WAIT):
+        # retry briefly instead of failing the recovery path
+        deadline = time.monotonic() + bind_timeout
+        while True:
+            try:
+                self._sock = socket.create_server((host, port))
+                break
+            except OSError:
+                if port == 0 or time.monotonic() >= deadline:
+                    raise
+                time.sleep(0.1)
         self.host, self.port = self._sock.getsockname()[:2]
         self._threads: list[threading.Thread] = []
+        self._conns: list[socket.socket] = []
         self._running = True
         self._accept_thread = threading.Thread(target=self._accept_loop,
                                                daemon=True)
@@ -179,8 +218,12 @@ class Broker:
                 return
             t = threading.Thread(target=self._serve, args=(conn,),
                                  daemon=True)
-            t.start()
+            # register BEFORE start: _serve's cleanup removes these
+            # entries, and an immediately-closing connection would
+            # otherwise race the removal and leak the entry forever
             self._threads.append(t)
+            self._conns.append(conn)
+            t.start()
 
     def _serve(self, conn: socket.socket):
         try:
@@ -192,10 +235,7 @@ class Broker:
                 elif op == _OP_GET:
                     (ms,) = struct.unpack(">Q", payload)
                     timeout = None if ms == 0 else ms / 1000.0
-                    try:
-                        msg = self._store.get(queue, timeout)
-                    except QueueClosed:
-                        return
+                    msg = self._store.get(queue, timeout)
                     if msg is None:
                         conn.sendall(_OP_REPLY + struct.pack(">I", 0)
                                      + struct.pack(">Q", _TIMEOUT_SENTINEL))
@@ -204,49 +244,159 @@ class Broker:
                 elif op == _OP_PURGE:
                     self._store.purge(None if not payload
                                       else payload.decode().split(","))
-        except (ConnectionError, OSError):
-            return
+        except (QueueClosed, ConnectionError, OSError):
+            return   # broker shutdown or client gone: quiet exit
+        finally:
+            # release the fd and drop the bookkeeping entry: under
+            # reconnect churn (auto-reconnecting TcpTransports) a
+            # long-running broker would otherwise accumulate dead
+            # CLOSE_WAIT sockets until accept() hits EMFILE
+            try:
+                conn.close()
+            except OSError:
+                pass
+            try:
+                self._conns.remove(conn)
+            except ValueError:
+                pass
+            try:
+                self._threads.remove(threading.current_thread())
+            except ValueError:
+                pass
 
     def close(self):
         self._running = False
         self._store.close()
+        # shutdown() BEFORE close(), on the listener and on every
+        # accepted connection: a thread blocked in accept()/recv() holds
+        # a python-level io-ref that DEFERS the real fd close, so a bare
+        # close() leaves clients hanging (no EOF ever sent) and keeps
+        # the port busy — a same-port broker RESTART (the recovery path
+        # TcpTransport reconnects to) would then fail with EADDRINUSE
+        # indefinitely.  shutdown wakes the blocked threads so the fds
+        # actually release.
+        try:
+            self._sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
         try:
             self._sock.close()
         except OSError:
             pass
+        for conn in list(self._conns):
+            try:
+                conn.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                conn.close()
+            except OSError:
+                pass
+        self._accept_thread.join(timeout=5.0)
+        for t in list(self._threads):
+            t.join(timeout=5.0)
 
 
 class TcpTransport(Transport):
     """Client of a :class:`Broker`. One socket per transport instance;
-    safe for one thread (create one per worker thread)."""
+    safe for one thread (create one per worker thread).
 
-    def __init__(self, host: str, port: int, connect_timeout: float = 30.0):
+    A mid-run ``ConnectionError``/``BrokenPipeError`` (broker restart,
+    transient network reset) no longer kills the process: every op
+    reconnects with capped exponential backoff and retries, up to
+    ``reconnect_timeout`` seconds per outage.  Messages queued inside a
+    restarted broker are gone — layer :class:`ReliableTransport` on top
+    when that loss matters."""
+
+    def __init__(self, host: str, port: int, connect_timeout: float = 30.0,
+                 reconnect_timeout: float = 15.0, faults=None):
         super().__init__()
+        self.host, self.port = host, port
+        self._reconnect_timeout = reconnect_timeout
+        self._closed = False
+        if faults is None:
+            from split_learning_tpu.runtime.trace import (
+                default_fault_counters,
+            )
+            faults = default_fault_counters
+        self.faults = faults
         # the broker may still be coming up (simultaneous launch): retry
         # with backoff instead of failing the whole client process
-        deadline = time.monotonic() + connect_timeout
+        self._sock = self._connect(connect_timeout)
+        self._lock = threading.Lock()
+
+    def _connect(self, timeout: float) -> socket.socket:
+        deadline = time.monotonic() + timeout
+        delay = 0.1
         while True:
+            if self._closed:
+                raise ConnectionError("transport closed")
             try:
-                self._sock = socket.create_connection((host, port),
-                                                      timeout=10.0)
-                self._sock.settimeout(None)
-                break
+                sock = socket.create_connection((self.host, self.port),
+                                                timeout=10.0)
+                sock.settimeout(None)
+                return sock
             except (ConnectionRefusedError, ConnectionResetError,
                     TimeoutError):
                 # only not-up-yet errors; bad hostnames etc. fail fast
                 if time.monotonic() >= deadline:
                     raise
-                time.sleep(0.5)
-        self._lock = threading.Lock()
+                time.sleep(delay)
+                delay = min(delay * 2, 2.0)   # capped exponential backoff
+
+    def _reconnect(self) -> None:
+        """Steady-state reconnect: the broker died under us."""
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+        self.faults.inc("reconnects")
+        self._sock = self._connect(self._reconnect_timeout)
+
+    _MAX_OP_RETRIES = 5
+
+    def _retry(self, op):
+        """Run ``op`` (which uses ``self._sock``); on a connection-level
+        failure reconnect and re-issue.  Caller holds ``self._lock``.
+
+        Bounded per op: when reconnects SUCCEED but the op keeps
+        failing (e.g. the broker enforces a lower frame cap and kills
+        the connection on every resend), retrying forever would be a
+        hot connect/send/reset livelock — after ``_MAX_OP_RETRIES``
+        consecutive failures the error surfaces to the caller.  A
+        broker OUTAGE is bounded separately by ``reconnect_timeout``
+        inside ``_reconnect``."""
+        attempts = 0
+        while True:
+            try:
+                return op()
+            except (ConnectionError, OSError):
+                # includes BrokenPipeError/ConnectionResetError; a close()
+                # from our own side must still raise out to the caller
+                if self._closed:
+                    raise
+                attempts += 1
+                if attempts > self._MAX_OP_RETRIES:
+                    raise
+                self._reconnect()
 
     def publish(self, queue: str, payload: bytes) -> None:
+        # fail fast on a frame the broker deterministically rejects:
+        # _retry cannot tell a cap rejection from a transient outage and
+        # would reconnect-and-resend the same doomed frame forever
+        if len(payload) > MAX_FRAME_BYTES:
+            raise ValueError(
+                f"payload of {len(payload)} bytes exceeds the "
+                f"{MAX_FRAME_BYTES}-byte frame cap")
         self._count(queue, payload)
         with self._lock:
-            _send_frame(self._sock, _OP_PUB, queue.encode(), payload)
+            self._retry(lambda: _send_frame(self._sock, _OP_PUB,
+                                            queue.encode(), payload))
 
     def get(self, queue: str, timeout: float | None = None) -> bytes | None:
         ms = 0 if timeout is None else max(1, int(timeout * 1000))
-        with self._lock:
+
+        def once():
             _send_frame(self._sock, _OP_GET, queue.encode(),
                         struct.pack(">Q", ms))
             op, _, payload = _recv_frame(self._sock)
@@ -254,16 +404,375 @@ class TcpTransport(Transport):
                 raise ConnectionError(f"unexpected broker reply op {op!r}")
             return payload  # None on timeout
 
+        with self._lock:
+            # a reconnect mid-get re-issues the request: the original
+            # GET (and any reply in flight) died with the old socket
+            return self._retry(once)
+
     def purge(self, queues: Iterable[str] | None = None) -> None:
         payload = b"" if queues is None else ",".join(queues).encode()
         with self._lock:
-            _send_frame(self._sock, _OP_PURGE, b"", payload)
+            self._retry(lambda: _send_frame(self._sock, _OP_PURGE, b"",
+                                            payload))
 
     def close(self) -> None:
+        self._closed = True
         try:
             self._sock.close()
         except OSError:
             pass
+
+
+# --------------------------------------------------------------------------
+# at-least-once, in-order delivery
+# --------------------------------------------------------------------------
+# Envelope: RB1 | crc32(body) | body, with
+#   body(data) = 0x01 | 8B seq | 2B name-len | sender-token | payload
+#   body(ack)  = 0x02 | 8B seq | 2B name-len | queue-name
+# Data frames ride the application queue; acks ride ``__ack__.{token}``.
+# The envelope checksum is the first integrity line: a corrupt frame is
+# silently discarded (no ack), so the sender's redelivery repairs it.
+
+_ENV_MAGIC = b"RB1"
+_ENV_DATA, _ENV_ACK = 0x01, 0x02
+_ENV_HDR = len(_ENV_MAGIC) + 4
+
+
+def _env_frame(kind: int, seq: int, name: bytes, payload: bytes) -> bytes:
+    body = struct.pack(">BQH", kind, seq, len(name)) + name + payload
+    return _ENV_MAGIC + struct.pack(">I", zlib.crc32(body)) + body
+
+
+def _env_parse(raw: bytes):
+    """None = not an envelope; "corrupt" = failed integrity; else
+    ``(kind, name, seq, payload)``."""
+    if not raw.startswith(_ENV_MAGIC):
+        return None
+    if len(raw) < _ENV_HDR + 11:
+        return "corrupt"
+    (want,) = struct.unpack_from(">I", raw, len(_ENV_MAGIC))
+    body = raw[_ENV_HDR:]
+    if zlib.crc32(body) != want:
+        return "corrupt"
+    kind, seq, nlen = struct.unpack_from(">BQH", body, 0)
+    if kind not in (_ENV_DATA, _ENV_ACK) or len(body) < 11 + nlen:
+        return "corrupt"
+    name = body[11:11 + nlen].decode("utf-8", "replace")
+    return kind, name, seq, body[11 + nlen:]
+
+
+def _ack_queue(token: str) -> str:
+    return f"__ack__.{token}"
+
+
+class ReliableTransport(Transport):
+    """At-least-once, in-order delivery over any :class:`Transport`.
+
+    Sender side (queues matching ``patterns``): each payload is wrapped
+    in a sequence-numbered envelope, kept until acked, and redelivered
+    with capped exponential backoff up to ``max_redeliver`` times
+    (then counted ``gave_up`` and dropped — bounded redelivery, no
+    infinite queues).  Receiver side (any queue — envelopes are
+    self-describing): frames are acked on receipt, deduplicated on
+    ``(queue, sender_token, seq)`` and resequenced back into the
+    sender's publish order, so the layer above sees exactly-once
+    in-order bytes as long as the sender keeps redelivering.  A gap
+    whose frame was given up on is skipped after ``gap_timeout_s``
+    (counted ``lost``), trading completeness for liveness.
+
+    The sender token carries a per-instance nonce: a crashed-and-
+    restarted participant starts a fresh sequence space instead of
+    colliding with its predecessor's.
+
+    The redelivery/ack daemon uses ``side`` when given (a second
+    connection — required over :class:`TcpTransport`, whose blocking
+    ``get`` serializes the socket) and ``inner`` otherwise (fine for
+    :class:`InProcTransport`).  Non-matching queues pass through
+    untouched, so control and data planes can mix policies on one bus.
+
+    The default ``patterns`` come from ``TransportConfig.reliable_queues``
+    (single source of truth), so directly-constructed instances and
+    config-driven stacks can't silently diverge.
+    """
+
+    def __init__(self, inner: Transport, sender: str,
+                 patterns: Iterable[str] | None = None,
+                 side: Transport | None = None,
+                 redeliver_s: float = 0.3, max_redeliver: int = 20,
+                 gap_timeout_s: float | None = None, faults=None):
+        super().__init__()
+        self.inner = inner
+        self._side = side if side is not None else inner
+        self._own_side = side is not None
+        self.sender = sender
+        self.token = f"{sender}#{uuid.uuid4().hex[:8]}"
+        if patterns is None:
+            from split_learning_tpu.config import TransportConfig
+            patterns = TransportConfig().reliable_queues
+        self.patterns = tuple(patterns)
+        self._redeliver_s = redeliver_s
+        self._max_redeliver = max_redeliver
+        if gap_timeout_s is None:
+            # must exceed the sender's full retry horizon, whatever the
+            # configured attempt count: a gap skipped while the sender
+            # is still redelivering turns a late arrival into a
+            # permanent loss (the skip moved `expected` past it)
+            horizon = sum(min(redeliver_s * (1.5 ** k), 1.0)
+                          for k in range(1, max_redeliver + 1))
+            gap_timeout_s = horizon + 10.0
+        self._gap_timeout_s = gap_timeout_s
+        if faults is None:
+            from split_learning_tpu.runtime.trace import (
+                default_fault_counters,
+            )
+            faults = default_fault_counters
+        self.faults = faults
+        self._lock = threading.Lock()
+        self._seq: dict[str, int] = {}
+        # (queue, seq) -> [frame, next_due, attempts]
+        self._unacked: dict[tuple, list] = {}
+        # receive state, guarded by _lock (get may be called from one
+        # thread while purge/close run from another)
+        # _expected must NEVER be pruned for a token that might still
+        # send (forgetting the watermark would mis-hold its next frame
+        # behind a phantom 0..N gap and count phantom losses); it is one
+        # int per (queue, sender-incarnation) — the same order of growth
+        # as the broker's queue map itself.  _held IS pruned when empty
+        # so the per-get scans stay proportional to active gaps.
+        self._expected: dict[tuple, int] = {}
+        self._held: dict[tuple, dict] = {}
+        self._gap_since: dict[tuple, float] = {}
+        self._closed = threading.Event()
+        self._daemon = threading.Thread(target=self._daemon_loop,
+                                        daemon=True,
+                                        name=f"reliable-{sender}")
+        self._daemon.start()
+
+    # -- sender ------------------------------------------------------------
+
+    def _match(self, queue: str) -> bool:
+        return any(fnmatch.fnmatchcase(queue, p) for p in self.patterns)
+
+    def publish(self, queue: str, payload: bytes) -> None:
+        if not self._match(queue):
+            self.inner.publish(queue, payload)
+            return
+        with self._lock:
+            seq = self._seq.get(queue, 0)
+            self._seq[queue] = seq + 1
+            frame = _env_frame(_ENV_DATA, seq, self.token.encode(),
+                               payload)
+            self._unacked[(queue, seq)] = [
+                frame, time.monotonic() + self._redeliver_s, 0]
+        self.inner.publish(queue, frame)
+
+    def _daemon_loop(self) -> None:
+        """Consume acks; redeliver overdue unacked frames.
+
+        The daemon must outlive ANY single failure: it is the only
+        thread repairing losses, so an unexpected exception (a chaos
+        wrapper below it, a frame-cap ValueError, a decoding surprise)
+        is counted and survived — only shutdown and a closed bus end
+        the loop."""
+        ackq = _ack_queue(self.token)
+        while not self._closed.is_set():
+            try:
+                raw = self._side.get(ackq, timeout=0.05)
+            except QueueClosed:
+                return
+            except (ConnectionError, OSError):
+                if self._closed.is_set():
+                    return
+                time.sleep(0.2)
+                continue
+            except Exception:  # noqa: BLE001 — see docstring
+                self.faults.inc("daemon_errors")
+                time.sleep(0.2)
+                continue
+            if raw is not None:
+                parsed = _env_parse(raw)
+                if (isinstance(parsed, tuple)
+                        and parsed[0] == _ENV_ACK):
+                    _, queue, seq, _ = parsed
+                    with self._lock:
+                        self._unacked.pop((queue, seq), None)
+                continue   # drain the ack queue dry before redelivering
+            now = time.monotonic()
+            due = []
+            with self._lock:
+                for key, ent in list(self._unacked.items()):
+                    if ent[1] > now:
+                        continue
+                    ent[2] += 1
+                    if ent[2] > self._max_redeliver:
+                        del self._unacked[key]
+                        self.faults.inc("gave_up")
+                        continue
+                    # capped backoff: cheap early retries beat a long
+                    # horizon — under sustained loss p the give-up odds
+                    # are p^(attempts+1), so attempts are the lever
+                    ent[1] = now + min(
+                        self._redeliver_s * (1.5 ** ent[2]), 1.0)
+                    due.append((key[0], ent[0]))
+            for queue, frame in due:
+                try:
+                    self._side.publish(queue, frame)
+                    self.faults.inc("redeliveries")
+                except QueueClosed:
+                    return
+                except (ConnectionError, OSError):
+                    break   # broker down: next tick retries
+                except Exception:  # noqa: BLE001 — see docstring
+                    self.faults.inc("daemon_errors")
+                    break
+
+    # -- receiver ----------------------------------------------------------
+
+    def _send_ack(self, token: str, queue: str, seq: int) -> None:
+        try:
+            self.inner.publish(_ack_queue(token),
+                               _env_frame(_ENV_ACK, seq, queue.encode(),
+                                          b""))
+        except (QueueClosed, ConnectionError, OSError):
+            pass   # a lost ack only costs a redelivery + dedup hit
+
+    def _pop_ready(self, queue: str) -> bytes | None:
+        """Next in-order held frame for ``queue``, if any."""
+        with self._lock:
+            for (q, token), held in self._held.items():
+                if q != queue or not held:
+                    continue
+                exp = self._expected.get((q, token), 0)
+                if exp in held:
+                    payload = held.pop(exp)
+                    self._expected[(q, token)] = exp + 1
+                    if held:
+                        self._gap_since[(q, token)] = time.monotonic()
+                    else:
+                        del self._held[(q, token)]
+                        self._gap_since.pop((q, token), None)
+                    return payload
+        return None
+
+    def _skip_dead_gaps(self, queue: str) -> None:
+        """A gap older than gap_timeout_s means the sender gave up (or
+        died): jump past it rather than stalling the queue forever."""
+        now = time.monotonic()
+        with self._lock:
+            for (q, token), since in list(self._gap_since.items()):
+                if q != queue or now - since < self._gap_timeout_s:
+                    continue
+                held = self._held.get((q, token))
+                if not held:
+                    self._gap_since.pop((q, token), None)
+                    continue
+                exp = self._expected.get((q, token), 0)
+                nxt = min(held)
+                self.faults.inc("lost", nxt - exp)
+                self._expected[(q, token)] = nxt
+                self._gap_since[(q, token)] = now
+
+    def get(self, queue: str, timeout: float | None = None) -> bytes | None:
+        if not self._match(queue):
+            # pass-through queues keep the inner transport's REAL
+            # blocking wait (condition variable / socket) — slicing
+            # them into 0.1 s polls would reintroduce the reference's
+            # sleep-polling on every idle control-plane wait
+            return self.inner.get(queue, timeout)
+        deadline = (None if timeout is None
+                    else time.monotonic() + timeout)
+        while True:
+            ready = self._pop_ready(queue)
+            if ready is not None:
+                return ready
+            remain = (None if deadline is None
+                      else deadline - time.monotonic())
+            if remain is not None and remain <= 0:
+                return None
+            slice_t = 0.1 if remain is None else min(remain, 0.1)
+            raw = self.inner.get(queue, slice_t)
+            if raw is None:
+                self._skip_dead_gaps(queue)
+                continue
+            parsed = _env_parse(raw)
+            if parsed is None:
+                if self._match(queue):
+                    # every sender on a reliable queue envelopes its
+                    # frames, so an unparseable one here is corruption
+                    # that ate the envelope magic — drop it (no ack:
+                    # the sender's redelivery repairs it), don't hand
+                    # garbage (or a mis-ordered raw frame) to the app
+                    self.faults.inc("corrupt_rejected")
+                    continue
+                return raw            # unwrapped control queue
+            if parsed == "corrupt":
+                self.faults.inc("corrupt_rejected")
+                continue              # no ack -> sender redelivers
+            kind, token, seq, payload = parsed
+            if kind != _ENV_DATA:
+                continue              # stray ack on a data queue
+            self._send_ack(token, queue, seq)
+            key = (queue, token)
+            with self._lock:
+                exp = self._expected.get(key, 0)
+                if seq < exp or seq in self._held.get(key, {}):
+                    self.faults.inc("dedup_hits")
+                    continue
+                if seq == exp:
+                    self._expected[key] = exp + 1
+                    if self._held.get(key):
+                        self._gap_since[key] = time.monotonic()
+                    else:
+                        self._gap_since.pop(key, None)
+                    return payload
+                # future frame: hold for resequencing until the gap fills
+                self._held.setdefault(key, {})[seq] = payload
+                self._gap_since.setdefault(key, time.monotonic())
+                self.faults.inc("resequenced")
+
+    # -- plumbing ----------------------------------------------------------
+
+    def purge(self, queues: Iterable[str] | None = None) -> None:
+        self.inner.purge(queues)
+        with self._lock:
+            if queues is None:
+                self._unacked.clear()
+                self._held.clear()
+                self._expected.clear()
+                self._gap_since.clear()
+            else:
+                qs = set(queues)
+                for d in (self._held, self._expected, self._gap_since):
+                    for key in [k for k in d if k[0] in qs]:
+                        del d[key]
+                for key in [k for k in self._unacked if k[0] in qs]:
+                    del self._unacked[key]
+
+    def total_bytes_out(self) -> int:
+        return self.inner.total_bytes_out()
+
+    def bytes_out_snapshot(self) -> dict:
+        return self.inner.bytes_out_snapshot()
+
+    def stop(self, close_inner: bool = True) -> None:
+        """Shut the daemon down; ``close_inner=False`` detaches from a
+        SHARED underlying bus without closing it (crash simulation in
+        tests: the 'process' dies, the network does not)."""
+        self._closed.set()
+        self._daemon.join(timeout=5.0)
+        try:
+            # our ack queue dies with our token: leave no orphaned
+            # entries (and any unconsumed acks) in the broker store
+            self.inner.purge([_ack_queue(self.token)])
+        except (QueueClosed, ConnectionError, OSError):
+            pass
+        if close_inner:
+            self.inner.close()
+            if self._own_side:
+                self._side.close()
+
+    def close(self) -> None:
+        self.stop(close_inner=True)
 
 
 def make_transport(kind: str, host: str = "127.0.0.1",

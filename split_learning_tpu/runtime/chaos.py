@@ -1,0 +1,252 @@
+"""Deterministic fault injection for the message transports.
+
+The protocol stack is only as trustworthy as its worst recovery path,
+and recovery paths are exactly the code normal runs never execute.
+:class:`ChaosTransport` wraps any :class:`~split_learning_tpu.runtime
+.bus.Transport` and injects the full failure vocabulary of a real
+deployment — dropped, duplicated, reordered, delayed and bit-corrupted
+messages, plus scripted process crashes — **reproducibly**: every
+probabilistic decision is drawn from a per-queue RNG seeded by
+``(chaos.seed, queue_name)``, so a failing run replays from one integer
+regardless of thread scheduling (each fault roll consumes a fixed number
+of draws whether or not it fires, keeping the per-queue stream aligned).
+
+Faults are injected on the *publish* side, which models every channel
+failure the receiver can observe; the layers that must survive them are
+
+* ``runtime/protocol.py`` — checksummed frames reject corruption before
+  unpickling;
+* ``runtime/bus.py ReliableTransport`` — seq/ack/redeliver + dedup +
+  resequencing turns drops/dups/reordering back into an exact in-order
+  stream;
+* the protocol server/client — barrier deadlines, elastic drop and
+  crash-atomic checkpoints absorb scripted crashes.
+
+Stack order: ``ReliableTransport(ChaosTransport(bus))`` — chaos sits
+*below* reliability, exactly where the physical network does, so
+redelivered frames roll fresh faults too.
+
+Scripted crash points model "client c2 dies right after sending its 2nd
+stage-1 activation": when the owning participant's Nth publish to a
+matching queue completes, :class:`ChaosCrash` is raised out of
+``publish`` and the participant's process/thread unwinds.  The message
+itself IS sent first (the failure mode that matters — a crash before
+the send is indistinguishable from a drop).
+"""
+
+from __future__ import annotations
+
+import fnmatch
+import random
+import threading
+import zlib
+from typing import Iterable
+
+from split_learning_tpu.config import ChaosConfig, Config
+from split_learning_tpu.runtime.bus import (
+    QueueClosed, ReliableTransport, Transport, make_transport,
+)
+
+
+class ChaosCrash(RuntimeError):
+    """Scripted process death (chaos.crash) — raised out of publish()."""
+
+
+class ChaosTransport(Transport):
+    """Seeded fault-injecting wrapper over any transport.
+
+    ``name`` identifies the owning participant for crash scripts.  All
+    fault state (RNGs, reorder stash, crash counters) is per-instance:
+    give every simulated process its own wrapper over the shared bus.
+    """
+
+    def __init__(self, inner: Transport, cfg: ChaosConfig, name: str = "",
+                 faults=None, side: Transport | None = None):
+        super().__init__()
+        self.inner = inner
+        # delayed frames publish from Timer threads; over TCP they must
+        # not contend for the main socket's lock (a blocking get holds
+        # it indefinitely) — give them their own connection via ``side``
+        self._side = side if side is not None else inner
+        self.cfg = cfg
+        self.name = name
+        if faults is None:
+            from split_learning_tpu.runtime.trace import (
+                default_fault_counters,
+            )
+            faults = default_fault_counters
+        self.faults = faults
+        self._lock = threading.Lock()
+        self._rngs: dict[str, random.Random] = {}
+        self._stash: dict[str, bytes] = {}     # reorder slot per queue
+        # scripted crash points owned by this participant (copies: the
+        # publish counter lives in the spec under "_n")
+        self._crash = [dict(s) for s in cfg.crash
+                       if s.get("client") in ("*", name)]
+        self._timers: list[threading.Timer] = []
+
+    def _rng(self, queue: str) -> random.Random:
+        r = self._rngs.get(queue)
+        if r is None:
+            r = random.Random(zlib.crc32(
+                f"{self.cfg.seed}:{queue}".encode()))
+            self._rngs[queue] = r
+        return r
+
+    def _match(self, queue: str) -> bool:
+        return any(fnmatch.fnmatchcase(queue, p)
+                   for p in self.cfg.queues)
+
+    def _crash_due(self, queue: str) -> bool:
+        due = False
+        for spec in self._crash:
+            if fnmatch.fnmatchcase(queue, spec.get("queue", "*")):
+                spec["_n"] = spec.get("_n", 0) + 1
+                if spec["_n"] == int(spec.get("after", 1)):
+                    due = True
+        return due
+
+    def _late_publish(self, queue: str, payload: bytes) -> None:
+        try:
+            self._side.publish(queue, payload)
+        except (QueueClosed, ConnectionError, OSError):
+            pass   # the run ended before the delayed frame landed
+
+    def publish(self, queue: str, payload: bytes) -> None:
+        with self._lock:
+            # crash scripts fire on ANY queue (a process dies wherever
+            # the script says); probabilistic faults only on cfg.queues
+            crash = self._crash_due(queue)
+        if not self._match(queue):
+            self.inner.publish(queue, payload)
+            if crash:
+                self.faults.inc("crashes")
+                raise ChaosCrash(
+                    f"scripted crash: {self.name or '?'} dies at "
+                    f"publish to {queue}")
+            return
+        cfg = self.cfg
+        with self._lock:
+            r = self._rng(queue)
+            # fixed draw count per publish keeps the per-queue fault
+            # stream aligned whatever fires
+            drop = r.random() < cfg.drop
+            dup = r.random() < cfg.duplicate
+            reorder = r.random() < cfg.reorder
+            corrupt = r.random() < cfg.corrupt
+            delay = r.random() < cfg.delay
+            pos_f = r.random()
+
+            out = payload
+            if corrupt and payload:
+                i = int(pos_f * len(payload)) % len(payload)
+                out = payload[:i] + bytes([payload[i] ^ 0xFF]) \
+                    + payload[i + 1:]
+                self.faults.inc("corruptions")
+            sends = []
+            if drop:
+                self.faults.inc("drops")
+            else:
+                sends.append(out)
+                if dup:
+                    sends.append(out)
+                    self.faults.inc("duplicates")
+            # reorder: stash one frame; it rides out AFTER the next
+            # publish to the same queue (a classic 2-swap)
+            prior = self._stash.pop(queue, None)
+            emit = []
+            for s in sends:
+                if reorder and queue not in self._stash:
+                    self._stash[queue] = s
+                    self.faults.inc("reorders")
+                else:
+                    emit.append(s)
+            if prior is not None:
+                emit.append(prior)
+            if delay and cfg.delay_s > 0 and emit:
+                self.faults.inc("delays")
+                self._timers = [t for t in self._timers if t.is_alive()]
+                for s in emit:
+                    t = threading.Timer(cfg.delay_s, self._late_publish,
+                                        (queue, s))
+                    t.daemon = True
+                    self._timers.append(t)
+                    t.start()
+                emit = []
+        for s in emit:
+            self.inner.publish(queue, s)
+        if crash:
+            self.faults.inc("crashes")
+            raise ChaosCrash(
+                f"scripted crash: {self.name or '?'} dies at publish "
+                f"to {queue}")
+
+    def get(self, queue: str, timeout: float | None = None):
+        return self.inner.get(queue, timeout)
+
+    def purge(self, queues: Iterable[str] | None = None) -> None:
+        self.inner.purge(queues)
+        with self._lock:
+            if queues is None:
+                self._stash.clear()
+            else:
+                for q in queues:
+                    self._stash.pop(q, None)
+
+    def total_bytes_out(self) -> int:
+        return self.inner.total_bytes_out()
+
+    def bytes_out_snapshot(self) -> dict:
+        return self.inner.bytes_out_snapshot()
+
+    def stop(self, close_inner: bool = True) -> None:
+        with self._lock:
+            for t in self._timers:
+                t.cancel()
+            self._timers.clear()
+        if close_inner:
+            self.inner.close()
+            if self._side is not self.inner:
+                self._side.close()
+
+    def close(self) -> None:
+        self.stop(close_inner=True)
+
+
+def make_runtime_transport(cfg: Config, name: str,
+                           faults=None) -> Transport:
+    """Build one participant's full transport stack from config.
+
+    Over TCP the chaos delay timers and the reliable redelivery/ack
+    daemon each get their own broker connection (a blocked ``get``
+    serializes a TcpTransport's socket, so background publishers must
+    not share the main one).  The daemon's connection is itself
+    chaos-wrapped so redelivered frames roll fresh faults, keeping the
+    chaos-below-reliability layering identical across backends."""
+    tcp = cfg.transport.kind == "tcp"
+
+    def mk() -> Transport:
+        return make_transport(cfg.transport.kind, cfg.transport.host,
+                              cfg.transport.port)
+
+    bus = mk()
+    if cfg.chaos.enabled:
+        bus = ChaosTransport(bus, cfg.chaos, name=name, faults=faults,
+                             side=mk() if tcp else None)
+    if cfg.transport.reliable:
+        side = None
+        if tcp:
+            side = mk()
+            if cfg.chaos.enabled:
+                # probabilistic faults only: a scripted crash models the
+                # PROCESS dying, which the main-path wrapper already
+                # does — the repair daemon must not crash twice
+                import dataclasses
+                side = ChaosTransport(
+                    side, dataclasses.replace(cfg.chaos, crash=()),
+                    name=f"{name}.redeliver", faults=faults)
+        bus = ReliableTransport(
+            bus, sender=name, patterns=cfg.transport.reliable_queues,
+            side=side, redeliver_s=cfg.transport.redeliver_s,
+            max_redeliver=cfg.transport.max_redeliver, faults=faults)
+    return bus

@@ -8,16 +8,29 @@ round counter) is written with orbax; shard extraction is
 :func:`~split_learning_tpu.models.split.shard_params` pytree slicing —
 the dict-key matching the reference does by hand.
 
-Checkpoints are directories named ``{MODEL}_{DATASET}`` under the
-configured checkpoint root (the reference's ``{model}_{data}.pth``
-naming).  A msgpack fallback (flax.serialization) covers environments
-where orbax is unusable; load auto-detects the format.
+Checkpoints are named ``{MODEL}_{DATASET}`` under the configured
+checkpoint root (the reference's ``{model}_{data}.pth`` naming).  A
+msgpack fallback (flax.serialization) covers environments where orbax is
+unusable; load auto-detects the format.
+
+Crash atomicity: a save never touches the live checkpoint.  The tree is
+written to a hidden slot directory (``.{name}.data0``/``.data1``,
+alternating) and published by atomically replacing the ``{name}``
+symlink (``os.replace`` of a fresh symlink — one rename syscall).  A
+process killed at ANY point leaves either the previous complete
+checkpoint or the new complete checkpoint visible, never a torn one;
+:func:`load_checkpoint` additionally treats an unreadable/truncated
+checkpoint as absent (warn + ``None``) instead of raising, so a corrupt
+file can never wedge a restart.
 """
 
 from __future__ import annotations
 
 import json
+import os
 import pathlib
+import shutil
+import warnings
 from typing import Any
 
 import jax
@@ -39,6 +52,31 @@ def checkpoint_path(directory: str | pathlib.Path,
     return pathlib.Path(directory).resolve() / model_key
 
 
+def _write_tree(target: pathlib.Path, tree: Any) -> None:
+    if _HAVE_ORBAX:
+        ocp.PyTreeCheckpointer().save(target, tree, force=True)
+    else:  # pragma: no cover
+        import flax.serialization
+        target.mkdir(parents=True, exist_ok=True)
+        (target / "state.msgpack").write_bytes(
+            flax.serialization.to_bytes(tree))
+
+
+def _publish(path: pathlib.Path, slot_name: str) -> None:
+    """Atomically point the live ``path`` symlink at ``slot_name``."""
+    staged = path.parent / f".{path.name}.lnk"
+    try:
+        staged.unlink()
+    except FileNotFoundError:
+        pass
+    os.symlink(slot_name, staged)
+    if path.exists() and not path.is_symlink():
+        # legacy real-directory layout: one-time migration (the only
+        # non-atomic window this scheme ever has)
+        shutil.rmtree(path)
+    os.replace(staged, path)
+
+
 def save_checkpoint(directory: str | pathlib.Path, model_key: str,
                     params: Any, batch_stats: Any | None = None,
                     round_idx: int = 0, extra: dict | None = None) -> None:
@@ -47,42 +85,69 @@ def save_checkpoint(directory: str | pathlib.Path, model_key: str,
     tree = {"params": _to_host(params),
             "batch_stats": _to_host(batch_stats or {}),
             "meta": {"round_idx": np.int64(round_idx)}}
-    if _HAVE_ORBAX:
-        ckpt = ocp.PyTreeCheckpointer()
-        ckpt.save(path, tree, force=True)
-    else:  # pragma: no cover
-        import flax.serialization
-        path.mkdir(parents=True, exist_ok=True)
-        (path / "state.msgpack").write_bytes(
-            flax.serialization.to_bytes(tree))
+    # write into the slot NOT currently live, then flip the symlink —
+    # the previous checkpoint stays intact until the new one is complete
+    live = os.readlink(path) if path.is_symlink() else None
+    slot_name = (f".{model_key}.data1"
+                 if live == f".{model_key}.data0"
+                 else f".{model_key}.data0")
+    tmp = path.parent / f".{model_key}.tmp"
+    shutil.rmtree(tmp, ignore_errors=True)
+    _write_tree(tmp, tree)
+    final = path.parent / slot_name
+    shutil.rmtree(final, ignore_errors=True)
+    os.replace(tmp, final)
+    _publish(path, slot_name)
     if extra:
-        (path.parent / f"{model_key}.meta.json").write_text(
-            json.dumps(extra))
+        meta = path.parent / f"{model_key}.meta.json"
+        staged = path.parent / f".{model_key}.meta.json.tmp"
+        staged.write_text(json.dumps(extra))
+        os.replace(staged, meta)
 
 
 def load_checkpoint(directory: str | pathlib.Path,
                     model_key: str) -> dict | None:
-    """Returns {params, batch_stats, round_idx} or None if absent."""
+    """Returns {params, batch_stats, round_idx}, or None when the
+    checkpoint is absent OR unreadable (torn write from a hard crash,
+    bit rot): a corrupt checkpoint warns and is treated as no
+    checkpoint rather than wedging the restart."""
     path = checkpoint_path(directory, model_key)
-    if not path.exists():
+    if not path.exists():   # dangling symlink also reads as absent
         return None
-    if (path / "state.msgpack").exists():  # pragma: no cover
-        import flax.serialization
-        tree = flax.serialization.msgpack_restore(
-            (path / "state.msgpack").read_bytes())
-    elif _HAVE_ORBAX:
-        tree = ocp.PyTreeCheckpointer().restore(path)
-    else:  # pragma: no cover
+    try:
+        if (path / "state.msgpack").exists():  # pragma: no cover
+            import flax.serialization
+            tree = flax.serialization.msgpack_restore(
+                (path / "state.msgpack").read_bytes())
+        elif _HAVE_ORBAX:
+            tree = ocp.PyTreeCheckpointer().restore(path)
+        else:  # pragma: no cover
+            return None
+        return {"params": tree["params"],
+                "batch_stats": tree.get("batch_stats") or {},
+                "round_idx": int(tree["meta"]["round_idx"])}
+    except Exception as e:  # noqa: BLE001 — any torn/corrupt state
+        warnings.warn(
+            f"checkpoint at {path} is unreadable ({type(e).__name__}: "
+            f"{e}); ignoring it and starting fresh", RuntimeWarning,
+            stacklevel=2)
         return None
-    return {"params": tree["params"],
-            "batch_stats": tree.get("batch_stats") or {},
-            "round_idx": int(tree["meta"]["round_idx"])}
 
 
 def delete_checkpoint(directory: str | pathlib.Path,
                       model_key: str) -> None:
     """Reference's "delete the .pth to reset" (README.md:173-177)."""
-    import shutil
     path = checkpoint_path(directory, model_key)
-    if path.exists():
+    if path.is_symlink():
+        path.unlink()
+    elif path.exists():
         shutil.rmtree(path)
+    for p in path.parent.glob(f".{model_key}.*"):
+        # slot dirs, tmp dir, staged links
+        if p.is_dir() and not p.is_symlink():
+            shutil.rmtree(p, ignore_errors=True)
+        else:
+            try:
+                p.unlink()
+            except OSError:
+                pass

@@ -48,7 +48,7 @@ from split_learning_tpu.config import Config, LearningConfig, from_yaml
 from split_learning_tpu.data import make_data_loader, subset_seed
 from split_learning_tpu.models import build_model
 from split_learning_tpu.ops.lora import lora_init, lora_merge, split_frozen
-from split_learning_tpu.runtime.bus import Transport, make_transport
+from split_learning_tpu.runtime.bus import Transport
 from split_learning_tpu.runtime.log import Logger
 from split_learning_tpu.runtime.memo import bounded_setdefault
 from split_learning_tpu.runtime.protocol import (
@@ -336,8 +336,19 @@ class ProtocolClient:
         self.stage = stage
         self.cluster = cluster
         self.profile = profile
-        self.bus = transport or make_transport(
-            cfg.transport.kind, cfg.transport.host, cfg.transport.port)
+        if transport is None:
+            # configured stack: base bus -> chaos injection -> reliable
+            # delivery (tests pass a pre-built transport instead)
+            from split_learning_tpu.runtime.chaos import (
+                make_runtime_transport,
+            )
+            transport = make_runtime_transport(cfg, client_id)
+        self.bus = transport
+        from split_learning_tpu.runtime.trace import (
+            default_fault_counters,
+        )
+        self.faults = getattr(self.bus, "faults", None) \
+            or default_fault_counters
         self.log = logger or Logger(cfg.log_path, debug=cfg.debug,
                                     console=False, name=client_id)
         self.runner: ShardRunner | None = None
@@ -356,6 +367,20 @@ class ProtocolClient:
         self.wire_dtype = _wire_np_dtype(cfg.transport.wire_dtype)
 
     # -- control plane -----------------------------------------------------
+
+    def _decode(self, raw: bytes):
+        """Tolerant decode: a frame that fails the checksum (or ANY
+        guard inside decode — a crafted pickle can raise arbitrary
+        exceptions from numpy reconstruction) is dropped and counted,
+        never fatal: a flipped bit on the wire must cost one message
+        (which the reliable layer redelivers), not the process.  Same
+        breadth as the server's rpc pump."""
+        try:
+            return decode(raw)
+        except Exception as e:  # noqa: BLE001 — see docstring
+            self.faults.inc("corrupt_rejected")
+            self.log.warning(f"dropping undecodable frame: {e}")
+            return None
 
     def register(self):
         self.bus.publish(RPC_QUEUE, encode(Register(
@@ -396,7 +421,9 @@ class ProtocolClient:
                 if not started:
                     self.register()
                 continue
-            msg = decode(raw)
+            msg = self._decode(raw)
+            if msg is None:
+                continue
             if isinstance(msg, Start):
                 started = True
                 self._on_start(msg)
@@ -564,6 +591,17 @@ class ProtocolClient:
         self.log.info(f"[>>>] UPDATE samples={self.num_samples} "
                       f"ok={self.round_ok}"
                       + ("" if with_weights else " (no weights)"))
+        # failure/recovery counters live per PROCESS: in a multi-process
+        # deployment the server can only report its own, so each client
+        # surfaces its cumulative stack counters into (its) metrics.jsonl
+        # at round end — same diff-successive-records contract
+        snap = {k: v for k, v in self.faults.snapshot().items() if v}
+        if snap and snap != getattr(self, "_fault_base", None):
+            self._fault_base = snap
+            self.log.info("round faults (cumulative): " + " ".join(
+                f"{k}={v}" for k, v in sorted(snap.items())))
+            self.log.metric(kind="faults", client=self.client_id,
+                            round_idx=self.round_idx, **snap)
 
     def _redeliver_stop(self, msg: Stop) -> Pause:
         """A STOP arriving mid-training: requeue it for the run() loop and
@@ -589,7 +627,9 @@ class ProtocolClient:
             raw = self.bus.get(q)
             if raw is None:
                 continue
-            msg = decode(raw)
+            msg = self._decode(raw)
+            if msg is None:
+                continue
             if isinstance(msg, Pause):
                 self.log.info("[<<<] PAUSE")
                 return msg
@@ -605,7 +645,9 @@ class ProtocolClient:
         raw = self.bus.get(reply_queue(self.client_id), timeout=0.001)
         if raw is None:
             return None
-        msg = decode(raw)
+        msg = self._decode(raw)
+        if msg is None:
+            return None
         if isinstance(msg, Pause):
             return msg
         if isinstance(msg, Stop):
@@ -669,9 +711,9 @@ class ProtocolClient:
             while not (exhausted and n_fwd == n_bwd):
                 raw = self.bus.get(grad_q, timeout=0.0005)
                 if raw is not None:
-                    g = decode(raw)
-                    if g.round_idx != self.fence:
-                        continue   # gradient from a dropped round
+                    g = self._decode(raw)
+                    if g is None or g.round_idx != self.fence:
+                        continue   # corrupt, or from a dropped round
                     ent = inflight.pop(g.data_id, None)
                     if ent is None:   # no longer tracked (cut round)
                         continue
@@ -765,9 +807,9 @@ class ProtocolClient:
                 return pause
             raw = self.bus.get(grad_q, timeout=0.0005)
             if raw is not None:
-                g = decode(raw)
-                if g.round_idx != self.fence:
-                    continue   # gradient from a dropped round
+                g = self._decode(raw)
+                if g is None or g.round_idx != self.fence:
+                    continue   # corrupt, or from a dropped round
                 ent = inflight.pop(g.data_id, None)
                 if ent is None:   # no longer tracked (cut round)
                     continue
@@ -789,9 +831,9 @@ class ProtocolClient:
             raw = self.bus.get(in_q, timeout=0.0005)
             if raw is None:
                 continue
-            act = decode(raw)
-            if act.round_idx != self.fence:
-                continue   # activation from a dropped round: discard
+            act = self._decode(raw)
+            if act is None or act.round_idx != self.fence:
+                continue   # corrupt, or from a dropped round: discard
             if isinstance(act, EpochEnd):
                 key = (act.client_id, act.epoch)
                 fence_copies[key] = fence_copies.get(key, 0) + 1
@@ -867,7 +909,12 @@ class ProtocolClient:
             return [o for o, q in pending.items() if q]
 
         def pop_window(require_full: bool) -> list[Activation] | None:
-            origins = live()
+            # sorted, NOT arrival order: the window's concat order feeds
+            # the jitted step, and a deterministic order is what lets a
+            # chaos run's aggregated params match the fault-free run
+            # bit-for-bit (tests/test_chaos.py) — arrival order is
+            # thread-scheduling noise even without faults
+            origins = sorted(live())
             if not origins or (require_full and len(origins) < target):
                 return None
             return [pending[o].pop(0)
@@ -920,9 +967,9 @@ class ProtocolClient:
                         target = max(1, len(w))
                         self._sda_step(w)
                 continue
-            act = decode(raw)
-            if act.round_idx != self.fence:
-                continue   # message from a dropped round: discard
+            act = self._decode(raw)
+            if act is None or act.round_idx != self.fence:
+                continue   # corrupt, or from a dropped round: discard
             if isinstance(act, EpochEnd):
                 key = (act.client_id, act.epoch)
                 fence_copies[key] = fence_copies.get(key, 0) + 1

@@ -24,6 +24,8 @@ from __future__ import annotations
 import dataclasses
 import io
 import pickle
+import struct
+import zlib
 from typing import Any
 
 import numpy as np
@@ -223,6 +225,23 @@ _WIRE_HELPERS = {"QuantLeaf": QuantLeaf}
 # Arrays are framed out-of-band (np.save) and the remainder pickled; a
 # restricted unpickler only admits protocol dataclasses + builtins, unlike
 # the reference's bare pickle.loads of broker bytes (SURVEY.md §1 L0).
+#
+# Every frame is checksummed: ``MAGIC | crc32(body) | body``.  A corrupt
+# or truncated frame raises :class:`CorruptFrame` BEFORE any unpickling —
+# bit-rot on the wire (or an injected chaos fault) must never reach the
+# unpickler, whose failure modes on garbage are arbitrary exceptions deep
+# inside numpy reconstruction.
+
+FRAME_MAGIC = b"SLT1"
+_HDR_LEN = len(FRAME_MAGIC) + 4
+
+
+class CorruptFrame(pickle.UnpicklingError):
+    """Frame failed the integrity check (bad magic / length / checksum).
+
+    Subclasses UnpicklingError so callers guarding decode() with the
+    pre-checksum except clause keep working."""
+
 
 class _SafeUnpickler(pickle.Unpickler):
     _ALLOWED = {
@@ -254,11 +273,20 @@ class _SafeUnpickler(pickle.Unpickler):
 def encode(msg) -> bytes:
     if type(msg).__name__ not in _TYPE_BY_NAME:
         raise TypeError(f"not a protocol message: {type(msg)!r}")
-    return pickle.dumps(msg, protocol=pickle.HIGHEST_PROTOCOL)
+    body = pickle.dumps(msg, protocol=pickle.HIGHEST_PROTOCOL)
+    return FRAME_MAGIC + struct.pack(">I", zlib.crc32(body)) + body
 
 
 def decode(raw: bytes):
-    msg = _SafeUnpickler(io.BytesIO(raw)).load()
+    if len(raw) < _HDR_LEN or raw[:len(FRAME_MAGIC)] != FRAME_MAGIC:
+        raise CorruptFrame(
+            f"protocol frame missing magic/header ({len(raw)} bytes)")
+    (want,) = struct.unpack_from(">I", raw, len(FRAME_MAGIC))
+    body = raw[_HDR_LEN:]
+    if zlib.crc32(body) != want:
+        raise CorruptFrame("protocol frame checksum mismatch "
+                           f"({len(raw)} bytes)")
+    msg = _SafeUnpickler(io.BytesIO(body)).load()
     # wire helpers (QuantLeaf) are only valid NESTED in a payload — a
     # bare one must fail here, not as an AttributeError in a hot loop
     if not isinstance(msg, CONTROL_TYPES + DATA_TYPES):

@@ -36,9 +36,7 @@ import numpy as np
 from split_learning_tpu.config import Config, from_yaml
 from split_learning_tpu.models import shard_params
 from split_learning_tpu.parallel.mesh import stage_ranges
-from split_learning_tpu.runtime.bus import (
-    Broker, Transport, make_transport,
-)
+from split_learning_tpu.runtime.bus import Broker, Transport
 from split_learning_tpu.runtime.context import MeshContext
 from split_learning_tpu.runtime.log import Logger
 from split_learning_tpu.runtime.loop import TrainResult, run_training
@@ -85,6 +83,12 @@ class ProtocolContext(MeshContext):
                 ".run); the multi-process protocol deployment does not "
                 "shard client models yet")
         self.bus = transport
+        from split_learning_tpu.runtime.trace import (
+            default_fault_counters,
+        )
+        self.faults = getattr(transport, "faults", None) \
+            or default_fault_counters
+        self._fault_base: dict = {}   # snapshot at the last round log
         self.log = logger or Logger(cfg.log_path, debug=cfg.debug,
                                     console=False, name="server")
         self.client_timeout = client_timeout
@@ -124,7 +128,13 @@ class ProtocolContext(MeshContext):
         raw = self.bus.get(RPC_QUEUE, timeout=timeout)
         if raw is None:
             return False
-        msg = decode(raw)
+        try:
+            msg = decode(raw)
+        except Exception as e:  # noqa: BLE001 — corrupt frame: a flipped
+            # bit on rpc_queue must cost one message, not the server
+            self.faults.inc("corrupt_rejected")
+            self.log.warning(f"dropping undecodable rpc frame: {e}")
+            return True
         if isinstance(msg, Register):
             if (self.cfg.topology.elastic_join
                     and not 1 <= msg.stage <= self.cfg.num_stages):
@@ -191,6 +201,7 @@ class ProtocolContext(MeshContext):
             remain = deadline - time.monotonic()
             if remain <= 0:
                 w = what() if callable(what) else what
+                self.faults.inc("timeouts")
                 self.log.warning(f"timeout waiting for {w}")
                 return False
             self._pump_one(timeout=min(remain, 0.25))
@@ -575,6 +586,24 @@ class ProtocolContext(MeshContext):
                         cumulative_reply_bytes=totals["reply"],
                         cumulative_rpc_bytes=totals["rpc"],
                         cumulative_data_bytes=totals["data"])
+        # failure/recovery observability: CUMULATIVE fault counters
+        # (drops, timeouts, redeliveries, dedup_hits, reconnects, ...)
+        # from this process's transport stack — chaos runs must be
+        # auditable, not silently self-healing.  Same diff-successive-
+        # records contract as the wire bytes above.  Logged only when
+        # something actually happened, so clean runs stay clean.
+        snap = {k: v for k, v in self.faults.snapshot().items() if v}
+        if snap:
+            if snap != self._fault_base:
+                self.log.info(
+                    "round faults (cumulative): "
+                    + " ".join(f"{k}={v}"
+                               for k, v in sorted(snap.items())),
+                    "yellow")
+                self._fault_base = snap
+            self.log.metric(kind="faults", gen=self._cur_gen,
+                            round_idx=round_idx,
+                            cluster=plan.cluster_id, **snap)
         return updates
 
     def stop_all(self, reason: str = "training complete"):
@@ -599,8 +628,12 @@ class ProtocolServer:
         self.cfg = cfg
         self.log = logger or Logger(cfg.log_path, debug=cfg.debug,
                                     name="server")
-        bus = transport or make_transport(
-            cfg.transport.kind, cfg.transport.host, cfg.transport.port)
+        if transport is None:
+            from split_learning_tpu.runtime.chaos import (
+                make_runtime_transport,
+            )
+            transport = make_runtime_transport(cfg, "server")
+        bus = transport
         bus.purge()   # queue hygiene at startup (src/Utils.py:8-32)
         self.ctx = ProtocolContext(cfg, bus, logger=self.log,
                                    client_timeout=client_timeout,

@@ -55,6 +55,11 @@ def aggregate_cluster(updates: Sequence[Update]) -> tuple[Any, Any, int]:
     stats: dict = {}
     n_samples = 0
     for stage, ups in sorted(by_stage.items()):
+        # client-id order, not arrival order: float summation order must
+        # not depend on which UPDATE won a thread race, or two identical
+        # rounds (e.g. a chaos run vs its fault-free twin) diverge in
+        # the last bits
+        ups = sorted(ups, key=lambda u: u.client_id)
         weights = [max(1, u.num_samples) for u in ups]
         params.update(fedavg_trees([u.params for u in ups], weights))
         st = [u.batch_stats for u in ups if u.batch_stats]

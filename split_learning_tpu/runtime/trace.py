@@ -10,16 +10,52 @@ and a one-shot message-size probe (SURVEY.md §5.1); its in-message
 * :func:`trace` — context manager around ``jax.profiler`` writing a
   TensorBoard-loadable XLA trace;
 * :func:`annotate` — ``TraceAnnotation`` wrapper so host-side round
-  phases (plan/train/aggregate/validate) show up on the trace timeline.
+  phases (plan/train/aggregate/validate) show up on the trace timeline;
+* :class:`FaultCounters` — thread-safe failure/recovery counters
+  (``drops``, ``timeouts``, ``redeliveries``, ``dedup_hits``,
+  ``reconnects``, ...) shared by the transport stack
+  (``runtime/bus.py`` reliability layer, ``runtime/chaos.py`` fault
+  injection, TCP reconnect) and surfaced by the protocol server into
+  ``metrics.jsonl`` and its end-of-round log line, so chaos runs are
+  observable instead of silently self-healing.
 """
 
 from __future__ import annotations
 
 import collections
 import contextlib
+import threading
 import time
 
 import jax
+
+
+class FaultCounters:
+    """Monotonic named counters; values never reset during a run, so
+    consumers diff successive snapshots (same contract as the server's
+    cumulative wire-byte metrics)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counts: collections.Counter = collections.Counter()
+
+    def inc(self, name: str, n: int = 1) -> None:
+        with self._lock:
+            self._counts[name] += n
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return dict(self._counts)
+
+    def total(self) -> int:
+        with self._lock:
+            return sum(self._counts.values())
+
+
+#: process-wide default registry: every transport wrapper created without
+#: an explicit ``faults=`` lands here, so one process's server sees its
+#: clients' counters too in single-process (inproc) deployments
+default_fault_counters = FaultCounters()
 
 
 class StepTimer:

@@ -1,0 +1,643 @@
+"""Chaos-grade runtime: deterministic fault injection + the hardening
+it flushes out.
+
+Transport-level checks (fast, tier-1): the reliable-delivery layer must
+turn a channel with drops/duplicates/reordering/corruption/delay back
+into the exact sent byte stream; the TCP bus must survive a broker
+restart; checkpoints must be crash-atomic; the protocol codec must
+reject corrupt frames before unpickling.
+
+Full-round soaks (``slow``): a real multi-client split-learning round
+under each fault class must aggregate params BIT-IDENTICAL to the
+fault-free run, and a scripted mid-round client crash must degrade via
+elastic drop and resume from a crash-atomic checkpoint.
+"""
+
+import os
+import threading
+import warnings
+
+import numpy as np
+import pytest
+
+from split_learning_tpu.config import ChaosConfig, from_dict
+from split_learning_tpu.runtime.bus import (
+    Broker, InProcTransport, ReliableTransport, TcpTransport,
+)
+from split_learning_tpu.runtime.chaos import ChaosCrash, ChaosTransport
+from split_learning_tpu.runtime.trace import FaultCounters
+
+pytestmark = pytest.mark.chaos
+
+TINY_KWT = {"embed_dim": 16, "num_heads": 2, "mlp_dim": 32}
+
+DATA_Q = "intermediate_queue_0_0"
+
+
+def _chaos(seed=7, **over):
+    base = dict(enabled=True, seed=seed, queues=("intermediate_queue*",
+                                                 "gradient_queue*"))
+    base.update(over)
+    return ChaosConfig(**base)
+
+
+def _pump(sender, msgs, queue=DATA_Q):
+    t = threading.Thread(
+        target=lambda: [sender.publish(queue, m) for m in msgs],
+        daemon=True)
+    t.start()
+    return t
+
+
+# --------------------------------------------------------------------------
+# protocol codec rejection paths (_SafeUnpickler + frame checksum)
+# --------------------------------------------------------------------------
+
+class TestCodecRejection:
+    def _frame(self, body: bytes) -> bytes:
+        import struct
+        import zlib
+
+        from split_learning_tpu.runtime.protocol import FRAME_MAGIC
+        return FRAME_MAGIC + struct.pack(">I", zlib.crc32(body)) + body
+
+    def test_checksum_mismatch_rejected_before_unpickling(self):
+        from split_learning_tpu.runtime.protocol import (
+            CorruptFrame, Ready, decode, encode,
+        )
+        raw = encode(Ready(client_id="c1", round_idx=3))
+        assert decode(raw).client_id == "c1"   # happy path still pinned
+        for i in (0, 5, len(raw) // 2, len(raw) - 1):
+            bad = raw[:i] + bytes([raw[i] ^ 0xFF]) + raw[i + 1:]
+            with pytest.raises(CorruptFrame):
+                decode(bad)
+
+    def test_truncated_frame_rejected(self):
+        from split_learning_tpu.runtime.protocol import (
+            CorruptFrame, Ready, decode, encode,
+        )
+        raw = encode(Ready(client_id="c1"))
+        for n in (0, 3, 7, len(raw) - 4):
+            with pytest.raises(CorruptFrame):
+                decode(raw[:n])
+
+    def test_disallowed_class_rejected(self):
+        import pickle
+
+        from split_learning_tpu.runtime.protocol import decode
+
+        # a correctly-checksummed frame smuggling a non-protocol class
+        # must still die in the restricted unpickler
+        body = pickle.dumps(os.system)
+        with pytest.raises(pickle.UnpicklingError, match="disallowed"):
+            decode(self._frame(body))
+
+    def test_bare_wire_helper_rejected_as_top_level(self):
+        import pickle
+
+        from split_learning_tpu.runtime.protocol import QuantLeaf, decode
+        body = pickle.dumps(QuantLeaf(q=np.zeros(2, np.int8), scale=1.0))
+        with pytest.raises(pickle.UnpicklingError,
+                           match="not a protocol message"):
+            decode(self._frame(body))
+
+
+# --------------------------------------------------------------------------
+# chaos transport: seeded determinism + crash scripts
+# --------------------------------------------------------------------------
+
+class TestChaosTransport:
+    def _run(self, seed, n=40):
+        bus = InProcTransport()
+        fc = FaultCounters()
+        tx = ChaosTransport(bus, _chaos(seed=seed, drop=0.2,
+                                        duplicate=0.2, reorder=0.2),
+                            name="s", faults=fc)
+        for i in range(n):
+            tx.publish(DATA_Q, b"m%03d" % i)
+        got = []
+        while True:
+            m = bus.get(DATA_Q, timeout=0.05)
+            if m is None:
+                break
+            got.append(m)
+        return got, fc.snapshot()
+
+    def test_fault_pattern_reproducible_from_seed(self):
+        a, ca = self._run(seed=3)
+        b, cb = self._run(seed=3)
+        assert a == b
+        assert ca == cb
+        c, _ = self._run(seed=4)
+        assert a != c, "different seed produced the same fault pattern"
+        # faults actually fired
+        assert ca["drops"] > 0 and ca["duplicates"] > 0
+        assert ca["reorders"] > 0
+
+    def test_corruption_flips_exactly_one_byte(self):
+        bus = InProcTransport()
+        tx = ChaosTransport(bus, _chaos(corrupt=0.5), name="s",
+                            faults=FaultCounters())
+        sent = [b"x" * 64 for _ in range(30)]
+        for m in sent:
+            tx.publish(DATA_Q, m)
+        flipped = clean = 0
+        while True:
+            m = bus.get(DATA_Q, timeout=0.05)
+            if m is None:
+                break
+            diff = sum(a != b for a, b in zip(m, b"x" * 64))
+            assert diff in (0, 1)
+            flipped += diff == 1
+            clean += diff == 0
+        assert flipped and clean
+
+    def test_scripted_crash_point(self):
+        bus = InProcTransport()
+        spec = {"client": "c1", "queue": "intermediate_queue*",
+                "after": 3}
+        tx = ChaosTransport(bus, _chaos(crash=(spec,)), name="c1",
+                            faults=FaultCounters())
+        other = ChaosTransport(bus, _chaos(crash=(spec,)), name="c2",
+                               faults=FaultCounters())
+        for i in range(5):   # a different client never crashes
+            other.publish(DATA_Q, b"ok")
+        tx.publish(DATA_Q, b"one")
+        tx.publish("reply_c1", b"ctrl")   # non-matching queue: no count
+        tx.publish(DATA_Q, b"two")
+        with pytest.raises(ChaosCrash):
+            tx.publish(DATA_Q, b"three")
+        # the fatal message IS sent before the crash (a crash before
+        # the send is indistinguishable from a drop)
+        seen = []
+        while True:
+            m = bus.get(DATA_Q, timeout=0.05)
+            if m is None:
+                break
+            seen.append(m)
+        assert b"three" in seen
+
+
+# --------------------------------------------------------------------------
+# reliable delivery: at-least-once + dedup + resequencing
+# --------------------------------------------------------------------------
+
+class TestReliableDelivery:
+    @pytest.mark.parametrize("seed", [7, 11, 23])
+    def test_exact_stream_under_all_fault_classes(self, seed):
+        bus = InProcTransport()
+        fc = FaultCounters()
+        chaos = ChaosTransport(bus, _chaos(
+            seed=seed, drop=0.2, duplicate=0.2, reorder=0.2,
+            corrupt=0.1, delay=0.1, delay_s=0.01), name="s", faults=fc)
+        sender = ReliableTransport(chaos, sender="s",
+                                   patterns=("intermediate_queue*",),
+                                   redeliver_s=0.05, faults=fc)
+        recv = ReliableTransport(bus, sender="r",
+                                 patterns=("intermediate_queue*",),
+                                 redeliver_s=0.05, faults=fc)
+        msgs = [b"payload-%03d" % i for i in range(80)]
+        t = _pump(sender, msgs)
+        got = [recv.get(DATA_Q, timeout=10.0) for _ in msgs]
+        t.join()
+        assert got == msgs, "stream not exact/in-order under faults"
+        assert recv.get(DATA_Q, timeout=0.3) is None, "phantom message"
+        snap = fc.snapshot()
+        assert snap["drops"] and snap["redeliveries"]
+        assert snap["duplicates"] and snap["dedup_hits"]
+        sender.stop(close_inner=False)
+        recv.stop(close_inner=False)
+
+    def test_unmatched_queues_pass_through_raw(self):
+        bus = InProcTransport()
+        sender = ReliableTransport(bus, sender="s",
+                                   patterns=("intermediate_queue*",))
+        recv = ReliableTransport(bus, sender="r",
+                                 patterns=("intermediate_queue*",))
+        sender.publish("reply_c1", b"ctrl")
+        assert recv.get("reply_c1", timeout=1.0) == b"ctrl"
+        assert bus.bytes_out["reply_c1"] == len(b"ctrl"), \
+            "control frame grew an envelope"
+        sender.stop(close_inner=False)
+        recv.stop(close_inner=False)
+
+    def test_bounded_redelivery_gives_up(self):
+        import time
+        bus = InProcTransport()
+        fc = FaultCounters()
+        # drop EVERYTHING the sender publishes: acks can never come back
+        sink = ChaosTransport(bus, _chaos(drop=1.0), name="s",
+                              faults=fc)
+        sender = ReliableTransport(sink, sender="s",
+                                   patterns=("intermediate_queue*",),
+                                   redeliver_s=0.02, max_redeliver=3,
+                                   faults=fc)
+        sender.publish(DATA_Q, b"doomed")
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline and not sender.faults.snapshot(
+                ).get("gave_up"):
+            time.sleep(0.02)
+        assert fc.snapshot().get("gave_up") == 1
+        assert not sender._unacked, "gave-up frame still buffered"
+        sender.stop(close_inner=False)
+
+
+# --------------------------------------------------------------------------
+# tcp bus: reconnect + broker restart
+# --------------------------------------------------------------------------
+
+class TestTcpRecovery:
+    def test_reconnect_after_broker_restart(self):
+        import time
+        fc = FaultCounters()
+        b = Broker("127.0.0.1", 0)
+        port = b.port
+        tx = TcpTransport("127.0.0.1", port, faults=fc)
+        rx = TcpTransport("127.0.0.1", port, faults=fc)
+        try:
+            tx.publish("q", b"one")
+            assert rx.get("q", timeout=2.0) == b"one"
+            b.close()
+            b = Broker("127.0.0.1", port)
+            # plain transport is at-most-once: in-flight frames around
+            # the restart may drop, but the NEXT ops must reconnect and
+            # work instead of killing the process
+            got, deadline = None, time.monotonic() + 30
+            while got is None and time.monotonic() < deadline:
+                tx.publish("q", b"two")
+                got = rx.get("q", timeout=1.0)
+            assert got == b"two"
+            assert fc.snapshot().get("reconnects", 0) >= 1
+        finally:
+            tx.close()
+            rx.close()
+            b.close()
+
+    def test_reliable_over_tcp_exact_across_broker_restart(self):
+        import time
+        fc = FaultCounters()
+        b = Broker("127.0.0.1", 0)
+        port = b.port
+
+        def mk():
+            return TcpTransport("127.0.0.1", port,
+                                reconnect_timeout=30.0, faults=fc)
+
+        sender = ReliableTransport(mk(), sender="s", patterns=("data*",),
+                                   side=mk(), redeliver_s=0.1, faults=fc)
+        recv = ReliableTransport(mk(), sender="r", patterns=("data*",),
+                                 side=mk(), redeliver_s=0.1, faults=fc)
+        try:
+            msgs = [b"m%02d" % i for i in range(12)]
+
+            def send():
+                for m in msgs:
+                    sender.publish("data_q", m)
+                    time.sleep(0.05)
+
+            t = threading.Thread(target=send, daemon=True)
+            t.start()
+            got = []
+            for i in range(len(msgs)):
+                if i == 4:
+                    # the broker dies MID-STREAM, losing whatever it
+                    # held; the reliable layer redelivers into the
+                    # restarted one
+                    b.close()
+                    b = Broker("127.0.0.1", port)
+                m = recv.get("data_q", timeout=30.0)
+                assert m is not None, f"stream stalled at {i}"
+                got.append(m)
+            t.join()
+            assert got == msgs, "loss or reorder across broker restart"
+            assert fc.snapshot().get("reconnects", 0) >= 1
+        finally:
+            sender.close()
+            recv.close()
+            b.close()
+
+
+# --------------------------------------------------------------------------
+# crash-atomic checkpoints
+# --------------------------------------------------------------------------
+
+class TestCheckpointAtomicity:
+    def _params(self, v=0.0):
+        return {"layer1": {"w": np.full((2, 3), v, np.float32)}}
+
+    def test_save_is_symlink_flip_and_keeps_previous_slot(self, tmp_path):
+        from split_learning_tpu.runtime import checkpoint as ck
+        ck.save_checkpoint(tmp_path, "M_D", self._params(1.0),
+                           round_idx=1)
+        path = ck.checkpoint_path(tmp_path, "M_D")
+        assert path.is_symlink()
+        first_slot = os.readlink(path)
+        ck.save_checkpoint(tmp_path, "M_D", self._params(2.0),
+                           round_idx=2)
+        assert os.readlink(path) != first_slot, "slot did not alternate"
+        # the PREVIOUS complete checkpoint survives the new save: a
+        # crash mid-save can never destroy the last good state
+        assert (path.parent / first_slot).exists()
+        out = ck.load_checkpoint(tmp_path, "M_D")
+        assert out["round_idx"] == 2
+        np.testing.assert_array_equal(out["params"]["layer1"]["w"],
+                                      self._params(2.0)["layer1"]["w"])
+
+    def test_torn_write_warns_and_returns_none(self, tmp_path):
+        from split_learning_tpu.runtime import checkpoint as ck
+        ck.save_checkpoint(tmp_path, "M_D", self._params(), round_idx=5)
+        path = ck.checkpoint_path(tmp_path, "M_D")
+        target = path.parent / os.readlink(path)
+        # tear every file in the live slot (hard power-cut simulation)
+        for f in sorted(target.rglob("*")):
+            if f.is_file():
+                data = f.read_bytes()
+                f.write_bytes(data[: max(1, len(data) // 2)]
+                              if len(data) > 1 else b"\x00")
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            assert ck.load_checkpoint(tmp_path, "M_D") is None
+        assert any("unreadable" in str(x.message) for x in w)
+        # and a fresh save repairs the checkpoint in place
+        ck.save_checkpoint(tmp_path, "M_D", self._params(3.0),
+                           round_idx=6)
+        assert ck.load_checkpoint(tmp_path, "M_D")["round_idx"] == 6
+
+    def test_torn_msgpack_fallback(self, tmp_path, monkeypatch):
+        from split_learning_tpu.runtime import checkpoint as ck
+        monkeypatch.setattr(ck, "_HAVE_ORBAX", False)
+        ck.save_checkpoint(tmp_path, "M_D", self._params(), round_idx=1)
+        path = ck.checkpoint_path(tmp_path, "M_D")
+        f = path / "state.msgpack"
+        assert f.exists()
+        f.write_bytes(f.read_bytes()[: f.stat().st_size // 3])
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            assert ck.load_checkpoint(tmp_path, "M_D") is None
+        assert any("unreadable" in str(x.message) for x in w)
+
+    def test_legacy_real_directory_layout_migrates(self, tmp_path):
+        from split_learning_tpu.runtime import checkpoint as ck
+        legacy = ck.checkpoint_path(tmp_path, "M_D")
+        legacy.mkdir(parents=True)
+        (legacy / "stale").write_text("old format")
+        ck.save_checkpoint(tmp_path, "M_D", self._params(4.0),
+                           round_idx=9)
+        assert legacy.is_symlink()
+        assert ck.load_checkpoint(tmp_path, "M_D")["round_idx"] == 9
+
+    def test_delete_cleans_slots(self, tmp_path):
+        from split_learning_tpu.runtime import checkpoint as ck
+        ck.save_checkpoint(tmp_path, "M_D", self._params(), round_idx=1)
+        ck.save_checkpoint(tmp_path, "M_D", self._params(), round_idx=2)
+        ck.delete_checkpoint(tmp_path, "M_D")
+        assert ck.load_checkpoint(tmp_path, "M_D") is None
+        assert not list(tmp_path.glob(".M_D.*"))
+        # idempotent on an absent checkpoint
+        ck.delete_checkpoint(tmp_path, "M_D")
+
+
+# --------------------------------------------------------------------------
+# full-round soaks (slow): faults masked end-to-end
+# --------------------------------------------------------------------------
+
+def _round_cfg(tmp_path, log_dir, **over):
+    """A fully deterministic 3-client (2 feeders + 1 head) 2-stage round:
+    control_count=1 serializes each feeder's 1F1B into lockstep, and the
+    strict distinct-origin SDA window (sorted pop order) removes the
+    arrival-order race at the head — fault-free runs are bit-identical,
+    so fault masking is testable bit-for-bit."""
+    base = dict(
+        model="KWT", dataset="SPEECHCOMMANDS", clients=[2, 1],
+        global_rounds=1, synthetic_size=48, val_max_batches=1,
+        val_batch_size=16, compute_dtype="float32",
+        model_kwargs=TINY_KWT, log_path=str(log_dir),
+        learning={"batch_size": 4, "control_count": 1,
+                  "optimizer": "adamw", "learning_rate": 1e-3},
+        distribution={"num_samples": 8},
+        topology={"cut_layers": [2]},
+        aggregation={"strategy": "sda", "sda_size": 2,
+                     "sda_strict": True, "local_rounds": 1},
+        checkpoint={"directory": str(tmp_path / "ckpt"), "save": False},
+    )
+    for k, v in over.items():
+        if isinstance(v, dict) and isinstance(base.get(k), dict):
+            base[k].update(v)
+        else:
+            base[k] = v
+    return from_dict(base)
+
+
+def _run_cell(cfg, chaos_cfg=None, reliable=False, faults=None,
+              crashable=(), server_timeout=300.0, ready_timeout=None,
+              server_transport=None):
+    """One in-process deployment; per-client wrapper stacks; threads
+    hosting a scripted ChaosCrash die like processes (their reliable
+    daemon stops too, the shared bus survives)."""
+    from split_learning_tpu.runtime.client import ProtocolClient
+    from split_learning_tpu.runtime.server import ProtocolServer
+
+    bus = InProcTransport()
+    faults = faults if faults is not None else FaultCounters()
+    stacks = []
+
+    def make(name):
+        t = bus
+        if chaos_cfg is not None:
+            t = ChaosTransport(t, chaos_cfg, name=name, faults=faults)
+        if reliable:
+            t = ReliableTransport(t, sender=name, redeliver_s=0.1,
+                                  faults=faults)
+        if t is not bus:
+            stacks.append(t)
+        return t
+
+    sbus = make("server") if server_transport is None else server_transport
+    server = ProtocolServer(cfg, transport=sbus,
+                            client_timeout=server_timeout,
+                            ready_timeout=ready_timeout)
+    threads = []
+    for stage, count in enumerate(cfg.clients, start=1):
+        for i in range(count):
+            cid = f"client_{stage}_{i}"
+            stack = make(cid)
+            client = ProtocolClient(cfg, cid, stage, transport=stack)
+
+            def run(c=client, s=stack):
+                try:
+                    c.run()
+                except ChaosCrash:
+                    if hasattr(s, "stop"):
+                        s.stop(close_inner=False)
+
+            t = threading.Thread(target=run, daemon=True)
+            t.start()
+            threads.append((cid, t))
+    result = server.serve()
+    for cid, t in threads:
+        t.join(timeout=30)
+        assert not t.is_alive() or cid in crashable, \
+            f"client thread {cid} failed to stop"
+    for s in stacks:
+        if hasattr(s, "stop"):
+            s.stop(close_inner=False)
+    return result
+
+
+def _assert_trees_identical(a, b):
+    import jax
+    la, ta = jax.tree_util.tree_flatten(a)
+    lb, tb = jax.tree_util.tree_flatten(b)
+    assert ta == tb
+    for x, y in zip(la, lb):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+@pytest.mark.slow
+def test_chaos_round_bit_identical_to_fault_free(tmp_path):
+    """The acceptance bar: a 3-client 2-stage round under 10% drop +
+    10% duplicate + reorder + corruption + delay (fixed seed) completes
+    and its aggregated params match the fault-free run BIT-FOR-BIT —
+    the reliable layer fully masks the injected channel."""
+    cfg_a = _round_cfg(tmp_path, tmp_path / "a")
+    base = _run_cell(cfg_a)
+    cfg_b = _round_cfg(tmp_path, tmp_path / "b")
+    again = _run_cell(cfg_b)
+    # determinism sanity: without it, bit-identity would be meaningless
+    _assert_trees_identical(base.params, again.params)
+
+    faults = FaultCounters()
+    cfg_c = _round_cfg(tmp_path, tmp_path / "c")
+    chaotic = _run_cell(
+        cfg_c,
+        chaos_cfg=_chaos(seed=1234, drop=0.10, duplicate=0.10,
+                         reorder=0.15, corrupt=0.05, delay=0.10,
+                         delay_s=0.005),
+        reliable=True, faults=faults)
+
+    assert chaotic.history[0].ok
+    assert chaotic.history[0].num_samples == base.history[0].num_samples
+    _assert_trees_identical(base.params, chaotic.params)
+    snap = faults.snapshot()
+    assert snap.get("drops") and snap.get("redeliveries"), snap
+    assert snap.get("duplicates") and snap.get("dedup_hits"), snap
+
+
+@pytest.mark.slow
+def test_scripted_crash_elastic_drop_then_checkpoint_resume(tmp_path):
+    """A feeder dies mid-round (scripted crash right after its first
+    activation publish).  The run must complete all rounds via barrier
+    deadlines + elastic drop, checkpoint every good round, and a fresh
+    server must resume from the crash-atomic checkpoint — no manual
+    intervention anywhere."""
+    from split_learning_tpu.runtime import checkpoint as ck
+
+    faults = FaultCounters()
+    crash = {"client": "client_1_1", "queue": "intermediate_queue*",
+             "after": 1}
+    cfg = _round_cfg(
+        tmp_path, tmp_path / "run1", global_rounds=2,
+        aggregation={"strategy": "fedavg", "sda_size": 1,
+                     "sda_strict": False},
+        topology={"cut_layers": [2], "elastic_join": True},
+        checkpoint={"directory": str(tmp_path / "ckpt"), "save": True})
+    result = _run_cell(cfg, chaos_cfg=_chaos(crash=(crash,)),
+                       faults=faults, crashable=("client_1_1",),
+                       server_timeout=25.0, ready_timeout=5.0)
+
+    assert [r.ok for r in result.history] == [True, True]
+    # round 0: the survivor's samples only (the crashed feeder never
+    # UPDATEd); round 1: the dead client is dropped at the READY barrier
+    assert result.history[0].num_samples == 8
+    assert result.history[1].num_samples == 8
+    assert faults.snapshot().get("crashes") == 1
+    log_text = (tmp_path / "run1" / "app.log").read_text()
+    assert "timeout waiting for" in log_text   # barrier deadline fired
+
+    saved = ck.load_checkpoint(tmp_path / "ckpt", cfg.model_key)
+    assert saved is not None and saved["round_idx"] == 2
+
+    # fresh server + all-healthy clients resume from the checkpoint
+    cfg2 = _round_cfg(
+        tmp_path, tmp_path / "run2", global_rounds=3,
+        aggregation={"strategy": "fedavg", "sda_size": 1,
+                     "sda_strict": False},
+        topology={"cut_layers": [2], "elastic_join": True},
+        checkpoint={"directory": str(tmp_path / "ckpt"), "save": True,
+                    "load": True})
+    result2 = _run_cell(cfg2, server_timeout=120.0)
+    assert [r.round_idx for r in result2.history] == [2]
+    assert result2.history[0].ok
+    assert result2.history[0].num_samples == 16   # both feeders back
+    log2 = (tmp_path / "run2" / "app.log").read_text()
+    assert "Loaded checkpoint at round 2" in log2
+
+
+@pytest.mark.slow
+def test_broker_killed_and_restarted_mid_round(tmp_path):
+    """The in-process TCP broker dies mid-round (after SYN, data plane
+    live) and restarts on the same port.  With reliable delivery on all
+    protocol queues every participant reconnects, unacked frames
+    redeliver into the fresh broker, and both rounds complete."""
+    from split_learning_tpu.runtime.client import ProtocolClient
+    from split_learning_tpu.runtime.server import ProtocolServer
+
+    broker = Broker("127.0.0.1", 0)
+    port = broker.port
+    faults = FaultCounters()
+    patterns = ("intermediate_queue*", "gradient_queue*", "rpc_queue",
+                "reply_*")
+    cfg = _round_cfg(
+        tmp_path, tmp_path, clients=[1, 1], global_rounds=2,
+        aggregation={"strategy": "fedavg", "sda_size": 1,
+                     "sda_strict": False},
+        transport={"kind": "tcp", "host": "127.0.0.1", "port": port})
+
+    def mk(name):
+        tcp = lambda: TcpTransport("127.0.0.1", port,  # noqa: E731
+                                   reconnect_timeout=60.0, faults=faults)
+        return ReliableTransport(tcp(), sender=name, patterns=patterns,
+                                 side=tcp(), redeliver_s=0.2,
+                                 faults=faults)
+
+    state = {"broker": broker, "killed": False}
+    log = tmp_path / "app.log"
+
+    def killer():
+        import time
+        deadline = time.monotonic() + 240
+        while time.monotonic() < deadline:
+            if log.exists() and "SYN ->" in log.read_text():
+                state["broker"].close()
+                state["broker"] = Broker("127.0.0.1", port)
+                state["killed"] = True
+                return
+            time.sleep(0.05)
+
+    kt = threading.Thread(target=killer, daemon=True)
+    kt.start()
+    server = ProtocolServer(cfg, transport=mk("server"),
+                            client_timeout=300.0)
+    threads = []
+    for stage in (1, 2):
+        cid = f"client_{stage}_0"
+        client = ProtocolClient(cfg, cid, stage, transport=mk(cid))
+        t = threading.Thread(target=client.run, daemon=True)
+        t.start()
+        threads.append(t)
+    try:
+        result = server.serve()
+        for t in threads:
+            t.join(timeout=30)
+            assert not t.is_alive(), "client thread failed to stop"
+        kt.join(timeout=10)
+        assert state["killed"], "broker kill never triggered"
+        assert [r.ok for r in result.history] == [True, True]
+        assert all(r.num_samples == 8 for r in result.history)
+        assert faults.snapshot().get("reconnects", 0) >= 1
+        # the server surfaced the recovery in its observability stream
+        metrics = (tmp_path / "metrics.jsonl").read_text()
+        assert '"kind": "faults"' in metrics
+        assert "round faults (cumulative)" in log.read_text()
+    finally:
+        state["broker"].close()

@@ -86,9 +86,18 @@ class TestProtocol:
 
     def test_rejects_non_protocol_payloads(self):
         import pickle
+        import struct
+        import zlib
         evil = pickle.dumps(ValueError("boom"))
-        with pytest.raises(pickle.UnpicklingError, match="disallowed"):
+        # a bare (unframed) pickle dies at the checksum layer...
+        with pytest.raises(protocol.CorruptFrame):
             protocol.decode(evil)
+        # ...and a correctly-framed one still dies in the restricted
+        # unpickler
+        framed = (protocol.FRAME_MAGIC
+                  + struct.pack(">I", zlib.crc32(evil)) + evil)
+        with pytest.raises(pickle.UnpicklingError, match="disallowed"):
+            protocol.decode(framed)
 
     def test_queue_names_match_reference_topology(self):
         assert protocol.intermediate_queue(1, 0) == "intermediate_queue_1_0"

@@ -1,0 +1,185 @@
+#!/usr/bin/env python
+"""Chaos sweep: fault probabilities x seeds -> pass/fail matrix.
+
+Each cell pushes a message stream through the production transport
+stack — ``ReliableTransport`` over a seeded ``ChaosTransport`` over the
+in-process bus — and PASSes iff the receiver sees the exact sent
+sequence, in order, with nothing extra.  Because every cell is
+reproducible from its (fault, probability, seed) triple, a FAIL here is
+a ready-made regression test: rerun with ``--only drop:0.4 --seeds 1
+--seed-base <seed>`` and debug.
+
+    python tools/run_chaos.py                  # default grid, 5 seeds
+    python tools/run_chaos.py --seeds 20 --messages 400   # longer soak
+    python tools/run_chaos.py --full           # full tiny training
+                                               # round per cell (slow;
+                                               # needs jax/CPU)
+
+Exit code is non-zero when any cell fails, so it slots into CI.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import threading
+import time
+
+sys.path.insert(0, ".")  # run from the repo root
+
+from split_learning_tpu.config import ChaosConfig  # noqa: E402
+from split_learning_tpu.runtime.bus import (  # noqa: E402
+    InProcTransport, ReliableTransport,
+)
+from split_learning_tpu.runtime.chaos import ChaosTransport  # noqa: E402
+from split_learning_tpu.runtime.trace import FaultCounters  # noqa: E402
+
+QUEUE = "intermediate_queue_0_0"
+
+
+def transport_cell(fault: str, prob: float, seed: int,
+                   n_messages: int) -> tuple[bool, str]:
+    """True iff the reliable layer fully masks this fault class."""
+    kwargs = {f: 0.0 for f in ("drop", "duplicate", "reorder", "corrupt",
+                               "delay")}
+    if fault == "mixed":
+        for f in kwargs:
+            kwargs[f] = prob
+    else:
+        kwargs[fault] = prob
+    cfg = ChaosConfig(enabled=True, seed=seed, delay_s=0.005,
+                      queues=("intermediate_queue*",), **kwargs)
+    bus = InProcTransport()
+    fc = FaultCounters()
+    # provision the redelivery budget for the injected loss regime: at
+    # sustained ~2/3 per-attempt loss (mixed:0.4) the give-up odds are
+    # loss^(attempts+1), so 40 attempts ≈ 5e-7/message.  The receiver's
+    # gap timeout must exceed the sender's full retry horizon or a
+    # skip-then-late-arrival turns into a loss.
+    sender = ReliableTransport(
+        ChaosTransport(bus, cfg, name="s", faults=fc), sender="s",
+        patterns=("intermediate_queue*",), redeliver_s=0.05,
+        max_redeliver=40, faults=fc)
+    recv = ReliableTransport(bus, sender="r",
+                             patterns=("intermediate_queue*",),
+                             redeliver_s=0.05, max_redeliver=40,
+                             gap_timeout_s=60.0, faults=fc)
+    msgs = [b"m%06d" % i for i in range(n_messages)]
+    t = threading.Thread(
+        target=lambda: [sender.publish(QUEUE, m) for m in msgs],
+        daemon=True)
+    t.start()
+    got = []
+    for _ in msgs:
+        m = recv.get(QUEUE, timeout=30.0)
+        if m is None:
+            break
+        got.append(m)
+    t.join(timeout=10)
+    extra = recv.get(QUEUE, timeout=0.2)
+    sender.stop(close_inner=False)
+    recv.stop(close_inner=False)
+    if got != msgs:
+        return False, f"{len(got)}/{len(msgs)} exact"
+    if extra is not None:
+        return False, "phantom extra message"
+    snap = fc.snapshot()
+    note = "+".join(f"{k[0]}{v}" for k, v in sorted(snap.items())
+                    if k in ("drops", "duplicates", "reorders",
+                             "corruptions", "delays"))
+    return True, note or "quiet"
+
+
+def full_round_cell(fault: str, prob: float, seed: int, tmp: str
+                    ) -> tuple[bool, str]:
+    """Full 3-client round; PASS iff params match the fault-free run
+    bit-for-bit (baseline computed once and cached on the function)."""
+    import numpy as np
+
+    sys.path.insert(0, "tests")
+    from test_chaos import _chaos, _round_cfg, _run_cell  # noqa: E402
+    import pathlib
+    root = pathlib.Path(tmp)
+    if not hasattr(full_round_cell, "_base"):
+        cfg = _round_cfg(root, root / "base")
+        full_round_cell._base = _run_cell(cfg)
+    base = full_round_cell._base
+    kwargs = {f: 0.0 for f in ("drop", "duplicate", "reorder", "corrupt",
+                               "delay")}
+    if fault == "mixed":
+        for f in kwargs:
+            kwargs[f] = prob
+    else:
+        kwargs[fault] = prob
+    cfg = _round_cfg(root, root / f"{fault}_{prob}_{seed}")
+    res = _run_cell(cfg, chaos_cfg=_chaos(seed=seed, delay_s=0.005,
+                                          **kwargs), reliable=True)
+    if not res.history[0].ok:
+        return False, "round not ok"
+    if res.history[0].num_samples != base.history[0].num_samples:
+        return False, "sample count drifted"
+    import jax
+    for a, b in zip(jax.tree_util.tree_leaves(base.params),
+                    jax.tree_util.tree_leaves(res.params)):
+        if not np.array_equal(np.asarray(a), np.asarray(b)):
+            return False, "params not bit-identical"
+    return True, "bit-identical"
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="Sweep fault probabilities over seeds; print a "
+                    "pass/fail matrix.")
+    ap.add_argument("--seeds", type=int, default=5)
+    ap.add_argument("--seed-base", type=int, default=100)
+    ap.add_argument("--messages", type=int, default=150)
+    ap.add_argument("--probs", default="0.05,0.2,0.4",
+                    help="comma-separated probabilities")
+    ap.add_argument("--only", default=None,
+                    help="restrict to one cell, e.g. drop:0.4")
+    ap.add_argument("--full", action="store_true",
+                    help="full tiny training round per cell (slow)")
+    args = ap.parse_args(argv)
+
+    faults = ["drop", "duplicate", "reorder", "corrupt", "delay",
+              "mixed"]
+    probs = [float(p) for p in args.probs.split(",")]
+    cells = [(f, p) for f in faults for p in probs]
+    if args.only:
+        f, _, p = args.only.partition(":")
+        cells = [(f, float(p))]
+
+    tmp = None
+    if args.full:
+        import tempfile
+        tmp = tempfile.mkdtemp(prefix="chaos_sweep_")
+
+    width = max(len(f) for f, _ in cells) + 6
+    print(f"{'cell':<{width}} " + " ".join(
+        f"seed{args.seed_base + i:<4}" for i in range(args.seeds)))
+    failures = 0
+    for fault, prob in cells:
+        row = []
+        for i in range(args.seeds):
+            seed = args.seed_base + i
+            t0 = time.monotonic()
+            if args.full:
+                ok, note = full_round_cell(fault, prob, seed, tmp)
+            else:
+                ok, note = transport_cell(fault, prob, seed,
+                                          args.messages)
+            dt = time.monotonic() - t0
+            row.append("PASS" if ok else f"FAIL({note})")
+            if not ok:
+                failures += 1
+                print(f"  FAIL {fault}:{prob} seed={seed} -> {note} "
+                      f"({dt:.1f}s)", file=sys.stderr)
+        print(f"{fault + ':' + str(prob):<{width}} " + " ".join(
+            f"{r:<8}" for r in row))
+    print(f"\n{len(cells) * args.seeds - failures}/"
+          f"{len(cells) * args.seeds} cells passed")
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
