@@ -188,6 +188,17 @@ class Artifact:
         if isinstance(split, dict) and "ratio_vs_unsplit" in split:
             self.extra["split_ratio_vs_unsplit"] = split[
                 "ratio_vs_unsplit"]
+        # stable keys (round-8 wire/overlap PR): protocol-mode
+        # throughput, steady-round wire bytes, and the cold-round
+        # compile tax mirrored at the top of `extra` under fixed names
+        proto = self.results.get("protocol_mode")
+        if isinstance(proto, dict):
+            for src, dst in (("samples_per_sec",
+                              "protocol_samples_per_sec"),
+                             ("wire_mb_per_round", "wire_mb_per_round"),
+                             ("cold_round_wall_s", "cold_round_wall_s")):
+                if src in proto:
+                    self.extra[dst] = proto[src]
         plan = (self.cfgs.get("tinyllama_tinystories_4stage") or {})
         if isinstance(plan, dict):
             per_dev = (plan.get("memory_plan") or {}).get("per_device_gb")
@@ -910,6 +921,12 @@ def _sec_protocol_mode(ctx: dict) -> dict:
         "checkpoint": {"directory": "/tmp/slt_bench_protocol_ckpt",
                        "save": False},
         "log-path": logdir,
+        # persistent compile cache (runtime compile-cache-dir):
+        # deliberately NOT wiped between bench runs — cutting the
+        # cold-round compile tax across process restarts is the thing
+        # being measured, and within one run same-stage clients share
+        # entries too
+        "compile-cache-dir": "/tmp/slt_bench_protocol_jaxcache",
         "transport": {"kind": "tcp", "host": "127.0.0.1", "port": port},
     }))
     env = dict(os.environ)
@@ -968,17 +985,28 @@ def _sec_protocol_mode(ctx: dict) -> dict:
             except (ProcessLookupError, PermissionError, OSError):
                 pass
     rounds = []
+    wire_by_client: dict = {}
     for line in (pathlib.Path(logdir) / "metrics.jsonl"
                  ).read_text().splitlines():
         rec = json.loads(line)
         if "wall_s" in rec and "num_samples" in rec:
             rounds.append(rec)
+        elif rec.get("kind") == "wire_client":
+            wire_by_client.setdefault(rec["client"], []).append(rec)
     if len(rounds) < 2:
         raise RuntimeError(f"expected 2 round records, got {rounds}")
     steady = rounds[-1]
     train_s = (steady.get("phases", {}).get("train", {})
                .get("total_s", steady["wall_s"]))
-    return {
+    # steady-round DATA-plane wire bytes (activations + input
+    # gradients), summed over clients: the counters are cumulative, so
+    # diff each client's last two round records (one record per round)
+    wire_bytes = 0
+    for recs in wire_by_client.values():
+        last = recs[-1].get("data_bytes_out", 0)
+        prev = recs[-2].get("data_bytes_out", 0) if len(recs) > 1 else 0
+        wire_bytes += last - prev
+    out = {
         "transport": "tcp (native C++ broker preferred)",
         "processes": "broker + server + 2 feeders + 1 head",
         "backend": "cpu-multiprocess (chip holds one process; "
@@ -989,10 +1017,15 @@ def _sec_protocol_mode(ctx: dict) -> dict:
         "samples_per_sec": round(
             steady["num_samples"] / max(train_s, 1e-9), 2),
         "cold_round_wall_s": round(rounds[0]["wall_s"], 2),
+        "wire_dtype": "bfloat16 (transport.wire-dtype default)",
+        "compile_cache": "persistent (/tmp/slt_bench_protocol_jaxcache)",
         "note": "all 5 processes share this host's CPU core(s); the "
                 "reference's deployment runs one process per machine — "
                 "this measures protocol/wire overhead, not scale-out",
     }
+    if wire_bytes:
+        out["wire_mb_per_round"] = round(wire_bytes / 2**20, 3)
+    return out
 
 
 def _sec_test_ok(ctx: dict) -> dict:

@@ -241,12 +241,26 @@ class TransportConfig:
     kind: str = "inproc"            # inproc | tcp
     host: str = "127.0.0.1"
     port: int = 5672
-    # Activation/gradient float payload dtype on the data-plane wire.
-    # float16/bfloat16 halve the per-hop bytes (the reference always
-    # ships fp32 pickles, src/train/VGG16.py:27); int8 absmax-quantizes
-    # each payload leaf for ~4x (runtime/protocol.py QuantLeaf);
-    # control-plane weights (START/UPDATE) always travel full precision.
-    wire_dtype: str = "float32"     # float32 | float16 | bfloat16 | int8
+    # Activation/input-gradient float payload dtype on the data-plane
+    # wire.  bf16 (the default) halves the per-hop bytes vs the
+    # reference's fp32 pickles (src/train/VGG16.py:27); int8
+    # absmax-quantizes each payload leaf for ~4x (runtime/protocol.py
+    # QuantLeaf).  fp32 MASTER copies are untouched: weights in
+    # START/UPDATE always travel full precision.  Aliases fp32/bf16/fp16
+    # accepted.
+    wire_dtype: str = "bfloat16"    # float32 | float16 | bfloat16 | int8
+    # Async data plane (runtime/bus.py AsyncTransport, default on):
+    # sends are enqueued into a bounded background sender (depth =
+    # send-depth) that does the device fetch + TENSOR encode + socket
+    # write off the training thread, and data-plane receives are pulled
+    # prefetch-depth frames ahead by per-queue prefetchers.
+    async_send: bool = True
+    send_depth: int = 8
+    prefetch_depth: int = 2
+    # One frame's wire-size cap before it splits into crc'd chunks
+    # (runtime/protocol.py encode_parts / FrameAssembler) — keeps a
+    # giant UPDATE under the broker's frame sanity cap.
+    chunk_mb: int = 512
     # At-least-once in-order delivery (runtime/bus.py ReliableTransport)
     # for queues matching ``reliable-queues``: sequence-numbered + ack'd
     # frames with bounded redelivery, receiver-side dedup + resequencing.
@@ -259,15 +273,27 @@ class TransportConfig:
     redeliver_s: float = 0.3        # first redelivery deadline (backoff x1.5)
     max_redeliver: int = 20         # bounded redelivery, then give up
 
+    #: short spellings accepted for wire-dtype
+    WIRE_DTYPE_ALIASES = {"fp32": "float32", "fp16": "float16",
+                          "bf16": "bfloat16"}
+
+    @property
+    def wire_dtype_normalized(self) -> str:
+        return self.WIRE_DTYPE_ALIASES.get(self.wire_dtype,
+                                           self.wire_dtype)
+
     def validate(self):
         _check(self.kind in ("inproc", "tcp"),
                f"transport must be inproc|tcp, got {self.kind!r}")
-        _check(self.wire_dtype in ("float32", "float16", "bfloat16",
-                                   "int8"),
-               f"wire-dtype must be float32|float16|bfloat16|int8, "
-               f"got {self.wire_dtype!r}")
+        _check(self.wire_dtype_normalized in ("float32", "float16",
+                                              "bfloat16", "int8"),
+               f"wire-dtype must be float32|float16|bfloat16|int8 "
+               f"(or fp32|fp16|bf16), got {self.wire_dtype!r}")
         _check(self.redeliver_s > 0, "redeliver-s must be > 0")
         _check(self.max_redeliver >= 1, "max-redeliver must be >= 1")
+        _check(self.send_depth >= 1, "send-depth must be >= 1")
+        _check(self.prefetch_depth >= 1, "prefetch-depth must be >= 1")
+        _check(self.chunk_mb >= 1, "chunk-mb must be >= 1")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -327,6 +353,11 @@ class Config:
     debug: bool = False
     log_path: str = "."
     compute_dtype: str = "bfloat16"     # bfloat16 | float32
+    # Persistent XLA compilation cache directory (default off): every
+    # entry point applies it via platform.apply_compile_cache, so a
+    # restarted process (the protocol deployment's cold round) reuses
+    # compiled programs instead of re-paying the compile tax.
+    compile_cache_dir: str | None = None
     model_kwargs: Any = None            # overrides for the model builder
     synthetic_size: int | None = None   # force synthetic datasets (tests)
     val_batch_size: int = 200

@@ -66,6 +66,8 @@ def main(argv=None):
     ap.add_argument("--config", default="config.yaml")
     args = ap.parse_args(argv)
     cfg = from_yaml(args.config)
+    from split_learning_tpu.platform import apply_compile_cache
+    apply_compile_cache(cfg.compile_cache_dir)
     result = run_local(cfg)
     for rec in result.history:
         acc = (f" val_acc={rec.val_accuracy:.4f}"

@@ -775,6 +775,305 @@ class ReliableTransport(Transport):
         self.stop(close_inner=True)
 
 
+# --------------------------------------------------------------------------
+# async data plane: background sender + receive prefetch
+# --------------------------------------------------------------------------
+
+class AsyncTransport(Transport):
+    """Outermost wrapper that takes serialization and socket I/O off the
+    training thread.
+
+    * **Sender**: ``publish`` enqueues into a bounded FIFO drained by
+      one background thread, so the hot loop never blocks on the wire.
+      The payload may be a *callable* returning bytes (or a list of
+      frame parts): the thunk — typically "fetch the device array to
+      host + TENSOR-encode it" — then runs on the sender thread,
+      overlapping microbatch k's device→host transfer and encode with
+      the training thread's microbatch k+1 compute.  One thread drains
+      the queue, so per-queue publish order (which the EpochEnd fence
+      protocol and the reliable layer's seq numbers depend on) is
+      exactly the enqueue order.
+    * **Prefetch**: queues matching ``prefetch`` get a lazy background
+      prefetcher that pulls up to ``prefetch_depth`` frames ahead of
+      the consumer, so the next gradient/activation is already on-host
+      when the hot loop asks.  The depth is deliberately small: shared
+      per-cluster queues load-balance across same-stage clients, and a
+      deep prefetch would steal peers' work.
+
+    A sender-thread failure (``ChaosCrash``, a dead bus) is re-raised
+    on the training thread's next ``publish``/``get`` — the participant
+    dies where its process would have.  ``slice_gets`` bounds how long
+    a pass-through blocking ``get`` may hold a lock-serialized inner
+    transport (TcpTransport's single socket), so the sender thread can
+    interleave its publishes.
+    """
+
+    deferred = True   # publish() accepts thunks / frame-part lists
+
+    def __init__(self, inner: Transport, send_depth: int = 8,
+                 prefetch: Iterable[str] = ("intermediate_queue*",
+                                            "gradient_queue*"),
+                 prefetch_depth: int = 2, recv_factory=None,
+                 slice_gets: bool = False, wire=None, faults=None):
+        super().__init__()
+        self.inner = inner
+        self._send_depth = max(1, send_depth)
+        self._prefetch_patterns = tuple(prefetch)
+        self._prefetch_depth = max(1, prefetch_depth)
+        self._recv_factory = recv_factory
+        self._slice_gets = slice_gets
+        if wire is None:
+            # fresh per instance, NOT the process-wide default: in-proc
+            # cells build one AsyncTransport per participant, and a
+            # shared registry would attribute every client's bytes to
+            # each wire_client metrics record
+            from split_learning_tpu.runtime.trace import WireCounters
+            wire = WireCounters()
+        self.wire = wire
+        if faults is None:
+            from split_learning_tpu.runtime.trace import (
+                default_fault_counters,
+            )
+            faults = default_fault_counters
+        self.faults = faults
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+        self._sendq: collections.deque = collections.deque()
+        self._inflight = 0      # popped by the sender, not yet published
+        self._error: BaseException | None = None
+        self._closed = threading.Event()
+        self._prefetchers: dict[str, _Prefetcher] = {}
+        self._sender = threading.Thread(target=self._send_loop,
+                                        daemon=True, name="async-sender")
+        self._sender.start()
+
+    # -- sender ------------------------------------------------------------
+
+    def _check_error(self):
+        err = self._error
+        if err is not None:
+            raise err
+
+    def publish(self, queue: str, payload) -> None:
+        with self._cv:
+            self._check_error()
+            self._cv.wait_for(lambda: len(self._sendq) < self._send_depth
+                              or self._error or self._closed.is_set())
+            self._check_error()
+            if self._closed.is_set():
+                raise QueueClosed(queue)
+            self._sendq.append((queue, payload))
+            self.wire.note_send_depth(len(self._sendq))
+            self._cv.notify_all()
+
+    def _send_loop(self) -> None:
+        while True:
+            with self._cv:
+                self._cv.wait_for(
+                    lambda: self._sendq or self._closed.is_set())
+                if not self._sendq:
+                    return   # closed and drained
+                queue, payload = self._sendq.popleft()
+                self._inflight += 1   # flush must see this frame too
+                self._cv.notify_all()
+            try:
+                if callable(payload):
+                    t0 = time.perf_counter()
+                    payload = payload()
+                    self.wire.add_encode(time.perf_counter() - t0)
+                parts = (payload if isinstance(payload, (list, tuple))
+                         else (payload,))
+                for part in parts:
+                    self.inner.publish(queue, part)
+                    self.wire.count_out(queue, len(part))
+            except BaseException as e:  # noqa: BLE001 — surfaced to the
+                # training thread; the sender stops like a dead process
+                with self._cv:
+                    self._error = e
+                    self._inflight -= 1
+                    self._cv.notify_all()
+                self.faults.inc("async_send_errors")
+                return
+            with self._cv:
+                self._inflight -= 1
+                self._cv.notify_all()
+
+    def flush(self, timeout: float | None = 30.0) -> bool:
+        """Block until every enqueued frame reached the inner transport
+        (or the sender died).  False on timeout.  Covers the frame the
+        sender has popped but not yet published — returning while the
+        last UPDATE/STOP is mid-write would let the process exit (or
+        the broker be torn down) under it."""
+        deadline = (None if timeout is None
+                    else time.monotonic() + timeout)
+        with self._cv:
+            def drained():
+                return ((not self._sendq and not self._inflight)
+                        or self._error is not None)
+            remain = (None if deadline is None
+                      else max(0.0, deadline - time.monotonic()))
+            self._cv.wait_for(drained, remain)
+            return not self._sendq and not self._inflight
+
+    # -- receive -----------------------------------------------------------
+
+    def _match(self, queue: str) -> bool:
+        return any(fnmatch.fnmatchcase(queue, p)
+                   for p in self._prefetch_patterns)
+
+    def _prefetcher(self, queue: str) -> "_Prefetcher":
+        with self._lock:
+            pf = self._prefetchers.get(queue)
+            if pf is None:
+                src = (self._recv_factory()
+                       if self._recv_factory is not None else self.inner)
+                pf = _Prefetcher(queue, src,
+                                 own_src=self._recv_factory is not None,
+                                 depth=self._prefetch_depth,
+                                 wire=self.wire, faults=self.faults)
+                self._prefetchers[queue] = pf
+            return pf
+
+    def get(self, queue: str, timeout: float | None = None) -> bytes | None:
+        self._check_error()
+        if self._match(queue):
+            return self._prefetcher(queue).pop(timeout)
+        if not self._slice_gets or (timeout is not None and timeout <= 0.1):
+            raw = self.inner.get(queue, timeout)
+        else:
+            # lock-serialized inner (one TCP socket): bounded slices let
+            # the sender thread's publishes interleave with this wait
+            deadline = (None if timeout is None
+                        else time.monotonic() + timeout)
+            raw = None
+            while raw is None:
+                self._check_error()
+                remain = (None if deadline is None
+                          else deadline - time.monotonic())
+                if remain is not None and remain <= 0:
+                    break
+                raw = self.inner.get(
+                    queue, 0.1 if remain is None else min(remain, 0.1))
+        if raw is not None:
+            self.wire.count_in(queue, len(raw))
+        return raw
+
+    # -- plumbing ----------------------------------------------------------
+
+    def purge(self, queues: Iterable[str] | None = None) -> None:
+        self.inner.purge(queues)
+        with self._lock:
+            pfs = list(self._prefetchers.items())
+        for q, pf in pfs:
+            if queues is None or q in set(queues):
+                pf.clear()
+        with self._cv:
+            if queues is None:
+                self._sendq.clear()
+            else:
+                qs = set(queues)
+                self._sendq = collections.deque(
+                    e for e in self._sendq if e[0] not in qs)
+            self._cv.notify_all()
+
+    def total_bytes_out(self) -> int:
+        return self.inner.total_bytes_out()
+
+    def bytes_out_snapshot(self) -> dict:
+        return self.inner.bytes_out_snapshot()
+
+    def stop(self, close_inner: bool = True) -> None:
+        self.flush(timeout=10.0)
+        self._closed.set()
+        with self._cv:
+            self._cv.notify_all()
+        self._sender.join(timeout=5.0)
+        with self._lock:
+            pfs = list(self._prefetchers.values())
+            self._prefetchers.clear()
+        for pf in pfs:
+            pf.stop()
+        if close_inner:
+            self.inner.close()
+
+    def close(self) -> None:
+        self.stop(close_inner=True)
+
+
+class _Prefetcher:
+    """One queue's bounded look-ahead buffer + puller thread."""
+
+    def __init__(self, queue: str, src: Transport, own_src: bool,
+                 depth: int, wire, faults):
+        self.queue = queue
+        self.src = src
+        self._own_src = own_src
+        self._depth = depth
+        self._wire = wire
+        self._faults = faults
+        self._buf: collections.deque = collections.deque()
+        self._cond = threading.Condition()
+        self._closed = False
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name=f"prefetch-{queue}")
+        self._thread.start()
+
+    def _loop(self) -> None:
+        while True:
+            with self._cond:
+                self._cond.wait_for(lambda: len(self._buf) < self._depth
+                                    or self._closed)
+                if self._closed:
+                    return
+            try:
+                raw = self.src.get(self.queue, timeout=0.05)
+            except QueueClosed:
+                with self._cond:
+                    self._closed = True
+                    self._cond.notify_all()
+                return
+            except Exception:  # noqa: BLE001 — a transient transport
+                # error must not kill the only thread filling the buffer
+                if self._closed:
+                    return
+                self._faults.inc("prefetch_errors")
+                time.sleep(0.1)
+                continue
+            if raw is not None:
+                with self._cond:
+                    self._buf.append(raw)
+                    self._wire.count_in(self.queue, len(raw))
+                    self._cond.notify_all()
+
+    def pop(self, timeout: float | None) -> bytes | None:
+        with self._cond:
+            self._cond.wait_for(lambda: self._buf or self._closed,
+                                timeout)
+            if self._buf:
+                raw = self._buf.popleft()
+                self._cond.notify_all()
+                return raw
+            if self._closed:
+                raise QueueClosed(self.queue)
+            return None
+
+    def clear(self) -> None:
+        with self._cond:
+            self._buf.clear()
+            self._cond.notify_all()
+
+    def stop(self) -> None:
+        with self._cond:
+            self._closed = True
+            self._cond.notify_all()
+        self._thread.join(timeout=5.0)
+        if self._own_src:
+            try:
+                self.src.close()
+            except (QueueClosed, ConnectionError, OSError):
+                pass
+
+
 def make_transport(kind: str, host: str = "127.0.0.1",
                    port: int = 5672) -> Transport:
     if kind == "inproc":

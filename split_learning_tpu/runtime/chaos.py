@@ -44,7 +44,8 @@ from typing import Iterable
 
 from split_learning_tpu.config import ChaosConfig, Config
 from split_learning_tpu.runtime.bus import (
-    QueueClosed, ReliableTransport, Transport, make_transport,
+    AsyncTransport, QueueClosed, ReliableTransport, Transport,
+    make_transport,
 )
 
 
@@ -222,7 +223,16 @@ def make_runtime_transport(cfg: Config, name: str,
     serializes a TcpTransport's socket, so background publishers must
     not share the main one).  The daemon's connection is itself
     chaos-wrapped so redelivered frames roll fresh faults, keeping the
-    chaos-below-reliability layering identical across backends."""
+    chaos-below-reliability layering identical across backends.
+
+    ``transport.async-send`` (default on) adds :class:`AsyncTransport`
+    as the OUTERMOST layer: the training thread enqueues encode thunks
+    and the background sender drives the reliable/chaos/bus stack, so
+    redelivery envelopes and fault injection see exactly the same frame
+    stream as the synchronous path.  Data-plane receive prefetch gets a
+    dedicated broker connection when there is no reliable layer (the
+    reliable receiver's dedup/resequence state must stay on ONE
+    instance per queue, so with it the prefetcher shares the stack)."""
     tcp = cfg.transport.kind == "tcp"
 
     def mk() -> Transport:
@@ -249,4 +259,11 @@ def make_runtime_transport(cfg: Config, name: str,
             bus, sender=name, patterns=cfg.transport.reliable_queues,
             side=side, redeliver_s=cfg.transport.redeliver_s,
             max_redeliver=cfg.transport.max_redeliver, faults=faults)
+    if cfg.transport.async_send:
+        recv_factory = (mk if tcp and not cfg.transport.reliable
+                        else None)
+        bus = AsyncTransport(
+            bus, send_depth=cfg.transport.send_depth,
+            prefetch_depth=cfg.transport.prefetch_depth,
+            recv_factory=recv_factory, slice_gets=tcp, faults=faults)
     return bus

@@ -52,18 +52,33 @@ from split_learning_tpu.runtime.bus import Transport
 from split_learning_tpu.runtime.log import Logger
 from split_learning_tpu.runtime.memo import bounded_setdefault
 from split_learning_tpu.runtime.protocol import (
-    Activation, EpochEnd, Gradient, Notify, Pause, Ready, Register, Start,
-    Stop, Syn, QuantLeaf, Update, decode, encode, gradient_queue,
-    intermediate_queue, reply_queue, RPC_QUEUE,
+    Activation, EpochEnd, FrameAssembler, Gradient, Notify, Pause, Ready,
+    Register, Start, Stop, Syn, QuantLeaf, Update, encode, encode_parts,
+    gradient_queue, intermediate_queue, reply_queue, RPC_QUEUE,
 )
 from split_learning_tpu.runtime.validation import dataset_for_model
 
-
 def _wire_np_dtype(name: str):
+    from split_learning_tpu.config import TransportConfig
+    name = TransportConfig.WIRE_DTYPE_ALIASES.get(name, name)
     if name == "bfloat16":
         import ml_dtypes
         return ml_dtypes.bfloat16
     return np.dtype(name)
+
+
+def _start_host_copy(tree) -> None:
+    """Kick off the device→host transfer of every leaf WITHOUT blocking
+    (jax.Array.copy_to_host_async), so by the time the async sender's
+    encode thunk calls np.asarray the bytes are already on host — the
+    transfer overlaps the training thread's next microbatch compute."""
+    for leaf in jax.tree_util.tree_leaves(tree):
+        copy = getattr(leaf, "copy_to_host_async", None)
+        if copy is not None:
+            try:
+                copy()
+            except Exception:  # noqa: BLE001 — purely a prefetch hint
+                return
 
 
 def _quant_int8(a: np.ndarray):
@@ -345,10 +360,16 @@ class ProtocolClient:
             transport = make_runtime_transport(cfg, client_id)
         self.bus = transport
         from split_learning_tpu.runtime.trace import (
-            default_fault_counters,
+            default_fault_counters, default_wire_counters,
         )
         self.faults = getattr(self.bus, "faults", None) \
             or default_fault_counters
+        self.wire = getattr(self.bus, "wire", None) \
+            or default_wire_counters
+        # chunked-frame reassembly is per consumer thread; the client is
+        # single-threaded over its queues
+        self._assembler = FrameAssembler()
+        self._chunk_bytes = cfg.transport.chunk_mb << 20
         self.log = logger or Logger(cfg.log_path, debug=cfg.debug,
                                     console=False, name=client_id)
         self.runner: ShardRunner | None = None
@@ -369,18 +390,38 @@ class ProtocolClient:
     # -- control plane -----------------------------------------------------
 
     def _decode(self, raw: bytes):
-        """Tolerant decode: a frame that fails the checksum (or ANY
-        guard inside decode — a crafted pickle can raise arbitrary
-        exceptions from numpy reconstruction) is dropped and counted,
-        never fatal: a flipped bit on the wire must cost one message
-        (which the reliable layer redelivers), not the process.  Same
-        breadth as the server's rpc pump."""
+        """Tolerant decode: a frame that fails a checksum (or ANY guard
+        inside decode — a crafted pickle can raise arbitrary exceptions
+        from numpy reconstruction) is dropped and counted, never fatal:
+        a flipped bit on the wire must cost one message (which the
+        reliable layer redelivers), not the process.  Same breadth as
+        the server's rpc pump.  Returns None both for dropped frames
+        and for a chunk of a still-partial message."""
+        t0 = time.perf_counter()
         try:
-            return decode(raw)
+            return self._assembler.feed(raw)
         except Exception as e:  # noqa: BLE001 — see docstring
             self.faults.inc("corrupt_rejected")
             self.log.warning(f"dropping undecodable frame: {e}")
             return None
+        finally:
+            self.wire.add_decode(time.perf_counter() - t0)
+
+    def _publish_parts(self, queue: str, build) -> None:
+        """Data-plane publish: ``build()`` produces the frame part list
+        (device fetch + TENSOR encode + chunking).  On an async bus the
+        thunk is enqueued and runs on the background sender —
+        microbatch k's transfer/encode/socket-write overlaps microbatch
+        k+1's compute; on a plain bus it runs inline."""
+        if getattr(self.bus, "deferred", False):
+            self.bus.publish(queue, build)
+            return
+        t0 = time.perf_counter()
+        parts = build()
+        self.wire.add_encode(time.perf_counter() - t0)
+        for part in parts:
+            self.bus.publish(queue, part)
+            self.wire.count_out(queue, len(part))
 
     def register(self):
         self.bus.publish(RPC_QUEUE, encode(Register(
@@ -434,6 +475,11 @@ class ProtocolClient:
                 self._on_syn(msg)
             elif isinstance(msg, Stop):
                 self.log.info(f"[<<<] STOP {msg.reason}")
+                # drain the async sender before the process exits: a
+                # still-enqueued frame must not die with this client
+                flush = getattr(self.bus, "flush", None)
+                if flush is not None:
+                    flush(timeout=30.0)
                 return
             else:
                 self.log.warning(f"unexpected control message {msg}")
@@ -583,11 +629,16 @@ class ProtocolClient:
             merged = self.runner.merge_params(self.frozen, self.trainable)
             params_h = jax.tree_util.tree_map(np.asarray, merged)
             stats_h = jax.tree_util.tree_map(np.asarray, self.stats)
-        self.bus.publish(RPC_QUEUE, encode(Update(
-            client_id=self.client_id, stage=self.stage,
-            cluster=self.cluster, params=params_h,
-            batch_stats=stats_h, num_samples=self.num_samples,
-            ok=self.round_ok, round_idx=self.fence)))
+        # TENSOR-framed and chunked: a shard UPDATE is the biggest frame
+        # a client ever publishes
+        self._publish_parts(RPC_QUEUE, lambda p=params_h, s=stats_h,
+                            n=self.num_samples, ok=self.round_ok,
+                            fence=self.fence, cl=self.cluster:
+                            encode_parts(Update(
+                                client_id=self.client_id,
+                                stage=self.stage, cluster=cl, params=p,
+                                batch_stats=s, num_samples=n, ok=ok,
+                                round_idx=fence), self._chunk_bytes))
         self.log.info(f"[>>>] UPDATE samples={self.num_samples} "
                       f"ok={self.round_ok}"
                       + ("" if with_weights else " (no weights)"))
@@ -602,6 +653,13 @@ class ProtocolClient:
                 f"{k}={v}" for k, v in sorted(snap.items())))
             self.log.metric(kind="faults", client=self.client_id,
                             round_idx=self.round_idx, **snap)
+        # wire counters (bytes in/out, encode/decode seconds, sender
+        # high-water mark) follow the same contract
+        wsnap = {k: v for k, v in self.wire.snapshot().items() if v}
+        if wsnap and wsnap != getattr(self, "_wire_base", None):
+            self._wire_base = wsnap
+            self.log.metric(kind="wire_client", client=self.client_id,
+                            round_idx=self.round_idx, **wsnap)
 
     def _redeliver_stop(self, msg: Stop) -> Pause:
         """A STOP arriving mid-training: requeue it for the run() loop and
@@ -751,13 +809,24 @@ class ProtocolClient:
                 inflight[data_id] = _Inflight(x=x, rng=rng,
                                               trace=[self.client_id],
                                               n=len(labels))
-                self.bus.publish(out_qs[n_fwd % len(out_qs)],
-                                 encode(Activation(
-                    data_id=data_id,
-                    data=_to_wire_tree(out, self.wire_dtype),
-                    labels=np.asarray(labels, np.int32),
-                    trace=[self.client_id], cluster=self.cluster,
-                    round_idx=self.fence)))
+                # double buffer: start the non-blocking device→host
+                # copy now and hand the encode+send to the async
+                # sender; this thread moves straight on to batch k+1's
+                # dispatch (or the next gradient) while batch k drains
+                _start_host_copy(out)
+                labels_np = np.asarray(labels, np.int32)
+                # bind fence/cluster NOW: the thunk may run after an
+                # abandoned round's _on_start moved them
+                self._publish_parts(
+                    out_qs[n_fwd % len(out_qs)],
+                    lambda out=out, labels_np=labels_np, d=data_id,
+                    fence=self.fence, cl=self.cluster:
+                        encode_parts(Activation(
+                            data_id=d,
+                            data=_to_wire_tree(out, self.wire_dtype),
+                            labels=labels_np, trace=[self.client_id],
+                            cluster=cl, round_idx=fence),
+                            self._chunk_bytes))
                 n_fwd += 1
                 if next_item is None:
                     exhausted = True
@@ -820,13 +889,16 @@ class ProtocolClient:
                     self.trainable, self.opt_state, gt)
                 self.num_samples += ent.n   # see _train_first
                 origin = ent.trace[-1]
-                self.bus.publish(
+                _start_host_copy(gx)
+                self._publish_parts(
                     gradient_queue(self.stage - 1, origin),
-                    encode(Gradient(data_id=g.data_id,
-                                    data=_to_wire_tree(
-                                        gx, self.wire_dtype),
-                                    trace=ent.trace[:-1],
-                                    round_idx=self.fence)))
+                    lambda gx=gx, d=g.data_id, tr=ent.trace[:-1],
+                    fence=self.fence:
+                        encode_parts(Gradient(
+                            data_id=d,
+                            data=_to_wire_tree(gx, self.wire_dtype),
+                            trace=tr, round_idx=fence),
+                            self._chunk_bytes))
                 continue
             raw = self.bus.get(in_q, timeout=0.0005)
             if raw is None:
@@ -847,11 +919,18 @@ class ProtocolClient:
             inflight[act.data_id] = _Inflight(x=x, rng=rng,
                                               trace=list(act.trace),
                                               n=len(act.labels))
-            self.bus.publish(out_qs[n_fwd % len(out_qs)], encode(Activation(
-                data_id=act.data_id,
-                data=_to_wire_tree(out, self.wire_dtype),
-                labels=act.labels, trace=list(act.trace) + [self.client_id],
-                cluster=self.cluster, round_idx=self.fence)))
+            _start_host_copy(out)
+            self._publish_parts(
+                out_qs[n_fwd % len(out_qs)],
+                lambda out=out, act=act, fence=self.fence,
+                cl=self.cluster:
+                    encode_parts(Activation(
+                        data_id=act.data_id,
+                        data=_to_wire_tree(out, self.wire_dtype),
+                        labels=act.labels,
+                        trace=list(act.trace) + [self.client_id],
+                        cluster=cl, round_idx=fence),
+                        self._chunk_bytes))
             n_fwd += 1
 
     def _train_last(self) -> Pause:
@@ -1029,21 +1108,24 @@ class ProtocolClient:
         self.trainable, self.opt_state = r.apply_update(
             self.trainable, self.opt_state, gt)
         self.num_samples += int(sum(sizes))
+        _start_host_copy(gx)
         off = 0
         for act, n in zip(window, sizes):
             # slice the raw cotangent, THEN wire-encode the part:
             # int8 wrapper leaves don't slice, and per-part quantization
             # scales are tighter than one window-wide scale anyway
-            part = _to_wire_tree(
-                jax.tree_util.tree_map(lambda a: a[off:off + n], gx),
-                self.wire_dtype)
+            gx_part = jax.tree_util.tree_map(
+                lambda a, off=off, n=n: a[off:off + n], gx)
             off += n
             origin = act.trace[-1]
-            self.bus.publish(
+            self._publish_parts(
                 gradient_queue(self.stage - 1, origin),
-                encode(Gradient(data_id=act.data_id, data=part,
-                                trace=list(act.trace)[:-1],
-                                round_idx=self.fence)))
+                lambda gx_part=gx_part, act=act, fence=self.fence:
+                    encode_parts(Gradient(
+                        data_id=act.data_id,
+                        data=_to_wire_tree(gx_part, self.wire_dtype),
+                        trace=list(act.trace)[:-1], round_idx=fence),
+                        self._chunk_bytes))
 
 
 def main(argv=None):
@@ -1061,6 +1143,8 @@ def main(argv=None):
                     help="path to profiling.json (optional)")
     args = ap.parse_args(argv)
     cfg = from_yaml(args.config)
+    from split_learning_tpu.platform import apply_compile_cache
+    apply_compile_cache(cfg.compile_cache_dir)
     profile = None
     if args.profile:
         import json

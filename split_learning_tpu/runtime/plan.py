@@ -211,7 +211,7 @@ def _cluster_cuts(cfg: Config, cluster_id: int, stage1_regs: list,
     # will really ship (non-float extras like masks are negligible)
     wire_factor = {"float32": 1.0, "float16": 0.5,
                    "bfloat16": 0.5, "int8": 0.25}[
-                       cfg.transport.wire_dtype]
+                       cfg.transport.wire_dtype_normalized]
     size_data = [s * wire_factor for s in profs[0]["size_data"]]
     # later-stage devices are unprofiled at the server (the reference also
     # only keeps stage-1 size_data — src/Server.py:115-117); mirror group 1

@@ -21,14 +21,23 @@ Queue naming keeps the reference topology so the protocol surface maps
 
 from __future__ import annotations
 
+import collections
 import dataclasses
 import io
+import math
 import pickle
 import struct
+import uuid
 import zlib
 from typing import Any
 
 import numpy as np
+
+try:                                   # bf16 wire payloads (jax dep)
+    import ml_dtypes as _ml_dtypes
+    _BF16 = np.dtype(_ml_dtypes.bfloat16)
+except ImportError:                    # pragma: no cover - jax ships it
+    _BF16 = None
 
 RPC_QUEUE = "rpc_queue"
 
@@ -212,27 +221,57 @@ class QuantLeaf:
     scale: float        # dequantization factor
 
 
+@dataclasses.dataclass
+class _TensorRef:
+    """Placeholder left in a TENSOR frame's pickled skeleton where an
+    ndarray leaf was lifted out into the raw out-of-band blob table
+    (index into it).  Wire-internal only — never a top-level message."""
+    idx: int
+
+
 CONTROL_TYPES = (Register, Ready, Notify, Update, Start, Syn, Pause, Stop)
 DATA_TYPES = (Activation, Gradient, EpochEnd)
+#: messages whose ndarray payloads ride the zero-copy TENSOR framing
+#: (the high-volume data plane + the round's weight upload); control
+#: messages keep the pickled frame — their payloads are small and their
+#: schema churns more
+TENSOR_TYPES = (Activation, Gradient, Update)
 _TYPE_BY_NAME = {t.__name__: t for t in CONTROL_TYPES + DATA_TYPES}
 #: nested wire-format helpers (never valid as a top-level message)
-_WIRE_HELPERS = {"QuantLeaf": QuantLeaf}
+_WIRE_HELPERS = {"QuantLeaf": QuantLeaf, "_TensorRef": _TensorRef}
 
 
 # --------------------------------------------------------------------------
 # serialization
 # --------------------------------------------------------------------------
-# Arrays are framed out-of-band (np.save) and the remainder pickled; a
-# restricted unpickler only admits protocol dataclasses + builtins, unlike
-# the reference's bare pickle.loads of broker bytes (SURVEY.md §1 L0).
+# Three frame families, dispatched on a 4-byte magic:
 #
-# Every frame is checksummed: ``MAGIC | crc32(body) | body``.  A corrupt
-# or truncated frame raises :class:`CorruptFrame` BEFORE any unpickling —
+# * ``SLT1`` — pickled frame: ``MAGIC | crc32(body) | pickle(body)``.
+#   Control messages only; a restricted unpickler admits protocol
+#   dataclasses + builtins, unlike the reference's bare pickle.loads of
+#   broker bytes (SURVEY.md §1 L0).
+# * ``SLT2`` — zero-copy TENSOR frame for the data plane
+#   (Activation/Gradient/Update): every ndarray leaf is lifted out of
+#   the message into a raw out-of-band blob with a fixed binary header
+#   (dtype code, flags, shape, crc32, byte length) and decoded with
+#   ``np.frombuffer`` straight off the received buffer — no pickle
+#   byte-shuffling on the hot path, and the (tiny) pickled skeleton
+#   holds only ``_TensorRef`` placeholders.
+# * ``SLTC`` — chunk frame: a frame larger than the chunk cap is split
+#   into crc'd parts (``encode_parts``) that a :class:`FrameAssembler`
+#   reassembles, so one huge UPDATE can't trip the broker's frame cap.
+#
+# Every family is checksummed end to end: a corrupt or truncated frame
+# raises :class:`CorruptFrame` BEFORE any unpickling or np.frombuffer —
 # bit-rot on the wire (or an injected chaos fault) must never reach the
 # unpickler, whose failure modes on garbage are arbitrary exceptions deep
-# inside numpy reconstruction.
+# inside numpy reconstruction.  In the TENSOR frame the outer crc covers
+# the headers + skeleton and each blob carries its OWN crc, so every
+# byte is covered exactly once (no double hashing of bulk data).
 
 FRAME_MAGIC = b"SLT1"
+TENSOR_MAGIC = b"SLT2"
+CHUNK_MAGIC = b"SLTC"
 _HDR_LEN = len(FRAME_MAGIC) + 4
 
 
@@ -270,26 +309,282 @@ class _SafeUnpickler(pickle.Unpickler):
             f"disallowed class in protocol message: {module}.{name}")
 
 
-def encode(msg) -> bytes:
+def encode_pickled(msg) -> bytes:
+    """Legacy pickled frame (``SLT1``) — still what control messages
+    use, and kept callable on data messages so the fp32 wire-parity
+    test can diff the two framings."""
     if type(msg).__name__ not in _TYPE_BY_NAME:
         raise TypeError(f"not a protocol message: {type(msg)!r}")
     body = pickle.dumps(msg, protocol=pickle.HIGHEST_PROTOCOL)
     return FRAME_MAGIC + struct.pack(">I", zlib.crc32(body)) + body
 
 
-def decode(raw: bytes):
-    if len(raw) < _HDR_LEN or raw[:len(FRAME_MAGIC)] != FRAME_MAGIC:
-        raise CorruptFrame(
-            f"protocol frame missing magic/header ({len(raw)} bytes)")
+def _decode_pickled(raw: bytes):
     (want,) = struct.unpack_from(">I", raw, len(FRAME_MAGIC))
     body = raw[_HDR_LEN:]
     if zlib.crc32(body) != want:
         raise CorruptFrame("protocol frame checksum mismatch "
                            f"({len(raw)} bytes)")
     msg = _SafeUnpickler(io.BytesIO(body)).load()
-    # wire helpers (QuantLeaf) are only valid NESTED in a payload — a
-    # bare one must fail here, not as an AttributeError in a hot loop
+    # wire helpers (QuantLeaf/_TensorRef) are only valid NESTED in a
+    # payload — a bare one must fail here, not as an AttributeError in
+    # a hot loop
     if not isinstance(msg, CONTROL_TYPES + DATA_TYPES):
         raise pickle.UnpicklingError(
             f"not a protocol message: {type(msg).__name__}")
     return msg
+
+
+# -- TENSOR frames ----------------------------------------------------------
+
+#: dtype code table — the fixed vocabulary of raw-blob payloads.  bf16
+#: is a first-class code (the wire default for activations/gradients);
+#: anything outside the table (object arrays, exotic dtypes) stays in
+#: the pickled skeleton, which the restricted unpickler still guards.
+_DTYPE_BY_CODE: dict[int, np.dtype] = {
+    1: np.dtype(np.float32), 2: np.dtype(np.float64),
+    3: np.dtype(np.float16), 5: np.dtype(np.int8),
+    6: np.dtype(np.int16), 7: np.dtype(np.int32),
+    8: np.dtype(np.int64), 9: np.dtype(np.uint8),
+    10: np.dtype(np.uint16), 11: np.dtype(np.uint32),
+    12: np.dtype(np.uint64), 13: np.dtype(np.bool_),
+}
+if _BF16 is not None:
+    _DTYPE_BY_CODE[4] = _BF16
+_CODE_BY_DTYPE = {dt: c for c, dt in _DTYPE_BY_CODE.items()}
+
+#: per-tensor fixed header: dtype code, flags (reserved), ndim,
+#: crc32(raw bytes), raw byte length — shape dims (u64 each) follow
+_THDR = struct.Struct(">BBHIQ")
+_MAX_NDIM = 32
+_MAX_TENSORS = 1 << 20
+
+
+def _blob(a: np.ndarray):
+    """Contiguous little-endian buffer view of one array (no copy when
+    the array already is one)."""
+    a = np.ascontiguousarray(a)
+    if a.dtype.byteorder == ">":
+        a = a.astype(a.dtype.newbyteorder("<"))
+    try:
+        return a, memoryview(a).cast("B")
+    except (TypeError, ValueError):   # dtype without buffer support
+        return a, a.tobytes()
+
+
+def _encode_tensor(msg) -> bytes:
+    tensors: list = []
+
+    def strip(o):
+        if isinstance(o, np.ndarray) and o.dtype in _CODE_BY_DTYPE:
+            tensors.append(o)
+            return _TensorRef(len(tensors) - 1)
+        if isinstance(o, QuantLeaf):
+            return QuantLeaf(q=strip(o.q), scale=o.scale)
+        if isinstance(o, dict):
+            return {k: strip(v) for k, v in o.items()}
+        if isinstance(o, list):
+            return [strip(v) for v in o]
+        if isinstance(o, tuple):
+            return tuple(strip(v) for v in o)
+        return o
+
+    skel = type(msg)(**{f.name: strip(getattr(msg, f.name))
+                        for f in dataclasses.fields(msg)})
+    skel_bytes = pickle.dumps(skel, protocol=pickle.HIGHEST_PROTOCOL)
+
+    headers: list[bytes] = []
+    blobs: list = []
+    for a in tensors:
+        a, buf = _blob(a)
+        headers.append(
+            _THDR.pack(_CODE_BY_DTYPE[a.dtype], 0, a.ndim,
+                       zlib.crc32(buf), a.nbytes)
+            + struct.pack(f">{a.ndim}Q", *a.shape))
+        blobs.append(buf)
+    meta = (struct.pack(">I", len(tensors)) + b"".join(headers)
+            + struct.pack(">I", len(skel_bytes)) + skel_bytes)
+    return b"".join([TENSOR_MAGIC, struct.pack(">I", zlib.crc32(meta)),
+                     meta, *blobs])
+
+
+def _decode_tensor(raw: bytes):
+    view = memoryview(raw)
+    try:
+        (want,) = struct.unpack_from(">I", raw, 4)
+        off = 8
+        (n_tensors,) = struct.unpack_from(">I", raw, off)
+        off += 4
+        if n_tensors > _MAX_TENSORS:
+            raise CorruptFrame(f"tensor frame claims {n_tensors} tensors")
+        hdrs = []
+        for _ in range(n_tensors):
+            code, flags, ndim, bcrc, nbytes = _THDR.unpack_from(raw, off)
+            off += _THDR.size
+            if ndim > _MAX_NDIM:
+                raise CorruptFrame(f"tensor frame claims ndim={ndim}")
+            shape = struct.unpack_from(f">{ndim}Q", raw, off)
+            off += 8 * ndim
+            hdrs.append((code, shape, bcrc, nbytes))
+        (skel_len,) = struct.unpack_from(">I", raw, off)
+        off += 4
+        if off + skel_len > len(raw):
+            raise CorruptFrame("tensor frame skeleton truncated")
+        skel = raw[off:off + skel_len]
+        off += skel_len
+    except struct.error as e:
+        raise CorruptFrame(f"tensor frame header truncated: {e}") from None
+    # integrity BEFORE np.frombuffer / unpickling: meta (headers +
+    # skeleton) under the outer crc, each raw blob under its own
+    if zlib.crc32(view[8:off]) != want:
+        raise CorruptFrame("tensor frame meta checksum mismatch "
+                           f"({len(raw)} bytes)")
+    if len(raw) - off != sum(h[3] for h in hdrs):
+        raise CorruptFrame("tensor frame blob region length mismatch")
+    arrays = []
+    for code, shape, bcrc, nbytes in hdrs:
+        dt = _DTYPE_BY_CODE.get(code)
+        if dt is None:
+            raise CorruptFrame(f"unknown tensor dtype code {code}")
+        count, rem = divmod(nbytes, dt.itemsize)
+        if rem or math.prod(shape) != count:
+            raise CorruptFrame("tensor header shape/length mismatch")
+        if zlib.crc32(view[off:off + nbytes]) != bcrc:
+            raise CorruptFrame("tensor blob checksum mismatch")
+        arrays.append(np.frombuffer(raw, dtype=dt, count=count,
+                                    offset=off).reshape(shape))
+        off += nbytes
+    msg = _SafeUnpickler(io.BytesIO(skel)).load()
+    if not isinstance(msg, TENSOR_TYPES):
+        raise pickle.UnpicklingError(
+            f"not a tensor-frame message: {type(msg).__name__}")
+
+    def fill(o):
+        if isinstance(o, _TensorRef):
+            if not 0 <= o.idx < len(arrays):
+                raise CorruptFrame(f"tensor ref {o.idx} out of range")
+            return arrays[o.idx]
+        if isinstance(o, QuantLeaf):
+            return QuantLeaf(q=fill(o.q), scale=o.scale)
+        if isinstance(o, dict):
+            return {k: fill(v) for k, v in o.items()}
+        if isinstance(o, list):
+            return [fill(v) for v in o]
+        if isinstance(o, tuple):
+            return tuple(fill(v) for v in o)
+        return o
+
+    return type(msg)(**{f.name: fill(getattr(msg, f.name))
+                        for f in dataclasses.fields(msg)})
+
+
+def encode(msg) -> bytes:
+    """One complete frame: TENSOR framing for the data-plane payload
+    types, the pickled frame for everything else."""
+    if type(msg).__name__ not in _TYPE_BY_NAME:
+        raise TypeError(f"not a protocol message: {type(msg)!r}")
+    if isinstance(msg, TENSOR_TYPES):
+        return _encode_tensor(msg)
+    return encode_pickled(msg)
+
+
+def decode(raw: bytes):
+    """Decode one COMPLETE frame (either framing).  Chunk frames only
+    make sense inside a :class:`FrameAssembler`."""
+    if len(raw) < _HDR_LEN:
+        raise CorruptFrame(
+            f"protocol frame missing magic/header ({len(raw)} bytes)")
+    magic = raw[:4]
+    if magic == TENSOR_MAGIC:
+        return _decode_tensor(raw)
+    if magic == CHUNK_MAGIC:
+        raise CorruptFrame("chunk frame outside a FrameAssembler")
+    if magic != FRAME_MAGIC:
+        raise CorruptFrame(
+            f"protocol frame missing magic/header ({len(raw)} bytes)")
+    return _decode_pickled(raw)
+
+
+# -- chunking ---------------------------------------------------------------
+
+#: one frame's on-the-wire size cap before it is split into SLTC chunks
+#: (config: ``transport.chunk-mb``).  Sized well under the broker's
+#: 8 GiB frame sanity cap so a giant UPDATE can't kill the connection.
+DEFAULT_CHUNK_BYTES = 512 << 20
+_CHUNK_HDR = 16 + 8                      # uuid | u32 idx | u32 total
+_MAX_CHUNKS = 1 << 16
+
+
+def encode_parts(msg, max_bytes: int | None = None) -> list[bytes]:
+    """Encode into one or more publishable frames: a single complete
+    frame when it fits ``max_bytes``, else crc'd SLTC chunks carrying a
+    shared message id.  Per-queue FIFO (which every transport layer
+    preserves, reliable included) is what keeps a message's chunks
+    together; out-of-order arrival within the id is still handled."""
+    frame = encode(msg)
+    cap = int(max_bytes) if max_bytes else DEFAULT_CHUNK_BYTES
+    if len(frame) <= cap:
+        return [frame]
+    mid = uuid.uuid4().bytes
+    total = -(-len(frame) // cap)
+    if total > _MAX_CHUNKS:
+        raise ValueError(f"frame of {len(frame)} bytes needs {total} "
+                         f"chunks (cap {_MAX_CHUNKS})")
+    parts = []
+    for idx in range(total):
+        body = (mid + struct.pack(">II", idx, total)
+                + frame[idx * cap:(idx + 1) * cap])
+        parts.append(CHUNK_MAGIC + struct.pack(">I", zlib.crc32(body))
+                     + body)
+    return parts
+
+
+class FrameAssembler:
+    """Per-consumer reassembly of SLTC chunk streams.
+
+    ``feed`` returns the decoded message once complete (immediately for
+    unchunked frames), or None while a chunked message is still
+    partial.  Bounded: at most ``max_pending`` partial messages are
+    held — on an at-most-once transport a dropped chunk strands its
+    message, and the stalest partial is evicted rather than leaking.
+    Not thread-safe: give each consumer thread its own assembler (same
+    ownership rule as a transport connection)."""
+
+    def __init__(self, max_pending: int = 64):
+        self._max_pending = max_pending
+        self._pending: collections.OrderedDict = collections.OrderedDict()
+        # mids whose partial was evicted: their LATE chunks must be
+        # dropped, not allowed to recreate a can-never-complete partial
+        # that would occupy a slot and evict further live messages
+        self._evicted: collections.OrderedDict = collections.OrderedDict()
+
+    def feed(self, raw: bytes):
+        if raw[:4] != CHUNK_MAGIC:
+            return decode(raw)
+        if len(raw) < _HDR_LEN + _CHUNK_HDR:
+            raise CorruptFrame(f"chunk frame truncated ({len(raw)} bytes)")
+        (want,) = struct.unpack_from(">I", raw, 4)
+        body = memoryview(raw)[8:]
+        if zlib.crc32(body) != want:
+            raise CorruptFrame("chunk frame checksum mismatch")
+        mid = bytes(body[:16])
+        idx, total = struct.unpack_from(">II", body, 16)
+        if not 0 < total <= _MAX_CHUNKS or idx >= total:
+            raise CorruptFrame(f"chunk index {idx}/{total} out of range")
+        if mid in self._evicted:
+            return None
+        ent = self._pending.get(mid)
+        if ent is None:
+            ent = self._pending[mid] = {"total": total, "parts": {}}
+            while len(self._pending) > self._max_pending:
+                dead, _ = self._pending.popitem(last=False)
+                self._evicted[dead] = True
+                while len(self._evicted) > 4 * self._max_pending:
+                    self._evicted.popitem(last=False)
+        if ent["total"] != total:
+            raise CorruptFrame("chunk total mismatch within message")
+        ent["parts"].setdefault(idx, bytes(body[_CHUNK_HDR:]))
+        if len(ent["parts"]) < total:
+            return None
+        del self._pending[mid]
+        return decode(b"".join(ent["parts"][i] for i in range(total)))

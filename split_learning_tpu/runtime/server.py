@@ -44,8 +44,8 @@ from split_learning_tpu.runtime.plan import (
     ClusterPlan, Registration, plan_clusters,
 )
 from split_learning_tpu.runtime.protocol import (
-    Notify, Pause, Ready, Register, Start, Stop, Syn, Update,
-    decode, encode, reply_queue, RPC_QUEUE,
+    FrameAssembler, Notify, Pause, Ready, Register, Start, Stop, Syn,
+    Update, encode, reply_queue, RPC_QUEUE,
 )
 
 
@@ -84,11 +84,14 @@ class ProtocolContext(MeshContext):
                 "shard client models yet")
         self.bus = transport
         from split_learning_tpu.runtime.trace import (
-            default_fault_counters,
+            default_fault_counters, default_wire_counters,
         )
         self.faults = getattr(transport, "faults", None) \
             or default_fault_counters
+        self.wire = getattr(transport, "wire", None) \
+            or default_wire_counters
         self._fault_base: dict = {}   # snapshot at the last round log
+        self._assembler = FrameAssembler()   # chunked UPDATE reassembly
         self.log = logger or Logger(cfg.log_path, debug=cfg.debug,
                                     console=False, name="server")
         self.client_timeout = client_timeout
@@ -128,13 +131,18 @@ class ProtocolContext(MeshContext):
         raw = self.bus.get(RPC_QUEUE, timeout=timeout)
         if raw is None:
             return False
+        t0 = time.perf_counter()
         try:
-            msg = decode(raw)
+            msg = self._assembler.feed(raw)
         except Exception as e:  # noqa: BLE001 — corrupt frame: a flipped
             # bit on rpc_queue must cost one message, not the server
             self.faults.inc("corrupt_rejected")
             self.log.warning(f"dropping undecodable rpc frame: {e}")
             return True
+        finally:
+            self.wire.add_decode(time.perf_counter() - t0)
+        if msg is None:
+            return True   # chunk of a still-partial frame
         if isinstance(msg, Register):
             if (self.cfg.topology.elastic_join
                     and not 1 <= msg.stage <= self.cfg.num_stages):
@@ -581,11 +589,25 @@ class ProtocolContext(MeshContext):
             kind = ("reply" if q.startswith("reply_")
                     else "rpc" if q == RPC_QUEUE else "data")
             totals[kind] += n
+        # per-process wire counters ride the same record (bytes in/out
+        # by plane, encode/decode seconds, async sender high-water
+        # mark) and the end-of-round log line, so the wire's cost is
+        # auditable next to its volume
+        wsnap = {k: v for k, v in self.wire.snapshot().items() if v}
         self.log.metric(kind="wire", gen=self._cur_gen,
                         round_idx=round_idx, cluster=plan.cluster_id,
                         cumulative_reply_bytes=totals["reply"],
                         cumulative_rpc_bytes=totals["rpc"],
-                        cumulative_data_bytes=totals["data"])
+                        cumulative_data_bytes=totals["data"],
+                        **wsnap)
+        if wsnap:
+            self.log.info(
+                "round wire (cumulative): "
+                f"out={wsnap.get('bytes_out_total', 0)}B "
+                f"in={wsnap.get('bytes_in_total', 0)}B "
+                f"encode={wsnap.get('encode_s', 0):.3f}s "
+                f"decode={wsnap.get('decode_s', 0):.3f}s "
+                f"sendq_hwm={wsnap.get('send_queue_hwm', 0)}")
         # failure/recovery observability: CUMULATIVE fault counters
         # (drops, timeouts, redeliveries, dedup_hits, reconnects, ...)
         # from this process's transport stack — chaos runs must be
@@ -610,6 +632,11 @@ class ProtocolContext(MeshContext):
         for reg in self.registrations:
             self.bus.publish(reply_queue(reg.client_id),
                              encode(Stop(reason=reason)))
+        # the STOP fan-out must actually leave this process before the
+        # caller tears the broker down
+        flush = getattr(self.bus, "flush", None)
+        if flush is not None:
+            flush(timeout=10.0)
         self.log.sent(f"STOP -> all ({reason})")
 
 
@@ -672,6 +699,8 @@ def main(argv=None):
                          "(default: --client_timeout)")
     args = ap.parse_args(argv)
     cfg = from_yaml(args.config)
+    from split_learning_tpu.platform import apply_compile_cache
+    apply_compile_cache(cfg.compile_cache_dir)
     broker = None
     if args.broker and cfg.transport.kind == "tcp":
         broker = Broker(cfg.transport.host, cfg.transport.port)

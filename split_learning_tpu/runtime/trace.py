@@ -58,6 +58,88 @@ class FaultCounters:
 default_fault_counters = FaultCounters()
 
 
+class WireCounters:
+    """Thread-safe wire-traffic counters (same monotonic contract as
+    :class:`FaultCounters`: values never reset, consumers diff
+    successive snapshots): bytes sent/received per queue, cumulative
+    encode/decode seconds, and the async sender-queue high-water mark.
+    Fed by the transport stack (``runtime/bus.py AsyncTransport``) and
+    the protocol codec call sites; surfaced into ``metrics.jsonl`` by
+    the server's end-of-round summary and each client's round-end
+    record."""
+
+    #: queue-name prefixes classified as data-plane traffic
+    _DATA_PREFIXES = ("intermediate_queue", "gradient_queue")
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._bytes_out: collections.Counter = collections.Counter()
+        self._bytes_in: collections.Counter = collections.Counter()
+        self._msgs_out = 0
+        self._msgs_in = 0
+        self._encode_s = 0.0
+        self._encode_n = 0
+        self._decode_s = 0.0
+        self._decode_n = 0
+        self._send_queue_hwm = 0
+
+    def count_out(self, queue: str, nbytes: int) -> None:
+        with self._lock:
+            self._bytes_out[queue] += nbytes
+            self._msgs_out += 1
+
+    def count_in(self, queue: str, nbytes: int) -> None:
+        with self._lock:
+            self._bytes_in[queue] += nbytes
+            self._msgs_in += 1
+
+    def add_encode(self, seconds: float) -> None:
+        with self._lock:
+            self._encode_s += seconds
+            self._encode_n += 1
+
+    def add_decode(self, seconds: float) -> None:
+        with self._lock:
+            self._decode_s += seconds
+            self._decode_n += 1
+
+    def note_send_depth(self, depth: int) -> None:
+        with self._lock:
+            if depth > self._send_queue_hwm:
+                self._send_queue_hwm = depth
+
+    def per_queue(self) -> dict:
+        with self._lock:
+            return {"bytes_out": dict(self._bytes_out),
+                    "bytes_in": dict(self._bytes_in)}
+
+    def _data_bytes(self, counter) -> int:
+        return sum(n for q, n in counter.items()
+                   if q.startswith(self._DATA_PREFIXES))
+
+    def snapshot(self) -> dict:
+        """Flat record for metrics.jsonl (zero-valued fields included —
+        callers prune)."""
+        with self._lock:
+            return {
+                "bytes_out_total": sum(self._bytes_out.values()),
+                "bytes_in_total": sum(self._bytes_in.values()),
+                "data_bytes_out": self._data_bytes(self._bytes_out),
+                "data_bytes_in": self._data_bytes(self._bytes_in),
+                "msgs_out": self._msgs_out,
+                "msgs_in": self._msgs_in,
+                "encode_s": round(self._encode_s, 6),
+                "encode_n": self._encode_n,
+                "decode_s": round(self._decode_s, 6),
+                "decode_n": self._decode_n,
+                "send_queue_hwm": self._send_queue_hwm,
+            }
+
+
+#: process-wide default, mirroring ``default_fault_counters``
+default_wire_counters = WireCounters()
+
+
 class StepTimer:
     """Accumulates wall-clock per named phase; device-fenced."""
 

@@ -430,10 +430,13 @@ def _round_cfg(tmp_path, log_dir, **over):
 
 def _run_cell(cfg, chaos_cfg=None, reliable=False, faults=None,
               crashable=(), server_timeout=300.0, ready_timeout=None,
-              server_transport=None):
+              server_transport=None, async_wrap=False):
     """One in-process deployment; per-client wrapper stacks; threads
     hosting a scripted ChaosCrash die like processes (their reliable
-    daemon stops too, the shared bus survives)."""
+    daemon stops too, the shared bus survives).  ``async_wrap`` adds
+    the AsyncTransport (background sender + prefetch) on top, the
+    make_runtime_transport production layering."""
+    from split_learning_tpu.runtime.bus import AsyncTransport
     from split_learning_tpu.runtime.client import ProtocolClient
     from split_learning_tpu.runtime.server import ProtocolServer
 
@@ -448,6 +451,8 @@ def _run_cell(cfg, chaos_cfg=None, reliable=False, faults=None,
         if reliable:
             t = ReliableTransport(t, sender=name, redeliver_s=0.1,
                                   faults=faults)
+        if async_wrap:
+            t = AsyncTransport(t, faults=faults)
         if t is not bus:
             stacks.append(t)
         return t
@@ -521,6 +526,35 @@ def test_chaos_round_bit_identical_to_fault_free(tmp_path):
     snap = faults.snapshot()
     assert snap.get("drops") and snap.get("redeliveries"), snap
     assert snap.get("duplicates") and snap.get("dedup_hits"), snap
+
+
+@pytest.mark.slow
+def test_chaos_round_bf16_zero_copy_async_bit_identical(tmp_path):
+    """PR-3 acceptance: the bf16 zero-copy TENSOR frames over the full
+    production stack (async sender/prefetch above reliable above chaos)
+    still mask drop + duplicate + corruption completely — a 3-client
+    round aggregates BIT-IDENTICAL to its own fault-free run, and every
+    corrupted raw tensor frame is caught by a frame crc before
+    np.frombuffer (the round would not be bit-identical otherwise)."""
+    cfg_a = _round_cfg(tmp_path, tmp_path / "async_a")
+    assert cfg_a.transport.wire_dtype_normalized == "bfloat16"  # default
+    base = _run_cell(cfg_a, async_wrap=True)
+
+    faults = FaultCounters()
+    cfg_b = _round_cfg(tmp_path, tmp_path / "async_b")
+    chaotic = _run_cell(
+        cfg_b,
+        chaos_cfg=_chaos(seed=4321, drop=0.25, duplicate=0.20,
+                         corrupt=0.15),
+        reliable=True, faults=faults, async_wrap=True)
+
+    assert chaotic.history[0].ok
+    assert chaotic.history[0].num_samples == base.history[0].num_samples
+    _assert_trees_identical(base.params, chaotic.params)
+    snap = faults.snapshot()
+    assert snap.get("drops") and snap.get("redeliveries"), snap
+    assert snap.get("duplicates") and snap.get("dedup_hits"), snap
+    assert snap.get("corruptions") and snap.get("corrupt_rejected"), snap
 
 
 @pytest.mark.slow
