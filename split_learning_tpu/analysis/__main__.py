@@ -1,0 +1,107 @@
+"""``python -m split_learning_tpu.analysis`` — the slcheck CLI.
+
+Runs the three analyzers (protocol conformance, jaxpr hot-path audit,
+concurrency lint) over the repo, subtracts the checked-in suppression
+baseline, and reports the rest.  Exit code 1 iff any non-baselined
+finding remains, so it slots straight into CI.
+
+    python -m split_learning_tpu.analysis                 # human output
+    python -m split_learning_tpu.analysis --format json   # machine
+    python -m split_learning_tpu.analysis --analyzers protocol,concurrency
+    python -m split_learning_tpu.analysis --no-trace      # AST-only (no jax)
+    python -m split_learning_tpu.analysis --validate-log app.log
+    python -m split_learning_tpu.analysis --write-baseline  # accept debt
+"""
+
+from __future__ import annotations
+
+import argparse
+import pathlib
+import sys
+
+from split_learning_tpu.analysis.findings import (
+    Baseline, Finding, render_human, render_json,
+)
+
+ANALYZERS = ("protocol", "jaxpr", "concurrency")
+
+
+def repo_root() -> pathlib.Path:
+    return pathlib.Path(__file__).resolve().parents[2]
+
+
+def run_analyzers(root: pathlib.Path, names=ANALYZERS,
+                  trace: bool = True) -> list[Finding]:
+    findings: list[Finding] = []
+    if "protocol" in names:
+        from split_learning_tpu.analysis import protocol_check
+        findings += protocol_check.run(root)
+    if "jaxpr" in names:
+        from split_learning_tpu.analysis import jaxpr_audit
+        findings += jaxpr_audit.run(root, trace=trace)
+    if "concurrency" in names:
+        from split_learning_tpu.analysis import concurrency
+        findings += concurrency.run(root)
+    return findings
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="slcheck",
+        description="Static analysis for the wire protocol, the "
+                    "compiled hot path and the transport threads.")
+    ap.add_argument("--format", choices=("human", "json"),
+                    default="human")
+    ap.add_argument("--analyzers", default=",".join(ANALYZERS),
+                    help="comma-separated subset of "
+                         f"{'/'.join(ANALYZERS)}")
+    ap.add_argument("--root", default=None,
+                    help="repo root (default: derived from the package)")
+    ap.add_argument("--baseline", default=None,
+                    help="suppression file (default: "
+                         "tools/slcheck_baseline.json under the root)")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="accept every current finding into the "
+                         "baseline instead of failing")
+    ap.add_argument("--no-trace", action="store_true",
+                    help="skip the jaxpr tracing pass (no jax import; "
+                         "AST checks still run)")
+    ap.add_argument("--validate-log", default=None, metavar="PATH",
+                    help="additionally replay a recorded app.log "
+                         "through the protocol-model trace validator")
+    args = ap.parse_args(argv)
+
+    root = pathlib.Path(args.root) if args.root else repo_root()
+    names = tuple(n.strip() for n in args.analyzers.split(",") if n)
+    for n in names:
+        if n not in ANALYZERS:
+            ap.error(f"unknown analyzer {n!r}")
+    findings = run_analyzers(root, names, trace=not args.no_trace)
+
+    if args.validate_log:
+        from split_learning_tpu.analysis.model import validate_log
+        text = pathlib.Path(args.validate_log).read_text()
+        findings += validate_log(text, source=args.validate_log)
+
+    baseline_path = (pathlib.Path(args.baseline) if args.baseline
+                     else root / "tools" / "slcheck_baseline.json")
+    baseline = Baseline.load(baseline_path)
+    if args.write_baseline:
+        # only a FULL run may prune: a partial analyzer set must not
+        # delete the other analyzers' accepted suppressions
+        full_run = set(names) == set(ANALYZERS) and not args.no_trace
+        baseline.save(findings, prune=full_run)
+        print(f"wrote {len(findings)} suppression(s) to "
+              f"{baseline_path}"
+              + ("" if full_run else " (partial run: existing "
+                 "suppressions kept)"))
+        return 0
+    new, suppressed = baseline.split(findings)
+    out = (render_json(new, suppressed) if args.format == "json"
+           else render_human(new, suppressed))
+    print(out)
+    return 1 if new else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

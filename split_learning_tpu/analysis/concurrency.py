@@ -1,0 +1,441 @@
+"""Concurrency lint for the transport threads.
+
+Builds the lock/thread graph of ``runtime/bus.py``, ``runtime/chaos.py``
+and ``broker.py`` from the AST — every ``threading.Lock`` /
+``Condition`` / ``Thread`` / ``Timer`` site (including ones created via
+the rank-named factories in :mod:`split_learning_tpu.analysis.locks`)
+— and checks:
+
+* **CL001** — lock acquisition order is globally consistent: the
+  held-lock -> acquired-lock nesting graph (direct nested ``with``
+  plus transitive same-class method calls) must be acyclic, and no
+  path may re-acquire a lock already held (non-reentrant deadlock);
+* **CL002** — no blocking call (socket I/O, ``time.sleep``, ``join``,
+  frame send/recv helpers) runs while a state lock is held.  A lock
+  whose assignment carries ``# slcheck: io-lock`` is exempt — it
+  exists to serialize an I/O resource (TcpTransport's single socket)
+  and blocking under it is its purpose.  ``cond.wait``/``wait_for``
+  under its own condition is always legal (it releases the lock);
+* **CL003** — every started thread/timer has a join/cancel shutdown
+  path in its owning class (direct ``attr.join()`` or a loop over the
+  list the thread is registered in);
+* **CL004** — ``wait``/``wait_for``/``notify``/``notify_all`` on a
+  condition only ever run inside a ``with`` of that same condition;
+* **CL005** — no call into the inner/wrapped transport
+  (``self.inner`` / ``self._side`` / ``self.src`` / ``self._store``)
+  while holding one's own state lock: the wrapper layering is the
+  cross-class lock order, and calling down while holding up is how
+  lock-order inversions between layers are born.
+
+The runtime twin of CL001 is the instrumented-lock mode
+(``SLCHECK_LOCKS=1``, :mod:`split_learning_tpu.analysis.locks`).
+"""
+
+from __future__ import annotations
+
+import ast
+import pathlib
+import re
+
+from split_learning_tpu.analysis.findings import Finding
+
+FILES = ("split_learning_tpu/runtime/bus.py",
+         "split_learning_tpu/runtime/chaos.py",
+         "split_learning_tpu/broker.py")
+
+_LOCK_CTORS = {"Lock", "RLock", "Condition", "make_lock",
+               "make_condition"}
+_THREAD_CTORS = {"Thread", "Timer"}
+_BLOCKING_ATTRS = {"sleep", "join", "recv", "sendall", "sendto",
+                   "accept", "connect", "create_connection", "flush",
+                   "result", "block_until_ready", "device_get"}
+_INNER_OBJECTS = {"inner", "_side", "src", "_store"}
+_ANNOT_RE = re.compile(r"#\s*slcheck:\s*(.+?)\s*$")
+
+
+def _ctor_name(node: ast.AST) -> str | None:
+    if isinstance(node, ast.Call):
+        f = node.func
+        if isinstance(f, ast.Attribute):
+            return f.attr
+        if isinstance(f, ast.Name):
+            return f.id
+    return None
+
+
+def _self_attr(node: ast.AST) -> str | None:
+    if isinstance(node, ast.Attribute) and isinstance(node.value,
+                                                      ast.Name) \
+            and node.value.id == "self":
+        return node.attr
+    return None
+
+
+class _ClassInfo:
+    def __init__(self, module: str, node: ast.ClassDef,
+                 source_lines: list[str]):
+        self.module = module
+        self.name = node.name
+        self.node = node
+        self.methods = {m.name: m for m in node.body
+                        if isinstance(m, ast.FunctionDef)}
+        # lock attrs: attr -> {"kind", "io", "alias"}
+        self.locks: dict[str, dict] = {}
+        # thread attrs + list-registered threads
+        self.thread_attrs: dict[str, int] = {}
+        self.thread_lists: dict[str, int] = {}
+        for m in self.methods.values():
+            for stmt in ast.walk(m):
+                if not isinstance(stmt, ast.Assign):
+                    continue
+                ctor = _ctor_name(stmt.value)
+                tgt = (stmt.targets[0] if len(stmt.targets) == 1
+                       else None)
+                attr = _self_attr(tgt) if tgt is not None else None
+                local = (tgt.id if isinstance(tgt, ast.Name) else None)
+                if ctor in _LOCK_CTORS and attr:
+                    line = source_lines[stmt.lineno - 1] \
+                        if stmt.lineno <= len(source_lines) else ""
+                    note = _ANNOT_RE.search(line)
+                    alias = None
+                    call = stmt.value
+                    if isinstance(call, ast.Call):
+                        for a in call.args:
+                            sub = _self_attr(a)
+                            if sub:   # Condition(self._lock) aliasing
+                                alias = sub
+                    self.locks[attr] = {
+                        "kind": ctor,
+                        "io": bool(note and "io-lock" in note.group(1)),
+                        "alias": alias,
+                        "line": stmt.lineno,
+                    }
+                elif ctor in _THREAD_CTORS:
+                    if attr:
+                        self.thread_attrs[attr] = stmt.lineno
+                    elif local is not None:
+                        # registered into a list attr?
+                        reg = None
+                        for sub in ast.walk(m):
+                            if (isinstance(sub, ast.Call)
+                                    and isinstance(sub.func,
+                                                   ast.Attribute)
+                                    and sub.func.attr == "append"
+                                    and sub.args
+                                    and isinstance(sub.args[0],
+                                                   ast.Name)
+                                    and sub.args[0].id == local):
+                                reg = _self_attr(sub.func.value)
+                        if reg:
+                            self.thread_lists[reg] = stmt.lineno
+                        else:
+                            self.thread_attrs[f"<local {local}>"] = \
+                                stmt.lineno
+
+    def canonical(self, attr: str) -> str:
+        info = self.locks.get(attr)
+        if info and info["alias"] and info["alias"] in self.locks:
+            return info["alias"]
+        return attr
+
+    def is_lock(self, attr: str) -> bool:
+        return attr in self.locks
+
+    def is_io(self, attr: str) -> bool:
+        info = self.locks.get(self.canonical(attr)) \
+            or self.locks.get(attr)
+        return bool(info and info["io"]) or bool(
+            self.locks.get(attr, {}).get("io"))
+
+
+def _method_lock_sets(cls: _ClassInfo, depth: int = 3
+                      ) -> dict[str, set[str]]:
+    """attr-canonical locks each method may acquire (transitive)."""
+    cache: dict[str, set[str]] = {}
+
+    def compute(name: str, seen: frozenset) -> set[str]:
+        if name in cache:
+            return cache[name]
+        if name in seen or name not in cls.methods:
+            return set()
+        acquired: set[str] = set()
+        for node in ast.walk(cls.methods[name]):
+            if isinstance(node, ast.With):
+                for item in node.items:
+                    attr = _self_attr(item.context_expr)
+                    if attr and cls.is_lock(attr):
+                        acquired.add(cls.canonical(attr))
+            elif isinstance(node, ast.Call):
+                f = node.func
+                if isinstance(f, ast.Attribute) \
+                        and _self_attr(f.value) is None \
+                        and isinstance(f.value, ast.Name) \
+                        and f.value.id == "self" \
+                        and f.attr in cls.methods:
+                    if len(seen) < depth:
+                        acquired |= compute(f.attr,
+                                            seen | {name})
+        cache[name] = acquired
+        return acquired
+
+    return {m: compute(m, frozenset()) for m in cls.methods}
+
+
+def _module_functions(tree: ast.Module) -> dict[str, ast.FunctionDef]:
+    return {n.name: n for n in tree.body
+            if isinstance(n, ast.FunctionDef)}
+
+
+def _blocks(node: ast.AST, mod_funcs: dict, seen: frozenset = frozenset()
+            ) -> str | None:
+    """Name of a blocking call reachable from ``node``, else None."""
+    for sub in ast.walk(node):
+        if not isinstance(sub, ast.Call):
+            continue
+        f = sub.func
+        if isinstance(f, ast.Attribute) and f.attr in _BLOCKING_ATTRS:
+            return ast.unparse(f)
+        if isinstance(f, ast.Name) and f.id in mod_funcs \
+                and f.id not in seen and len(seen) < 3:
+            hit = _blocks(mod_funcs[f.id], mod_funcs, seen | {f.id})
+            if hit:
+                return f"{f.id} -> {hit}"
+    return None
+
+
+class _RegionChecker(ast.NodeVisitor):
+    """Walks one method tracking the held-lock stack."""
+
+    def __init__(self, cls: _ClassInfo, method: ast.FunctionDef,
+                 mod_funcs: dict, rel: str,
+                 findings: list[Finding]):
+        self.cls = cls
+        self.method = method
+        self.mod_funcs = mod_funcs
+        self.rel = rel
+        self.findings = findings
+        self.stack: list[str] = []       # canonical lock attrs held
+        self.edges: set[tuple] = set()   # (held, acquired)
+
+    def _where(self) -> str:
+        return f"{self.cls.name}.{self.method.name}"
+
+    def visit_With(self, node: ast.With):
+        attrs = []
+        for item in node.items:
+            attr = _self_attr(item.context_expr)
+            if attr and self.cls.is_lock(attr):
+                attrs.append(self.cls.canonical(attr))
+        for attr in attrs:
+            if attr in self.stack:
+                self.findings.append(Finding(
+                    "CL001", self.rel, node.lineno, self._where(),
+                    f"re-acquires non-reentrant lock self.{attr} "
+                    "already held on this path"))
+            for held in self.stack:
+                self.edges.add((f"{self.cls.name}.{held}",
+                                f"{self.cls.name}.{attr}"))
+            self.stack.append(attr)
+        for stmt in node.body:
+            self.visit(stmt)
+        for attr in attrs:
+            self.stack.pop()
+
+    def visit_Call(self, node: ast.Call):
+        if self.stack:
+            held = self.stack[-1]
+            # exemptions consider the WHOLE stack, not the innermost
+            # lock: blocking inside `with io_lock:` nested under a
+            # still-held state lock blocks the state lock just the same
+            non_io = [a for a in self.stack
+                      if not self.cls.is_io(a)]
+            io_held = not non_io
+            f = node.func
+            # CL005: descending into the wrapped transport under a lock
+            if isinstance(f, ast.Attribute):
+                base = f.value
+                base_attr = _self_attr(base)
+                if base_attr in _INNER_OBJECTS and not io_held:
+                    self.findings.append(Finding(
+                        "CL005", self.rel, node.lineno, self._where(),
+                        f"calls self.{base_attr}.{f.attr} while "
+                        f"holding self.{non_io[-1]}: wrapper locks "
+                        "must be released before descending a "
+                        "transport layer"))
+                # waiting on the innermost condition releases IT — but
+                # any OUTER state lock stays held through the wait
+                if f.attr in ("wait", "wait_for") \
+                        and base_attr is not None \
+                        and self.cls.canonical(base_attr) == held:
+                    outer_non_io = [a for a in self.stack[:-1]
+                                    if not self.cls.is_io(a)]
+                    if outer_non_io:
+                        self.findings.append(Finding(
+                            "CL002", self.rel, node.lineno,
+                            self._where(),
+                            f"self.{base_attr}.{f.attr}() waits while "
+                            f"outer lock self.{outer_non_io[-1]} stays "
+                            "held"))
+                    return
+            # CL001 transitive: self-method that acquires locks
+            if isinstance(f, ast.Attribute) \
+                    and isinstance(f.value, ast.Name) \
+                    and f.value.id == "self" \
+                    and f.attr in self.cls.methods:
+                for acq in self._lock_sets.get(f.attr, set()):
+                    if acq in self.stack:
+                        self.findings.append(Finding(
+                            "CL001", self.rel, node.lineno,
+                            self._where(),
+                            f"self.{f.attr}() re-acquires held lock "
+                            f"self.{acq}"))
+                    for held2 in self.stack:
+                        self.edges.add(
+                            (f"{self.cls.name}.{held2}",
+                             f"{self.cls.name}.{acq}"))
+            # CL002: blocking work while any non-io lock is held
+            if not io_held:
+                hit = _blocks(node, self.mod_funcs)
+                if hit:
+                    self.findings.append(Finding(
+                        "CL002", self.rel, node.lineno, self._where(),
+                        f"blocking call {hit} while holding "
+                        f"self.{non_io[-1]}"))
+                    return   # one finding per call expression
+        self.generic_visit(node)
+
+    _lock_sets: dict[str, set[str]] = {}
+
+
+def _check_cond_discipline(cls: _ClassInfo, rel: str,
+                           findings: list[Finding]) -> None:
+    conds = {a for a, info in cls.locks.items()
+             if info["kind"] in ("Condition", "make_condition")}
+    if not conds:
+        return
+
+    class V(ast.NodeVisitor):
+        def __init__(self, method):
+            self.method = method
+            self.held: list[str] = []
+
+        def visit_With(self, node):
+            attrs = []
+            for item in node.items:
+                a = _self_attr(item.context_expr)
+                if a:
+                    attrs.append(a)
+            self.held += attrs
+            for stmt in node.body:
+                self.visit(stmt)
+            del self.held[len(self.held) - len(attrs):]
+
+        def visit_Call(self, node):
+            f = node.func
+            if isinstance(f, ast.Attribute) and f.attr in (
+                    "wait", "wait_for", "notify", "notify_all"):
+                attr = _self_attr(f.value)
+                if attr in conds and attr not in self.held:
+                    findings.append(Finding(
+                        "CL004", rel, node.lineno,
+                        f"{cls.name}.{self.method}",
+                        f"self.{attr}.{f.attr}() outside 'with "
+                        f"self.{attr}:'"))
+            self.generic_visit(node)
+
+    for name, m in cls.methods.items():
+        V(name).visit(m)
+
+
+def _check_threads(cls: _ClassInfo, rel: str,
+                   findings: list[Finding]) -> None:
+    src = ast.unparse(cls.node)
+    for attr, lineno in cls.thread_attrs.items():
+        if attr.startswith("<local"):
+            findings.append(Finding(
+                "CL003", rel, lineno, cls.name,
+                f"thread {attr} is started but never registered for "
+                "join/cancel"))
+            continue
+        if not re.search(rf"self\.{re.escape(attr)}\.(join|cancel)\(",
+                         src):
+            findings.append(Finding(
+                "CL003", rel, lineno, cls.name,
+                f"thread self.{attr} has no join/cancel shutdown "
+                f"path in {cls.name}"))
+    for lst, lineno in cls.thread_lists.items():
+        joined = False
+        for node in ast.walk(cls.node):
+            if isinstance(node, ast.For) \
+                    and lst in ast.unparse(node.iter):
+                body_src = "\n".join(ast.unparse(s) for s in node.body)
+                if ".join(" in body_src or ".cancel(" in body_src:
+                    joined = True
+        if not joined:
+            findings.append(Finding(
+                "CL003", rel, lineno, cls.name,
+                f"threads registered in self.{lst} are never "
+                "joined/cancelled"))
+
+
+def _find_cycle(edges: set[tuple]) -> list | None:
+    graph: dict[str, set[str]] = {}
+    for a, b in edges:
+        if a != b:
+            graph.setdefault(a, set()).add(b)
+    WHITE, GRAY, BLACK = 0, 1, 2
+    color = {n: WHITE for n in
+             set(graph) | {b for bs in graph.values() for b in bs}}
+    path: list[str] = []
+
+    def dfs(n: str):
+        color[n] = GRAY
+        path.append(n)
+        for m in graph.get(n, ()):
+            if color[m] == GRAY:
+                return path[path.index(m):] + [m]
+            if color[m] == WHITE:
+                cyc = dfs(m)
+                if cyc:
+                    return cyc
+        path.pop()
+        color[n] = BLACK
+        return None
+
+    for n in list(color):
+        if color[n] == WHITE:
+            cyc = dfs(n)
+            if cyc:
+                return cyc
+    return None
+
+
+def run(root: pathlib.Path) -> list[Finding]:
+    findings: list[Finding] = []
+    all_edges: set[tuple] = set()
+    for rel in FILES:
+        path = root / rel
+        source = path.read_text()
+        lines = source.splitlines()
+        tree = ast.parse(source)
+        mod_funcs = _module_functions(tree)
+        for node in tree.body:
+            if not isinstance(node, ast.ClassDef):
+                continue
+            cls = _ClassInfo(rel, node, lines)
+            lock_sets = _method_lock_sets(cls)
+            for m in cls.methods.values():
+                checker = _RegionChecker(cls, m, mod_funcs, rel,
+                                         findings)
+                checker._lock_sets = lock_sets
+                checker.visit(m)
+                all_edges |= checker.edges
+            _check_cond_discipline(cls, rel, findings)
+            _check_threads(cls, rel, findings)
+    cycle = _find_cycle(all_edges)
+    if cycle:
+        findings.append(Finding(
+            "CL001", FILES[0], 0, "lock-graph",
+            "lock acquisition order is inconsistent: cycle "
+            + " -> ".join(cycle)))
+    return findings

@@ -1,0 +1,109 @@
+"""Finding records, suppression baseline, and report rendering.
+
+Every analyzer emits :class:`Finding` rows.  A finding's *fingerprint*
+deliberately excludes the line number — suppressions must survive
+unrelated edits above the flagged site — and is built from the analyzer
+code, the repo-relative path, and a stable detail slug (usually the
+enclosing function or the flagged symbol).
+
+The checked-in baseline (``tools/slcheck_baseline.json``) lists
+fingerprints for accepted debt so ``slcheck`` can gate CI on *new*
+findings only.  Format::
+
+    {"version": 1,
+     "suppressions": [{"fingerprint": "CL002:runtime/bus.py:get",
+                       "reason": "why this is accepted"}]}
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import pathlib
+
+
+@dataclasses.dataclass
+class Finding:
+    code: str           # e.g. "PC001"
+    path: str           # repo-relative source path (or "<trace>")
+    line: int           # 1-based, 0 when not tied to a source line
+    where: str          # stable slug: enclosing function / symbol
+    message: str
+
+    @property
+    def fingerprint(self) -> str:
+        return f"{self.code}:{self.path}:{self.where}"
+
+    def to_dict(self) -> dict:
+        return {"code": self.code, "path": self.path, "line": self.line,
+                "where": self.where, "message": self.message,
+                "fingerprint": self.fingerprint}
+
+    def render(self) -> str:
+        loc = f"{self.path}:{self.line}" if self.line else self.path
+        return f"{self.code} {loc} [{self.where}] {self.message}"
+
+
+class Baseline:
+    """Suppression set keyed by fingerprint."""
+
+    def __init__(self, suppressions: dict[str, str] | None = None,
+                 path: pathlib.Path | None = None):
+        self.suppressions: dict[str, str] = dict(suppressions or {})
+        self.path = path
+
+    @classmethod
+    def load(cls, path: pathlib.Path) -> "Baseline":
+        if not path.exists():
+            return cls(path=path)
+        data = json.loads(path.read_text())
+        sups = {s["fingerprint"]: s.get("reason", "")
+                for s in data.get("suppressions", [])}
+        return cls(sups, path=path)
+
+    def save(self, findings: list[Finding], prune: bool = True) -> None:
+        """Persist ``findings`` as suppressions.  ``prune=False`` keeps
+        every existing suppression too — required when only a SUBSET of
+        analyzers ran (a partial run must not delete other analyzers'
+        accepted debt); a full run prunes entries that no longer
+        fire."""
+        assert self.path is not None
+        merged = {} if prune else dict(self.suppressions)
+        for f in findings:
+            merged[f.fingerprint] = self.suppressions.get(
+                f.fingerprint, "baselined by --write-baseline")
+        # stable order so the checked-in file diffs cleanly
+        sups = [{"fingerprint": fp, "reason": reason}
+                for fp, reason in sorted(merged.items())]
+        self.path.write_text(json.dumps(
+            {"version": 1, "suppressions": sups}, indent=2) + "\n")
+
+    def split(self, findings: list[Finding]
+              ) -> tuple[list[Finding], list[Finding]]:
+        """(new, suppressed) partition of ``findings``."""
+        new, sup = [], []
+        for f in findings:
+            (sup if f.fingerprint in self.suppressions else new).append(f)
+        return new, sup
+
+
+def render_human(new: list[Finding], suppressed: list[Finding]) -> str:
+    lines = []
+    for f in new:
+        lines.append(f.render())
+    if suppressed:
+        lines.append(f"({len(suppressed)} baselined finding(s) "
+                     "suppressed)")
+    if not new:
+        lines.append("slcheck: clean")
+    else:
+        lines.append(f"slcheck: {len(new)} new finding(s)")
+    return "\n".join(lines)
+
+
+def render_json(new: list[Finding], suppressed: list[Finding]) -> str:
+    return json.dumps({
+        "ok": not new,
+        "findings": [f.to_dict() for f in new],
+        "suppressed": [f.to_dict() for f in suppressed],
+    }, indent=2)

@@ -1,0 +1,165 @@
+"""Named lock factories with an opt-in runtime ordering check.
+
+The transport stack (``runtime/bus.py``, ``runtime/chaos.py``) creates
+its locks and condition variables through :func:`make_lock` /
+:func:`make_condition` so every primitive carries a *rank name* from
+:data:`LOCK_ORDER`.  By default the factories return plain
+``threading`` primitives — zero overhead, byte-identical behavior.
+
+With ``SLCHECK_LOCKS=1`` in the environment they return checked
+wrappers that keep a per-thread stack of held ranks and raise
+:class:`LockOrderViolation` the moment any thread acquires a lock whose
+rank is not strictly inner to everything it already holds.  This is the
+runtime twin of the static lock-order lint
+(:mod:`split_learning_tpu.analysis.concurrency`): the lint proves the
+order is consistent in the AST, the instrumented mode proves the same
+order on a live run (tests enable it around transport exercises).
+
+``LOCK_ORDER`` is outermost-first and mirrors the transport stack's
+layering (``runtime/chaos.py make_runtime_transport``): AsyncTransport
+wraps ReliableTransport wraps ChaosTransport wraps the base bus.  A
+well-behaved layer never calls *into* an inner layer while holding its
+own lock, so in a correct run the per-thread stack never holds more
+than one rank at a time — the checker exists to catch the regression
+that breaks that.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+
+#: global acquisition order, outermost first.  A thread may only
+#: acquire a lock whose rank appears STRICTLY LATER than every rank it
+#: already holds.
+LOCK_ORDER = (
+    "async",            # AsyncTransport._lock/_cv (outermost wrapper)
+    "prefetch",         # _Prefetcher._cond
+    "reliable",         # ReliableTransport._lock
+    "chaos",            # ChaosTransport._lock
+    "tcp.io",           # TcpTransport._lock (socket serialization)
+    "inproc",           # InProcTransport._lock/_cond (base bus)
+    "transport.count",  # Transport._count_lock (leaf byte counters)
+)
+
+
+class LockOrderViolation(AssertionError):
+    """A thread acquired locks against :data:`LOCK_ORDER`."""
+
+
+def _rank(name: str) -> int:
+    try:
+        return LOCK_ORDER.index(name)
+    except ValueError:
+        raise ValueError(f"unknown lock rank {name!r}; add it to "
+                         "analysis.locks.LOCK_ORDER") from None
+
+
+_held = threading.local()
+
+
+def _stack() -> list:
+    stack = getattr(_held, "stack", None)
+    if stack is None:
+        stack = []
+        setattr(_held, "stack", stack)  # noqa: B010 — dynamic TLS slot
+    return stack
+
+
+def _push(name: str) -> None:
+    stack = _stack()
+    rank = _rank(name)
+    if stack and rank <= stack[-1][1]:
+        held = ", ".join(n for n, _ in stack)
+        raise LockOrderViolation(
+            f"acquiring {name!r} while holding [{held}] violates "
+            f"LOCK_ORDER {LOCK_ORDER}")
+    stack.append((name, rank))
+
+
+def _pop(name: str) -> None:
+    stack = _stack()
+    for i in range(len(stack) - 1, -1, -1):
+        if stack[i][0] == name:
+            del stack[i]
+            return
+
+
+class _CheckedLock:
+    """``threading.Lock`` facade that records rank on acquisition."""
+
+    def __init__(self, name: str):
+        self._slname = name
+        self._real = threading.Lock()
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        # order is asserted at REQUEST time, before blocking: a
+        # violation must raise without leaving the raw lock held (and
+        # checking before the wait is what prevents the deadlock the
+        # order exists to rule out)
+        _push(self._slname)
+        ok = self._real.acquire(blocking, timeout)
+        if not ok:
+            _pop(self._slname)
+        return ok
+
+    def release(self) -> None:
+        _pop(self._slname)
+        self._real.release()
+
+    def locked(self) -> bool:
+        return self._real.locked()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+
+class _CheckedCondition(threading.Condition):
+    """Condition that records its lock's rank on ``with``-entry.
+
+    ``wait``/``wait_for`` release and reacquire the underlying lock
+    internally without touching the rank stack — the waiting thread
+    still *logically* owns the region, and other threads are checked
+    against their own per-thread stacks."""
+
+    def __init__(self, name: str, lock=None):
+        self._slname = name
+        real = lock._real if isinstance(lock, _CheckedLock) else lock
+        super().__init__(real)
+        self._sllock = lock
+
+    def __enter__(self):
+        _push(self._slname)
+        return super().__enter__()
+
+    def __exit__(self, *exc):
+        _pop(self._slname)
+        return super().__exit__(*exc)
+
+
+def checking_enabled() -> bool:
+    return os.environ.get("SLCHECK_LOCKS", "") not in ("", "0")
+
+
+def make_lock(name: str):
+    """A lock carrying rank ``name`` (plain ``threading.Lock`` unless
+    ``SLCHECK_LOCKS=1``)."""
+    if checking_enabled():
+        return _CheckedLock(name)
+    return threading.Lock()
+
+
+def make_condition(name: str, lock=None):
+    """A condition variable carrying rank ``name``.  ``lock`` may be a
+    lock from :func:`make_lock` to share its underlying primitive (the
+    aliasing ``Condition(self._lock)`` pattern)."""
+    if checking_enabled():
+        return _CheckedCondition(name, lock)
+    if isinstance(lock, _CheckedLock):  # mixed-mode construction
+        lock = lock._real
+    return threading.Condition(lock)

@@ -1,0 +1,296 @@
+"""Declarative protocol model + trace validator.
+
+The runtime's message state machine lives implicitly across
+``runtime/client.py`` (lifecycle loop + hot loops) and
+``runtime/server.py`` (rpc pump + round choreography).  This module
+lifts it into one declarative description — states x frame kinds x
+legal transitions — that serves three consumers:
+
+* the **AST conformance checker**
+  (:mod:`split_learning_tpu.analysis.protocol_check`) verifies every
+  send/recv site in the source names a frame type, queue family and
+  direction the model allows;
+* the **trace validator** (:func:`validate_events` /
+  :func:`validate_log`) replays a recorded run — the ``app.log``
+  protocol markers or a decoded frame stream — and flags transition
+  sequences the model forbids (``tools/run_chaos.py`` runs it at the
+  end of every sweep cell);
+* the **instrumented tests** use it as the oracle for deliberately
+  broken sequences.
+
+Model vocabulary
+----------------
+
+Queue families (patterns as in ``runtime/protocol.py``):
+
+=============  =======================  ===============================
+family         pattern                  direction
+=============  =======================  ===============================
+rpc            ``rpc_queue``            any client -> server
+reply          ``reply_{client_id}``    server -> one client (clients
+                                        may re-queue Start/Stop to
+                                        their OWN reply queue to unwind
+                                        a hot loop)
+intermediate   ``intermediate_queue_*`` stage k -> stage k+1
+gradient       ``gradient_queue_*``     stage k+1 -> one stage-k client
+=============  =======================  ===============================
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import fnmatch
+import re
+
+from split_learning_tpu.analysis.findings import Finding
+
+# -- wire vocabulary --------------------------------------------------------
+
+CONTROL_KINDS = ("Register", "Ready", "Notify", "Update",
+                 "Start", "Syn", "Pause", "Stop")
+DATA_KINDS = ("Activation", "Gradient", "EpochEnd")
+ALL_KINDS = CONTROL_KINDS + DATA_KINDS
+
+QUEUE_FAMILIES = {
+    "rpc": "rpc_queue",
+    "reply": "reply_*",
+    "intermediate": "intermediate_queue_*",
+    "gradient": "gradient_queue_*",
+}
+
+#: legal (sender-role, queue-family, kind) triples.  The two
+#: ("client", "reply", ...) rows are the self-requeue paths: a hot loop
+#: that sees a Start/Stop mid-round re-publishes it to its OWN reply
+#: queue so the lifecycle loop can unwind (client.py _redeliver_*).
+SEND_RULES = frozenset({
+    ("client", "rpc", "Register"), ("client", "rpc", "Ready"),
+    ("client", "rpc", "Notify"), ("client", "rpc", "Update"),
+    ("server", "reply", "Start"), ("server", "reply", "Syn"),
+    ("server", "reply", "Pause"), ("server", "reply", "Stop"),
+    ("client", "reply", "Start"), ("client", "reply", "Stop"),
+    ("client", "intermediate", "Activation"),
+    ("client", "intermediate", "EpochEnd"),
+    ("client", "gradient", "Gradient"),
+})
+
+#: queue families each role may consume from
+RECV_RULES = frozenset({
+    ("server", "rpc"),
+    ("client", "reply"), ("client", "intermediate"),
+    ("client", "gradient"),
+})
+
+#: kinds legal on each DATA queue family (post-transport stream)
+DATA_RULES = {
+    "intermediate": frozenset({"Activation", "EpochEnd"}),
+    "gradient": frozenset({"Gradient"}),
+}
+
+
+def queue_family(queue: str) -> str | None:
+    for fam, pat in QUEUE_FAMILIES.items():
+        if fnmatch.fnmatchcase(queue, pat) or queue == pat:
+            return fam
+    return None
+
+
+# -- control-plane state machines -------------------------------------------
+# Transitions are {state: {(direction, kind): next_state}}; directions
+# are from the OWNING role's point of view ("send" = it published).
+# Stop may arrive/be sent at almost any point (teardown races are legal)
+# — that is part of the model, not looseness: the runtime really does
+# accept it everywhere.
+
+SERVER_FSM: dict[str, dict[tuple[str, str], str]] = {
+    "idle": {
+        ("recv", "Register"): "idle",
+        ("send", "Start"): "starting",
+        ("send", "Stop"): "stopped",
+    },
+    "starting": {                       # STARTs out, READY barrier
+        ("send", "Start"): "starting",
+        ("recv", "Register"): "starting",
+        ("recv", "Ready"): "starting",
+        ("send", "Syn"): "running",
+        ("send", "Stop"): "stopped",
+    },
+    "running": {                        # training; NOTIFY barrier
+        ("recv", "Notify"): "running",
+        ("recv", "Register"): "running",
+        ("send", "Pause"): "pausing",
+        ("send", "Stop"): "stopped",
+    },
+    "pausing": {                        # UPDATE collection
+        ("recv", "Update"): "pausing",
+        ("recv", "Notify"): "pausing",   # straggler NOTIFY still legal
+        ("recv", "Register"): "pausing",
+        ("send", "Start"): "starting",   # next invocation / cluster
+        ("send", "Stop"): "stopped",
+    },
+    "stopped": {                        # stragglers drain silently
+        ("send", "Stop"): "stopped",
+        ("recv", "Register"): "stopped",
+        ("recv", "Notify"): "stopped",
+        ("recv", "Update"): "stopped",
+    },
+}
+
+CLIENT_FSM: dict[str, dict[tuple[str, str], str]] = {
+    "idle": {
+        ("send", "Register"): "idle",    # re-REGISTER until STARTed
+        ("recv", "Start"): "started",
+        ("recv", "Stop"): "stopped",
+    },
+    "started": {                        # shard built, data loaded
+        ("send", "Ready"): "ready",
+        ("recv", "Stop"): "stopped",
+    },
+    "ready": {
+        ("recv", "Syn"): "training",
+        ("recv", "Start"): "started",    # server re-STARTed the round
+        ("recv", "Stop"): "stopped",
+    },
+    "training": {
+        ("send", "Notify"): "notified",  # stage-1 data exhausted
+        ("recv", "Pause"): "updating",   # middle/last stages skip NOTIFY
+        ("recv", "Start"): "started",    # timed out of the round; rejoin
+        ("recv", "Stop"): "stopped",
+    },
+    "notified": {
+        ("recv", "Pause"): "updating",
+        ("recv", "Start"): "started",
+        ("recv", "Stop"): "stopped",
+    },
+    "updating": {
+        ("send", "Update"): "after_update",
+        ("recv", "Stop"): "stopped",
+    },
+    "after_update": {
+        ("recv", "Start"): "started",    # next round
+        ("recv", "Stop"): "stopped",
+    },
+    "stopped": {
+        ("recv", "Stop"): "stopped",
+    },
+}
+
+FSM_BY_ROLE = {"server": SERVER_FSM, "client": CLIENT_FSM}
+INITIAL_STATE = "idle"
+
+
+@dataclasses.dataclass
+class Event:
+    """One protocol-visible action of one participant."""
+    role: str            # "server" | "client"
+    direction: str       # "send" | "recv"
+    kind: str            # message class name
+    participant: str = ""
+    line: int = 0        # source line in the replayed log, if any
+
+
+def validate_events(events: list[Event],
+                    source: str = "<trace>") -> list[Finding]:
+    """Replay per-participant event streams through the role FSMs.
+
+    Illegal transitions are flagged and the state left unchanged
+    (forgiving recovery: one bad event should not cascade into flagging
+    the whole tail of the trace)."""
+    findings: list[Finding] = []
+    states: dict[str, str] = {}
+    for ev in events:
+        fsm = FSM_BY_ROLE.get(ev.role)
+        if fsm is None or ev.kind not in ALL_KINDS:
+            findings.append(Finding(
+                "TV002", source, ev.line,
+                ev.participant or ev.role,
+                f"unknown role/kind in trace: {ev.role} "
+                f"{ev.direction} {ev.kind}"))
+            continue
+        who = ev.participant or ev.role
+        state = states.get(who, INITIAL_STATE)
+        nxt = fsm[state].get((ev.direction, ev.kind))
+        if nxt is None:
+            legal = ", ".join(f"{d} {k}" for d, k in fsm[state])
+            findings.append(Finding(
+                "TV001", source, ev.line, who,
+                f"illegal transition: {ev.direction} {ev.kind} in "
+                f"state {state!r} (legal: {legal})"))
+            continue
+        states[who] = nxt
+    return findings
+
+
+# -- log replay -------------------------------------------------------------
+# runtime/log.py writes "%(asctime)s - %(name)s - %(levelname)s -
+# %(message)s" with [>>>] (sent) / [<<<] (received) markers; the logger
+# name is "{participant}.{id:x}".  One app.log may interleave every
+# participant of an in-process cell — events are split per participant
+# and validated independently.
+
+_LOG_RE = re.compile(
+    r" - (?P<name>[^ ]+) - \w+ - .*?\[(?P<dir>>>>|<<<)\] (?P<word>\w+)")
+_WORD_TO_KIND = {k.upper(): k for k in ALL_KINDS}
+
+
+def events_from_log(text: str) -> list[Event]:
+    events: list[Event] = []
+    for lineno, line in enumerate(text.splitlines(), start=1):
+        m = _LOG_RE.search(line)
+        if m is None:
+            continue
+        kind = _WORD_TO_KIND.get(m.group("word").upper())
+        if kind is None:
+            continue   # non-protocol marker line
+        participant = m.group("name").rsplit(".", 1)[0]
+        role = "server" if participant == "server" else "client"
+        events.append(Event(
+            role=role,
+            direction="send" if m.group("dir") == ">>>" else "recv",
+            kind=kind, participant=participant, line=lineno))
+    return events
+
+
+def validate_log(text: str, source: str = "app.log") -> list[Finding]:
+    """Validate every participant's control-plane sequence in one
+    (possibly interleaved) ``app.log``."""
+    return validate_events(events_from_log(text), source=source)
+
+
+# -- data-plane stream validation -------------------------------------------
+
+def validate_data_stream(messages: list, queue: str,
+                         source: str = "<stream>") -> list[Finding]:
+    """Validate a decoded post-transport message stream on one data
+    queue: only kinds legal for the queue family, no duplicate
+    ``data_id`` delivery (the reliable layer's dedup contract), and no
+    round regression (a message from round N after round N+1 means a
+    stale frame leaked through the fences)."""
+    findings: list[Finding] = []
+    fam = queue_family(queue)
+    legal = DATA_RULES.get(fam or "", frozenset())
+    seen_ids: set = set()
+    max_round = None
+    for i, msg in enumerate(messages):
+        kind = type(msg).__name__
+        if kind not in legal:
+            findings.append(Finding(
+                "TV003", source, i + 1, queue,
+                f"{kind} is not legal on {fam or 'unknown'} queue "
+                f"{queue!r} (legal: {sorted(legal)})"))
+            continue
+        data_id = getattr(msg, "data_id", None)
+        if data_id is not None:
+            if (kind, data_id) in seen_ids:
+                findings.append(Finding(
+                    "TV003", source, i + 1, queue,
+                    f"duplicate {kind} data_id={data_id!r} delivered"))
+            seen_ids.add((kind, data_id))
+        r = getattr(msg, "round_idx", None)
+        if r is not None:
+            if max_round is not None and r < max_round:
+                findings.append(Finding(
+                    "TV003", source, i + 1, queue,
+                    f"round regression: {kind} round_idx={r} after "
+                    f"round {max_round}"))
+            max_round = r if max_round is None else max(max_round, r)
+    return findings

@@ -1,0 +1,487 @@
+"""Protocol conformance checker (static + codec self-test).
+
+Three passes over the protocol surface, all driven by the declarative
+model in :mod:`split_learning_tpu.analysis.model`:
+
+* **send/recv site conformance** (PC001-PC003, PC008): AST-walk
+  ``runtime/client.py`` / ``runtime/server.py`` and verify every
+  ``bus.publish`` / ``bus.get`` names a frame type, queue family and
+  direction :data:`~split_learning_tpu.analysis.model.SEND_RULES`
+  allows.  ``runtime/bus.py`` / ``runtime/chaos.py`` are checked for
+  the transport invariant instead: a transport layer forwards its
+  caller's queue (or its own ``__ack__`` side channel) and never
+  originates application-queue traffic.
+* **codec coverage** (PC004-PC006): every member of
+  ``CONTROL_TYPES``/``DATA_TYPES`` must round-trip through
+  ``encode``/``decode`` (TENSOR framing for ``TENSOR_TYPES``), reject
+  a corrupted frame *before* interpreting payload bytes (checked both
+  at runtime with a bit flip and in the AST: any function calling
+  ``np.frombuffer`` or ``.load()`` must run a ``zlib.crc32`` check
+  first), and ride a queue family the default chaos-injection patterns
+  cover.
+* **handler coverage** (PC007): the message kinds each role
+  ``isinstance``-dispatches on must match what the model says the role
+  can receive.
+
+Inline annotations (``# slcheck: ...`` trailing comments) feed the
+checker facts the AST cannot recover:
+
+* ``# slcheck: wire=EpochEnd`` — this publish forwards an undecoded
+  raw frame of the named kind (the middle-stage fence relay);
+* ``# slcheck: allow-send`` — suppress PC001/PC002 on this line.
+"""
+
+from __future__ import annotations
+
+import ast
+import pathlib
+import re
+
+from split_learning_tpu.analysis.findings import Finding
+from split_learning_tpu.analysis.model import (
+    ALL_KINDS, DATA_KINDS, RECV_RULES, SEND_RULES, queue_family,
+)
+
+_QUEUE_CTORS = {"reply_queue": "reply", "intermediate_queue":
+                "intermediate", "gradient_queue": "gradient",
+                "_ack_queue": "ack"}
+_ANNOT_RE = re.compile(r"#\s*slcheck:\s*(.+?)\s*$")
+
+
+def _annotations(source: str) -> dict[int, str]:
+    out: dict[int, str] = {}
+    for i, line in enumerate(source.splitlines(), start=1):
+        m = _ANNOT_RE.search(line)
+        if m:
+            out[i] = m.group(1)
+    return out
+
+
+def _call_name(node: ast.AST) -> str | None:
+    if isinstance(node, ast.Call):
+        f = node.func
+        if isinstance(f, ast.Name):
+            return f.id
+        if isinstance(f, ast.Attribute):
+            return f.attr
+    return None
+
+
+class _QueueEnv:
+    """Per-function resolution of queue expressions to families."""
+
+    def __init__(self, cls_methods: dict[str, ast.FunctionDef]):
+        self.cls_methods = cls_methods
+        self.names: dict[str, str] = {}
+
+    def family_of(self, node: ast.AST) -> str | None:
+        if isinstance(node, ast.Call):
+            name = _call_name(node)
+            if name in _QUEUE_CTORS:
+                return _QUEUE_CTORS[name]
+            if (isinstance(node.func, ast.Attribute)
+                    and isinstance(node.func.value, ast.Name)
+                    and node.func.value.id == "self"
+                    and name in self.cls_methods):
+                return self._family_of_method(name)
+            return None
+        if isinstance(node, ast.Name):
+            if node.id == "RPC_QUEUE":
+                return "rpc"
+            return self.names.get(node.id)
+        if isinstance(node, ast.Constant) and isinstance(node.value, str):
+            return queue_family(node.value)
+        if isinstance(node, ast.Subscript):
+            return self.family_of(node.value)
+        if isinstance(node, (ast.List, ast.Tuple)):
+            fams = {self.family_of(e) for e in node.elts}
+            return fams.pop() if len(fams) == 1 else None
+        return None
+
+    def _family_of_method(self, name: str) -> str | None:
+        """Family of a same-class helper that builds queue names
+        (e.g. ``_out_queues``): unique ctor family in its returns."""
+        fams = set()
+        for node in ast.walk(self.cls_methods[name]):
+            cn = _call_name(node)
+            if cn in _QUEUE_CTORS:
+                fams.add(_QUEUE_CTORS[cn])
+        return fams.pop() if len(fams) == 1 else None
+
+    def note(self, stmt: ast.stmt) -> None:
+        if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 \
+                and isinstance(stmt.targets[0], ast.Name):
+            fam = self.family_of(stmt.value)
+            if fam is not None:
+                self.names[stmt.targets[0].id] = fam
+        elif isinstance(stmt, (ast.For, ast.AsyncFor)) \
+                and isinstance(stmt.target, ast.Name):
+            fam = self.family_of(stmt.iter)
+            if fam is not None:
+                self.names[stmt.target.id] = fam
+
+
+def _message_kind(node: ast.AST, fn: ast.FunctionDef | None,
+                  env_assigns: dict[str, str]) -> str | None:
+    """Resolve the frame kind a publish payload expression carries."""
+    if isinstance(node, ast.Lambda):
+        return _message_kind(node.body, fn, env_assigns)
+    name = _call_name(node)
+    if name in ("encode", "encode_parts", "encode_pickled"):
+        inner = node.args[0] if getattr(node, "args", None) else None
+        if inner is None:
+            return None
+        inner_name = _call_name(inner)
+        if inner_name in ALL_KINDS:
+            return inner_name
+        if isinstance(inner, ast.Name):
+            if inner.id in env_assigns:
+                return env_assigns[inner.id]
+            if fn is not None:    # typed parameter, e.g. ``msg: Stop``
+                for a in fn.args.args:
+                    if (a.arg == inner.id
+                            and isinstance(a.annotation, ast.Name)
+                            and a.annotation.id in ALL_KINDS):
+                        return a.annotation.id
+        return None
+    if name in ALL_KINDS:
+        return name
+    return None
+
+
+def _iter_functions(tree: ast.Module):
+    """(classdef-or-None, functiondef) pairs, outermost functions."""
+    for node in tree.body:
+        if isinstance(node, ast.FunctionDef):
+            yield None, node
+        elif isinstance(node, ast.ClassDef):
+            for sub in node.body:
+                if isinstance(sub, ast.FunctionDef):
+                    yield node, sub
+
+
+def _check_role_file(path: pathlib.Path, rel: str,
+                     role: str) -> list[Finding]:
+    source = path.read_text()
+    tree = ast.parse(source)
+    notes = _annotations(source)
+    findings: list[Finding] = []
+
+    for cls, fn in _iter_functions(tree):
+        methods = ({m.name: m for m in cls.body
+                    if isinstance(m, ast.FunctionDef)} if cls else {})
+        env = _QueueEnv(methods)
+        kinds_env: dict[str, str] = {}
+        for stmt in ast.walk(fn):
+            if isinstance(stmt, ast.stmt):
+                env.note(stmt)
+            if isinstance(stmt, ast.Assign) \
+                    and len(stmt.targets) == 1 \
+                    and isinstance(stmt.targets[0], ast.Name):
+                k = _call_name(stmt.value)
+                if k in ALL_KINDS:
+                    kinds_env[stmt.targets[0].id] = k
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            if not isinstance(f, ast.Attribute):
+                continue
+            target = ast.unparse(f.value)
+            note = notes.get(node.lineno, "")
+            if f.attr in ("publish", "_publish_parts") and target in (
+                    "self.bus", "self._publish_parts", "self") \
+                    and len(node.args) >= 2:
+                if "allow-send" in note:
+                    continue
+                # a queue that is this function's own PARAMETER marks a
+                # publish wrapper (client._publish_parts): its call
+                # sites are the real send sites
+                if isinstance(node.args[0], ast.Name) and \
+                        node.args[0].id in {a.arg for a in fn.args.args}:
+                    continue
+                fam = env.family_of(node.args[0])
+                kind = _message_kind(node.args[1], fn, kinds_env)
+                m = re.search(r"wire=(\w+)", note)
+                if kind is None and m:
+                    kind = m.group(1)
+                if fam is None or kind is None:
+                    findings.append(Finding(
+                        "PC002", rel, node.lineno, fn.name,
+                        f"unresolved publish site (family={fam}, "
+                        f"kind={kind}); name the frame with "
+                        "'# slcheck: wire=<Kind>' if the AST cannot"))
+                elif (role, fam, kind) not in SEND_RULES:
+                    findings.append(Finding(
+                        "PC001", rel, node.lineno, fn.name,
+                        f"model forbids {role} sending {kind} on "
+                        f"{fam} queue"))
+            elif f.attr == "get" and target == "self.bus" \
+                    and node.args:
+                fam = env.family_of(node.args[0])
+                if fam is None:
+                    findings.append(Finding(
+                        "PC002", rel, node.lineno, fn.name,
+                        "unresolved bus.get queue family"))
+                elif (role, fam) not in RECV_RULES:
+                    findings.append(Finding(
+                        "PC003", rel, node.lineno, fn.name,
+                        f"model forbids {role} consuming from {fam} "
+                        "queue"))
+    return findings
+
+
+_PASSTHROUGH_ARGS = {"queue", "q", "ackq"}
+
+
+def _check_transport_file(path: pathlib.Path, rel: str) -> list[Finding]:
+    """Transport layers (bus/chaos) must never originate traffic on an
+    application queue: every publish/get forwards the caller's queue
+    variable or targets the ``__ack__`` side channel."""
+    tree = ast.parse(path.read_text())
+    findings: list[Finding] = []
+    for cls, fn in _iter_functions(tree):
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            if not isinstance(f, ast.Attribute) \
+                    or f.attr not in ("publish", "get"):
+                continue
+            target = ast.unparse(f.value)
+            if not any(t in target for t in
+                       ("self.inner", "self._side", "self.src",
+                        "self._store")):
+                continue
+            if not node.args:
+                continue
+            q = node.args[0]
+            ok = (isinstance(q, ast.Name)
+                  and q.id in _PASSTHROUGH_ARGS) \
+                or (isinstance(q, ast.Attribute) and q.attr == "queue") \
+                or _call_name(q) == "_ack_queue"
+            if not ok:
+                findings.append(Finding(
+                    "PC008", rel, node.lineno, fn.name,
+                    f"transport layer {f.attr} on non-passthrough "
+                    f"queue expression {ast.unparse(q)!r}"))
+    return findings
+
+
+# -- codec coverage ---------------------------------------------------------
+
+def _sample_messages():
+    import numpy as np
+
+    from split_learning_tpu.runtime import protocol as P
+    return {
+        "Register": P.Register(client_id="c", stage=1),
+        "Ready": P.Ready(client_id="c"),
+        "Notify": P.Notify(client_id="c", cluster=0),
+        "Update": P.Update(
+            client_id="c", stage=1, cluster=0,
+            params={"w": np.arange(6, dtype=np.float32).reshape(2, 3)},
+            num_samples=3),
+        "Start": P.Start(start_layer=0, end_layer=-1, cluster=0,
+                         params={"w": np.ones((2,), np.float32)}),
+        "Syn": P.Syn(),
+        "Pause": P.Pause(),
+        "Stop": P.Stop(),
+        "Activation": P.Activation(
+            data_id="d0", data=np.ones((2, 3), np.float32),
+            labels=np.zeros((2,), np.int64), trace=["c"], cluster=0),
+        "Gradient": P.Gradient(
+            data_id="d0", data=np.ones((2, 3), np.float32), trace=[]),
+        "EpochEnd": P.EpochEnd(client_id="c"),
+    }
+
+
+def _trees_equal(a, b) -> bool:
+    import numpy as np
+    if isinstance(a, dict) and isinstance(b, dict):
+        return a.keys() == b.keys() and all(
+            _trees_equal(a[k], b[k]) for k in a)
+    if isinstance(a, (list, tuple)) and isinstance(b, (list, tuple)):
+        return len(a) == len(b) and all(
+            _trees_equal(x, y) for x, y in zip(a, b))
+    if isinstance(a, np.ndarray) or isinstance(b, np.ndarray):
+        return (np.asarray(a).dtype == np.asarray(b).dtype
+                and np.array_equal(np.asarray(a), np.asarray(b)))
+    return a == b
+
+
+def _check_codec() -> list[Finding]:
+    import dataclasses as dc
+
+    from split_learning_tpu.config import ChaosConfig
+    from split_learning_tpu.runtime import protocol as P
+
+    rel = "split_learning_tpu/runtime/protocol.py"
+    findings: list[Finding] = []
+    declared = {t.__name__ for t in P.CONTROL_TYPES + P.DATA_TYPES}
+    samples = _sample_messages()
+    for kind in sorted(declared | set(samples)):
+        if kind not in P._TYPE_BY_NAME:
+            findings.append(Finding(
+                "PC004", rel, 0, kind,
+                f"{kind} has no encoder dispatch entry (_TYPE_BY_NAME)"))
+            continue
+        msg = samples.get(kind)
+        if msg is None:
+            findings.append(Finding(
+                "PC004", rel, 0, kind,
+                f"{kind} is declared but the codec self-test has no "
+                "sample for it — add one to _sample_messages"))
+            continue
+        try:
+            frame = P.encode(msg)
+            back = P.decode(frame)
+        except Exception as e:  # noqa: BLE001 — any failure is the finding
+            findings.append(Finding(
+                "PC004", rel, 0, kind,
+                f"{kind} does not round-trip: {type(e).__name__}: {e}"))
+            continue
+        if type(back) is not type(msg) or not _trees_equal(
+                dc.asdict(msg), dc.asdict(back)):
+            findings.append(Finding(
+                "PC004", rel, 0, kind,
+                f"{kind} round-trip changed the message"))
+            continue
+        if isinstance(msg, P.TENSOR_TYPES) \
+                and frame[:4] != P.TENSOR_MAGIC:
+            findings.append(Finding(
+                "PC004", rel, 0, kind,
+                f"{kind} is a TENSOR type but did not use SLT2 framing"))
+        # corruption must be rejected before payload interpretation
+        i = len(frame) // 2
+        corrupt = frame[:i] + bytes([frame[i] ^ 0xFF]) + frame[i + 1:]
+        try:
+            P.decode(corrupt)
+            findings.append(Finding(
+                "PC004", rel, 0, kind,
+                f"corrupted {kind} frame decoded without an integrity "
+                "error"))
+        except P.CorruptFrame:
+            pass
+        except Exception as e:  # noqa: BLE001 — reached the unpickler
+            findings.append(Finding(
+                "PC004", rel, 0, kind,
+                f"corrupted {kind} frame escaped the checksum and "
+                f"raised {type(e).__name__} from payload decoding"))
+        # chunk framing must reassemble
+        if isinstance(msg, P.TENSOR_TYPES):
+            try:
+                parts = P.encode_parts(msg, max_bytes=64)
+                asm = P.FrameAssembler()
+                out = None
+                for part in parts:
+                    out = asm.feed(part)
+                assert out is not None and type(out) is type(msg)
+            except Exception as e:  # noqa: BLE001 — the finding
+                findings.append(Finding(
+                    "PC004", rel, 0, kind,
+                    f"{kind} chunked round-trip failed: "
+                    f"{type(e).__name__}: {e}"))
+        # chaos-injection coverage: the queue families this kind rides
+        # must be matched by the default fault-injection patterns
+        if kind in DATA_KINDS or kind in (
+                t.__name__ for t in P.TENSOR_TYPES):
+            fams = {fam for role, fam, k in SEND_RULES if k == kind}
+            examples = {"rpc": "rpc_queue", "reply": "reply_c",
+                        "intermediate": "intermediate_queue_1_0",
+                        "gradient": "gradient_queue_1_c"}
+            import fnmatch
+            pats = ChaosConfig().queues
+            for fam in fams:
+                if not any(fnmatch.fnmatchcase(examples[fam], p)
+                           for p in pats):
+                    findings.append(Finding(
+                        "PC006", rel, 0, kind,
+                        f"{kind} rides {fam} queues but no default "
+                        f"chaos pattern {pats} matches them — faults "
+                        "on this path are untestable"))
+    return findings
+
+
+_RISKY_CALLS = ("frombuffer", "load")
+
+
+def _check_crc_order(path: pathlib.Path, rel: str) -> list[Finding]:
+    """Any protocol function interpreting payload bytes
+    (``np.frombuffer`` / unpickler ``.load``) must run a
+    ``zlib.crc32`` integrity check at an earlier line."""
+    tree = ast.parse(path.read_text())
+    findings: list[Finding] = []
+    for _, fn in _iter_functions(tree):
+        risky: list[tuple[int, str]] = []
+        crc_lines: list[int] = []
+        for node in ast.walk(fn):
+            name = _call_name(node)
+            if name in _RISKY_CALLS:
+                risky.append((node.lineno, name))
+            if name == "crc32":
+                crc_lines.append(node.lineno)
+        if not risky:
+            continue
+        first_risky = min(line for line, _ in risky)
+        if not crc_lines or min(crc_lines) > first_risky:
+            what = ", ".join(sorted({n for _, n in risky}))
+            findings.append(Finding(
+                "PC005", rel, first_risky, fn.name,
+                f"{what} runs before any crc32 integrity check in "
+                f"{fn.name}"))
+    return findings
+
+
+def _check_handlers(root: pathlib.Path) -> list[Finding]:
+    findings: list[Finding] = []
+    receivable = {
+        role: {k for r, fam, k in SEND_RULES
+               if (role, fam) in RECV_RULES}
+        for role in ("client", "server")
+    }
+    must_handle = {"client": {"Start", "Syn", "Pause", "Stop"},
+                   "server": {"Register", "Ready", "Notify", "Update"}}
+    for role in ("client", "server"):
+        rel = f"split_learning_tpu/runtime/{role}.py"
+        tree = ast.parse((root / rel).read_text())
+        handled: set[str] = set()
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Name) \
+                    and node.func.id == "isinstance" \
+                    and len(node.args) == 2:
+                arg = node.args[1]
+                names = ([arg] if isinstance(arg, ast.Name)
+                         else list(arg.elts)
+                         if isinstance(arg, ast.Tuple) else [])
+                for n in names:
+                    if isinstance(n, ast.Name) and n.id in ALL_KINDS:
+                        handled.add(n.id)
+        for kind in sorted(handled - receivable[role]):
+            findings.append(Finding(
+                "PC007", rel, 0, kind,
+                f"{role} dispatches on {kind}, which the model says "
+                f"it can never receive"))
+        for kind in sorted(must_handle[role] - handled):
+            findings.append(Finding(
+                "PC007", rel, 0, kind,
+                f"{role} never dispatches on {kind}, which the model "
+                f"says it must handle"))
+    return findings
+
+
+def run(root: pathlib.Path) -> list[Finding]:
+    findings: list[Finding] = []
+    for rel, role in (("split_learning_tpu/runtime/client.py", "client"),
+                      ("split_learning_tpu/runtime/server.py", "server")):
+        findings += _check_role_file(root / rel, rel, role)
+    for rel in ("split_learning_tpu/runtime/bus.py",
+                "split_learning_tpu/runtime/chaos.py"):
+        findings += _check_transport_file(root / rel, rel)
+    findings += _check_crc_order(
+        root / "split_learning_tpu/runtime/protocol.py",
+        "split_learning_tpu/runtime/protocol.py")
+    findings += _check_codec()
+    findings += _check_handlers(root)
+    return findings

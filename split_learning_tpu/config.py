@@ -25,7 +25,7 @@ from __future__ import annotations
 
 import dataclasses
 import pathlib
-from typing import Any, Sequence
+from typing import Any
 
 import yaml
 
@@ -319,7 +319,12 @@ class ChaosConfig:
     corrupt: float = 0.0            # one payload byte flipped
     delay: float = 0.0              # message held for delay-s
     delay_s: float = 0.02
-    queues: tuple = ("intermediate_queue*", "gradient_queue*")
+    # rpc_queue included so EVERY tensor-framed message kind has a
+    # default fault-injection point (slcheck PC006): Update rides
+    # rpc_queue, and a wire type chaos can never touch is a recovery
+    # path no soak ever exercises
+    queues: tuple = ("intermediate_queue*", "gradient_queue*",
+                     "rpc_queue")
     crash: tuple = ()               # scripted crash points (dicts)
 
     def validate(self):

@@ -10,8 +10,6 @@ permute chain.
 
 from __future__ import annotations
 
-from typing import Callable, Optional
-
 import flax.linen as nn
 import jax.numpy as jnp
 

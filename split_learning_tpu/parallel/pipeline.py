@@ -77,8 +77,7 @@ round, deterministic, and MXU-friendly.
 from __future__ import annotations
 
 import functools
-import math
-from typing import Any, Callable, Sequence
+from typing import Callable, Sequence
 
 import jax
 import jax.numpy as jnp
@@ -287,8 +286,8 @@ class PipelineModel:
     def _to_wire(self, x) -> jnp.ndarray:
         leaves = jax.tree_util.tree_leaves(x)
         flat = jnp.concatenate(
-            [l.reshape(l.shape[0], -1).astype(self.wire_dtype)
-             for l in leaves], axis=1)
+            [v.reshape(v.shape[0], -1).astype(self.wire_dtype)
+             for v in leaves], axis=1)
         pad = self.max_flat - flat.shape[1]
         return jnp.pad(flat, ((0, 0), (0, pad))) if pad else flat
 
@@ -412,8 +411,8 @@ class PipelineModel:
                 return (self._to_wire(x), jnp.zeros(()), new_stats, aux)
             if last:
                 tail = jnp.concatenate(
-                    [l.reshape(mb, -1).astype(self.wire_dtype)
-                     for l in jax.tree_util.tree_leaves(x)], axis=1)
+                    [v.reshape(mb, -1).astype(self.wire_dtype)
+                     for v in jax.tree_util.tree_leaves(x)], axis=1)
                 return (jnp.zeros((mb, self.max_flat), self.wire_dtype),
                         tail, new_stats, aux)
             return (self._to_wire(x),

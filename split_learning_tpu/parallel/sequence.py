@@ -34,11 +34,11 @@ import jax.numpy as jnp
 import numpy as np
 
 
-def _online_block(q, k, v, mask, m, l, o, scale):
+def _online_block(q, k, v, mask, m, lse, o, scale):
     """One flash-attention accumulation step over a K/V block.
 
     q: (B, Sq, H, D); k/v: (B, Sk, H, D); mask: (Sq, Sk) or None;
-    m, l: (B, H, Sq); o: (B, Sq, H, D).
+    m, lse: (B, H, Sq); o: (B, Sq, H, D).
     """
     s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
     if mask is not None:
@@ -51,7 +51,7 @@ def _online_block(q, k, v, mask, m, l, o, scale):
         p = jnp.where(mask[None, None], p, 0.0)
     # m finite -> exponent <= 0 (safe_m >= m); m == -inf -> exp == 0.0
     corr = jnp.exp(jnp.where(jnp.isfinite(m), m - safe_m, -jnp.inf))
-    l_new = l * corr + p.sum(axis=-1)
+    l_new = lse * corr + p.sum(axis=-1)
     o_new = (o * corr.transpose(0, 2, 1)[..., None]
              + jnp.einsum("bhqk,bkhd->bqhd", p, v))
     return m_new, l_new, o_new
@@ -72,12 +72,12 @@ def ring_attention(q, k, v, axis_name: str = "seq",
     q32, k32, v32 = (t.astype(jnp.float32) for t in (q, k, v))
 
     m = jnp.full((b, h, s_blk), -jnp.inf, jnp.float32)
-    l = jnp.zeros((b, h, s_blk), jnp.float32)
+    lse = jnp.zeros((b, h, s_blk), jnp.float32)
     o = jnp.zeros((b, s_blk, h, d), jnp.float32)
     perm = [(j, (j + 1) % n) for j in range(n)]
 
     def body(j, carry):
-        m, l, o, k_cur, v_cur = carry
+        m, lse, o, k_cur, v_cur = carry
         src = (i - j) % n            # ring position this K/V came from
         if causal:
             q_pos = i * s_blk + jnp.arange(s_blk)[:, None]
@@ -85,17 +85,17 @@ def ring_attention(q, k, v, axis_name: str = "seq",
             mask = k_pos <= q_pos
         else:
             mask = None
-        m, l, o = _online_block(q32, k_cur, v_cur, mask, m, l, o, scale)
+        m, lse, o = _online_block(q32, k_cur, v_cur, mask, m, lse, o, scale)
         k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
         v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
-        return m, l, o, k_nxt, v_nxt
+        return m, lse, o, k_nxt, v_nxt
 
-    carry = (m, l, o, k32, v32)
+    carry = (m, lse, o, k32, v32)
     # static python loop: n is a mesh constant, keeps masks cheap
     for j in range(n):
         carry = body(j, carry)
-    _, l, o, _, _ = carry
-    denom = jnp.where(l > 0, l, 1.0).transpose(0, 2, 1)[..., None]
+    _, lse, o, _, _ = carry
+    denom = jnp.where(lse > 0, lse, 1.0).transpose(0, 2, 1)[..., None]
     return (o / denom).astype(q.dtype)
 
 

@@ -98,7 +98,8 @@ def scale_by_adam_bf16(b1: float = 0.9, b2: float = 0.999,
     """
 
     def init_fn(params):
-        zeros = lambda p: jnp.zeros_like(p, dtype=jnp.bfloat16)
+        zeros = lambda p: jnp.zeros_like(  # noqa: E731
+            p, dtype=jnp.bfloat16)
         return ScaleByAdamBf16State(
             count=jnp.zeros([], jnp.int32),
             mu=jax.tree_util.tree_map(zeros, params),
@@ -111,9 +112,9 @@ def scale_by_adam_bf16(b1: float = 0.9, b2: float = 0.999,
             lambda g, m, v: _adam_direction(g, m, v, count, b1, b2, eps),
             updates, state.mu, state.nu,
             is_leaf=lambda x: isinstance(x, jnp.ndarray))
-        pick = lambda i: jax.tree_util.tree_map(
+        pick = lambda i: jax.tree_util.tree_map(  # noqa: E731
             lambda t: t[i], stepped, is_leaf=lambda x: isinstance(x, tuple))
-        to_bf16 = lambda t: jax.tree_util.tree_map(
+        to_bf16 = lambda t: jax.tree_util.tree_map(  # noqa: E731
             lambda a: a.astype(jnp.bfloat16), t)
         return pick(0), ScaleByAdamBf16State(
             count=count, mu=to_bf16(pick(1)), nu=to_bf16(pick(2)))
@@ -138,8 +139,8 @@ def adamw_bf16_states(learning_rate, b1: float = 0.9, b2: float = 0.999,
 
 def _flat_geometry(params_host, stage_axis: int) -> tuple[int, int]:
     """(n_params, shard_len) with shard_len * A >= n_params (padded)."""
-    n = sum(int(np.prod(l.shape))
-            for l in jax.tree_util.tree_leaves(params_host))
+    n = sum(int(np.prod(leaf.shape))
+            for leaf in jax.tree_util.tree_leaves(params_host))
     shard = -(-n // stage_axis)  # ceil div
     return n, shard
 

@@ -108,10 +108,10 @@ def profile_model(model_key: str, batch_size: int = 32,
     # wire-dtype ratio at plan time (runtime/plan.py) against this
     # fp32-equivalent record
     size_data = [
-        sum(int(np.prod(l.shape))
-            * (4 if jnp.issubdtype(l.dtype, jnp.floating)
-               else np.dtype(l.dtype).itemsize)
-            for l in jax.tree_util.tree_leaves(b))
+        sum(int(np.prod(leaf.shape))
+            * (4 if jnp.issubdtype(leaf.dtype, jnp.floating)
+               else np.dtype(leaf.dtype).itemsize)
+            for leaf in jax.tree_util.tree_leaves(b))
         for b in bounds[1:]
     ]
 

@@ -43,6 +43,8 @@ import uuid
 import zlib
 from typing import Iterable
 
+from split_learning_tpu.analysis.locks import make_condition, make_lock
+
 
 class QueueClosed(Exception):
     pass
@@ -58,7 +60,7 @@ class Transport:
 
     def __init__(self):
         # own lock: one transport is shared by server + client threads
-        self._count_lock = threading.Lock()
+        self._count_lock = make_lock("transport.count")
         self.bytes_out: dict = {}
 
     def publish(self, queue: str, payload: bytes) -> None:
@@ -95,8 +97,8 @@ class Transport:
 class InProcTransport(Transport):
     def __init__(self):
         super().__init__()
-        self._lock = threading.Lock()
-        self._cond = threading.Condition(self._lock)
+        self._lock = make_lock("inproc")
+        self._cond = make_condition("inproc", self._lock)
         self._queues: dict[str, collections.deque] = \
             collections.defaultdict(collections.deque)
         self._closed = False
@@ -323,7 +325,9 @@ class TcpTransport(Transport):
         # the broker may still be coming up (simultaneous launch): retry
         # with backoff instead of failing the whole client process
         self._sock = self._connect(connect_timeout)
-        self._lock = threading.Lock()
+        # serializes the single socket, not state: blocking I/O (and the
+        # reconnect backoff sleep) under it is this lock's PURPOSE
+        self._lock = make_lock("tcp.io")  # slcheck: io-lock
 
     def _connect(self, timeout: float) -> socket.socket:
         deadline = time.monotonic() + timeout
@@ -527,7 +531,7 @@ class ReliableTransport(Transport):
             )
             faults = default_fault_counters
         self.faults = faults
-        self._lock = threading.Lock()
+        self._lock = make_lock("reliable")
         self._seq: dict[str, int] = {}
         # (queue, seq) -> [frame, next_due, attempts]
         self._unacked: dict[tuple, list] = {}
@@ -634,7 +638,10 @@ class ReliableTransport(Transport):
                                _env_frame(_ENV_ACK, seq, queue.encode(),
                                           b""))
         except (QueueClosed, ConnectionError, OSError):
-            pass   # a lost ack only costs a redelivery + dedup hit
+            # a lost ack only costs a redelivery + dedup hit — but it
+            # must be VISIBLE: a spike here is how an operator tells a
+            # dying ack path from ordinary wire loss
+            self.faults.inc("ack_send_failures")
 
     def _pop_ready(self, queue: str) -> bytes | None:
         """Next in-order held frame for ``queue``, if any."""
@@ -836,8 +843,8 @@ class AsyncTransport(Transport):
             )
             faults = default_fault_counters
         self.faults = faults
-        self._lock = threading.Lock()
-        self._cv = threading.Condition(self._lock)
+        self._lock = make_lock("async")
+        self._cv = make_condition("async", self._lock)
         self._sendq: collections.deque = collections.deque()
         self._inflight = 0      # popped by the sender, not yet published
         self._error: BaseException | None = None
@@ -1012,7 +1019,7 @@ class _Prefetcher:
         self._wire = wire
         self._faults = faults
         self._buf: collections.deque = collections.deque()
-        self._cond = threading.Condition()
+        self._cond = make_condition("prefetch")
         self._closed = False
         self._thread = threading.Thread(target=self._loop, daemon=True,
                                         name=f"prefetch-{queue}")

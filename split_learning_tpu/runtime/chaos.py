@@ -42,6 +42,7 @@ import threading
 import zlib
 from typing import Iterable
 
+from split_learning_tpu.analysis.locks import make_lock
 from split_learning_tpu.config import ChaosConfig, Config
 from split_learning_tpu.runtime.bus import (
     AsyncTransport, QueueClosed, ReliableTransport, Transport,
@@ -77,7 +78,7 @@ class ChaosTransport(Transport):
             )
             faults = default_fault_counters
         self.faults = faults
-        self._lock = threading.Lock()
+        self._lock = make_lock("chaos")
         self._rngs: dict[str, random.Random] = {}
         self._stash: dict[str, bytes] = {}     # reorder slot per queue
         # scripted crash points owned by this participant (copies: the
@@ -111,7 +112,9 @@ class ChaosTransport(Transport):
         try:
             self._side.publish(queue, payload)
         except (QueueClosed, ConnectionError, OSError):
-            pass   # the run ended before the delayed frame landed
+            # the run ended before the delayed frame landed; counted so
+            # a sweep can tell "delayed into teardown" from a real drop
+            self.faults.inc("late_drops")
 
     def publish(self, queue: str, payload: bytes) -> None:
         with self._lock:

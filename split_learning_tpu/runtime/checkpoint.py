@@ -149,5 +149,8 @@ def delete_checkpoint(directory: str | pathlib.Path,
         else:
             try:
                 p.unlink()
-            except OSError:
-                pass
+            except OSError as e:
+                # a leftover slot means the NEXT save may publish into
+                # a dirty directory — surface it instead of silence
+                warnings.warn(f"could not remove checkpoint debris "
+                              f"{p}: {e}", RuntimeWarning, stacklevel=2)

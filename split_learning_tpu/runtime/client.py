@@ -67,6 +67,37 @@ def _wire_np_dtype(name: str):
     return np.dtype(name)
 
 
+def device_wire_dtype(wire_np_dtype):
+    """jnp dtype for the ON-DEVICE wire cast, or None when no device
+    cast applies: fp32 ships as-is, and int8 quantizes host-side
+    (QuantLeaf needs the absmax, which would be a device sync)."""
+    dt = np.dtype(wire_np_dtype)
+    if dt == np.dtype(np.float16):
+        return jnp.float16
+    if dt.name == "bfloat16":
+        return jnp.bfloat16
+    return None
+
+
+def _cast_for_wire(tree, dtype):
+    """Cast float leaves to the wire dtype ON DEVICE, before the
+    device->host fetch: the async sender's ``np.asarray`` then moves
+    wire-width bytes instead of fp32 (half the PCIe traffic on the
+    bf16 default), and the host-side ``_to_wire_tree`` cast becomes a
+    no-op.  Bit-identical to casting on host — both round to nearest
+    even.  No-op when ``dtype`` is None."""
+    if dtype is None:
+        return tree
+
+    def conv(leaf):
+        ldt = getattr(leaf, "dtype", None)
+        if (ldt is None or ldt == jax.dtypes.float0
+                or not jnp.issubdtype(ldt, jnp.floating)):
+            return leaf
+        return leaf if ldt == dtype else leaf.astype(dtype)
+    return jax.tree_util.tree_map(conv, tree)
+
+
 def _start_host_copy(tree) -> None:
     """Kick off the device→host transfer of every leaf WITHOUT blocking
     (jax.Array.copy_to_host_async), so by the time the async sender's
@@ -386,6 +417,11 @@ class ProtocolClient:
         self.round_ok = True
         self.num_samples = 0
         self.wire_dtype = _wire_np_dtype(cfg.transport.wire_dtype)
+        self._dev_cast = device_wire_dtype(self.wire_dtype)
+        # device-resident NaN sentinel: hot loops fold jnp.isfinite
+        # into this WITHOUT a host sync; _send_update reads it once
+        # per round (slcheck JX001)
+        self._ok_dev = None
 
     # -- control plane -----------------------------------------------------
 
@@ -593,6 +629,7 @@ class ProtocolClient:
     def _on_syn(self, msg: Syn):
         self.log.info(f"[<<<] SYN round={msg.round_idx}")
         self.round_ok = True
+        self._ok_dev = jnp.asarray(True)
         self.round_idx = msg.round_idx
         self.num_samples = 0
         # responsive-set overrides (server recomputes after the READY
@@ -624,6 +661,10 @@ class ProtocolClient:
             self._send_update()
 
     def _send_update(self, with_weights: bool = True):
+        # the round's ONE host sync of the NaN sentinel the hot loops
+        # accumulated on device (per-batch bool() was a per-tick sync)
+        if self._ok_dev is not None and not bool(self._ok_dev):
+            self.round_ok = False
         params_h = stats_h = None
         if with_weights:
             merged = self.runner.merge_params(self.frozen, self.trainable)
@@ -724,8 +765,10 @@ class ProtocolClient:
                     self.frozen, self.trainable, self.stats,
                     jnp.asarray(x),
                     jnp.asarray(labels.astype(np.int32)), r.next_rng())
-                if not bool(jnp.isfinite(loss)):
-                    self.round_ok = False
+                # folded on DEVICE; synced once in _send_update — a
+                # bool() here would stall the loop every batch
+                self._ok_dev = jnp.logical_and(self._ok_dev,
+                                               jnp.isfinite(loss))
                 self.trainable, self.opt_state = r.apply_update(
                     self.trainable, self.opt_state, grads)
                 self.num_samples += len(labels)
@@ -803,8 +846,9 @@ class ProtocolClient:
                 next_item = next(data_iter, None)
                 x = jnp.asarray(x)
                 rng = r.next_rng()
-                out = r.fwd(self.frozen, self.trainable, self.stats, x,
-                            rng)
+                out = _cast_for_wire(
+                    r.fwd(self.frozen, self.trainable, self.stats, x,
+                          rng), self._dev_cast)
                 data_id = uuid.uuid4().hex
                 inflight[data_id] = _Inflight(x=x, rng=rng,
                                               trace=[self.client_id],
@@ -889,6 +933,7 @@ class ProtocolClient:
                     self.trainable, self.opt_state, gt)
                 self.num_samples += ent.n   # see _train_first
                 origin = ent.trace[-1]
+                gx = _cast_for_wire(gx, self._dev_cast)
                 _start_host_copy(gx)
                 self._publish_parts(
                     gradient_queue(self.stage - 1, origin),
@@ -911,11 +956,13 @@ class ProtocolClient:
                 fence_copies[key] = fence_copies.get(key, 0) + 1
                 if fence_copies[key] == quorum:
                     for q in out_qs:   # fence ALL downstream devices
-                        self.bus.publish(q, raw)
+                        self.bus.publish(q, raw)  # slcheck: wire=EpochEnd
                 continue
             x = _from_wire_tree(act.data)
             rng = r.next_rng()
-            out = r.fwd(self.frozen, self.trainable, self.stats, x, rng)
+            out = _cast_for_wire(
+                r.fwd(self.frozen, self.trainable, self.stats, x, rng),
+                self._dev_cast)
             inflight[act.data_id] = _Inflight(x=x, rng=rng,
                                               trace=list(act.trace),
                                               n=len(act.labels))
@@ -1103,11 +1150,14 @@ class ProtocolClient:
         loss, gt, gx, self.stats = r.last_step(
             self.frozen, self.trainable, self.stats, x, labels,
             r.next_rng())
-        if not bool(jnp.isfinite(loss)):
-            self.round_ok = False   # NaN sentinel (src/train/VGG16.py:169)
+        # NaN sentinel (src/train/VGG16.py:169), folded on DEVICE and
+        # synced once per round in _send_update (slcheck JX001)
+        self._ok_dev = jnp.logical_and(self._ok_dev,
+                                       jnp.isfinite(loss))
         self.trainable, self.opt_state = r.apply_update(
             self.trainable, self.opt_state, gt)
         self.num_samples += int(sum(sizes))
+        gx = _cast_for_wire(gx, self._dev_cast)
         _start_host_copy(gx)
         off = 0
         for act, n in zip(window, sizes):

@@ -214,8 +214,9 @@ class MeshContext(TrainContext):
         if not hasattr(self, "_n_params"):
             shapes = jax.eval_shape(self.init_variables)
             self._n_params = int(sum(
-                np.prod(l.shape)
-                for l in jax.tree_util.tree_leaves(shapes["params"])))
+                np.prod(leaf.shape)
+                for leaf in jax.tree_util.tree_leaves(
+                    shapes["params"])))
         return self._n_params
 
     def _parallel_axis(self) -> tuple[str, int] | None:

@@ -47,8 +47,13 @@ class Logger:
             self._log.removeHandler(h)
             h.close()
         handler = logging.FileHandler(root / "app.log")
+        # %(name)s carries the participant ("server"/"{client_id}"):
+        # an in-process cell interleaves every participant in ONE
+        # app.log, and the protocol-model trace validator
+        # (analysis/model.py events_from_log) needs it to replay each
+        # participant's state machine separately
         handler.setFormatter(logging.Formatter(
-            "%(asctime)s - %(levelname)s - %(message)s"))
+            "%(asctime)s - %(name)s - %(levelname)s - %(message)s"))
         self._log.addHandler(handler)
         self._handler = handler
 

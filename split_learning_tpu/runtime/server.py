@@ -250,7 +250,8 @@ class ProtocolContext(MeshContext):
         if self.cfg.topology.elastic_join:
             enough = lambda: all(  # noqa: E731
                 c >= n for c, n in zip(by_stage(), need))
-            what = lambda: f"per-stage registrations {by_stage()}/{need}"
+            what = lambda: (  # noqa: E731
+                f"per-stage registrations {by_stage()}/{need}")
         else:
             total = sum(need)
             enough = lambda: len(self._registrations) >= total  # noqa

@@ -1,0 +1,545 @@
+"""slcheck analyzer suite: the repo must run clean, and each analyzer
+must catch its deliberately broken negative snippet (an illegal
+protocol transition, a host sync in a jitted tick loop, a lock-order
+inversion, ...)."""
+
+import json
+import pathlib
+import textwrap
+
+import pytest
+
+from split_learning_tpu.analysis import concurrency as CL
+from split_learning_tpu.analysis import jaxpr_audit as JX
+from split_learning_tpu.analysis import model as M
+from split_learning_tpu.analysis import protocol_check as PC
+from split_learning_tpu.analysis.__main__ import main as slcheck_main
+from split_learning_tpu.analysis.findings import Baseline, Finding
+
+ROOT = pathlib.Path(__file__).resolve().parents[1]
+
+
+def codes(findings):
+    return {f.code for f in findings}
+
+
+# --------------------------------------------------------------------------
+# the repo itself must be clean (acceptance criterion)
+# --------------------------------------------------------------------------
+
+def test_repo_runs_clean_json(capsys):
+    rc = slcheck_main(["--format", "json"])
+    out = capsys.readouterr().out
+    data = json.loads(out)
+    assert rc == 0, out
+    assert data["ok"], data["findings"]
+    assert data["findings"] == []
+
+
+def test_cli_baseline_suppresses(tmp_path, capsys):
+    # a baselined fingerprint must flip the exit code back to 0
+    f = Finding("PC001", "x.py", 3, "f", "boom")
+    Baseline({f.fingerprint: "accepted"}, path=tmp_path / "b.json").save(
+        [f])
+    b = Baseline.load(tmp_path / "b.json")
+    new, sup = b.split([f, Finding("PC001", "y.py", 1, "g", "other")])
+    assert [x.path for x in sup] == ["x.py"]
+    assert [x.path for x in new] == ["y.py"]
+
+
+# --------------------------------------------------------------------------
+# protocol conformance negatives
+# --------------------------------------------------------------------------
+
+def _role_check(tmp_path, snippet, role="client"):
+    p = tmp_path / "snippet.py"
+    p.write_text(textwrap.dedent(snippet))
+    return PC._check_role_file(p, "snippet.py", role)
+
+
+def test_client_sending_start_on_rpc_is_illegal(tmp_path):
+    fs = _role_check(tmp_path, """
+        class C:
+            def bad_send(self):
+                self.bus.publish(RPC_QUEUE, encode(Start(
+                    start_layer=0, end_layer=-1, cluster=0,
+                    params=None)))
+            def bad_recv(self):
+                raw = self.bus.get(RPC_QUEUE, timeout=1.0)
+        """)
+    assert "PC001" in codes(fs)    # client may not SEND Start
+    assert "PC003" in codes(fs)    # client may not CONSUME rpc_queue
+
+
+def test_server_gradient_send_is_illegal(tmp_path):
+    fs = _role_check(tmp_path, """
+        class S:
+            def bad(self, cid):
+                self.bus.publish(gradient_queue(1, cid),
+                                 encode(Gradient(data_id="d",
+                                                 data=None, trace=[])))
+        """, role="server")
+    assert "PC001" in codes(fs)
+
+
+def test_unresolved_publish_needs_annotation(tmp_path):
+    fs = _role_check(tmp_path, """
+        class C:
+            def relay(self, q, raw):
+                self.bus.publish(mystery_queue(), raw)
+        """)
+    assert "PC002" in codes(fs)
+
+
+def test_legal_sites_pass(tmp_path):
+    fs = _role_check(tmp_path, """
+        class C:
+            def good(self):
+                self.bus.publish(RPC_QUEUE, encode(Register(
+                    client_id="c", stage=1)))
+                out_qs = [intermediate_queue(1, 0)]
+                for q in out_qs:
+                    self.bus.publish(q, encode(EpochEnd(
+                        client_id="c")))
+                raw = self.bus.get(reply_queue(self.client_id))
+        """)
+    assert fs == []
+
+
+def test_transport_origination_is_flagged(tmp_path):
+    p = tmp_path / "bus.py"
+    p.write_text(textwrap.dedent("""
+        class T:
+            def sneaky(self):
+                self.inner.publish("rpc_queue", b"fake")
+            def ok(self, queue, payload):
+                self.inner.publish(queue, payload)
+        """))
+    fs = PC._check_transport_file(p, "bus.py")
+    assert codes(fs) == {"PC008"}
+    assert len(fs) == 1
+
+
+def test_crc_order_violation_detected(tmp_path):
+    p = tmp_path / "proto.py"
+    p.write_text(textwrap.dedent("""
+        def bad_decode(raw):
+            arr = np.frombuffer(raw, np.float32)   # before any crc!
+            if zlib.crc32(raw) != 0:
+                raise ValueError
+            return arr
+        """))
+    fs = PC._check_crc_order(p, "proto.py")
+    assert codes(fs) == {"PC005"}
+
+
+def test_codec_round_trip_clean():
+    assert PC._check_codec() == []
+
+
+# --------------------------------------------------------------------------
+# trace validator
+# --------------------------------------------------------------------------
+
+def _ev(role, direction, kind, who=""):
+    return M.Event(role=role, direction=direction, kind=kind,
+                   participant=who or role)
+
+
+def test_legal_round_validates_clean():
+    events = [
+        _ev("client", "send", "Register", "c1"),
+        _ev("server", "recv", "Register"),
+        _ev("server", "send", "Start"),
+        _ev("client", "recv", "Start", "c1"),
+        _ev("client", "send", "Ready", "c1"),
+        _ev("server", "recv", "Ready"),
+        _ev("server", "send", "Syn"),
+        _ev("client", "recv", "Syn", "c1"),
+        _ev("client", "send", "Notify", "c1"),
+        _ev("server", "recv", "Notify"),
+        _ev("server", "send", "Pause"),
+        _ev("client", "recv", "Pause", "c1"),
+        _ev("client", "send", "Update", "c1"),
+        _ev("server", "recv", "Update"),
+        _ev("server", "send", "Stop"),
+        _ev("client", "recv", "Stop", "c1"),
+    ]
+    assert M.validate_events(events) == []
+
+
+def test_illegal_transitions_flagged():
+    # SYN before any START
+    fs = M.validate_events([_ev("server", "send", "Syn")])
+    assert codes(fs) == {"TV001"}
+    # client uploading without a PAUSE
+    fs = M.validate_events([
+        _ev("client", "recv", "Start"),
+        _ev("client", "send", "Ready"),
+        _ev("client", "send", "Update"),
+    ])
+    assert codes(fs) == {"TV001"}
+    # PAUSE before SYN on the server
+    fs = M.validate_events([
+        _ev("server", "send", "Start"),
+        _ev("server", "send", "Pause"),
+    ])
+    assert codes(fs) == {"TV001"}
+
+
+def test_log_replay_roundtrip():
+    good = "\n".join([
+        "2026-08-03 10:00:00,001 - c1.1a2b - INFO - [>>>] REGISTER "
+        "stage=1",
+        "2026-08-03 10:00:00,002 - server.9f - INFO - [<<<] REGISTER c1 "
+        "stage=1",
+        "2026-08-03 10:00:00,003 - server.9f - INFO - [>>>] START -> c1 "
+        "layers=[0, -1]",
+        "2026-08-03 10:00:00,004 - c1.1a2b - INFO - [<<<] START "
+        "layers=[0, -1] cluster=0",
+        "2026-08-03 10:00:00,005 - c1.1a2b - INFO - [>>>] READY",
+        "2026-08-03 10:00:00,006 - server.9f - INFO - [>>>] SYN -> "
+        "['c1']",
+        "2026-08-03 10:00:00,007 - c1.1a2b - INFO - [<<<] SYN round=0",
+        "2026-08-03 10:00:00,008 - c1.1a2b - INFO - [>>>] NOTIFY fwd=1",
+        "2026-08-03 10:00:00,009 - server.9f - INFO - [<<<] NOTIFY c1",
+        "2026-08-03 10:00:00,010 - server.9f - INFO - [>>>] PAUSE -> "
+        "['c1']",
+        "2026-08-03 10:00:00,011 - c1.1a2b - INFO - [<<<] PAUSE",
+        "2026-08-03 10:00:00,012 - c1.1a2b - INFO - [>>>] UPDATE "
+        "samples=8 ok=True",
+        "2026-08-03 10:00:00,013 - server.9f - INFO - [<<<] UPDATE c1 "
+        "samples=8 ok=True",
+        "2026-08-03 10:00:00,014 - server.9f - INFO - [>>>] STOP -> all",
+        "2026-08-03 10:00:00,015 - c1.1a2b - INFO - [<<<] STOP done",
+    ])
+    assert M.validate_log(good) == []
+    bad = good.replace(
+        "c1.1a2b - INFO - [>>>] READY",
+        "c1.1a2b - INFO - [>>>] UPDATE samples=0 ok=True", 1)
+    assert "TV001" in codes(M.validate_log(bad))
+
+
+def test_real_round_log_validates_clean():
+    """A genuine app.log from a full protocol round (written by the
+    slow round tests / chaos runs) must replay clean.  Synthesizes a
+    round via the real Logger to pin the format end to end."""
+    import tempfile
+
+    from split_learning_tpu.runtime.log import Logger
+    with tempfile.TemporaryDirectory() as d:
+        server = Logger(d, console=False, name="server")
+        client = Logger(d, console=False, name="client_1_0")
+        client.info("[>>>] REGISTER stage=1")
+        server.received("REGISTER client_1_0 stage=1")
+        server.sent("START -> client_1_0 layers=[0, -1]")
+        client.info("[<<<] START layers=[0, -1] cluster=0")
+        client.info("[>>>] READY")
+        server.sent("SYN -> ['client_1_0']")
+        client.info("[<<<] SYN round=0")
+        client.info("[>>>] NOTIFY fwd=2 bwd=2")
+        server.received("NOTIFY client_1_0")
+        server.sent("PAUSE -> ['client_1_0']")
+        client.info("[<<<] PAUSE")
+        client.info("[>>>] UPDATE samples=8 ok=True")
+        server.received("UPDATE client_1_0 samples=8 ok=True")
+        server.sent("STOP -> all (training complete)")
+        client.info("[<<<] STOP training complete")
+        server.close()
+        client.close()
+        text = (pathlib.Path(d) / "app.log").read_text()
+        events = M.events_from_log(text)
+        assert len(events) == 15
+        assert M.validate_log(text) == []
+
+
+def test_data_stream_validator():
+    import numpy as np
+
+    from split_learning_tpu.runtime.protocol import Activation, Gradient
+    act = lambda i: Activation(  # noqa: E731
+        data_id=f"d{i}", data=np.ones((1,), np.float32),
+        labels=np.zeros((1,), np.int64), trace=["c"], cluster=0)
+    q = "intermediate_queue_1_0"
+    assert M.validate_data_stream([act(0), act(1)], q) == []
+    # duplicate delivery after the reliable layer is a contract breach
+    fs = M.validate_data_stream([act(0), act(0)], q)
+    assert codes(fs) == {"TV003"}
+    # a gradient does not belong on the forward plane
+    g = Gradient(data_id="g", data=None, trace=[])
+    assert codes(M.validate_data_stream([g], q)) == {"TV003"}
+
+
+# --------------------------------------------------------------------------
+# jaxpr auditor negatives
+# --------------------------------------------------------------------------
+
+def _hot_tree(tmp_path, client_body, context_body="pass"):
+    root = tmp_path
+    rt = root / "split_learning_tpu" / "runtime"
+    rt.mkdir(parents=True)
+    (rt / "client.py").write_text(textwrap.dedent(client_body))
+    (rt / "context.py").write_text(textwrap.dedent(f"""
+        def _drive_columns(self):
+            {context_body}
+        """))
+    return root
+
+
+def test_host_sync_in_tick_loop_detected(tmp_path):
+    root = _hot_tree(tmp_path, """
+        class C:
+            def _train_first(self):
+                while True:
+                    loss = r.fwd(x)
+                    if not bool(jnp.isfinite(loss)):   # per-tick sync!
+                        break
+        """)
+    fs = JX._audit_hot_loops(root)
+    assert codes(fs) == {"JX001"}
+
+
+def test_allow_sync_annotation_suppresses(tmp_path):
+    root = _hot_tree(tmp_path, """
+        class C:
+            def _train_first(self):
+                while True:
+                    loss = r.fwd(x)
+                    ok = bool(loss)  # slcheck: allow-sync
+        """)
+    assert JX._audit_hot_loops(root) == []
+
+
+def test_jit_in_loop_detected(tmp_path):
+    root = _hot_tree(tmp_path, """
+        class C:
+            def _train_middle(self):
+                for x in data:
+                    step = jax.jit(lambda v: v)
+        """)
+    assert "JX006" in codes(JX._audit_hot_loops(root))
+
+
+def test_donated_reuse_detected(tmp_path):
+    root = _hot_tree(tmp_path, """
+        pass
+        """, context_body="""
+            out = step(params, opt, stats, x, labels, rngs)
+            return params""")
+    fs = JX._audit_donation(root)
+    assert {f.code for f in fs} == {"JX005"}
+    assert sum("params" in f.message for f in fs) == 1
+
+
+def test_wire_upcast_detected_when_device_cast_removed(monkeypatch):
+    import split_learning_tpu.runtime.client as client_mod
+    monkeypatch.setattr(client_mod, "device_wire_dtype",
+                        lambda d: None)
+    fs = JX._audit_jaxprs(ROOT, "bfloat16")
+    assert "JX002" in codes(fs)
+
+
+def test_jaxpr_pass_clean_on_repo():
+    assert JX._audit_jaxprs(ROOT, "bfloat16") == []
+
+
+# --------------------------------------------------------------------------
+# concurrency lint negatives
+# --------------------------------------------------------------------------
+
+def _concurrency(tmp_path, snippet, monkeypatch):
+    p = tmp_path / "snippet_bus.py"
+    p.write_text(textwrap.dedent(snippet))
+    monkeypatch.setattr(CL, "FILES", ("snippet_bus.py",))
+    return CL.run(tmp_path)
+
+
+def test_lock_order_inversion_detected(tmp_path, monkeypatch):
+    fs = _concurrency(tmp_path, """
+        import threading
+        class A:
+            def __init__(self):
+                self._a = threading.Lock()
+                self._b = threading.Lock()
+            def m1(self):
+                with self._a:
+                    with self._b:
+                        pass
+            def m2(self):
+                with self._b:
+                    with self._a:
+                        pass
+        """, monkeypatch)
+    assert "CL001" in codes(fs)
+    assert any("cycle" in f.message for f in fs)
+
+
+def test_blocking_under_lock_detected(tmp_path, monkeypatch):
+    fs = _concurrency(tmp_path, """
+        import threading, time
+        class A:
+            def __init__(self):
+                self._a = threading.Lock()
+            def m(self):
+                with self._a:
+                    time.sleep(1)
+        """, monkeypatch)
+    assert codes(fs) == {"CL002"}
+
+
+def test_io_lock_annotation_allows_blocking(tmp_path, monkeypatch):
+    fs = _concurrency(tmp_path, """
+        import threading, time
+        class A:
+            def __init__(self):
+                self._a = threading.Lock()  # slcheck: io-lock
+            def m(self):
+                with self._a:
+                    self.sock.sendall(b"x")
+        """, monkeypatch)
+    assert fs == []
+
+
+def test_thread_without_join_detected(tmp_path, monkeypatch):
+    fs = _concurrency(tmp_path, """
+        import threading
+        class A:
+            def __init__(self):
+                self._t = threading.Thread(target=self.run)
+                self._t.start()
+        """, monkeypatch)
+    assert codes(fs) == {"CL003"}
+
+
+def test_inner_call_under_lock_detected(tmp_path, monkeypatch):
+    fs = _concurrency(tmp_path, """
+        import threading
+        class A:
+            def __init__(self):
+                self._a = threading.Lock()
+                self._t = threading.Thread(target=self.m)
+                self._t.start()
+            def m(self):
+                with self._a:
+                    self.inner.publish("q", b"")
+            def stop(self):
+                self._t.join()
+        """, monkeypatch)
+    assert codes(fs) == {"CL005"}
+
+
+def test_io_lock_nested_under_state_lock_still_flagged(tmp_path,
+                                                       monkeypatch):
+    """An io-lock only exempts blocking when NOTHING else is held: a
+    socket write inside `with io_lock:` nested under a state lock
+    still blocks the state lock."""
+    fs = _concurrency(tmp_path, """
+        import threading
+        class A:
+            def __init__(self):
+                self._state = threading.Lock()
+                self._io = threading.Lock()  # slcheck: io-lock
+            def m(self):
+                with self._state:
+                    with self._io:
+                        self.sock.sendall(b"x")
+        """, monkeypatch)
+    assert "CL002" in codes(fs)
+    assert any("_state" in f.message for f in fs)
+
+
+def test_cond_wait_under_outer_lock_flagged(tmp_path, monkeypatch):
+    fs = _concurrency(tmp_path, """
+        import threading
+        class A:
+            def __init__(self):
+                self._state = threading.Lock()
+                self._c = threading.Condition()
+            def m(self):
+                with self._state:
+                    with self._c:
+                        self._c.wait_for(lambda: True)
+        """, monkeypatch)
+    assert any(f.code == "CL002" and "stays held" in f.message
+               for f in fs)
+
+
+def test_write_baseline_partial_run_keeps_other_suppressions(tmp_path):
+    path = tmp_path / "b.json"
+    keep = Finding("CL002", "bus.py", 1, "get", "accepted debt")
+    Baseline({keep.fingerprint: "why"}, path=path).save([keep])
+    new = Finding("PC001", "client.py", 2, "send", "fresh")
+    b = Baseline.load(path)
+    b.save([new], prune=False)         # partial analyzer run
+    merged = Baseline.load(path)
+    assert keep.fingerprint in merged.suppressions
+    assert merged.suppressions[keep.fingerprint] == "why"
+    assert new.fingerprint in merged.suppressions
+    b2 = Baseline.load(path)
+    b2.save([new], prune=True)         # full run prunes stale entries
+    assert Baseline.load(path).suppressions == {
+        new.fingerprint: "baselined by --write-baseline"}
+
+
+def test_notify_outside_with_detected(tmp_path, monkeypatch):
+    fs = _concurrency(tmp_path, """
+        import threading
+        class A:
+            def __init__(self):
+                self._c = threading.Condition()
+            def m(self):
+                self._c.notify_all()
+        """, monkeypatch)
+    assert codes(fs) == {"CL004"}
+
+
+def test_repo_concurrency_clean():
+    assert CL.run(ROOT) == []
+
+
+# --------------------------------------------------------------------------
+# instrumented-lock runtime mode (SLCHECK_LOCKS=1)
+# --------------------------------------------------------------------------
+
+def test_instrumented_locks_assert_order(monkeypatch):
+    monkeypatch.setenv("SLCHECK_LOCKS", "1")
+    from split_learning_tpu.analysis import locks
+    a = locks.make_lock("async")
+    b = locks.make_lock("inproc")
+    with a:
+        with b:          # outer -> inner: legal
+            pass
+    with pytest.raises(locks.LockOrderViolation):
+        with b:
+            with a:      # inner -> outer: inversion
+                pass
+    # the inversion above must not poison this thread's stack
+    with a:
+        with b:
+            pass
+
+
+def test_instrumented_transport_round_trip(monkeypatch):
+    """A live transport stack under SLCHECK_LOCKS=1: the layered
+    publish/get path must hold locks in LOCK_ORDER (the runtime twin
+    of the static CL001 check)."""
+    monkeypatch.setenv("SLCHECK_LOCKS", "1")
+    from split_learning_tpu.runtime.bus import (
+        InProcTransport, ReliableTransport,
+    )
+    bus = InProcTransport()
+    sender = ReliableTransport(bus, sender="s",
+                               patterns=("intermediate_queue*",),
+                               redeliver_s=0.05, max_redeliver=5)
+    recv = ReliableTransport(bus, sender="r",
+                             patterns=("intermediate_queue*",),
+                             redeliver_s=0.05, max_redeliver=5)
+    msgs = [b"m%d" % i for i in range(20)]
+    for m in msgs:
+        sender.publish("intermediate_queue_0_0", m)
+    got = [recv.get("intermediate_queue_0_0", timeout=10.0)
+           for _ in msgs]
+    assert got == msgs
+    sender.stop(close_inner=False)
+    recv.stop(close_inner=False)
+    bus.close()

@@ -5,7 +5,7 @@ import threading
 import numpy as np
 import pytest
 
-from split_learning_tpu.config import Config, ConfigError, from_dict
+from split_learning_tpu.config import ConfigError, from_dict
 from split_learning_tpu.runtime import bus, protocol
 
 

@@ -9,7 +9,6 @@ must not inflate its client's aggregation weight
 ``src/Server.py:169-179``).
 """
 
-import numpy as np
 import pytest
 
 from split_learning_tpu.config import from_dict

@@ -2,7 +2,6 @@
 blocks, and the model-level use_flash path (Pallas interpreter on CPU)."""
 
 import jax
-import jax.numpy as jnp
 import numpy as np
 import pytest
 

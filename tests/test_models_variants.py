@@ -4,7 +4,6 @@ and the ViT-S north-star geometry."""
 import jax
 import jax.numpy as jnp
 import numpy as np
-import pytest
 
 from split_learning_tpu.models import build_model, num_layers, shard_params
 

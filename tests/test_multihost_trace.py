@@ -3,7 +3,6 @@ tracing utilities."""
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 import pytest
 
 from split_learning_tpu.parallel.multihost import (

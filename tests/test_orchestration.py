@@ -13,7 +13,6 @@ from split_learning_tpu.runtime.checkpoint import (
     delete_checkpoint, load_checkpoint,
 )
 from split_learning_tpu.runtime.context import MeshContext, client_groups
-from split_learning_tpu.runtime.loop import run_training
 from split_learning_tpu.runtime.plan import (
     Registration, plan_clusters,
 )

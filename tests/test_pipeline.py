@@ -5,7 +5,6 @@ must produce exactly the loss/grads/batch_stats that a sequential
 full-model pass over the same microbatches produces — the TPU analog of
 the reference's split ≡ unsplit guarantee."""
 
-import functools
 
 import jax
 import jax.numpy as jnp

@@ -1,7 +1,6 @@
 """Profiler: per-layer costs/sizes feed the auto-partition planner
 (reference profiling.py → REGISTER → src/Partition.py pipeline)."""
 
-import numpy as np
 
 from split_learning_tpu.profiler import (
     profile_model, profile_network, write_profile,

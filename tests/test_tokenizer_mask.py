@@ -246,7 +246,7 @@ class TestFineGrainedBert:
             PipelineModel, make_train_step, make_mesh,
         )
         from split_learning_tpu.parallel.pipeline import (
-            init_pipeline_variables, stack_for_clients, shard_to_mesh,
+            init_pipeline_variables, stack_for_clients,
         )
         from split_learning_tpu.models import build_model
         from tests.test_pipeline import _ref_loss

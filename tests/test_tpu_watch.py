@@ -9,7 +9,6 @@ import importlib.util
 import json
 import pathlib
 import subprocess
-import sys
 import types
 
 import pytest

@@ -1,0 +1,209 @@
+"""Direct coverage for runtime/validation.py and runtime/trace.py.
+
+Both were previously exercised only indirectly through full round
+soaks; these tests pin their contracts — dataset mapping, the NaN/
+exploded-loss round gate, thread-safe counter accumulation, the
+shared-registry merging the server relies on, and the metrics
+snapshot shapes."""
+
+import threading
+
+import numpy as np
+import pytest
+
+from split_learning_tpu.runtime import trace as T
+from split_learning_tpu.runtime import validation as V
+
+TINY_KWT = {"embed_dim": 16, "num_heads": 2, "mlp_dim": 32}
+
+
+# --------------------------------------------------------------------------
+# validation.py
+# --------------------------------------------------------------------------
+
+class TestDatasetMapping:
+    def test_explicit_table(self):
+        assert V.dataset_for_model("VGG16_CIFAR10") == "CIFAR10"
+        assert V.dataset_for_model("KWT_SPEECHCOMMANDS") \
+            == "SPEECHCOMMANDS"
+
+    def test_convention_fallback(self):
+        # registry convention {MODEL}_{DATASET}
+        assert V.dataset_for_model("LLAMA_TINYSTORIES") == "TINYSTORIES"
+
+    def test_vocab_threading_for_token_datasets(self):
+        kw = V.dataset_kwargs_for_model("BERT_AGNEWS",
+                                        {"vocab_size": 128})
+        assert kw == {"vocab": 128}
+        # non-token datasets never get a vocab kwarg
+        assert V.dataset_kwargs_for_model("VGG16_CIFAR10",
+                                          {"vocab_size": 128}) == {}
+        # no override -> nothing to thread
+        assert V.dataset_kwargs_for_model("BERT_AGNEWS", {}) == {}
+
+
+class TestValResult:
+    def test_ok_accepts_finite(self):
+        assert V.ValResult(loss=2.3, accuracy=0.1, num_samples=8).ok
+
+    def test_rejects_nan_and_inf(self):
+        assert not V.ValResult(loss=float("nan"), accuracy=0.0,
+                               num_samples=8).ok
+        assert not V.ValResult(loss=float("inf"), accuracy=0.0,
+                               num_samples=8).ok
+
+    def test_rejects_exploded_loss(self):
+        # |loss| >= 1e5 marks the round failed even though finite
+        assert not V.ValResult(loss=1e6, accuracy=0.0,
+                               num_samples=8).ok
+        assert not V.ValResult(loss=-1e6, accuracy=0.0,
+                               num_samples=8).ok
+
+
+def test_evaluate_tiny_model_end_to_end():
+    import jax
+    import jax.numpy as jnp
+
+    from split_learning_tpu.models import build_model
+    model = build_model("KWT_SPEECHCOMMANDS", **TINY_KWT)
+    variables = model.init(jax.random.key(0),
+                           jnp.zeros((2, 40, 98), jnp.float32),
+                           train=False)
+    res = V.evaluate("KWT_SPEECHCOMMANDS", variables, batch_size=8,
+                     max_batches=2, model_kwargs=TINY_KWT,
+                     synthetic_size=32)
+    assert res.num_samples == 16          # 2 batches of 8
+    assert np.isfinite(res.loss)
+    assert 0.0 <= res.accuracy <= 1.0
+    assert res.ok
+
+
+# --------------------------------------------------------------------------
+# trace.py counters
+# --------------------------------------------------------------------------
+
+class TestFaultCounters:
+    def test_concurrent_increments_merge_exactly(self):
+        fc = T.FaultCounters()
+
+        def worker():
+            for _ in range(1000):
+                fc.inc("drops")
+                fc.inc("timeouts", 2)
+        threads = [threading.Thread(target=worker) for _ in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        snap = fc.snapshot()
+        assert snap == {"drops": 8000, "timeouts": 16000}
+        assert fc.total() == 24000
+
+    def test_snapshot_is_a_copy(self):
+        fc = T.FaultCounters()
+        fc.inc("x")
+        snap = fc.snapshot()
+        snap["x"] = 99
+        assert fc.snapshot() == {"x": 1}
+
+    def test_default_registry_merges_across_layers(self):
+        """Transport wrappers built without an explicit ``faults=``
+        share the process-wide default registry — this is how the
+        server's end-of-round record sees every layer's counters in an
+        in-process cell."""
+        from split_learning_tpu.runtime.bus import (
+            InProcTransport, ReliableTransport,
+        )
+        from split_learning_tpu.runtime.chaos import ChaosTransport
+        from split_learning_tpu.config import ChaosConfig
+        bus = InProcTransport()
+        rel = ReliableTransport(bus, sender="s",
+                                patterns=("never_matching*",))
+        ch = ChaosTransport(InProcTransport(), ChaosConfig())
+        try:
+            assert rel.faults is T.default_fault_counters
+            assert ch.faults is T.default_fault_counters
+            base = T.default_fault_counters.snapshot().get("drops", 0)
+            rel.faults.inc("drops")
+            ch.faults.inc("drops")
+            assert T.default_fault_counters.snapshot()["drops"] \
+                == base + 2
+        finally:
+            rel.stop(close_inner=True)
+            ch.close()
+
+
+class TestWireCounters:
+    def test_plane_classification_and_totals(self):
+        wc = T.WireCounters()
+        wc.count_out("intermediate_queue_1_0", 100)
+        wc.count_out("gradient_queue_1_c", 50)
+        wc.count_out("rpc_queue", 7)
+        wc.count_in("reply_c", 3)
+        snap = wc.snapshot()
+        assert snap["bytes_out_total"] == 157
+        assert snap["data_bytes_out"] == 150    # rpc is control plane
+        assert snap["bytes_in_total"] == 3
+        assert snap["data_bytes_in"] == 0
+        assert snap["msgs_out"] == 3 and snap["msgs_in"] == 1
+
+    def test_encode_decode_accumulation(self):
+        wc = T.WireCounters()
+        wc.add_encode(0.25)
+        wc.add_encode(0.25)
+        wc.add_decode(0.125)
+        snap = wc.snapshot()
+        assert snap["encode_s"] == pytest.approx(0.5)
+        assert snap["encode_n"] == 2
+        assert snap["decode_s"] == pytest.approx(0.125)
+        assert snap["decode_n"] == 1
+
+    def test_send_queue_high_water_mark_is_monotonic(self):
+        wc = T.WireCounters()
+        for depth in (1, 5, 3):
+            wc.note_send_depth(depth)
+        assert wc.snapshot()["send_queue_hwm"] == 5
+
+    def test_per_queue_view(self):
+        wc = T.WireCounters()
+        wc.count_out("a", 1)
+        wc.count_out("a", 2)
+        wc.count_in("b", 4)
+        assert wc.per_queue() == {"bytes_out": {"a": 3},
+                                  "bytes_in": {"b": 4}}
+
+    def test_concurrent_counting(self):
+        wc = T.WireCounters()
+
+        def worker():
+            for _ in range(500):
+                wc.count_out("q", 2)
+                wc.add_encode(0.001)
+        threads = [threading.Thread(target=worker) for _ in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        snap = wc.snapshot()
+        assert snap["bytes_out_total"] == 4000
+        assert snap["msgs_out"] == 2000
+        assert snap["encode_n"] == 2000
+
+
+class TestStepTimer:
+    def test_phase_and_record_merge(self):
+        st = T.StepTimer()
+        with st.phase("step"):
+            pass
+        st.record("step", 1.0)
+        st.record("agg", 0.5)
+        summary = st.summary()
+        assert summary["step"]["count"] == 2
+        assert summary["step"]["total_s"] >= 1.0
+        assert summary["agg"]["mean_s"] == pytest.approx(0.5)
+
+    def test_reset(self):
+        st = T.StepTimer()
+        st.record("x", 1.0)
+        st.reset()
+        assert st.summary() == {}

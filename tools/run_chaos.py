@@ -1,13 +1,19 @@
 #!/usr/bin/env python
 """Chaos sweep: fault probabilities x seeds -> pass/fail matrix.
 
-Each cell pushes a message stream through the production transport
-stack — ``ReliableTransport`` over a seeded ``ChaosTransport`` over the
+Each cell pushes a PROTOCOL message stream (TENSOR-framed Activations
+fenced by an EpochEnd) through the production transport stack —
+``ReliableTransport`` over a seeded ``ChaosTransport`` over the
 in-process bus — and PASSes iff the receiver sees the exact sent
-sequence, in order, with nothing extra.  Because every cell is
-reproducible from its (fault, probability, seed) triple, a FAIL here is
-a ready-made regression test: rerun with ``--only drop:0.4 --seeds 1
---seed-base <seed>`` and debug.
+sequence, in order, with nothing extra, AND the decoded stream replays
+clean through the protocol-model trace validator
+(``split_learning_tpu/analysis/model.py``) — so every sweep cell also
+proves protocol conformance, not just byte delivery.  ``--full`` cells
+additionally replay the round's ``app.log`` through the control-plane
+state machines.  Because every cell is reproducible from its (fault,
+probability, seed) triple, a FAIL here is a ready-made regression
+test: rerun with ``--only drop:0.4 --seeds 1 --seed-base <seed>`` and
+debug.
 
     python tools/run_chaos.py                  # default grid, 5 seeds
     python tools/run_chaos.py --seeds 20 --messages 400   # longer soak
@@ -27,6 +33,9 @@ import time
 
 sys.path.insert(0, ".")  # run from the repo root
 
+from split_learning_tpu.analysis.model import (  # noqa: E402
+    validate_data_stream, validate_log,
+)
 from split_learning_tpu.config import ChaosConfig  # noqa: E402
 from split_learning_tpu.runtime.bus import (  # noqa: E402
     InProcTransport, ReliableTransport,
@@ -35,6 +44,20 @@ from split_learning_tpu.runtime.chaos import ChaosTransport  # noqa: E402
 from split_learning_tpu.runtime.trace import FaultCounters  # noqa: E402
 
 QUEUE = "intermediate_queue_0_0"
+
+
+def _protocol_stream(n: int) -> list[bytes]:
+    """n TENSOR-framed Activations + the epoch fence, as wire bytes."""
+    import numpy as np
+
+    from split_learning_tpu.runtime import protocol as proto
+    frames = [proto.encode(proto.Activation(
+        data_id=f"d{i:06d}",
+        data=np.full((8,), i % 7, np.float32),
+        labels=np.asarray([i % 10], np.int64),
+        trace=["feeder"], cluster=0)) for i in range(n)]
+    frames.append(proto.encode(proto.EpochEnd(client_id="feeder")))
+    return frames
 
 
 def transport_cell(fault: str, prob: float, seed: int,
@@ -64,7 +87,7 @@ def transport_cell(fault: str, prob: float, seed: int,
                              patterns=("intermediate_queue*",),
                              redeliver_s=0.05, max_redeliver=40,
                              gap_timeout_s=60.0, faults=fc)
-    msgs = [b"m%06d" % i for i in range(n_messages)]
+    msgs = _protocol_stream(n_messages)
     t = threading.Thread(
         target=lambda: [sender.publish(QUEUE, m) for m in msgs],
         daemon=True)
@@ -83,6 +106,18 @@ def transport_cell(fault: str, prob: float, seed: int,
         return False, f"{len(got)}/{len(msgs)} exact"
     if extra is not None:
         return False, "phantom extra message"
+    # protocol conformance: the post-transport stream must decode and
+    # replay clean through the declarative data-plane model (right
+    # kinds on this queue family, no duplicate data_id, no round
+    # regression)
+    from split_learning_tpu.runtime import protocol as proto
+    try:
+        decoded = [proto.decode(m) for m in got]
+    except Exception as e:  # noqa: BLE001 — any decode failure fails the cell
+        return False, f"undecodable frame: {type(e).__name__}"
+    violations = validate_data_stream(decoded, QUEUE)
+    if violations:
+        return False, f"protocol: {violations[0].message}"
     snap = fc.snapshot()
     note = "+".join(f"{k[0]}{v}" for k, v in sorted(snap.items())
                     if k in ("drops", "duplicates", "reorders",
@@ -111,7 +146,8 @@ def full_round_cell(fault: str, prob: float, seed: int, tmp: str
             kwargs[f] = prob
     else:
         kwargs[fault] = prob
-    cfg = _round_cfg(root, root / f"{fault}_{prob}_{seed}")
+    cell_dir = root / f"{fault}_{prob}_{seed}"
+    cfg = _round_cfg(root, cell_dir)
     res = _run_cell(cfg, chaos_cfg=_chaos(seed=seed, delay_s=0.005,
                                           **kwargs), reliable=True)
     if not res.history[0].ok:
@@ -123,7 +159,15 @@ def full_round_cell(fault: str, prob: float, seed: int, tmp: str
                     jax.tree_util.tree_leaves(res.params)):
         if not np.array_equal(np.asarray(a), np.asarray(b)):
             return False, "params not bit-identical"
-    return True, "bit-identical"
+    # replay the round's recorded control-plane trace through the
+    # protocol state machines: a chaos run must also PROVE protocol
+    # conformance, not just converge to the right bits
+    log = pathlib.Path(cell_dir) / "app.log"
+    if log.exists():
+        violations = validate_log(log.read_text(), source=str(log))
+        if violations:
+            return False, f"protocol: {violations[0].message}"
+    return True, "bit-identical+conformant"
 
 
 def main(argv=None):

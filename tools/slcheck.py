@@ -1,0 +1,13 @@
+#!/usr/bin/env python
+"""Thin wrapper: ``python tools/slcheck.py`` == ``python -m
+split_learning_tpu.analysis`` from the repo root."""
+
+import pathlib
+import sys
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parents[1]))
+
+from split_learning_tpu.analysis.__main__ import main  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(main())
