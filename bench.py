@@ -986,6 +986,7 @@ def _sec_protocol_mode(ctx: dict) -> dict:
                 pass
     rounds = []
     wire_by_client: dict = {}
+    latency_by_part: dict = {}
     for line in (pathlib.Path(logdir) / "metrics.jsonl"
                  ).read_text().splitlines():
         rec = json.loads(line)
@@ -993,6 +994,13 @@ def _sec_protocol_mode(ctx: dict) -> dict:
             rounds.append(rec)
         elif rec.get("kind") == "wire_client":
             wire_by_client.setdefault(rec["client"], []).append(rec)
+        elif rec.get("kind") == "latency":
+            # cumulative per-participant histograms: keep each
+            # participant's LAST record (records never mix across
+            # participants — their populations differ)
+            latency_by_part[rec.get("participant", "?")] = {
+                k: v for k, v in rec.items()
+                if isinstance(v, dict) and "p95_ms" in v}
     if len(rounds) < 2:
         raise RuntimeError(f"expected 2 round records, got {rounds}")
     steady = rounds[-1]
@@ -1025,6 +1033,25 @@ def _sec_protocol_mode(ctx: dict) -> dict:
     }
     if wire_bytes:
         out["wire_mb_per_round"] = round(wire_bytes / 2**20, 3)
+    # per-frame latency attribution (runtime/spans.py tracing, default
+    # sampling): where a protocol round's wall time actually goes.
+    # Populations are per participant, so the keys pin WHICH one:
+    # server-side upload RTT + broker queue wait, and the slowest
+    # client's step p95 (the straggler is the number that matters)
+    server_lat = latency_by_part.get("server", {})
+    for src, dst in (("frame_rtt", "server_frame_rtt_p95_ms"),
+                     ("queue_wait", "queue_wait_p95_ms")):
+        if src in server_lat:
+            out[dst] = server_lat[src]["p95_ms"]
+    client_steps = [v["step"]["p95_ms"]
+                    for p, v in latency_by_part.items()
+                    if p != "server" and "step" in v]
+    if client_steps:
+        out["slowest_client_step_p95_ms"] = max(client_steps)
+    if latency_by_part:
+        out["tracing"] = ("spans-*.jsonl per participant; merge with "
+                          "tools/sl_trace.py for Perfetto trace + "
+                          "critical path")
     return out
 
 

@@ -1,9 +1,9 @@
 """``python -m split_learning_tpu.analysis`` — the slcheck CLI.
 
-Runs the three analyzers (protocol conformance, jaxpr hot-path audit,
-concurrency lint) over the repo, subtracts the checked-in suppression
-baseline, and reports the rest.  Exit code 1 iff any non-baselined
-finding remains, so it slots straight into CI.
+Runs the analyzers (protocol conformance, jaxpr hot-path audit,
+concurrency lint, counter-name registry) over the repo, subtracts the
+checked-in suppression baseline, and reports the rest.  Exit code 1 iff
+any non-baselined finding remains, so it slots straight into CI.
 
     python -m split_learning_tpu.analysis                 # human output
     python -m split_learning_tpu.analysis --format json   # machine
@@ -23,7 +23,7 @@ from split_learning_tpu.analysis.findings import (
     Baseline, Finding, render_human, render_json,
 )
 
-ANALYZERS = ("protocol", "jaxpr", "concurrency")
+ANALYZERS = ("protocol", "jaxpr", "concurrency", "counters")
 
 
 def repo_root() -> pathlib.Path:
@@ -42,6 +42,9 @@ def run_analyzers(root: pathlib.Path, names=ANALYZERS,
     if "concurrency" in names:
         from split_learning_tpu.analysis import concurrency
         findings += concurrency.run(root)
+    if "counters" in names:
+        from split_learning_tpu.analysis import counters
+        findings += counters.run(root)
     return findings
 
 

@@ -1,0 +1,86 @@
+"""Counter/histogram name-registry conformance (CT001/CT002).
+
+``FaultCounters.inc`` and ``HistogramSet.observe`` are string-keyed: a
+typo'd name does not fail — it silently mints a fresh key that no
+dashboard, test or metrics consumer ever reads, while the intended
+counter stays flat.  The runtime therefore declares its full name
+vocabulary in ``runtime/trace.py`` (:data:`FAULT_COUNTER_NAMES`,
+:data:`HISTOGRAM_NAMES`) and this analyzer enforces, statically, that
+every ``.inc("name", ...)`` / ``.observe("name", ...)`` call with a
+string-literal first argument anywhere in the package or ``tools/``
+uses a declared name.
+
+Non-literal names are deliberately ignored (they are always derived
+from an iteration over declared names today); test files are excluded
+(tests may fabricate names to prove the analyzer works).
+"""
+
+from __future__ import annotations
+
+import ast
+import pathlib
+
+from split_learning_tpu.analysis.findings import Finding
+
+#: (method name, finding code, registry attribute on runtime.trace)
+_RULES = {
+    "inc": ("CT001", "FAULT_COUNTER_NAMES", "FaultCounters counter"),
+    "observe": ("CT002", "HISTOGRAM_NAMES", "latency histogram"),
+}
+
+
+def _registries() -> dict[str, frozenset]:
+    from split_learning_tpu.runtime import trace
+    return {attr: getattr(trace, attr)
+            for _, (_, attr, _) in _RULES.items()}
+
+
+def scan_source(source: str, rel: str,
+                registries: dict[str, frozenset] | None = None
+                ) -> list[Finding]:
+    """All undeclared counter/histogram names in one source file."""
+    regs = registries if registries is not None else _registries()
+    findings: list[Finding] = []
+    tree = ast.parse(source)
+    # enclosing-function names make the fingerprints stable
+    where_of: dict[int, str] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for sub in ast.walk(node):
+                lineno = getattr(sub, "lineno", None)
+                if lineno is not None:
+                    where_of.setdefault(lineno, node.name)
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in _RULES and node.args):
+            continue
+        arg = node.args[0]
+        if not (isinstance(arg, ast.Constant)
+                and isinstance(arg.value, str)):
+            continue
+        code, reg_attr, what = _RULES[node.func.attr]
+        if arg.value in regs[reg_attr]:
+            continue
+        findings.append(Finding(
+            code, rel, node.lineno,
+            where_of.get(node.lineno, arg.value),
+            f"undeclared {what} name {arg.value!r} — add it to "
+            f"runtime/trace.py {reg_attr} (or fix the typo)"))
+    return findings
+
+
+def run(root: pathlib.Path) -> list[Finding]:
+    regs = _registries()
+    findings: list[Finding] = []
+    paths = sorted(
+        list((root / "split_learning_tpu").rglob("*.py"))
+        + list((root / "tools").glob("*.py")))
+    for path in paths:
+        rel = str(path.relative_to(root))
+        try:
+            source = path.read_text()
+        except OSError:
+            continue
+        findings += scan_source(source, rel, regs)
+    return findings

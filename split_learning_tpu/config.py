@@ -348,6 +348,32 @@ class ChaosConfig:
 
 
 @dataclasses.dataclass(frozen=True)
+class ObservabilityConfig:
+    """Distributed round tracing (``runtime/spans.py``).
+
+    Every participant journals spans to ``spans-{participant}.jsonl``
+    (under ``journal-dir``, default the run's ``log_path``); the wire
+    propagates a compact trace context on every TENSOR/chunk frame so
+    publish and consume spans link across participants.
+    ``tools/sl_trace.py`` merges the journals into a Perfetto
+    ``trace.json`` and prints the per-round critical-path report.
+    ``sample-rate`` thins the per-frame/per-batch spans (structural
+    round/phase spans always record); latency histograms and counters
+    are unaffected by sampling."""
+    enabled: bool = True
+    sample_rate: float = 1.0
+    journal_dir: str | None = None      # None -> the run's log_path
+    flush_every: int = 128              # span-journal buffer size
+
+    def validate(self):
+        _check(0.0 <= self.sample_rate <= 1.0,
+               f"observability.sample-rate must be in [0, 1], "
+               f"got {self.sample_rate!r}")
+        _check(self.flush_every >= 1,
+               "observability.flush-every must be >= 1")
+
+
+@dataclasses.dataclass(frozen=True)
 class Config:
     model: str = "VGG16"
     dataset: str = "CIFAR10"
@@ -374,6 +400,7 @@ class Config:
     checkpoint: CheckpointConfig = CheckpointConfig()
     transport: TransportConfig = TransportConfig()
     chaos: ChaosConfig = ChaosConfig()
+    observability: ObservabilityConfig = ObservabilityConfig()
 
     @property
     def model_key(self) -> str:
@@ -392,7 +419,8 @@ class Config:
                f"compute-dtype must be bfloat16|float32, "
                f"got {self.compute_dtype!r}")
         for sub in (self.learning, self.distribution, self.topology,
-                    self.aggregation, self.transport, self.chaos):
+                    self.aggregation, self.transport, self.chaos,
+                    self.observability):
             sub.validate()
         if self.topology.mode == "manual":
             cuts = self.topology.cluster_cut_layers or (
@@ -413,6 +441,7 @@ _SECTION_TYPES = {
     "checkpoint": CheckpointConfig,
     "transport": TransportConfig,
     "chaos": ChaosConfig,
+    "observability": ObservabilityConfig,
 }
 
 

@@ -95,6 +95,11 @@ class Transport:
 
 
 class InProcTransport(Transport):
+    """Entries are ``(t_enqueue, payload)``: the dequeue observes the
+    broker-level queue wait into the process-wide ``queue_wait``
+    latency histogram (``runtime/trace.py``), the one hop the frame's
+    own trace context cannot time from either endpoint."""
+
     def __init__(self):
         super().__init__()
         self._lock = make_lock("inproc")
@@ -102,13 +107,15 @@ class InProcTransport(Transport):
         self._queues: dict[str, collections.deque] = \
             collections.defaultdict(collections.deque)
         self._closed = False
+        from split_learning_tpu.runtime.trace import default_histograms
+        self._hists = default_histograms
 
     def publish(self, queue: str, payload: bytes) -> None:
         self._count(queue, payload)
         with self._cond:
             if self._closed:
                 raise QueueClosed(queue)
-            self._queues[queue].append(payload)
+            self._queues[queue].append((time.perf_counter(), payload))
             self._cond.notify_all()
 
     def get(self, queue: str, timeout: float | None = None) -> bytes | None:
@@ -119,7 +126,10 @@ class InProcTransport(Transport):
                 raise QueueClosed(queue)
             if not ok:
                 return None
-            return self._queues[queue].popleft()
+            t_enq, payload = self._queues[queue].popleft()
+        # histogram has its own lock: observe OUTSIDE the bus condition
+        self._hists.observe("queue_wait", time.perf_counter() - t_enq)
+        return payload
 
     def qsize(self, queue: str) -> int:
         with self._lock:
@@ -431,25 +441,40 @@ class TcpTransport(Transport):
 # at-least-once, in-order delivery
 # --------------------------------------------------------------------------
 # Envelope: RB1 | crc32(body) | body, with
-#   body(data) = 0x01 | 8B seq | 2B name-len | sender-token | payload
+#   body(data) = 0x03 | 8B seq | 2B name-len | sender-token | f64 t-send
+#                | payload
 #   body(ack)  = 0x02 | 8B seq | 2B name-len | queue-name
-# Data frames ride the application queue; acks ride ``__ack__.{token}``.
-# The envelope checksum is the first integrity line: a corrupt frame is
-# silently discarded (no ack), so the sender's redelivery repairs it.
+# (kind 0x01 is the pre-timestamp data envelope: still ACCEPTED so
+# this receiver can consume an old sender's frames, but an OLD
+# receiver rejects 0x03 as corrupt — sender and receiver must upgrade
+# together, like every other change to this envelope).  Data frames
+# ride the application queue; acks
+# ride ``__ack__.{token}``.  The envelope checksum is the first
+# integrity line: a corrupt frame is silently discarded (no ack), so
+# the sender's redelivery repairs it.  ``t-send`` is stamped once at
+# first publish and survives redelivery, so the receiver's
+# ``transport_rtt`` histogram measures the TRUE transport latency of
+# each frame — redelivery delays included.
 
 _ENV_MAGIC = b"RB1"
-_ENV_DATA, _ENV_ACK = 0x01, 0x02
+_ENV_DATA, _ENV_ACK, _ENV_DATA_TS = 0x01, 0x02, 0x03
 _ENV_HDR = len(_ENV_MAGIC) + 4
 
 
-def _env_frame(kind: int, seq: int, name: bytes, payload: bytes) -> bytes:
-    body = struct.pack(">BQH", kind, seq, len(name)) + name + payload
+def _env_frame(kind: int, seq: int, name: bytes, payload: bytes,
+               t_send: float | None = None) -> bytes:
+    body = struct.pack(">BQH", kind, seq, len(name)) + name
+    if kind == _ENV_DATA_TS:
+        body += struct.pack(">d", time.time() if t_send is None
+                            else t_send)
+    body += payload
     return _ENV_MAGIC + struct.pack(">I", zlib.crc32(body)) + body
 
 
 def _env_parse(raw: bytes):
     """None = not an envelope; "corrupt" = failed integrity; else
-    ``(kind, name, seq, payload)``."""
+    ``(kind, name, seq, payload, t_send)`` with kind normalized to
+    ``_ENV_DATA``/``_ENV_ACK`` (t_send None when the frame has none)."""
     if not raw.startswith(_ENV_MAGIC):
         return None
     if len(raw) < _ENV_HDR + 11:
@@ -459,10 +484,19 @@ def _env_parse(raw: bytes):
     if zlib.crc32(body) != want:
         return "corrupt"
     kind, seq, nlen = struct.unpack_from(">BQH", body, 0)
-    if kind not in (_ENV_DATA, _ENV_ACK) or len(body) < 11 + nlen:
+    if kind not in (_ENV_DATA, _ENV_ACK, _ENV_DATA_TS) \
+            or len(body) < 11 + nlen:
         return "corrupt"
     name = body[11:11 + nlen].decode("utf-8", "replace")
-    return kind, name, seq, body[11 + nlen:]
+    t_send = None
+    off = 11 + nlen
+    if kind == _ENV_DATA_TS:
+        if len(body) < off + 8:
+            return "corrupt"
+        (t_send,) = struct.unpack_from(">d", body, off)
+        off += 8
+        kind = _ENV_DATA
+    return kind, name, seq, body[off:], t_send
 
 
 def _ack_queue(token: str) -> str:
@@ -531,6 +565,8 @@ class ReliableTransport(Transport):
             )
             faults = default_fault_counters
         self.faults = faults
+        from split_learning_tpu.runtime.trace import default_histograms
+        self._hists = default_histograms
         self._lock = make_lock("reliable")
         self._seq: dict[str, int] = {}
         # (queue, seq) -> [frame, next_due, attempts]
@@ -564,7 +600,7 @@ class ReliableTransport(Transport):
         with self._lock:
             seq = self._seq.get(queue, 0)
             self._seq[queue] = seq + 1
-            frame = _env_frame(_ENV_DATA, seq, self.token.encode(),
+            frame = _env_frame(_ENV_DATA_TS, seq, self.token.encode(),
                                payload)
             self._unacked[(queue, seq)] = [
                 frame, time.monotonic() + self._redeliver_s, 0]
@@ -597,7 +633,7 @@ class ReliableTransport(Transport):
                 parsed = _env_parse(raw)
                 if (isinstance(parsed, tuple)
                         and parsed[0] == _ENV_ACK):
-                    _, queue, seq, _ = parsed
+                    _, queue, seq, _, _ = parsed
                     with self._lock:
                         self._unacked.pop((queue, seq), None)
                 continue   # drain the ack queue dry before redelivering
@@ -715,9 +751,14 @@ class ReliableTransport(Transport):
             if parsed == "corrupt":
                 self.faults.inc("corrupt_rejected")
                 continue              # no ack -> sender redelivers
-            kind, token, seq, payload = parsed
+            kind, token, seq, payload, t_send = parsed
             if kind != _ENV_DATA:
                 continue              # stray ack on a data queue
+            if t_send is not None:
+                # observed per ARRIVAL (dups included): this times the
+                # channel, not the dedup policy above it
+                self._hists.observe("transport_rtt",
+                                    max(0.0, time.time() - t_send))
             self._send_ack(token, queue, seq)
             key = (queue, token)
             with self._lock:
@@ -821,7 +862,8 @@ class AsyncTransport(Transport):
                  prefetch: Iterable[str] = ("intermediate_queue*",
                                             "gradient_queue*"),
                  prefetch_depth: int = 2, recv_factory=None,
-                 slice_gets: bool = False, wire=None, faults=None):
+                 slice_gets: bool = False, wire=None, faults=None,
+                 hists=None, tracer=None):
         super().__init__()
         self.inner = inner
         self._send_depth = max(1, send_depth)
@@ -837,6 +879,15 @@ class AsyncTransport(Transport):
             from split_learning_tpu.runtime.trace import WireCounters
             wire = WireCounters()
         self.wire = wire
+        if hists is None:
+            # per-participant, same reasoning as the wire counters
+            from split_learning_tpu.runtime.trace import HistogramSet
+            hists = HistogramSet()
+        self.hists = hists
+        # the participant's tracer rides the outermost transport layer
+        # so ProtocolClient/ProtocolServer (which receive a pre-built
+        # stack) find the one make_runtime_transport configured
+        self.tracer = tracer
         if faults is None:
             from split_learning_tpu.runtime.trace import (
                 default_fault_counters,
@@ -887,7 +938,9 @@ class AsyncTransport(Transport):
                 if callable(payload):
                     t0 = time.perf_counter()
                     payload = payload()
-                    self.wire.add_encode(time.perf_counter() - t0)
+                    dt = time.perf_counter() - t0
+                    self.wire.add_encode(dt)
+                    self.hists.observe("encode", dt)
                 parts = (payload if isinstance(payload, (list, tuple))
                          else (payload,))
                 for part in parts:

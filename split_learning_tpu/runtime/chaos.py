@@ -235,7 +235,14 @@ def make_runtime_transport(cfg: Config, name: str,
     stream as the synchronous path.  Data-plane receive prefetch gets a
     dedicated broker connection when there is no reliable layer (the
     reliable receiver's dedup/resequence state must stay on ONE
-    instance per queue, so with it the prefetcher shares the stack)."""
+    instance per queue, so with it the prefetcher shares the stack).
+
+    The participant's :class:`~split_learning_tpu.runtime.spans.Tracer`
+    rides the outermost layer (``bus.tracer``) so the protocol roles
+    pick up the configured one; the chaos/reliable layers below are
+    deliberately trace-transparent — they move payload bytes (and the
+    trace context inside them) untouched, apart from the corruption
+    chaos is paid to inject."""
     tcp = cfg.transport.kind == "tcp"
 
     def mk() -> Transport:
@@ -263,10 +270,12 @@ def make_runtime_transport(cfg: Config, name: str,
             side=side, redeliver_s=cfg.transport.redeliver_s,
             max_redeliver=cfg.transport.max_redeliver, faults=faults)
     if cfg.transport.async_send:
+        from split_learning_tpu.runtime.spans import make_tracer
         recv_factory = (mk if tcp and not cfg.transport.reliable
                         else None)
         bus = AsyncTransport(
             bus, send_depth=cfg.transport.send_depth,
             prefetch_depth=cfg.transport.prefetch_depth,
-            recv_factory=recv_factory, slice_gets=tcp, faults=faults)
+            recv_factory=recv_factory, slice_gets=tcp, faults=faults,
+            tracer=make_tracer(cfg, name))
     return bus

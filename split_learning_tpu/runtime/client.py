@@ -56,6 +56,7 @@ from split_learning_tpu.runtime.protocol import (
     Register, Start, Stop, Syn, QuantLeaf, Update, encode, encode_parts,
     gradient_queue, intermediate_queue, reply_queue, RPC_QUEUE,
 )
+from split_learning_tpu.runtime.spans import make_tracer, unpack_ctx
 from split_learning_tpu.runtime.validation import dataset_for_model
 
 def _wire_np_dtype(name: str):
@@ -391,12 +392,17 @@ class ProtocolClient:
             transport = make_runtime_transport(cfg, client_id)
         self.bus = transport
         from split_learning_tpu.runtime.trace import (
-            default_fault_counters, default_wire_counters,
+            HistogramSet, default_fault_counters, default_wire_counters,
         )
         self.faults = getattr(self.bus, "faults", None) \
             or default_fault_counters
         self.wire = getattr(self.bus, "wire", None) \
             or default_wire_counters
+        # distributed-tracing surface: the transport stack's tracer
+        # when make_runtime_transport built one, else this client's own
+        self.tracer = getattr(self.bus, "tracer", None) \
+            or make_tracer(cfg, client_id)
+        self.hists = getattr(self.bus, "hists", None) or HistogramSet()
         # chunked-frame reassembly is per consumer thread; the client is
         # single-threaded over its queues
         self._assembler = FrameAssembler()
@@ -425,36 +431,71 @@ class ProtocolClient:
 
     # -- control plane -----------------------------------------------------
 
-    def _decode(self, raw: bytes):
+    def _decode(self, raw: bytes, queue: str | None = None):
         """Tolerant decode: a frame that fails a checksum (or ANY guard
         inside decode — a crafted pickle can raise arbitrary exceptions
         from numpy reconstruction) is dropped and counted, never fatal:
         a flipped bit on the wire must cost one message (which the
         reliable layer redelivers), not the process.  Same breadth as
         the server's rpc pump.  Returns None both for dropped frames
-        and for a chunk of a still-partial message."""
+        and for a chunk of a still-partial message.
+
+        A decoded message carrying a wire trace context becomes a
+        *consume* span parented to the sender's publish span (the
+        cross-participant flow edge), and its context send-time feeds
+        the ``frame_rtt`` histogram."""
+        t_wall = time.time()
         t0 = time.perf_counter()
         try:
-            return self._assembler.feed(raw)
+            msg = self._assembler.feed(raw)
         except Exception as e:  # noqa: BLE001 — see docstring
             self.faults.inc("corrupt_rejected")
             self.log.warning(f"dropping undecodable frame: {e}")
-            return None
-        finally:
             self.wire.add_decode(time.perf_counter() - t0)
+            return None
+        dt = time.perf_counter() - t0
+        self.wire.add_decode(dt)
+        self.hists.observe("decode", dt)
+        if msg is not None:
+            ctx = unpack_ctx(getattr(msg, "_ctx", None))
+            if ctx is not None:
+                _, sender_span, t_send = ctx
+                rtt = max(0.0, t_wall - t_send)
+                self.hists.observe("frame_rtt", rtt)
+                self.tracer.record(
+                    "consume", t_wall, t_wall + dt, parent=sender_span,
+                    queue=queue, kind=type(msg).__name__,
+                    nbytes=len(raw), rtt_ms=round(rtt * 1e3, 3),
+                    round=getattr(msg, "round_idx", None))
+        return msg
 
-    def _publish_parts(self, queue: str, build) -> None:
-        """Data-plane publish: ``build()`` produces the frame part list
-        (device fetch + TENSOR encode + chunking).  On an async bus the
-        thunk is enqueued and runs on the background sender —
-        microbatch k's transfer/encode/socket-write overlaps microbatch
-        k+1's compute; on a plain bus it runs inline."""
+    def _publish_parts(self, queue: str, build, kind: str | None = None
+                       ) -> None:
+        """Data-plane publish: ``build(ctx)`` produces the frame part
+        list (device fetch + TENSOR encode + chunking) carrying the
+        wire trace context ``ctx``.  On an async bus the thunk is
+        enqueued and runs on the background sender — microbatch k's
+        transfer/encode/socket-write overlaps microbatch k+1's compute;
+        on a plain bus it runs inline.  The *publish* span opens at
+        enqueue (queue-time included) and closes when the frame bytes
+        exist; its id rides ``ctx`` to the receiver's consume span."""
+        span = self.tracer.start("publish", always=False, queue=queue,
+                                 kind=kind,
+                                 round=getattr(self, "round_idx", None))
+        ctx = self.tracer.wire_context(span)
         if getattr(self.bus, "deferred", False):
-            self.bus.publish(queue, build)
+            def thunk():
+                parts = build(ctx)
+                span.end(nbytes=sum(len(p) for p in parts))
+                return parts
+            self.bus.publish(queue, thunk)
             return
         t0 = time.perf_counter()
-        parts = build()
-        self.wire.add_encode(time.perf_counter() - t0)
+        parts = build(ctx)
+        dt = time.perf_counter() - t0
+        self.wire.add_encode(dt)
+        self.hists.observe("encode", dt)
+        span.end(nbytes=sum(len(p) for p in parts))
         for part in parts:
             self.bus.publish(queue, part)
             self.wire.count_out(queue, len(part))
@@ -493,12 +534,13 @@ class ProtocolClient:
                 if not started:
                     raise
                 self.log.warning(f"transport closed ({e}); shutting down")
+                self.tracer.close()
                 return
             if raw is None:
                 if not started:
                     self.register()
                 continue
-            msg = self._decode(raw)
+            msg = self._decode(raw, q)
             if msg is None:
                 continue
             if isinstance(msg, Start):
@@ -516,6 +558,7 @@ class ProtocolClient:
                 flush = getattr(self.bus, "flush", None)
                 if flush is not None:
                     flush(timeout=30.0)
+                self.tracer.close()
                 return
             else:
                 self.log.warning(f"unexpected control message {msg}")
@@ -525,6 +568,11 @@ class ProtocolClient:
                       f"{msg.end_layer}] cluster={msg.cluster}")
         self.cluster = msg.cluster
         extra = msg.extra or {}
+        # join the server's run-scoped trace: every span this client
+        # journals (and every wire context it sends) now carries the
+        # same trace id, across processes
+        if extra.get("trace_id"):
+            self.tracer.adopt_trace_id(extra["trace_id"])
         self.epochs = int(extra.get("epochs", 1))
         self.sda_size = int(extra.get("sda_size", 1))
         self.round_idx = msg.round_idx
@@ -642,23 +690,33 @@ class ProtocolClient:
         whole = (self.runner.start_layer == 0
                  and self.runner.model.resolved_end
                  == len(self.runner.model.specs))
-        if self.stage == 1 and whole:
-            pause = self._train_whole()
-        elif self.stage == 1:
-            pause = self._train_first()
-        elif self.stage == self.n_stages:
-            pause = self._train_last()
-        else:
-            pause = self._train_middle()
-        if isinstance(pause, _AbortPause):
-            return   # round abandoned: the server stopped counting us
-        if pause is not None and not pause.send_weights:
-            # FLEX non-aggregation round (other/FLEX/src/RpcClient.py:
-            # 110-121): UPDATE still reports samples/result, but carries
-            # NO weights — the shard persists locally for the next round
-            self._send_update(with_weights=False)
-        else:
-            self._send_update()
+        # the round's root span on this participant: hot-loop and
+        # publish spans parent under it, so the merged trace's span
+        # tree stays connected per round
+        with self.tracer.span("client_round", round=msg.round_idx,
+                              stage=self.stage):
+            if self.stage == 1 and whole:
+                pause = self._train_whole()
+            elif self.stage == 1:
+                pause = self._train_first()
+            elif self.stage == self.n_stages:
+                pause = self._train_last()
+            else:
+                pause = self._train_middle()
+            if isinstance(pause, _AbortPause):
+                self.tracer.flush()
+                return   # round abandoned: the server stopped counting us
+            if pause is not None and not pause.send_weights:
+                # FLEX non-aggregation round (other/FLEX/src/RpcClient
+                # .py:110-121): UPDATE still reports samples/result, but
+                # carries NO weights — the shard persists locally for
+                # the next round
+                self._send_update(with_weights=False)
+            else:
+                self._send_update()
+        # a finished round's spans must be durable even if the process
+        # dies while idle between rounds
+        self.tracer.flush()
 
     def _send_update(self, with_weights: bool = True):
         # the round's ONE host sync of the NaN sentinel the hot loops
@@ -672,14 +730,15 @@ class ProtocolClient:
             stats_h = jax.tree_util.tree_map(np.asarray, self.stats)
         # TENSOR-framed and chunked: a shard UPDATE is the biggest frame
         # a client ever publishes
-        self._publish_parts(RPC_QUEUE, lambda p=params_h, s=stats_h,
+        self._publish_parts(RPC_QUEUE, lambda ctx, p=params_h, s=stats_h,
                             n=self.num_samples, ok=self.round_ok,
                             fence=self.fence, cl=self.cluster:
                             encode_parts(Update(
                                 client_id=self.client_id,
                                 stage=self.stage, cluster=cl, params=p,
                                 batch_stats=s, num_samples=n, ok=ok,
-                                round_idx=fence), self._chunk_bytes))
+                                round_idx=fence), self._chunk_bytes,
+                                ctx=ctx), kind="Update")
         self.log.info(f"[>>>] UPDATE samples={self.num_samples} "
                       f"ok={self.round_ok}"
                       + ("" if with_weights else " (no weights)"))
@@ -701,6 +760,14 @@ class ProtocolClient:
             self._wire_base = wsnap
             self.log.metric(kind="wire_client", client=self.client_id,
                             round_idx=self.round_idx, **wsnap)
+        # fixed-bucket latency percentiles (frame RTT, queue wait, step
+        # time, encode/decode) ride metrics.jsonl next to the counters;
+        # cumulative like everything above — diff successive records
+        hsnap = self.hists.snapshot()
+        if hsnap and hsnap != getattr(self, "_hist_base", None):
+            self._hist_base = hsnap
+            self.log.metric(kind="latency", client=self.client_id,
+                            round_idx=self.round_idx, **hsnap)
 
     def _redeliver_stop(self, msg: Stop) -> Pause:
         """A STOP arriving mid-training: requeue it for the run() loop and
@@ -726,7 +793,7 @@ class ProtocolClient:
             raw = self.bus.get(q)
             if raw is None:
                 continue
-            msg = self._decode(raw)
+            msg = self._decode(raw, q)
             if msg is None:
                 continue
             if isinstance(msg, Pause):
@@ -744,7 +811,7 @@ class ProtocolClient:
         raw = self.bus.get(reply_queue(self.client_id), timeout=0.001)
         if raw is None:
             return None
-        msg = self._decode(raw)
+        msg = self._decode(raw, reply_queue(self.client_id))
         if msg is None:
             return None
         if isinstance(msg, Pause):
@@ -761,6 +828,7 @@ class ProtocolClient:
         r = self.runner
         for _ in range(self.epochs):
             for x, labels in self.loader:
+                t_sp = time.perf_counter()
                 loss, grads, self.stats = r.whole_step(
                     self.frozen, self.trainable, self.stats,
                     jnp.asarray(x),
@@ -771,6 +839,7 @@ class ProtocolClient:
                                                jnp.isfinite(loss))
                 self.trainable, self.opt_state = r.apply_update(
                     self.trainable, self.opt_state, grads)
+                self.hists.observe("step", time.perf_counter() - t_sp)
                 self.num_samples += len(labels)
         self.bus.publish(RPC_QUEUE, encode(Notify(
             client_id=self.client_id, cluster=self.cluster,
@@ -812,17 +881,23 @@ class ProtocolClient:
             while not (exhausted and n_fwd == n_bwd):
                 raw = self.bus.get(grad_q, timeout=0.0005)
                 if raw is not None:
-                    g = self._decode(raw)
+                    g = self._decode(raw, grad_q)
                     if g is None or g.round_idx != self.fence:
                         continue   # corrupt, or from a dropped round
                     ent = inflight.pop(g.data_id, None)
                     if ent is None:   # no longer tracked (cut round)
                         continue
+                    sp = self.tracer.start("bwd", always=False,
+                                           round=self.round_idx)
+                    t_sp = time.perf_counter()
                     gt, _, self.stats = r.bwd(
                         self.frozen, self.trainable, self.stats, ent.x,
                         _from_wire_tree(g.data), ent.rng)
                     self.trainable, self.opt_state = r.apply_update(
                         self.trainable, self.opt_state, gt)
+                    sp.end()
+                    self.hists.observe("step",
+                                       time.perf_counter() - t_sp)
                     n_bwd += 1
                     # counted here, not at dispatch: a mid-loop PAUSE
                     # abandons in-flight forwards, and the FedAvg weight
@@ -846,9 +921,12 @@ class ProtocolClient:
                 next_item = next(data_iter, None)
                 x = jnp.asarray(x)
                 rng = r.next_rng()
+                sp = self.tracer.start("fwd", always=False,
+                                       round=self.round_idx)
                 out = _cast_for_wire(
                     r.fwd(self.frozen, self.trainable, self.stats, x,
                           rng), self._dev_cast)
+                sp.end()
                 data_id = uuid.uuid4().hex
                 inflight[data_id] = _Inflight(x=x, rng=rng,
                                               trace=[self.client_id],
@@ -863,14 +941,15 @@ class ProtocolClient:
                 # abandoned round's _on_start moved them
                 self._publish_parts(
                     out_qs[n_fwd % len(out_qs)],
-                    lambda out=out, labels_np=labels_np, d=data_id,
+                    lambda ctx, out=out, labels_np=labels_np, d=data_id,
                     fence=self.fence, cl=self.cluster:
                         encode_parts(Activation(
                             data_id=d,
                             data=_to_wire_tree(out, self.wire_dtype),
                             labels=labels_np, trace=[self.client_id],
                             cluster=cl, round_idx=fence),
-                            self._chunk_bytes))
+                            self._chunk_bytes, ctx=ctx),
+                    kind="Activation")
                 n_fwd += 1
                 if next_item is None:
                     exhausted = True
@@ -920,35 +999,41 @@ class ProtocolClient:
                 return pause
             raw = self.bus.get(grad_q, timeout=0.0005)
             if raw is not None:
-                g = self._decode(raw)
+                g = self._decode(raw, grad_q)
                 if g is None or g.round_idx != self.fence:
                     continue   # corrupt, or from a dropped round
                 ent = inflight.pop(g.data_id, None)
                 if ent is None:   # no longer tracked (cut round)
                     continue
+                sp = self.tracer.start("bwd", always=False,
+                                       round=self.round_idx)
+                t_sp = time.perf_counter()
                 gt, gx, self.stats = r.bwd(
                     self.frozen, self.trainable, self.stats, ent.x,
                     _from_wire_tree(g.data), ent.rng)
                 self.trainable, self.opt_state = r.apply_update(
                     self.trainable, self.opt_state, gt)
+                sp.end()
+                self.hists.observe("step", time.perf_counter() - t_sp)
                 self.num_samples += ent.n   # see _train_first
                 origin = ent.trace[-1]
                 gx = _cast_for_wire(gx, self._dev_cast)
                 _start_host_copy(gx)
                 self._publish_parts(
                     gradient_queue(self.stage - 1, origin),
-                    lambda gx=gx, d=g.data_id, tr=ent.trace[:-1],
+                    lambda ctx, gx=gx, d=g.data_id, tr=ent.trace[:-1],
                     fence=self.fence:
                         encode_parts(Gradient(
                             data_id=d,
                             data=_to_wire_tree(gx, self.wire_dtype),
                             trace=tr, round_idx=fence),
-                            self._chunk_bytes))
+                            self._chunk_bytes, ctx=ctx),
+                    kind="Gradient")
                 continue
             raw = self.bus.get(in_q, timeout=0.0005)
             if raw is None:
                 continue
-            act = self._decode(raw)
+            act = self._decode(raw, in_q)
             if act is None or act.round_idx != self.fence:
                 continue   # corrupt, or from a dropped round: discard
             if isinstance(act, EpochEnd):
@@ -960,16 +1045,19 @@ class ProtocolClient:
                 continue
             x = _from_wire_tree(act.data)
             rng = r.next_rng()
+            sp = self.tracer.start("fwd", always=False,
+                                   round=self.round_idx)
             out = _cast_for_wire(
                 r.fwd(self.frozen, self.trainable, self.stats, x, rng),
                 self._dev_cast)
+            sp.end()
             inflight[act.data_id] = _Inflight(x=x, rng=rng,
                                               trace=list(act.trace),
                                               n=len(act.labels))
             _start_host_copy(out)
             self._publish_parts(
                 out_qs[n_fwd % len(out_qs)],
-                lambda out=out, act=act, fence=self.fence,
+                lambda ctx, out=out, act=act, fence=self.fence,
                 cl=self.cluster:
                     encode_parts(Activation(
                         data_id=act.data_id,
@@ -977,7 +1065,8 @@ class ProtocolClient:
                         labels=act.labels,
                         trace=list(act.trace) + [self.client_id],
                         cluster=cl, round_idx=fence),
-                        self._chunk_bytes))
+                        self._chunk_bytes, ctx=ctx),
+                kind="Activation")
             n_fwd += 1
 
     def _train_last(self) -> Pause:
@@ -1093,7 +1182,7 @@ class ProtocolClient:
                         target = max(1, len(w))
                         self._sda_step(w)
                 continue
-            act = self._decode(raw)
+            act = self._decode(raw, in_q)
             if act is None or act.round_idx != self.fence:
                 continue   # corrupt, or from a dropped round: discard
             if isinstance(act, EpochEnd):
@@ -1140,6 +1229,10 @@ class ProtocolClient:
     def _sda_step(self, window: list[Activation]):
         r = self.runner
         sizes = [len(a.labels) for a in window]
+        sp = self.tracer.start("sda_step", always=False,
+                               round=self.round_idx,
+                               window=len(window))
+        t_sp = time.perf_counter()
         # boundary payloads may be pytrees (mask-carrying models):
         # concatenate per leaf along the batch axis, split grads back
         x = jax.tree_util.tree_map(
@@ -1156,6 +1249,8 @@ class ProtocolClient:
                                        jnp.isfinite(loss))
         self.trainable, self.opt_state = r.apply_update(
             self.trainable, self.opt_state, gt)
+        sp.end()
+        self.hists.observe("step", time.perf_counter() - t_sp)
         self.num_samples += int(sum(sizes))
         gx = _cast_for_wire(gx, self._dev_cast)
         _start_host_copy(gx)
@@ -1170,12 +1265,13 @@ class ProtocolClient:
             origin = act.trace[-1]
             self._publish_parts(
                 gradient_queue(self.stage - 1, origin),
-                lambda gx_part=gx_part, act=act, fence=self.fence:
+                lambda ctx, gx_part=gx_part, act=act, fence=self.fence:
                     encode_parts(Gradient(
                         data_id=act.data_id,
                         data=_to_wire_tree(gx_part, self.wire_dtype),
                         trace=list(act.trace)[:-1], round_idx=fence),
-                        self._chunk_bytes))
+                        self._chunk_bytes, ctx=ctx),
+                kind="Gradient")
 
 
 def main(argv=None):

@@ -6,6 +6,19 @@ the console with ANSI colors, with ``[>>>]``/``[<<<]`` direction markers
 for protocol messages and a ``debug_mode`` gate.  Additions: per-round
 structured metrics records (JSON lines in ``metrics.jsonl``) so runs are
 machine-readable, which the reference lacks (SURVEY.md §5.5).
+
+Console mirroring runs THROUGH the logging stack (a color-formatting
+``StreamHandler`` attached only when ``console=True``) rather than raw
+``print_with_color`` calls next to it: every console line shares the
+file record's timestamp (so console output lines up with app.log and
+the span journals), and the ``console=False`` gate is structural — no
+code path can print around it.
+
+``metrics.jsonl`` is append-only across runs; every record is stamped
+with a run-scoped :data:`RUN_ID`, the writing ``participant`` and an
+explicit ``kind`` (default ``round``) so interleaved runs separate
+cleanly, and each line is flushed as written so a crashed run keeps its
+tail.
 """
 
 from __future__ import annotations
@@ -14,7 +27,9 @@ import json
 import logging
 import pathlib
 import sys
+import threading
 import time
+import uuid
 
 _COLORS = {
     "red": "\033[91m", "green": "\033[92m", "yellow": "\033[93m",
@@ -22,9 +37,37 @@ _COLORS = {
     "white": "\033[97m", "reset": "\033[0m",
 }
 
+#: run-scoped id: one per process, stamped on every metrics record (and
+#: adoptable via ``Logger(run_id=...)`` when a driver coordinates
+#: several processes of one logical run)
+RUN_ID = uuid.uuid4().hex[:12]
+
+_FMT = "%(asctime)s - %(name)s - %(levelname)s - %(message)s"
+
+#: colors applied by level when the call site names none
+_LEVEL_COLORS = {logging.WARNING: "yellow", logging.ERROR: "red",
+                 logging.DEBUG: "cyan"}
+
 
 def print_with_color(text: str, color: str = "white") -> None:
+    """Raw colored stdout write (reference ``Log.py`` parity helper).
+    Logger no longer routes console output here — its mirror runs
+    through the :class:`_ColorFormatter` handler so every console line
+    is timestamped and structurally gated by ``console=False``."""
     sys.stdout.write(f"{_COLORS.get(color, '')}{text}{_COLORS['reset']}\n")
+
+
+class _ColorFormatter(logging.Formatter):
+    """app.log format + ANSI color from ``extra={'color': ...}`` (or
+    the level default), for the console mirror handler."""
+
+    def format(self, record: logging.LogRecord) -> str:
+        base = super().format(record)
+        color = getattr(record, "color", None) \
+            or _LEVEL_COLORS.get(record.levelno)
+        if color in _COLORS:
+            return f"{_COLORS[color]}{base}{_COLORS['reset']}"
+        return base
 
 
 class Logger:
@@ -32,12 +75,17 @@ class Logger:
 
     def __init__(self, log_path: str | pathlib.Path = ".",
                  debug: bool = False, console: bool = True,
-                 name: str = "split_learning_tpu"):
+                 name: str = "split_learning_tpu",
+                 run_id: str | None = None):
         self.debug_mode = debug
         self.console = console
+        self.participant = name
+        self.run_id = run_id or RUN_ID
         root = pathlib.Path(log_path)
         root.mkdir(parents=True, exist_ok=True)
         self._metrics_path = root / "metrics.jsonl"
+        self._metrics_lock = threading.Lock()
+        self._metrics_f = None
         self._log = logging.getLogger(f"{name}.{id(self):x}")
         self._log.setLevel(logging.DEBUG)
         self._log.propagate = False
@@ -52,31 +100,32 @@ class Logger:
         # app.log, and the protocol-model trace validator
         # (analysis/model.py events_from_log) needs it to replay each
         # participant's state machine separately
-        handler.setFormatter(logging.Formatter(
-            "%(asctime)s - %(name)s - %(levelname)s - %(message)s"))
+        handler.setFormatter(logging.Formatter(_FMT))
         self._log.addHandler(handler)
         self._handler = handler
+        self._console_handler = None
+        if console:
+            ch = logging.StreamHandler(sys.stdout)
+            ch.setFormatter(_ColorFormatter(_FMT))
+            self._log.addHandler(ch)
+            self._console_handler = ch
+
+    def _emit(self, level: int, msg: str, color: str | None = None):
+        self._log.log(level, msg,
+                      extra=None if color is None else {"color": color})
 
     def info(self, msg: str, color: str = "white") -> None:
-        self._log.info(msg)
-        if self.console:
-            print_with_color(msg, color)
+        self._emit(logging.INFO, msg, color)
 
     def warning(self, msg: str) -> None:
-        self._log.warning(msg)
-        if self.console:
-            print_with_color(msg, "yellow")
+        self._emit(logging.WARNING, msg)
 
     def error(self, msg: str) -> None:
-        self._log.error(msg)
-        if self.console:
-            print_with_color(msg, "red")
+        self._emit(logging.ERROR, msg)
 
     def debug(self, msg: str) -> None:
         if self.debug_mode:
-            self._log.debug(msg)
-            if self.console:
-                print_with_color(msg, "cyan")
+            self._emit(logging.DEBUG, msg)
 
     def sent(self, msg: str) -> None:
         """Outbound protocol message (reference's red ``[>>>]`` marker)."""
@@ -87,11 +136,26 @@ class Logger:
         self.info(f"[<<<] {msg}", "blue")
 
     def metric(self, **fields) -> None:
-        """Append one structured metrics record (JSON line)."""
-        rec = {"ts": time.time(), **fields}
-        with open(self._metrics_path, "a") as f:
-            f.write(json.dumps(rec) + "\n")
+        """Append one structured metrics record (JSON line), stamped
+        ``run_id``/``participant``/``kind`` and flushed immediately so
+        a crashed run keeps every completed record."""
+        rec = {"ts": time.time(), "run_id": self.run_id,
+               "participant": self.participant,
+               "kind": fields.pop("kind", "round")}
+        rec.update(fields)
+        line = json.dumps(rec) + "\n"
+        with self._metrics_lock:
+            if self._metrics_f is None or self._metrics_f.closed:
+                self._metrics_f = open(self._metrics_path, "a")
+            self._metrics_f.write(line)
+            self._metrics_f.flush()
 
     def close(self) -> None:
         self._handler.close()
         self._log.removeHandler(self._handler)
+        if self._console_handler is not None:
+            self._log.removeHandler(self._console_handler)
+            self._console_handler = None
+        with self._metrics_lock:
+            if self._metrics_f is not None and not self._metrics_f.closed:
+                self._metrics_f.close()

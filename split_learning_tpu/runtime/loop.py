@@ -51,6 +51,14 @@ def run_training(cfg: Config, ctx: TrainContext,
                  init_stats: Any | None = None) -> TrainResult:
     logger = logger or Logger(cfg.log_path, debug=cfg.debug, console=False)
     strategy = make_strategy(cfg)
+    # round tracing (runtime/spans.py): the context's tracer when it
+    # has one (ProtocolContext), else a loop-owned one (in-process
+    # mesh runs) closed on exit
+    from split_learning_tpu.runtime.spans import make_tracer
+    tracer = getattr(ctx, "tracer", None)
+    own_tracer = tracer is None
+    if own_tracer:
+        tracer = make_tracer(cfg, "server")
 
     start_round = 0
     params, stats = init_params, init_stats
@@ -96,54 +104,68 @@ def run_training(cfg: Config, ctx: TrainContext,
                             f"cuts={plan.cuts} clients="
                             f"{[len(ids) for ids in plan.clients]}",
                             "cyan")
-            t0 = time.perf_counter()
-            with timer.phase("train"):
-                outcome = strategy.run_round(ctx, plans, r, params, stats)
-            wall = time.perf_counter() - t0
-            rec = RoundRecord(round_idx=r, ok=outcome.ok,
-                              num_samples=outcome.num_samples, wall_s=wall)
-            if not outcome.ok:
-                logger.error(f"Round {r}: Training failed! "
-                             f"(NaN detected; aggregation skipped)")
+            # one span per round, with the loop phases as
+            # children: the per-round anchor the critical-path
+            # walker (tools/sl_trace.py) starts from
+            with tracer.span("round", round=r) as round_span:
+                t0 = time.perf_counter()
+                with timer.phase("train"), \
+                        tracer.span("train", round=r):
+                    outcome = strategy.run_round(ctx, plans, r, params, stats)
+                wall = time.perf_counter() - t0
+                rec = RoundRecord(round_idx=r, ok=outcome.ok,
+                                  num_samples=outcome.num_samples, wall_s=wall)
+                if not outcome.ok:
+                    logger.error(f"Round {r}: Training failed! "
+                                 f"(NaN detected; aggregation skipped)")
+                    history.append(rec)
+                    logger.metric(**dataclasses.asdict(rec),
+                                  phases=timer.summary())
+                    timer.reset()  # don't leak this round's time onward
+                    # the failed round is the one an operator debugs:
+                    # its spans must hit disk like a clean round's (the
+                    # continue below skips the loop-tail flush; end()
+                    # is idempotent, so the context exit stays a no-op)
+                    round_span.end()
+                    tracer.flush()
+                    continue
+                prev_params, prev_stats = params, stats
+                params, stats = outcome.params, outcome.stats
+                if outcome.validate and cfg.checkpoint.validate:
+                    with timer.phase("validate"), \
+                            tracer.span("validate", round=r):
+                        val = ctx.validate(params, stats)
+                    rec.val_loss, rec.val_accuracy = val.loss, val.accuracy
+                    rec.ok = val.ok
+                    logger.info(
+                        f"Round {r}: samples={outcome.num_samples} "
+                        f"val_loss={val.loss:.4f} val_acc={val.accuracy:.4f} "
+                        f"({wall:.1f}s)", "green" if val.ok else "red")
+                    if not val.ok:
+                        # reference aborts on an exploded round
+                        # (src/Server.py:185-187); keep the last good weights
+                        # rather than training on from garbage
+                        logger.error(f"Round {r}: Training failed! "
+                                     f"(validation loss exploded)")
+                        params, stats = prev_params, prev_stats
+                else:
+                    logger.info(f"Round {r}: samples={outcome.num_samples} "
+                                f"({wall:.1f}s)", "green")
+                if rec.ok and cfg.checkpoint.save:
+                    with timer.phase("checkpoint"), \
+                            tracer.span("checkpoint", round=r):
+                        if ck_future is not None:
+                            ck_future.result()  # surface errors; keep order
+                        ck_future = ck_pool.submit(
+                            save_checkpoint, cfg.checkpoint.directory,
+                            cfg.model_key, params, stats, round_idx=r + 1)
                 history.append(rec)
                 logger.metric(**dataclasses.asdict(rec),
-                              phases=timer.summary())
-                timer.reset()  # don't leak this round's time onward
-                continue
-            prev_params, prev_stats = params, stats
-            params, stats = outcome.params, outcome.stats
-            if outcome.validate and cfg.checkpoint.validate:
-                with timer.phase("validate"):
-                    val = ctx.validate(params, stats)
-                rec.val_loss, rec.val_accuracy = val.loss, val.accuracy
-                rec.ok = val.ok
-                logger.info(
-                    f"Round {r}: samples={outcome.num_samples} "
-                    f"val_loss={val.loss:.4f} val_acc={val.accuracy:.4f} "
-                    f"({wall:.1f}s)", "green" if val.ok else "red")
-                if not val.ok:
-                    # reference aborts on an exploded round
-                    # (src/Server.py:185-187); keep the last good weights
-                    # rather than training on from garbage
-                    logger.error(f"Round {r}: Training failed! "
-                                 f"(validation loss exploded)")
-                    params, stats = prev_params, prev_stats
-            else:
-                logger.info(f"Round {r}: samples={outcome.num_samples} "
-                            f"({wall:.1f}s)", "green")
-            if rec.ok and cfg.checkpoint.save:
-                with timer.phase("checkpoint"):
-                    if ck_future is not None:
-                        ck_future.result()  # surface errors; keep order
-                    ck_future = ck_pool.submit(
-                        save_checkpoint, cfg.checkpoint.directory,
-                        cfg.model_key, params, stats, round_idx=r + 1)
-            history.append(rec)
-            logger.metric(**dataclasses.asdict(rec),
-                          phases=timer.summary(),
-                          **({"train_detail": outcome.metrics}
-                             if outcome.metrics else {}))
-            timer.reset()
+                              phases=timer.summary(),
+                              **({"train_detail": outcome.metrics}
+                                 if outcome.metrics else {}))
+                timer.reset()
+            tracer.flush()
             if cfg.limited_time and (time.perf_counter() - t_start
                                      > cfg.limited_time):
                 logger.warning(f"Wall-clock budget {cfg.limited_time}s "
@@ -156,4 +178,8 @@ def run_training(cfg: Config, ctx: TrainContext,
         if ck_future is not None:
             ck_future.result()  # the last checkpoint must be durable
         ck_pool.shutdown(wait=True)
+        if own_tracer:
+            tracer.close()
+        else:
+            tracer.flush()
     return TrainResult(params=params, stats=stats, history=history)

@@ -256,7 +256,12 @@ _WIRE_HELPERS = {"QuantLeaf": QuantLeaf, "_TensorRef": _TensorRef}
 #   (dtype code, flags, shape, crc32, byte length) and decoded with
 #   ``np.frombuffer`` straight off the received buffer — no pickle
 #   byte-shuffling on the hot path, and the (tiny) pickled skeleton
-#   holds only ``_TensorRef`` placeholders.
+#   holds only ``_TensorRef`` placeholders.  The meta region opens with
+#   an OPTIONAL length-prefixed trace context (``runtime/spans.py``:
+#   trace id, sender span id, send timestamp — 32 bytes when tracing,
+#   0 otherwise) that links the sender's publish span to the
+#   receiver's consume span; it is covered by the outer crc and
+#   surfaced on the decoded message as ``msg._ctx`` (opaque bytes).
 # * ``SLTC`` — chunk frame: a frame larger than the chunk cap is split
 #   into crc'd parts (``encode_parts``) that a :class:`FrameAssembler`
 #   reassembles, so one huge UPDATE can't trip the broker's frame cap.
@@ -372,7 +377,15 @@ def _blob(a: np.ndarray):
         return a, a.tobytes()
 
 
-def _encode_tensor(msg) -> bytes:
+#: trace-context sanity cap: today's context is 32 bytes; the u8 cap
+#: bounds what a corrupt length field can make the decoder slice
+_MAX_CTX_BYTES = 255
+
+
+def _encode_tensor(msg, ctx: bytes = b"") -> bytes:
+    if len(ctx) > _MAX_CTX_BYTES:
+        raise ValueError(f"trace context of {len(ctx)} bytes exceeds "
+                         f"the {_MAX_CTX_BYTES}-byte cap")
     tensors: list = []
 
     def strip(o):
@@ -402,7 +415,8 @@ def _encode_tensor(msg) -> bytes:
                        zlib.crc32(buf), a.nbytes)
             + struct.pack(f">{a.ndim}Q", *a.shape))
         blobs.append(buf)
-    meta = (struct.pack(">I", len(tensors)) + b"".join(headers)
+    meta = (struct.pack(">H", len(ctx)) + ctx
+            + struct.pack(">I", len(tensors)) + b"".join(headers)
             + struct.pack(">I", len(skel_bytes)) + skel_bytes)
     return b"".join([TENSOR_MAGIC, struct.pack(">I", zlib.crc32(meta)),
                      meta, *blobs])
@@ -413,6 +427,13 @@ def _decode_tensor(raw: bytes):
     try:
         (want,) = struct.unpack_from(">I", raw, 4)
         off = 8
+        (ctx_len,) = struct.unpack_from(">H", raw, off)
+        off += 2
+        if ctx_len > _MAX_CTX_BYTES or off + ctx_len > len(raw):
+            raise CorruptFrame(f"tensor frame claims {ctx_len}-byte "
+                               "trace context")
+        ctx = raw[off:off + ctx_len]
+        off += ctx_len
         (n_tensors,) = struct.unpack_from(">I", raw, off)
         off += 4
         if n_tensors > _MAX_TENSORS:
@@ -474,17 +495,25 @@ def _decode_tensor(raw: bytes):
             return tuple(fill(v) for v in o)
         return o
 
-    return type(msg)(**{f.name: fill(getattr(msg, f.name))
-                        for f in dataclasses.fields(msg)})
+    out = type(msg)(**{f.name: fill(getattr(msg, f.name))
+                       for f in dataclasses.fields(msg)})
+    if ctx_len:
+        # opaque tracing sidecar, NOT a message field: consumers read it
+        # via getattr so control frames (no attribute) degrade to None
+        out._ctx = bytes(ctx)
+    return out
 
 
-def encode(msg) -> bytes:
+def encode(msg, ctx: bytes = b"") -> bytes:
     """One complete frame: TENSOR framing for the data-plane payload
-    types, the pickled frame for everything else."""
+    types, the pickled frame for everything else.  ``ctx`` (an opaque
+    trace context, ``runtime/spans.py``) rides the TENSOR meta header;
+    the legacy pickled framing ignores it — SLT1 bytes stay bit-stable
+    for the fp32 parity contract."""
     if type(msg).__name__ not in _TYPE_BY_NAME:
         raise TypeError(f"not a protocol message: {type(msg)!r}")
     if isinstance(msg, TENSOR_TYPES):
-        return _encode_tensor(msg)
+        return _encode_tensor(msg, ctx)
     return encode_pickled(msg)
 
 
@@ -511,17 +540,25 @@ def decode(raw: bytes):
 #: (config: ``transport.chunk-mb``).  Sized well under the broker's
 #: 8 GiB frame sanity cap so a giant UPDATE can't kill the connection.
 DEFAULT_CHUNK_BYTES = 512 << 20
-_CHUNK_HDR = 16 + 8                      # uuid | u32 idx | u32 total
+_CHUNK_HDR = 16 + 8 + 2        # uuid | u32 idx | u32 total | u16 ctx-len
 _MAX_CHUNKS = 1 << 16
 
 
-def encode_parts(msg, max_bytes: int | None = None) -> list[bytes]:
+def encode_parts(msg, max_bytes: int | None = None,
+                 ctx: bytes = b"") -> list[bytes]:
     """Encode into one or more publishable frames: a single complete
     frame when it fits ``max_bytes``, else crc'd SLTC chunks carrying a
     shared message id.  Per-queue FIFO (which every transport layer
     preserves, reliable included) is what keeps a message's chunks
-    together; out-of-order arrival within the id is still handled."""
-    frame = encode(msg)
+    together; out-of-order arrival within the id is still handled.
+
+    ``ctx`` (trace context) rides the inner TENSOR frame AND every
+    chunk header, so a receiver can attribute chunk arrivals to the
+    sender's publish span without waiting for reassembly."""
+    if len(ctx) > _MAX_CTX_BYTES:
+        raise ValueError(f"trace context of {len(ctx)} bytes exceeds "
+                         f"the {_MAX_CTX_BYTES}-byte cap")
+    frame = encode(msg, ctx)
     cap = int(max_bytes) if max_bytes else DEFAULT_CHUNK_BYTES
     if len(frame) <= cap:
         return [frame]
@@ -533,6 +570,7 @@ def encode_parts(msg, max_bytes: int | None = None) -> list[bytes]:
     parts = []
     for idx in range(total):
         body = (mid + struct.pack(">II", idx, total)
+                + struct.pack(">H", len(ctx)) + ctx
                 + frame[idx * cap:(idx + 1) * cap])
         parts.append(CHUNK_MAGIC + struct.pack(">I", zlib.crc32(body))
                      + body)
@@ -571,11 +609,17 @@ class FrameAssembler:
         idx, total = struct.unpack_from(">II", body, 16)
         if not 0 < total <= _MAX_CHUNKS or idx >= total:
             raise CorruptFrame(f"chunk index {idx}/{total} out of range")
+        (ctx_len,) = struct.unpack_from(">H", body, 24)
+        if ctx_len > _MAX_CTX_BYTES or _CHUNK_HDR + ctx_len > len(body):
+            raise CorruptFrame(f"chunk frame claims {ctx_len}-byte "
+                               "trace context")
+        ctx = bytes(body[_CHUNK_HDR:_CHUNK_HDR + ctx_len])
         if mid in self._evicted:
             return None
         ent = self._pending.get(mid)
         if ent is None:
-            ent = self._pending[mid] = {"total": total, "parts": {}}
+            ent = self._pending[mid] = {"total": total, "parts": {},
+                                        "ctx": ctx}
             while len(self._pending) > self._max_pending:
                 dead, _ = self._pending.popitem(last=False)
                 self._evicted[dead] = True
@@ -583,8 +627,13 @@ class FrameAssembler:
                     self._evicted.popitem(last=False)
         if ent["total"] != total:
             raise CorruptFrame("chunk total mismatch within message")
-        ent["parts"].setdefault(idx, bytes(body[_CHUNK_HDR:]))
+        ent["parts"].setdefault(idx, bytes(body[_CHUNK_HDR + ctx_len:]))
         if len(ent["parts"]) < total:
             return None
         del self._pending[mid]
-        return decode(b"".join(ent["parts"][i] for i in range(total)))
+        msg = decode(b"".join(ent["parts"][i] for i in range(total)))
+        if ent["ctx"] and getattr(msg, "_ctx", None) is None:
+            # chunked legacy frame: the chunk headers carried the only
+            # copy of the context (TENSOR frames restore their own)
+            msg._ctx = ent["ctx"]
+        return msg

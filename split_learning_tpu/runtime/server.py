@@ -47,6 +47,7 @@ from split_learning_tpu.runtime.protocol import (
     FrameAssembler, Notify, Pause, Ready, Register, Start, Stop, Syn,
     Update, encode, reply_queue, RPC_QUEUE,
 )
+from split_learning_tpu.runtime.spans import unpack_ctx
 
 
 class RoundTimeout(RuntimeError):
@@ -83,13 +84,17 @@ class ProtocolContext(MeshContext):
                 ".run); the multi-process protocol deployment does not "
                 "shard client models yet")
         self.bus = transport
+        from split_learning_tpu.runtime.spans import make_tracer
         from split_learning_tpu.runtime.trace import (
-            default_fault_counters, default_wire_counters,
+            HistogramSet, default_fault_counters, default_wire_counters,
         )
         self.faults = getattr(transport, "faults", None) \
             or default_fault_counters
         self.wire = getattr(transport, "wire", None) \
             or default_wire_counters
+        self.tracer = getattr(transport, "tracer", None) \
+            or make_tracer(cfg, "server")
+        self.hists = getattr(transport, "hists", None) or HistogramSet()
         self._fault_base: dict = {}   # snapshot at the last round log
         self._assembler = FrameAssembler()   # chunked UPDATE reassembly
         self.log = logger or Logger(cfg.log_path, debug=cfg.debug,
@@ -131,6 +136,7 @@ class ProtocolContext(MeshContext):
         raw = self.bus.get(RPC_QUEUE, timeout=timeout)
         if raw is None:
             return False
+        t_wall = time.time()
         t0 = time.perf_counter()
         try:
             msg = self._assembler.feed(raw)
@@ -138,11 +144,25 @@ class ProtocolContext(MeshContext):
             # bit on rpc_queue must cost one message, not the server
             self.faults.inc("corrupt_rejected")
             self.log.warning(f"dropping undecodable rpc frame: {e}")
-            return True
-        finally:
             self.wire.add_decode(time.perf_counter() - t0)
+            return True
+        dt = time.perf_counter() - t0
+        self.wire.add_decode(dt)
+        self.hists.observe("decode", dt)
         if msg is None:
             return True   # chunk of a still-partial frame
+        ctx = unpack_ctx(getattr(msg, "_ctx", None))
+        if ctx is not None:
+            # consume span linked to the client's publish span: the
+            # UPDATE upload gets a flow edge like any data-plane frame
+            _, sender_span, t_send = ctx
+            rtt = max(0.0, t_wall - t_send)
+            self.hists.observe("frame_rtt", rtt)
+            self.tracer.record(
+                "consume", t_wall, t_wall + dt, parent=sender_span,
+                queue=RPC_QUEUE, kind=type(msg).__name__,
+                nbytes=len(raw), rtt_ms=round(rtt * 1e3, 3),
+                round=getattr(msg, "round_idx", None))
         if isinstance(msg, Register):
             if (self.cfg.topology.elastic_join
                     and not 1 <= msg.stage <= self.cfg.num_stages):
@@ -457,6 +477,13 @@ class ProtocolContext(MeshContext):
                 for cid in plan.clients[s - 1]:
                     pair_of[cid] = cid
 
+        # round-phase spans: sequential on the server thread, parented
+        # under the round loop's "train" span, so the critical-path
+        # walker can cross from the server timeline into client
+        # timelines at the consume spans recorded inside each barrier
+        fanout_span = self.tracer.start("start_fanout",
+                                        round=round_idx,
+                                        cluster=plan.cluster_id)
         for cid, s in active:
             a, b = ranges[s - 1]
             sp = (send_params.get(s, True)
@@ -521,17 +548,25 @@ class ProtocolContext(MeshContext):
                                      if sda_route and s < plan.n_stages
                                      else None),
                        "refresh": self.cfg.distribution.refresh,
+                       # clients adopt the server's run-scoped trace id
+                       # so all participants' spans merge onto ONE
+                       # trace, across processes
+                       "trace_id": self.tracer.trace_id,
                        "gen": self._cur_gen})))
             self.log.sent(f"START -> {cid} layers=[{a}, {end_layer}]"
                           + ("" if sp else " (no weights)"))
+        fanout_span.end()
 
         ids = {cid for cid, _ in active}
-        if not self._pump_until(
+        with self.tracer.span("ready_wait", round=round_idx):
+            ready_ok = self._pump_until(
                 lambda: ids <= self._ready,
                 lambda: f"READY from {ids - self._ready}",
-                deadline=time.monotonic() + self.ready_timeout):
+                deadline=time.monotonic() + self.ready_timeout)
+        if not ready_ok:
             ids &= self._ready  # drop unresponsive clients mid-round
         stage_of = dict(active)
+        syn_span = self.tracer.start("syn_fanout", round=round_idx)
         for cid in ids:
             s = stage_of[cid]
             # strict-SDA liveness under client loss (ADVICE r5): the
@@ -550,11 +585,15 @@ class ProtocolContext(MeshContext):
                 round_idx, sda_fence_quorum=quorum,
                 sda_feeders=feeders)))
         self.log.sent(f"SYN -> {sorted(ids)}")
+        syn_span.end()
 
         s1_ids = set(stage1) & ids
         deadline = time.monotonic() + self.client_timeout
-        self._pump_until(lambda: s1_ids <= self._notified,
-                         "NOTIFY from stage-1 clients", deadline=deadline)
+        with self.tracer.span("notify_wait", round=round_idx):
+            self._pump_until(lambda: s1_ids <= self._notified,
+                             "NOTIFY from stage-1 clients",
+                             deadline=deadline)
+        pause_span = self.tracer.start("pause_fanout", round=round_idx)
         for cid in ids:
             if isinstance(send_weights, dict):
                 flag = bool(send_weights.get(stage_of[cid], True))
@@ -563,13 +602,15 @@ class ProtocolContext(MeshContext):
             self.bus.publish(reply_queue(cid),
                              encode(Pause(send_weights=flag)))
         self.log.sent(f"PAUSE -> {sorted(ids)}")
+        pause_span.end()
 
         got = lambda: {u.client_id for u in self._updates} >= ids  # noqa
-        self._pump_until(
-            got,
-            lambda: (f"UPDATE from "
-                     f"{ids - {u.client_id for u in self._updates}}"),
-            deadline=time.monotonic() + self.client_timeout)
+        with self.tracer.span("update_wait", round=round_idx):
+            self._pump_until(
+                got,
+                lambda: (f"UPDATE from "
+                         f"{ids - {u.client_id for u in self._updates}}"),
+                deadline=time.monotonic() + self.client_timeout)
         updates = list(self._updates)
         self._updates = []
         # elastic liveness bookkeeping, folded per ROUND at the next
@@ -627,6 +668,22 @@ class ProtocolContext(MeshContext):
             self.log.metric(kind="faults", gen=self._cur_gen,
                             round_idx=round_idx,
                             cluster=plan.cluster_id, **snap)
+        # latency percentiles: this process's histograms (frame RTT,
+        # step, encode/decode) merged with the process-wide transport
+        # clocks (broker queue-wait, reliable-envelope RTT), which have
+        # no per-participant registry in reach.  Cumulative — diff
+        # successive records like every counter above.
+        from split_learning_tpu.runtime.trace import default_histograms
+        hsnap = {**default_histograms.snapshot(),
+                 **self.hists.snapshot()}
+        if hsnap and hsnap != getattr(self, "_hist_base", None):
+            self._hist_base = hsnap
+            self.log.metric(kind="latency", gen=self._cur_gen,
+                            round_idx=round_idx,
+                            cluster=plan.cluster_id, **hsnap)
+        # a finished invocation's spans must be durable before the next
+        # one (or a crash) — the journal buffers between flushes
+        self.tracer.flush()
         return updates
 
     def stop_all(self, reason: str = "training complete"):
@@ -639,6 +696,7 @@ class ProtocolContext(MeshContext):
         if flush is not None:
             flush(timeout=10.0)
         self.log.sent(f"STOP -> all ({reason})")
+        self.tracer.close()
 
 
 def _np_tree(tree: Any) -> Any:
@@ -675,9 +733,10 @@ class ProtocolServer:
         regs = self.ctx.wait_for_registrations()
         # elastic deployments may have spares beyond the configured
         # counts at startup; plan whoever is there
-        plans = plan_clusters(
-            self.cfg, regs,
-            exact_counts=not self.cfg.topology.elastic_join)
+        with self.ctx.tracer.span("plan"):
+            plans = plan_clusters(
+                self.cfg, regs,
+                exact_counts=not self.cfg.topology.elastic_join)
         try:
             result = run_training(self.cfg, self.ctx, plans, self.log)
         finally:

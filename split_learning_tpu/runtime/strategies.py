@@ -17,7 +17,9 @@ Aggregation math is shared: per-cluster per-stage weighted FedAvg
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
+import time
 from typing import Any, Sequence
 
 import jax
@@ -28,6 +30,14 @@ from split_learning_tpu.ops.fedavg import fedavg_trees
 from split_learning_tpu.runtime.context import TrainContext
 from split_learning_tpu.runtime.plan import ClusterPlan
 from split_learning_tpu.runtime.protocol import Update
+
+
+def _span(ctx, name: str, **attrs):
+    """Tracing span on the context's tracer (no-op without one)."""
+    tracer = getattr(ctx, "tracer", None)
+    if tracer is None:
+        return contextlib.nullcontext()
+    return tracer.span(name, **attrs)
 
 
 @dataclasses.dataclass
@@ -150,13 +160,16 @@ class FedAvgStrategy(RoundStrategy):
                         metrics=getattr(res, "timings", {}) or {})
         cluster_params, cluster_stats = [], []
         total, ok = 0, True
+        agg_s = 0.0
         for plan in plans:
             ups = ctx.train_cluster(
                 plan, params, stats, round_idx=round_idx,
                 epochs=self._epochs(), lr=self._lr(round_idx),
                 sync_all_later_stages=self.sync_all_later_stages)
             ok &= all(u.ok for u in ups)
+            t0 = time.perf_counter()
             p, s, n = aggregate_cluster(ups)
+            agg_s += time.perf_counter() - t0
             cluster_params.append(_fill(params, p))
             cluster_stats.append(_fill(stats, s))
             total += n
@@ -164,9 +177,16 @@ class FedAvgStrategy(RoundStrategy):
             # reference: round_result False -> skip aggregation entirely
             # (src/Server.py:162-166, :195-196)
             return RoundOutcome(params, stats, ok=False, validate=False)
-        return RoundOutcome(merge_clusters(cluster_params),
-                            merge_clusters(cluster_stats),
-                            num_samples=total)
+        # the round's FedAvg fold as one "aggregate" span (round-phase
+        # attribution for the critical-path report); timestamp-shifted
+        # spans would misplace the per-cluster folds, so the merged
+        # span covers the final merge and carries the fold total
+        with _span(ctx, "aggregate", round=round_idx,
+                   fold_s=round(agg_s, 6)):
+            out = RoundOutcome(merge_clusters(cluster_params),
+                               merge_clusters(cluster_stats),
+                               num_samples=total)
+        return out
 
 
 class SDAStrategy(FedAvgStrategy):

@@ -17,17 +17,54 @@ and a one-shot message-size probe (SURVEY.md §5.1); its in-message
   (``runtime/bus.py`` reliability layer, ``runtime/chaos.py`` fault
   injection, TCP reconnect) and surfaced by the protocol server into
   ``metrics.jsonl`` and its end-of-round log line, so chaos runs are
-  observable instead of silently self-healing.
+  observable instead of silently self-healing;
+* :class:`LatencyHistogram` / :class:`HistogramSet` — fixed-bucket
+  (log-spaced) latency histograms for frame RTT, broker queue wait,
+  step time and encode/decode, surfaced as ``kind: latency``
+  metrics.jsonl records next to the counters;
+* :data:`FAULT_COUNTER_NAMES` / :data:`HISTOGRAM_NAMES` — the declared
+  name registries the ``counters`` slcheck analyzer holds every
+  ``.inc``/``.observe`` call site to (typo'd names silently mint dead
+  keys otherwise).
 """
 
 from __future__ import annotations
 
+import bisect
 import collections
 import contextlib
+import math
 import threading
 import time
 
 import jax
+
+#: Declared registry of every FaultCounters name the runtime may
+#: increment.  ``FaultCounters.inc`` with a string literal outside this
+#: set is a typo that would silently mint a new key (and a dashboard
+#: nobody reads) — the ``counters`` slcheck analyzer
+#: (``analysis/counters.py``) enforces membership statically.
+FAULT_COUNTER_NAMES = frozenset({
+    # chaos injection (runtime/chaos.py)
+    "drops", "duplicates", "reorders", "corruptions", "delays",
+    "crashes", "late_drops",
+    # reliable delivery (runtime/bus.py ReliableTransport)
+    "redeliveries", "dedup_hits", "resequenced", "lost", "gave_up",
+    "daemon_errors", "ack_send_failures", "corrupt_rejected",
+    # transport plumbing
+    "reconnects", "timeouts", "async_send_errors", "prefetch_errors",
+})
+
+#: Declared registry of latency-histogram names (same contract as
+#: FAULT_COUNTER_NAMES, enforced on ``.observe("name", ...)`` sites).
+HISTOGRAM_NAMES = frozenset({
+    "frame_rtt",       # publish wire-context t_send -> consume decode
+    "queue_wait",      # broker enqueue -> dequeue (InProcTransport)
+    "transport_rtt",   # reliable envelope t_send -> receiver pop
+    "step",            # one hot-loop training step (bwd+apply / window)
+    "encode",          # frame encode (device fetch + TENSOR framing)
+    "decode",          # frame decode (assembler feed)
+})
 
 
 class FaultCounters:
@@ -138,6 +175,102 @@ class WireCounters:
 
 #: process-wide default, mirroring ``default_fault_counters``
 default_wire_counters = WireCounters()
+
+
+class LatencyHistogram:
+    """Fixed-bucket latency histogram: log-spaced bounds from 1 µs to
+    ~64 s (factor 2^0.25 per bucket, so a reported percentile is within
+    ~19% of the true value), O(log buckets) per observe, thread-safe.
+    Monotonic like the counters above: never reset, consumers diff
+    successive snapshots."""
+
+    #: geometric bucket upper bounds (seconds); one overflow bucket past
+    #: the last bound
+    BOUNDS = tuple(1e-6 * (2 ** (i / 4)) for i in range(104))
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counts = [0] * (len(self.BOUNDS) + 1)
+        self._n = 0
+        self._sum = 0.0
+        self._max = 0.0
+
+    def observe(self, seconds: float) -> None:
+        if not (seconds >= 0.0):     # NaN/negative: clock went backward
+            seconds = 0.0
+        i = bisect.bisect_left(self.BOUNDS, seconds)
+        with self._lock:
+            self._counts[i] += 1
+            self._n += 1
+            self._sum += seconds
+            if seconds > self._max:
+                self._max = seconds
+
+    def _bucket_value(self, i: int) -> float:
+        """Representative value: geometric mean of the bucket's edges."""
+        hi = self.BOUNDS[min(i, len(self.BOUNDS) - 1)]
+        lo = self.BOUNDS[i - 1] if i > 0 else hi / (2 ** 0.25)
+        return math.sqrt(lo * hi)
+
+    def percentile(self, q: float) -> float:
+        """Approximate q-th percentile (q in [0, 100]) in seconds."""
+        with self._lock:
+            n = self._n
+            if n == 0:
+                return 0.0
+            rank = max(1, math.ceil(n * q / 100.0))
+            cum = 0
+            for i, c in enumerate(self._counts):
+                cum += c
+                if cum >= rank:
+                    return min(self._bucket_value(i), self._max)
+            return self._max
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            n = self._n
+            if n == 0:
+                return {}
+            mean = self._sum / n
+        return {"count": n, "mean_ms": round(mean * 1e3, 4),
+                "p50_ms": round(self.percentile(50) * 1e3, 4),
+                "p95_ms": round(self.percentile(95) * 1e3, 4),
+                "p99_ms": round(self.percentile(99) * 1e3, 4),
+                "max_ms": round(self._max * 1e3, 4)}
+
+
+class HistogramSet:
+    """Named latency histograms, created on first observe.  Names must
+    come from :data:`HISTOGRAM_NAMES` (statically enforced by the
+    ``counters`` analyzer); snapshots flow into metrics.jsonl as
+    ``kind: latency`` records next to the counter records."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._hists: dict[str, LatencyHistogram] = {}
+
+    def hist(self, name: str) -> LatencyHistogram:
+        with self._lock:
+            h = self._hists.get(name)
+            if h is None:
+                h = self._hists[name] = LatencyHistogram()
+            return h
+
+    def observe(self, name: str, seconds: float) -> None:
+        self.hist(name).observe(seconds)
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            hists = list(self._hists.items())
+        return {name: snap for name, h in hists
+                if (snap := h.snapshot())}
+
+
+#: process-wide default: layers with no per-participant registry in
+#: reach (the in-process broker's queue-wait clock, the reliable
+#: receiver's envelope RTT) observe here, mirroring
+#: ``default_fault_counters``
+default_histograms = HistogramSet()
 
 
 class StepTimer:

@@ -543,3 +543,30 @@ def test_instrumented_transport_round_trip(monkeypatch):
     sender.stop(close_inner=False)
     recv.stop(close_inner=False)
     bus.close()
+
+
+# --------------------------------------------------------------------------
+# counter-name registry rule (CT001/CT002)
+# --------------------------------------------------------------------------
+
+def test_undeclared_counter_name_flagged():
+    from split_learning_tpu.analysis import counters
+    src = (
+        "def repair(faults, hists):\n"
+        "    faults.inc('drops')\n"              # declared: clean
+        "    faults.inc('drosp')\n"              # typo: CT001
+        "    hists.observe('frame_rtt', 0.1)\n"  # declared: clean
+        "    hists.observe('frame_rtt_ms', 0.1)\n"   # typo: CT002
+        "    faults.inc(derived_name)\n"         # non-literal: ignored
+    )
+    findings = counters.scan_source(src, "x.py")
+    assert sorted(f.code for f in findings) == ["CT001", "CT002"]
+    assert all(f.where == "repair" for f in findings)
+    assert "drosp" in findings[0].message
+    assert "FAULT_COUNTER_NAMES" in findings[0].message
+
+
+def test_counter_registry_clean_on_repo():
+    from split_learning_tpu.analysis import counters
+    from split_learning_tpu.analysis.__main__ import repo_root
+    assert counters.run(repo_root()) == []

@@ -158,8 +158,8 @@ class TestTensorFrameRejection:
         # message must still be rejected (tensor framing is data-plane
         # only, so a Start can't dodge its schema checks there)
         skel = pickle.dumps(P.Syn(round_idx=1))
-        meta = (struct.pack(">I", 0) + struct.pack(">I", len(skel))
-                + skel)
+        meta = (struct.pack(">H", 0) + struct.pack(">I", 0)
+                + struct.pack(">I", len(skel)) + skel)
         raw = (P.TENSOR_MAGIC + struct.pack(">I", zlib.crc32(meta))
                + meta)
         with pytest.raises(pickle.UnpicklingError,

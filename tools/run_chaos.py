@@ -27,6 +27,8 @@ Exit code is non-zero when any cell fails, so it slots into CI.
 from __future__ import annotations
 
 import argparse
+import json
+import pathlib
 import sys
 import threading
 import time
@@ -133,7 +135,6 @@ def full_round_cell(fault: str, prob: float, seed: int, tmp: str
 
     sys.path.insert(0, "tests")
     from test_chaos import _chaos, _round_cfg, _run_cell  # noqa: E402
-    import pathlib
     root = pathlib.Path(tmp)
     if not hasattr(full_round_cell, "_base"):
         cfg = _round_cfg(root, root / "base")
@@ -167,7 +168,36 @@ def full_round_cell(fault: str, prob: float, seed: int, tmp: str
         violations = validate_log(log.read_text(), source=str(log))
         if violations:
             return False, f"protocol: {violations[0].message}"
-    return True, "bit-identical+conformant"
+    # the distributed trace must survive chaos too: merge the cell's
+    # span journals, schema-validate the Perfetto export, and require
+    # a fully-connected span tree (every parent id resolves) — a chaos
+    # fault that orphans spans would make chaotic rounds undebuggable
+    # exactly when debugging matters.  trace.json is left in the cell
+    # dir (CI uploads it as a workflow artifact).
+    sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent))
+    import sl_trace
+    files = sl_trace.find_span_files(cell_dir)
+    if not files:
+        return False, "no span journals (tracing disabled?)"
+    spans = sl_trace.load_spans(files)
+    errs = sl_trace.validate_spans(spans)
+    if errs:
+        return False, f"spans: {errs[0]}"
+    orphans = sl_trace.orphan_spans(spans)
+    if orphans:
+        return False, f"{len(orphans)} orphan spans"
+    trace = sl_trace.build_trace(spans)
+    terr = sl_trace.validate_trace(trace)
+    if terr:
+        return False, f"trace: {terr[0]}"
+    (pathlib.Path(cell_dir) / "trace.json").write_text(
+        json.dumps(trace))
+    report = sl_trace.critical_path(spans)
+    if not report:
+        return False, "no train span in merged trace"
+    (pathlib.Path(cell_dir) / "critical_path.json").write_text(
+        json.dumps(report, indent=2))
+    return True, "bit-identical+conformant+traced"
 
 
 def main(argv=None):
@@ -183,6 +213,10 @@ def main(argv=None):
                     help="restrict to one cell, e.g. drop:0.4")
     ap.add_argument("--full", action="store_true",
                     help="full tiny training round per cell (slow)")
+    ap.add_argument("--artifacts-dir", default=None,
+                    help="with --full: run cells under this directory "
+                         "so spans-*.jsonl / metrics.jsonl / "
+                         "trace.json survive for CI artifact upload")
     args = ap.parse_args(argv)
 
     faults = ["drop", "duplicate", "reorder", "corrupt", "delay",
@@ -195,8 +229,12 @@ def main(argv=None):
 
     tmp = None
     if args.full:
-        import tempfile
-        tmp = tempfile.mkdtemp(prefix="chaos_sweep_")
+        if args.artifacts_dir:
+            tmp = args.artifacts_dir
+            pathlib.Path(tmp).mkdir(parents=True, exist_ok=True)
+        else:
+            import tempfile
+            tmp = tempfile.mkdtemp(prefix="chaos_sweep_")
 
     width = max(len(f) for f, _ in cells) + 6
     print(f"{'cell':<{width}} " + " ".join(
