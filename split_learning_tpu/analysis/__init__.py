@@ -13,7 +13,10 @@ enforced only by runtime tests:
 * :mod:`~split_learning_tpu.analysis.concurrency` — the transport
   threads: lock ordering, blocking-under-lock, thread shutdown paths
   (with a runtime twin in :mod:`~split_learning_tpu.analysis.locks`,
-  ``SLCHECK_LOCKS=1``).
+  ``SLCHECK_LOCKS=1``);
+* :mod:`~split_learning_tpu.analysis.codec_check` — the wire codecs:
+  every codec counter registered, no host-side quantization in hot
+  loops, quantizer kernels actually staged on device.
 
 CLI: ``python -m split_learning_tpu.analysis`` (wrapper:
 ``tools/slcheck.py``).  This package is import-light on purpose —

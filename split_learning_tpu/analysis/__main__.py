@@ -23,7 +23,7 @@ from split_learning_tpu.analysis.findings import (
     Baseline, Finding, render_human, render_json,
 )
 
-ANALYZERS = ("protocol", "jaxpr", "concurrency", "counters")
+ANALYZERS = ("protocol", "jaxpr", "concurrency", "counters", "codec")
 
 
 def repo_root() -> pathlib.Path:
@@ -45,6 +45,9 @@ def run_analyzers(root: pathlib.Path, names=ANALYZERS,
     if "counters" in names:
         from split_learning_tpu.analysis import counters
         findings += counters.run(root)
+    if "codec" in names:
+        from split_learning_tpu.analysis import codec_check
+        findings += codec_check.run(root, trace=trace)
     return findings
 
 

@@ -1,0 +1,138 @@
+"""Wire-codec conformance (CD001-CD003).
+
+The codec layer (``runtime/codec/``) has three invariants that used to
+live only in reviewers' heads:
+
+* **CD001 — counters registered**: every counter a codec declares in
+  ``codec/specs.py CODEC_COUNTERS`` must be a member of the declared
+  registries in ``runtime/trace.py`` (the CT-rules' single source of
+  truth).  A codec minting its own name would inc into a key no
+  metrics consumer, dashboard or test ever reads.
+* **CD002 — no host quantization in hot loops**: the data-plane codecs
+  exist to move quantized bytes over PCIe, which only happens when the
+  quantizer runs ON DEVICE before the fetch.  A call to a host-side
+  quantizer (``_quant_int8``, ``quantize_np``) inside a ``for``/
+  ``while`` body in the hot-path modules is the exact regression the
+  device kernels were built to eliminate — the numpy twins are legal
+  ONLY on the once-per-round Update/delta path, which has no loop.
+* **CD003 — quantization actually on device** (jaxpr-flavored, needs
+  jax): ``QuantCodec.prepare`` is traced with abstract inputs; its
+  staged output must carry int8/uint8 code arrays (the fetch then
+  moves quantized bytes).  A codec that silently fell back to a host
+  path fails the trace (tracer leak) or ships float codes — both are
+  findings, mirroring the JX002 wire-width audit.
+"""
+
+from __future__ import annotations
+
+import ast
+import pathlib
+
+from split_learning_tpu.analysis.findings import Finding
+
+#: host-side quantizer entry points (the numpy twins + the legacy
+#: per-tensor int8 helper); calling any of these under a loop in a
+#: hot-path module is CD002
+_HOST_QUANT_FNS = frozenset({"_quant_int8", "quantize_np"})
+
+#: modules whose loops are the data-plane hot path
+_HOT_MODULES = ("split_learning_tpu/runtime/client.py",)
+
+
+def check_counters(registries=None, codec_counters=None) -> list[Finding]:
+    """CD001 over the declared codec counter vocabulary."""
+    if registries is None:
+        from split_learning_tpu.runtime import trace
+        registries = trace.FAULT_COUNTER_NAMES | trace.HISTOGRAM_NAMES
+    if codec_counters is None:
+        from split_learning_tpu.runtime.codec.specs import CODEC_COUNTERS
+        codec_counters = CODEC_COUNTERS
+    findings: list[Finding] = []
+    rel = "split_learning_tpu/runtime/codec/specs.py"
+    for kind, names in sorted(codec_counters.items()):
+        for name in names:
+            if name not in registries:
+                findings.append(Finding(
+                    "CD001", rel, 0, kind,
+                    f"codec {kind!r} declares counter {name!r} which "
+                    "is not registered in runtime/trace.py "
+                    "FAULT_COUNTER_NAMES/HISTOGRAM_NAMES"))
+    return findings
+
+
+def scan_source(source: str, rel: str) -> list[Finding]:
+    """CD002 over one hot-path source file."""
+    findings: list[Finding] = []
+    tree = ast.parse(source)
+    fn_of: dict[int, str] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for sub in ast.walk(node):
+                lineno = getattr(sub, "lineno", None)
+                if lineno is not None:
+                    fn_of.setdefault(lineno, node.name)
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.For, ast.While, ast.AsyncFor)):
+            continue
+        for sub in ast.walk(node):
+            if not isinstance(sub, ast.Call):
+                continue
+            f = sub.func
+            name = f.id if isinstance(f, ast.Name) else (
+                f.attr if isinstance(f, ast.Attribute) else None)
+            if name in _HOST_QUANT_FNS:
+                findings.append(Finding(
+                    "CD002", rel, sub.lineno,
+                    fn_of.get(sub.lineno, name),
+                    f"host-side quantizer {name}() called inside a "
+                    "hot loop — quantize on device via the codec's "
+                    "prepare() so the device->host fetch moves "
+                    "quantized bytes"))
+    return findings
+
+
+def check_device_quant() -> list[Finding]:
+    """CD003: trace each quantizer spec's prepare with abstract inputs."""
+    import jax
+    import jax.numpy as jnp
+
+    from split_learning_tpu.runtime.codec.quant import QuantCodec
+    from split_learning_tpu.runtime.codec.specs import parse_spec
+
+    rel = "split_learning_tpu/runtime/codec/quant.py"
+    findings: list[Finding] = []
+    for spec in ("int8:64", "int4:64"):
+        codec = QuantCodec(parse_spec(spec))
+        x = jnp.zeros((4, 100), jnp.float32)
+        try:
+            staged = jax.eval_shape(lambda t, c=codec: c.prepare(t), x)
+        except Exception as e:  # noqa: BLE001 — a tracer leak IS the
+            # finding: prepare pulled the payload to host mid-trace
+            findings.append(Finding(
+                "CD003", rel, 0, spec,
+                f"QuantCodec({spec}).prepare does not trace "
+                f"device-side: {type(e).__name__}: {e}"))
+            continue
+        leaves = jax.tree_util.tree_leaves(staged)
+        code_dtypes = {str(leaf.dtype) for leaf in leaves}
+        if not code_dtypes & {"int8", "uint8"}:
+            findings.append(Finding(
+                "CD003", rel, 0, spec,
+                f"QuantCodec({spec}).prepare stages {code_dtypes} — "
+                "no int8/uint8 code array; the fetch would move "
+                "unquantized bytes (quantize on device)"))
+    return findings
+
+
+def run(root: pathlib.Path, trace: bool = True) -> list[Finding]:
+    findings = check_counters()
+    for rel in _HOT_MODULES:
+        path = root / rel
+        try:
+            source = path.read_text()
+        except OSError:
+            continue
+        findings += scan_source(source, rel)
+    if trace:
+        findings += check_device_quant()
+    return findings

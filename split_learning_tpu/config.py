@@ -261,6 +261,22 @@ class TransportConfig:
     # (runtime/protocol.py encode_parts / FrameAssembler) — keeps a
     # giant UPDATE under the broker's frame sanity cap.
     chunk_mb: int = 512
+    # Per-queue-family wire codec policy (runtime/codec/): a mapping of
+    # queue family -> codec spec, e.g.
+    #   codec: {intermediate: int8, gradient: "topk:0.05", rpc: delta}
+    # intermediate takes tiled quantizers (int8[:tile] | int4[:tile]),
+    # gradient additionally takes top-k + error-feedback
+    # (topk:<frac>), rpc takes delta-encoded Updates
+    # (delta | delta:bf16 | delta:int8[:tile]).  None = no codec; the
+    # plain wire-dtype path applies.
+    codec: Any = None
+    # Global lossy wire dtypes are ambiguous now that per-queue codec
+    # policies exist: ``wire-dtype: int8`` quantizes EVERY data-plane
+    # payload with the blunt per-tensor legacy quantizer and composes
+    # confusingly with a codec block.  It therefore requires this
+    # explicit opt-in (and is always rejected alongside ``codec:``);
+    # new configs should quantize via the codec block instead.
+    allow_global_lossy: bool = False
     # At-least-once in-order delivery (runtime/bus.py ReliableTransport)
     # for queues matching ``reliable-queues``: sequence-numbered + ack'd
     # frames with bounded redelivery, receiver-side dedup + resequencing.
@@ -289,6 +305,26 @@ class TransportConfig:
                                               "bfloat16", "int8"),
                f"wire-dtype must be float32|float16|bfloat16|int8 "
                f"(or fp32|fp16|bf16), got {self.wire_dtype!r}")
+        from split_learning_tpu.runtime.codec.specs import (
+            CodecSpecError, parse_codec_map,
+        )
+        try:
+            parsed = parse_codec_map(self.codec)
+        except CodecSpecError as e:
+            raise ConfigError(f"transport.codec: {e}") from None
+        if self.wire_dtype_normalized == "int8":
+            _check(not parsed,
+                   "transport.wire-dtype: int8 together with a "
+                   "transport.codec block is ambiguous (two quantizers "
+                   "would stack); move quantization into the codec "
+                   "block, e.g. codec: {intermediate: int8, "
+                   "gradient: int8}")
+            _check(self.allow_global_lossy,
+                   "transport.wire-dtype: int8 lossily quantizes EVERY "
+                   "data-plane payload with the legacy per-tensor "
+                   "quantizer; prefer the per-queue transport.codec "
+                   "block, or set transport.allow-global-lossy: true "
+                   "to opt in explicitly")
         _check(self.redeliver_s > 0, "redeliver-s must be > 0")
         _check(self.max_redeliver >= 1, "max-redeliver must be >= 1")
         _check(self.send_depth >= 1, "send-depth must be >= 1")

@@ -29,6 +29,16 @@ Robustness layers (chaos-grade runtime):
   :class:`~split_learning_tpu.runtime.chaos.ChaosTransport` injecting
   drops/duplicates/reordering/corruption, the application above sees the
   exact sent byte stream, in order.
+
+The wire codecs (``runtime/codec/``: quantized activations, top-k
+gradients, delta Updates) sit ABOVE this whole stack, inside the
+payload build: a codec transforms the message's tensor tree before
+``encode_parts`` produces frame bytes, so every layer here — async
+sender thunks, reliable envelopes, chaos injection, chunking, crc —
+moves codec-compressed bytes without knowing a codec exists.  That
+layering is what makes the chaos soaks compose: redelivered frames
+carry the SAME compressed bytes, so error-feedback state (advanced at
+payload-build time, before any fault can fire) stays deterministic.
 """
 
 from __future__ import annotations

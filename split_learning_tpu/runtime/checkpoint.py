@@ -134,6 +134,38 @@ def load_checkpoint(directory: str | pathlib.Path,
         return None
 
 
+def save_sidecar_arrays(directory: str | pathlib.Path, name: str,
+                        arrays: dict[str, Any]) -> None:
+    """Atomically persist a small named-array sidecar (e.g. a client's
+    wire-codec error-feedback residuals, ``runtime/codec/sparse.py``):
+    write ``.{name}.npz.tmp`` then one ``os.replace`` — the same
+    crash-atomicity contract as the model checkpoint, without the slot
+    machinery (a sidecar is one small file)."""
+    root = pathlib.Path(directory).resolve()
+    root.mkdir(parents=True, exist_ok=True)
+    tmp = root / f".{name}.npz.tmp"
+    with open(tmp, "wb") as f:
+        np.savez(f, **{k: np.asarray(v) for k, v in arrays.items()})
+    os.replace(tmp, root / f"{name}.npz")
+
+
+def load_sidecar_arrays(directory: str | pathlib.Path,
+                        name: str) -> dict | None:
+    """Sidecar arrays, or None when absent OR unreadable (torn write:
+    warn and treat as absent, mirroring :func:`load_checkpoint`)."""
+    path = pathlib.Path(directory).resolve() / f"{name}.npz"
+    if not path.exists():
+        return None
+    try:
+        with np.load(path, allow_pickle=False) as z:
+            return {k: z[k] for k in z.files}
+    except Exception as e:  # noqa: BLE001 — any torn/corrupt state
+        warnings.warn(
+            f"sidecar at {path} is unreadable ({type(e).__name__}: "
+            f"{e}); ignoring it", RuntimeWarning, stacklevel=2)
+        return None
+
+
 def delete_checkpoint(directory: str | pathlib.Path,
                       model_key: str) -> None:
     """Reference's "delete the .pth to reset" (README.md:173-177)."""

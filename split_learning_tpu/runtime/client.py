@@ -51,10 +51,12 @@ from split_learning_tpu.ops.lora import lora_init, lora_merge, split_frozen
 from split_learning_tpu.runtime.bus import Transport
 from split_learning_tpu.runtime.log import Logger
 from split_learning_tpu.runtime.memo import bounded_setdefault
+from split_learning_tpu.runtime.codec import make_codecs, wire_raw_nbytes
 from split_learning_tpu.runtime.protocol import (
     Activation, EpochEnd, FrameAssembler, Gradient, Notify, Pause, Ready,
-    Register, Start, Stop, Syn, QuantLeaf, Update, encode, encode_parts,
-    gradient_queue, intermediate_queue, reply_queue, RPC_QUEUE,
+    Register, SparseLeaf, Start, Stop, Syn, QuantLeaf, Update, encode,
+    encode_parts, gradient_queue, intermediate_queue, reply_queue,
+    RPC_QUEUE,
 )
 from split_learning_tpu.runtime.spans import make_tracer, unpack_ctx
 from split_learning_tpu.runtime.validation import dataset_for_model
@@ -155,10 +157,21 @@ def _to_wire_tree(tree, dtype=np.float32):
 
 
 def _from_wire_tree(tree):
+    """Wire payload tree -> device arrays.  Self-describing: QuantLeaf
+    (legacy per-tensor OR tiled codec form) and SparseLeaf decode
+    without knowing the sender's codec config, so mixed-policy
+    deployments interoperate."""
     def conv(leaf):
         if isinstance(leaf, QuantLeaf):
-            return jnp.asarray(leaf.q, jnp.float32) * np.float32(
-                leaf.scale)
+            from split_learning_tpu.runtime.codec.quant import (
+                dequantize_leaf,
+            )
+            return dequantize_leaf(leaf)
+        if isinstance(leaf, SparseLeaf):
+            from split_learning_tpu.runtime.codec.sparse import (
+                densify_leaf,
+            )
+            return densify_leaf(leaf)
         return jnp.asarray(leaf)
     return jax.tree_util.tree_map(conv, tree)
 
@@ -424,6 +437,17 @@ class ProtocolClient:
         self.num_samples = 0
         self.wire_dtype = _wire_np_dtype(cfg.transport.wire_dtype)
         self._dev_cast = device_wire_dtype(self.wire_dtype)
+        # per-queue-family wire codecs (transport.codec): quantized
+        # activations, EF-sparsified gradients, delta-encoded Updates.
+        # Families without a policy fall back to the wire-dtype path.
+        self.codecs = make_codecs(cfg, faults=self.faults)
+        # delta codec state: (version, base tree) of the last START
+        # params, and the shadow version the server advertised — a
+        # delta is sent ONLY when these agree (else: full frame)
+        self._delta_base = None
+        self._delta_advert = None
+        if cfg.checkpoint.load:
+            self._load_ef_state()
         # device-resident NaN sentinel: hot loops fold jnp.isfinite
         # into this WITHOUT a host sync; _send_update reads it once
         # per round (slcheck JX001)
@@ -499,6 +523,77 @@ class ProtocolClient:
         for part in parts:
             self.bus.publish(queue, part)
             self.wire.count_out(queue, len(part))
+
+    # -- wire codec plumbing -----------------------------------------------
+
+    def _wire_out(self, tree, family: str, queue: str):
+        """Device-side wire stage, ON the training thread (so stateful
+        codecs advance in publish order): codec ``prepare`` when a
+        policy covers ``family``, else the plain device wire cast.
+        Counts the pre-codec dense-equivalent bytes so the compression
+        ratio is measured, not estimated."""
+        c = self.codecs.get(family)
+        if c is None:
+            return _cast_for_wire(tree, self._dev_cast)
+        self.wire.count_raw(queue,
+                            wire_raw_nbytes(tree, self.wire_dtype))
+        return c.prepare(tree, key=queue)
+
+    def _wire_host(self, tree, family: str):
+        """Host-side wire stage (runs inside the publish thunk, i.e.
+        on the async sender): codec ``encode`` or the plain host cast."""
+        c = self.codecs.get(family)
+        if c is None:
+            return _to_wire_tree(tree, self.wire_dtype)
+        return c.encode(tree)
+
+    def _encode_update_wire(self, params_h):
+        """(wire params tree, delta_base version) for this round's
+        UPDATE: a quantized delta against the START base when the
+        version chain is intact, else the full fp32 frame (the resync
+        path — restarted client, moved shadow, no rpc codec)."""
+        rpc = self.codecs.get("rpc")
+        if rpc is None or params_h is None:
+            return params_h, None
+        base = self._delta_base
+        if (base is None or self._delta_advert is None
+                or base[0] != self._delta_advert):
+            return params_h, None   # server counts the full frame
+        ver, base_tree = base
+        self.wire.count_raw(
+            RPC_QUEUE, wire_raw_nbytes(params_h, np.float32))
+        return rpc.encode_update(params_h, base_tree), ver
+
+    def _ef_stateful_codecs(self):
+        for family in ("gradient", "rpc"):
+            c = self.codecs.get(family)
+            if c is not None and hasattr(c, "state_dict"):
+                yield family, c
+
+    def _save_ef_state(self):
+        """Persist each stateful codec's error-feedback residuals next
+        to the model checkpoint (atomic sidecar) so a restarted client
+        resumes with its unsent gradient mass instead of dropping it."""
+        from split_learning_tpu.runtime.checkpoint import (
+            save_sidecar_arrays,
+        )
+        for family, c in self._ef_stateful_codecs():
+            state = c.state_dict()
+            if state:
+                save_sidecar_arrays(
+                    self.cfg.checkpoint.directory,
+                    f"ef_{self.client_id}_{family}", state)
+
+    def _load_ef_state(self):
+        from split_learning_tpu.runtime.checkpoint import (
+            load_sidecar_arrays,
+        )
+        for family, c in self._ef_stateful_codecs():
+            state = load_sidecar_arrays(
+                self.cfg.checkpoint.directory,
+                f"ef_{self.client_id}_{family}")
+            if state:
+                c.load_state_dict(state)
 
     def register(self):
         self.bus.publish(RPC_QUEUE, encode(Register(
@@ -576,6 +671,10 @@ class ProtocolClient:
         self.epochs = int(extra.get("epochs", 1))
         self.sda_size = int(extra.get("sda_size", 1))
         self.round_idx = msg.round_idx
+        # delta-codec version chain: the server advertises the shadow
+        # version it holds for us; _send_update sends a delta only when
+        # our local base carries the same tag (else: full-frame resync)
+        self._delta_advert = extra.get("delta_base_version")
         # server-issued per-invocation generation: stamps every message
         # this client sends so the server/peers can drop strays from an
         # invocation that was already abandoned (round_idx alone can't —
@@ -628,6 +727,13 @@ class ProtocolClient:
                          or [int(c) for c in msg.label_counts]
                          != getattr(self, "_loader_counts", None))):
                 self._build_loader(msg)
+            # hold START: the delta base survives only while it still
+            # matches the server's shadow — a drifted advertisement
+            # (shadow lost/moved) breaks the chain, so fall back to a
+            # full-frame UPDATE rather than a delta nobody can fold
+            if (self._delta_base is not None
+                    and self._delta_base[0] != self._delta_advert):
+                self._delta_base = None
             return
         model_kwargs = dict(self.cfg.model_kwargs or {})
         self.runner = ShardRunner(
@@ -635,6 +741,13 @@ class ProtocolClient:
             msg.learning, model_kwargs=model_kwargs,
             seed=self.cfg.seed
             + zlib.crc32(self.client_id.encode()) % 100000)
+        if self.codecs.get("rpc") is not None \
+                and self._delta_advert is not None:
+            # base = the shard EXACTLY as received (the server's shadow
+            # holds the same bytes — START params travel fp32 pickled)
+            self._delta_base = (
+                self._delta_advert,
+                jax.tree_util.tree_map(np.asarray, msg.params))
         params = jax.tree_util.tree_map(jnp.asarray, msg.params)
         self.stats = jax.tree_util.tree_map(
             jnp.asarray, msg.batch_stats or {})
@@ -724,21 +837,31 @@ class ProtocolClient:
         if self._ok_dev is not None and not bool(self._ok_dev):
             self.round_ok = False
         params_h = stats_h = None
+        delta_base = None
         if with_weights:
             merged = self.runner.merge_params(self.frozen, self.trainable)
             params_h = jax.tree_util.tree_map(np.asarray, merged)
             stats_h = jax.tree_util.tree_map(np.asarray, self.stats)
+            # rpc codec: ship ``trained - base`` against the START's
+            # version tag when the chain is intact, full fp32 otherwise
+            params_h, delta_base = self._encode_update_wire(params_h)
         # TENSOR-framed and chunked: a shard UPDATE is the biggest frame
         # a client ever publishes
         self._publish_parts(RPC_QUEUE, lambda ctx, p=params_h, s=stats_h,
                             n=self.num_samples, ok=self.round_ok,
-                            fence=self.fence, cl=self.cluster:
+                            fence=self.fence, cl=self.cluster,
+                            db=delta_base:
                             encode_parts(Update(
                                 client_id=self.client_id,
                                 stage=self.stage, cluster=cl, params=p,
                                 batch_stats=s, num_samples=n, ok=ok,
-                                round_idx=fence), self._chunk_bytes,
+                                round_idx=fence, delta_base=db),
+                                self._chunk_bytes,
                                 ctx=ctx), kind="Update")
+        # error-feedback residuals are part of the client's durable
+        # state: checkpoint them with the round (atomic sidecar)
+        if self.cfg.checkpoint.save and self.codecs:
+            self._save_ef_state()
         self.log.info(f"[>>>] UPDATE samples={self.num_samples} "
                       f"ok={self.round_ok}"
                       + ("" if with_weights else " (no weights)"))
@@ -921,11 +1044,12 @@ class ProtocolClient:
                 next_item = next(data_iter, None)
                 x = jnp.asarray(x)
                 rng = r.next_rng()
+                out_q = out_qs[n_fwd % len(out_qs)]
                 sp = self.tracer.start("fwd", always=False,
                                        round=self.round_idx)
-                out = _cast_for_wire(
+                out = self._wire_out(
                     r.fwd(self.frozen, self.trainable, self.stats, x,
-                          rng), self._dev_cast)
+                          rng), "intermediate", out_q)
                 sp.end()
                 data_id = uuid.uuid4().hex
                 inflight[data_id] = _Inflight(x=x, rng=rng,
@@ -940,12 +1064,12 @@ class ProtocolClient:
                 # bind fence/cluster NOW: the thunk may run after an
                 # abandoned round's _on_start moved them
                 self._publish_parts(
-                    out_qs[n_fwd % len(out_qs)],
+                    out_q,
                     lambda ctx, out=out, labels_np=labels_np, d=data_id,
                     fence=self.fence, cl=self.cluster:
                         encode_parts(Activation(
                             data_id=d,
-                            data=_to_wire_tree(out, self.wire_dtype),
+                            data=self._wire_host(out, "intermediate"),
                             labels=labels_np, trace=[self.client_id],
                             cluster=cl, round_idx=fence),
                             self._chunk_bytes, ctx=ctx),
@@ -1017,15 +1141,16 @@ class ProtocolClient:
                 self.hists.observe("step", time.perf_counter() - t_sp)
                 self.num_samples += ent.n   # see _train_first
                 origin = ent.trace[-1]
-                gx = _cast_for_wire(gx, self._dev_cast)
+                grad_out_q = gradient_queue(self.stage - 1, origin)
+                gx = self._wire_out(gx, "gradient", grad_out_q)
                 _start_host_copy(gx)
                 self._publish_parts(
-                    gradient_queue(self.stage - 1, origin),
+                    grad_out_q,
                     lambda ctx, gx=gx, d=g.data_id, tr=ent.trace[:-1],
                     fence=self.fence:
                         encode_parts(Gradient(
                             data_id=d,
-                            data=_to_wire_tree(gx, self.wire_dtype),
+                            data=self._wire_host(gx, "gradient"),
                             trace=tr, round_idx=fence),
                             self._chunk_bytes, ctx=ctx),
                     kind="Gradient")
@@ -1045,23 +1170,24 @@ class ProtocolClient:
                 continue
             x = _from_wire_tree(act.data)
             rng = r.next_rng()
+            out_q = out_qs[n_fwd % len(out_qs)]
             sp = self.tracer.start("fwd", always=False,
                                    round=self.round_idx)
-            out = _cast_for_wire(
+            out = self._wire_out(
                 r.fwd(self.frozen, self.trainable, self.stats, x, rng),
-                self._dev_cast)
+                "intermediate", out_q)
             sp.end()
             inflight[act.data_id] = _Inflight(x=x, rng=rng,
                                               trace=list(act.trace),
                                               n=len(act.labels))
             _start_host_copy(out)
             self._publish_parts(
-                out_qs[n_fwd % len(out_qs)],
+                out_q,
                 lambda ctx, out=out, act=act, fence=self.fence,
                 cl=self.cluster:
                     encode_parts(Activation(
                         data_id=act.data_id,
-                        data=_to_wire_tree(out, self.wire_dtype),
+                        data=self._wire_host(out, "intermediate"),
                         labels=act.labels,
                         trace=list(act.trace) + [self.client_id],
                         cluster=cl, round_idx=fence),
@@ -1252,23 +1378,36 @@ class ProtocolClient:
         sp.end()
         self.hists.observe("step", time.perf_counter() - t_sp)
         self.num_samples += int(sum(sizes))
-        gx = _cast_for_wire(gx, self._dev_cast)
-        _start_host_copy(gx)
+        grad_codec = self.codecs.get("gradient")
+        if grad_codec is None:
+            # plain wire: one whole-window device cast + host copy,
+            # sliced after.  With a codec the dense window never
+            # crosses to host — only the per-part prepared leaves do.
+            gx = _cast_for_wire(gx, self._dev_cast)
+            _start_host_copy(gx)
         off = 0
         for act, n in zip(window, sizes):
             # slice the raw cotangent, THEN wire-encode the part:
-            # int8 wrapper leaves don't slice, and per-part quantization
-            # scales are tighter than one window-wide scale anyway
+            # quantized/sparse wrapper leaves don't slice, per-part
+            # quantization scales are tighter than one window-wide
+            # scale, and the EF residual must be per ORIGIN stream
             gx_part = jax.tree_util.tree_map(
                 lambda a, off=off, n=n: a[off:off + n], gx)
             off += n
             origin = act.trace[-1]
+            grad_out_q = gradient_queue(self.stage - 1, origin)
+            if grad_codec is not None:
+                wire_part = self._wire_out(gx_part, "gradient",
+                                           grad_out_q)
+                _start_host_copy(wire_part)
+            else:
+                wire_part = gx_part   # already cast + copying above
             self._publish_parts(
-                gradient_queue(self.stage - 1, origin),
-                lambda ctx, gx_part=gx_part, act=act, fence=self.fence:
+                grad_out_q,
+                lambda ctx, wp=wire_part, act=act, fence=self.fence:
                     encode_parts(Gradient(
                         data_id=act.data_id,
-                        data=_to_wire_tree(gx_part, self.wire_dtype),
+                        data=self._wire_host(wp, "gradient"),
                         trace=list(act.trace)[:-1], round_idx=fence),
                         self._chunk_bytes, ctx=ctx),
                 kind="Gradient")

@@ -1,0 +1,87 @@
+"""Composable wire codec layer between the runtime and the SLT2 frame
+format (ROADMAP open item 3; *Ampere*, arxiv 2507.07130).
+
+A codec is a per-queue-family policy configured in ``transport.codec``
+(:mod:`~split_learning_tpu.runtime.codec.specs` owns the grammar):
+
+* ``intermediate`` — :class:`~.quant.QuantCodec`: tiled absmax
+  int8/int4 activation quantization, scales computed ON DEVICE before
+  the fetch;
+* ``gradient`` — :class:`~.quant.QuantCodec` or
+  :class:`~.sparse.TopKCodec`: top-k sparsification with a seeded,
+  checkpointable error-feedback residual;
+* ``rpc`` — :class:`~.delta.DeltaCodec`: Update frames carry
+  ``params - last_server_acked`` against the server's versioned shadow
+  copies, with automatic full-frame resync when the version chain
+  breaks.
+
+Every codec composes under the Reliable/Chaos/Async transports: it
+transforms the PAYLOAD tree before ``encode_parts`` and after decode,
+so envelopes, chunking, checksums and the wire trace context are
+untouched.  The shared shape is a two-phase encoder matching the async
+data plane: ``prepare(tree, key)`` runs on the training thread (device
+ops + any stateful residual update, so state order == publish order)
+and ``encode(prepared)`` runs on the async sender thread (host fetch +
+wire-leaf construction).
+
+This module stays import-light (``specs`` only); the codec classes
+pull in jax and are imported lazily by :func:`make_codecs`.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from split_learning_tpu.runtime.codec.specs import (  # noqa: F401
+    CODEC_COUNTERS, CODEC_FAMILIES, CodecSpec, CodecSpecError,
+    parse_codec_map, parse_spec,
+)
+
+__all__ = [
+    "CODEC_COUNTERS", "CODEC_FAMILIES", "CodecSpec", "CodecSpecError",
+    "parse_codec_map", "parse_spec", "make_codecs", "wire_raw_nbytes",
+]
+
+
+def make_codecs(cfg, faults=None) -> dict:
+    """{family: codec instance} for one participant, from
+    ``cfg.transport.codec``.  Families without a spec are absent —
+    callers fall back to the plain wire-dtype path."""
+    specs = parse_codec_map(getattr(cfg.transport, "codec", None))
+    out: dict = {}
+    for family, spec in specs.items():
+        if spec.kind in ("int8", "int4"):
+            from split_learning_tpu.runtime.codec.quant import QuantCodec
+            out[family] = QuantCodec(spec, faults=faults)
+        elif spec.kind == "topk":
+            from split_learning_tpu.runtime.codec.sparse import TopKCodec
+            out[family] = TopKCodec(spec, faults=faults)
+        elif spec.kind == "delta":
+            from split_learning_tpu.runtime.codec.delta import DeltaCodec
+            out[family] = DeltaCodec(spec, faults=faults)
+    return out
+
+
+def wire_raw_nbytes(tree, wire_dtype) -> int:
+    """Bytes this payload tree WOULD occupy on the plain (codec-less)
+    wire: float leaves at the configured wire dtype, everything else at
+    its own width.  Shape-only — no device sync.  Feeds the
+    ``raw_bytes_out`` wire counter, the honest denominator of
+    ``extra.wire_compression_ratio``."""
+    import jax
+    import jax.numpy as jnp
+
+    itemsize = np.dtype(wire_dtype).itemsize
+    total = 0
+    for leaf in jax.tree_util.tree_leaves(tree):
+        ldt = getattr(leaf, "dtype", None)
+        if ldt is None:
+            continue
+        n = int(np.prod(np.shape(leaf))) if np.shape(leaf) else 1
+        if ldt == jax.dtypes.float0:
+            total += n * 4
+        elif jnp.issubdtype(ldt, jnp.floating):   # incl. bfloat16
+            total += n * itemsize
+        else:
+            total += n * np.dtype(ldt).itemsize
+    return total

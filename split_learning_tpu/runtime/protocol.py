@@ -111,6 +111,11 @@ class Update:
     ok: bool = True                 # False -> NaN seen, skip aggregation
     batch_stats: Any | None = None  # shard's running stats (BN models)
     round_idx: int = 0
+    # delta-encoded Update (transport.codec rpc family): params holds
+    # ``trained - base`` against the server's versioned shadow copy of
+    # what it sent in START.  None = full frame (the resync fallback
+    # whenever the version chain broke: client restart, shadow loss).
+    delta_base: int | None = None
 
 
 @dataclasses.dataclass
@@ -212,13 +217,42 @@ class EpochEnd:
 
 @dataclasses.dataclass
 class QuantLeaf:
-    """One int8 absmax-quantized float tensor on the data-plane wire
-    (``transport.wire-dtype: int8`` — ~4x smaller than the reference's
-    fp32 pickles, ``src/train/VGG16.py:27``): ``x ≈ q * scale`` with
-    ``scale = max|x| / 127``.  Deliberately NOT a registered pytree so
-    tree_maps over a wire payload treat it as a leaf."""
-    q: np.ndarray       # int8
-    scale: float        # dequantization factor
+    """One absmax-quantized float tensor on the data-plane wire:
+    ``x ≈ q * scale``.  Deliberately NOT a registered pytree so
+    tree_maps over a wire payload treat it as a leaf.
+
+    Two generations share this class:
+
+    * legacy per-tensor form (``transport.wire-dtype: int8``,
+      ``src/train/VGG16.py:27`` fp32-pickle contrast): ``q`` int8 with
+      the tensor's own shape, ``scale`` a python float
+      (``max|x| / 127``), defaults for the rest;
+    * tiled codec form (``transport.codec`` quantizers,
+      ``runtime/codec/quant.py``): ``q`` is the FLAT padded code array
+      — int8 codes, or uint8 with two 4-bit codes per byte when
+      ``bits == 4`` — ``scale`` a float32 array with one entry per
+      ``tile`` elements, and ``shape`` the original tensor shape.  A
+      non-finite payload tile ships a NaN scale so the receiver's NaN
+      sentinel still fires after dequantization.
+    """
+    q: np.ndarray            # codes (see above)
+    scale: Any               # float, or float32 ndarray of tile scales
+    bits: int = 8            # 8 = one code per byte, 4 = packed pairs
+    tile: int = 0            # elements per scale; 0 = per-tensor scalar
+    shape: tuple | None = None   # original shape (tiled form only)
+
+
+@dataclasses.dataclass
+class SparseLeaf:
+    """One top-k sparsified float tensor on the data-plane wire
+    (``transport.codec`` ``topk:<frac>``, ``runtime/codec/sparse.py``):
+    flat ``idx`` into the dense tensor, the kept ``val``ues, and the
+    dense ``shape`` to scatter back into (zeros elsewhere).  The
+    sender's error-feedback residual holds what was not sent.  Like
+    QuantLeaf, deliberately NOT a registered pytree."""
+    idx: np.ndarray          # int32 flat indices, sorted ascending
+    val: np.ndarray          # float32 values at idx
+    shape: tuple = ()        # dense shape
 
 
 @dataclasses.dataclass
@@ -238,7 +272,8 @@ DATA_TYPES = (Activation, Gradient, EpochEnd)
 TENSOR_TYPES = (Activation, Gradient, Update)
 _TYPE_BY_NAME = {t.__name__: t for t in CONTROL_TYPES + DATA_TYPES}
 #: nested wire-format helpers (never valid as a top-level message)
-_WIRE_HELPERS = {"QuantLeaf": QuantLeaf, "_TensorRef": _TensorRef}
+_WIRE_HELPERS = {"QuantLeaf": QuantLeaf, "SparseLeaf": SparseLeaf,
+                 "_TensorRef": _TensorRef}
 
 
 # --------------------------------------------------------------------------
@@ -358,9 +393,16 @@ if _BF16 is not None:
     _DTYPE_BY_CODE[4] = _BF16
 _CODE_BY_DTYPE = {dt: c for c, dt in _DTYPE_BY_CODE.items()}
 
-#: per-tensor fixed header: dtype code, flags (reserved), ndim,
-#: crc32(raw bytes), raw byte length — shape dims (u64 each) follow
+#: per-tensor fixed header: dtype code, flags, ndim, crc32(raw bytes),
+#: raw byte length — shape dims (u64 each) follow
 _THDR = struct.Struct(">BBHIQ")
+#: header ``flags`` bits, set on a QuantLeaf's code blob and
+#: cross-checked against the pickled skeleton at decode time — a
+#: skeleton/blob disagreement (bit rot the crc math happened to
+#: forgive, or a crafted skeleton) is rejected as corrupt instead of
+#: being mis-dequantized:
+TENSOR_FLAG_PACKED4 = 0x01   # two 4-bit codes per byte (bits == 4)
+TENSOR_FLAG_TILED = 0x02     # per-tile scales (tile > 0)
 _MAX_NDIM = 32
 _MAX_TENSORS = 1 << 20
 
@@ -387,13 +429,21 @@ def _encode_tensor(msg, ctx: bytes = b"") -> bytes:
         raise ValueError(f"trace context of {len(ctx)} bytes exceeds "
                          f"the {_MAX_CTX_BYTES}-byte cap")
     tensors: list = []
+    tflags: list[int] = []
 
-    def strip(o):
+    def strip(o, flags: int = 0):
         if isinstance(o, np.ndarray) and o.dtype in _CODE_BY_DTYPE:
             tensors.append(o)
+            tflags.append(flags)
             return _TensorRef(len(tensors) - 1)
         if isinstance(o, QuantLeaf):
-            return QuantLeaf(q=strip(o.q), scale=o.scale)
+            qf = ((TENSOR_FLAG_PACKED4 if o.bits == 4 else 0)
+                  | (TENSOR_FLAG_TILED if o.tile else 0))
+            return QuantLeaf(q=strip(o.q, qf), scale=strip(o.scale),
+                             bits=o.bits, tile=o.tile, shape=o.shape)
+        if isinstance(o, SparseLeaf):
+            return SparseLeaf(idx=strip(o.idx), val=strip(o.val),
+                              shape=o.shape)
         if isinstance(o, dict):
             return {k: strip(v) for k, v in o.items()}
         if isinstance(o, list):
@@ -408,10 +458,10 @@ def _encode_tensor(msg, ctx: bytes = b"") -> bytes:
 
     headers: list[bytes] = []
     blobs: list = []
-    for a in tensors:
+    for a, fl in zip(tensors, tflags):
         a, buf = _blob(a)
         headers.append(
-            _THDR.pack(_CODE_BY_DTYPE[a.dtype], 0, a.ndim,
+            _THDR.pack(_CODE_BY_DTYPE[a.dtype], fl, a.ndim,
                        zlib.crc32(buf), a.nbytes)
             + struct.pack(f">{a.ndim}Q", *a.shape))
         blobs.append(buf)
@@ -446,7 +496,7 @@ def _decode_tensor(raw: bytes):
                 raise CorruptFrame(f"tensor frame claims ndim={ndim}")
             shape = struct.unpack_from(f">{ndim}Q", raw, off)
             off += 8 * ndim
-            hdrs.append((code, shape, bcrc, nbytes))
+            hdrs.append((code, flags, shape, bcrc, nbytes))
         (skel_len,) = struct.unpack_from(">I", raw, off)
         off += 4
         if off + skel_len > len(raw):
@@ -460,10 +510,11 @@ def _decode_tensor(raw: bytes):
     if zlib.crc32(view[8:off]) != want:
         raise CorruptFrame("tensor frame meta checksum mismatch "
                            f"({len(raw)} bytes)")
-    if len(raw) - off != sum(h[3] for h in hdrs):
+    if len(raw) - off != sum(h[4] for h in hdrs):
         raise CorruptFrame("tensor frame blob region length mismatch")
     arrays = []
-    for code, shape, bcrc, nbytes in hdrs:
+    flags_of: list[int] = []
+    for code, flags, shape, bcrc, nbytes in hdrs:
         dt = _DTYPE_BY_CODE.get(code)
         if dt is None:
             raise CorruptFrame(f"unknown tensor dtype code {code}")
@@ -474,6 +525,7 @@ def _decode_tensor(raw: bytes):
             raise CorruptFrame("tensor blob checksum mismatch")
         arrays.append(np.frombuffer(raw, dtype=dt, count=count,
                                     offset=off).reshape(shape))
+        flags_of.append(flags)
         off += nbytes
     msg = _SafeUnpickler(io.BytesIO(skel)).load()
     if not isinstance(msg, TENSOR_TYPES):
@@ -486,7 +538,38 @@ def _decode_tensor(raw: bytes):
                 raise CorruptFrame(f"tensor ref {o.idx} out of range")
             return arrays[o.idx]
         if isinstance(o, QuantLeaf):
-            return QuantLeaf(q=fill(o.q), scale=o.scale)
+            # the skeleton's quantizer parameters must agree with the
+            # flags stamped on the code blob's header (both are under
+            # the outer crc, but a crafted frame can lie in one place)
+            if isinstance(o.q, _TensorRef) \
+                    and 0 <= o.q.idx < len(flags_of):
+                want = ((TENSOR_FLAG_PACKED4 if o.bits == 4 else 0)
+                        | (TENSOR_FLAG_TILED if o.tile else 0))
+                if flags_of[o.q.idx] != want:
+                    raise CorruptFrame(
+                        "quantized blob flags disagree with skeleton "
+                        f"(header {flags_of[o.q.idx]:#x}, skeleton "
+                        f"bits={o.bits} tile={o.tile})")
+            return QuantLeaf(q=fill(o.q), scale=fill(o.scale),
+                             bits=o.bits, tile=o.tile, shape=o.shape)
+        if isinstance(o, SparseLeaf):
+            idx, val = fill(o.idx), fill(o.val)
+            # bounds-check HERE, where decode errors are caught and
+            # counted (client._decode) — not at densify time on the
+            # training thread, where an uncaught CorruptFrame would
+            # kill the process a crafted frame should only cost one
+            # message
+            n = int(math.prod(o.shape)) if o.shape else 1
+            if isinstance(idx, np.ndarray):
+                if np.shape(idx) != np.shape(val):
+                    raise CorruptFrame("sparse leaf idx/val length "
+                                       "mismatch")
+                if idx.size and (int(idx.min()) < 0
+                                 or int(idx.max()) >= n):
+                    raise CorruptFrame(
+                        f"sparse leaf index out of range for shape "
+                        f"{o.shape}")
+            return SparseLeaf(idx=idx, val=val, shape=o.shape)
         if isinstance(o, dict):
             return {k: fill(v) for k, v in o.items()}
         if isinstance(o, list):

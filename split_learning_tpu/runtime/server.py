@@ -109,6 +109,15 @@ class ProtocolContext(MeshContext):
         self._ready: set = set()
         self._notified: set = set()
         self._updates: list[Update] = []
+        # delta-encoded Updates (transport.codec rpc family): versioned
+        # per-client shadow copies of the shards this server sent, so a
+        # delta UPDATE folds back into a full tree before aggregation
+        from split_learning_tpu.runtime.codec import parse_codec_map
+        self._delta_shadow = None
+        if parse_codec_map(getattr(cfg.transport, "codec",
+                                   None)).get("rpc") is not None:
+            from split_learning_tpu.runtime.codec.delta import DeltaShadow
+            self._delta_shadow = DeltaShadow(faults=self.faults)
         # elastic membership (topology.elastic-join): ids the CURRENT
         # plans were computed from; per-ROUND alive/silent bookkeeping
         # (sequential strategies run several train_cluster invocations
@@ -210,10 +219,40 @@ class ProtocolContext(MeshContext):
                 self.log.warning(f"stale UPDATE {msg.client_id} "
                                  f"gen={msg.round_idx} (dropped)")
             else:
+                self._fold_update(msg)
                 self._updates.append(msg)
                 self.log.received(f"UPDATE {msg.client_id} "
                                   f"samples={msg.num_samples} ok={msg.ok}")
         return True
+
+    def _fold_update(self, msg: Update) -> None:
+        """Reconstruct a delta-encoded UPDATE in place (``base +
+        dequant(delta)`` against the versioned shadow).  When the
+        version chain is broken (shadow missing/moved — redelivery
+        gap, server state loss) the delta is unusable: the update is
+        kept WEIGHT-LESS (the barrier must not stall on it; aggregation
+        skips param-less updates) and the client is marked for a full
+        re-seed, so the next round repairs the chain.  Full frames
+        (delta_base None) pass through and are counted — they ARE the
+        resync path."""
+        if msg.delta_base is None:
+            if self._delta_shadow is not None and msg.params is not None:
+                self.faults.inc("delta_full_frames")
+            return
+        full = (None if self._delta_shadow is None
+                else self._delta_shadow.fold(msg.client_id,
+                                             msg.delta_base, msg.params))
+        if full is None:
+            self.log.warning(
+                f"delta UPDATE {msg.client_id} against unknown base "
+                f"v{msg.delta_base}: weights dropped; full-frame "
+                "resync next round")
+            self._needs_params.add(msg.client_id)
+            msg.params = None
+            msg.batch_stats = None
+        else:
+            msg.params = full
+        msg.delta_base = None   # downstream sees a plain (full) update
 
     def _pump_until(self, pred: Callable[[], bool],
                     what: str | Callable[[], str],
@@ -347,6 +386,11 @@ class ProtocolContext(MeshContext):
         for cid in pruned:
             self.bus.publish(reply_queue(cid), encode(Stop(
                 reason="pruned: missed consecutive round barriers")))
+            if self._delta_shadow is not None:
+                # a pruned client's shadow is a full shard copy pinned
+                # in server memory; under membership churn that leaks
+                # without bound (a rejoiner full-frames anyway)
+                self._delta_shadow.clear(cid)
         self.log.info(f"elastic re-plan: joined={joined} "
                       f"pruned={pruned}", "cyan")
         self._planned_ids = live
@@ -501,6 +545,18 @@ class ProtocolContext(MeshContext):
                                                 self.specs, a, b))
             else:
                 shard_p = shard_s = None
+            # delta codec: keep a versioned shadow of EXACTLY what this
+            # START carries, and advertise the version we hold — the
+            # client sends a delta only against a matching base (a
+            # weight-less START advertises the standing shadow)
+            delta_ver = None
+            if self._delta_shadow is not None:
+                if sp:
+                    self._delta_shadow.note_sent(cid, self._cur_gen,
+                                                 shard_p)
+                    delta_ver = self._cur_gen
+                else:
+                    delta_ver = self._delta_shadow.version_for(cid)
             label_counts = None
             if s == 1:
                 label_counts = np.asarray(
@@ -552,6 +608,7 @@ class ProtocolContext(MeshContext):
                        # so all participants' spans merge onto ONE
                        # trace, across processes
                        "trace_id": self.tracer.trace_id,
+                       "delta_base_version": delta_ver,
                        "gen": self._cur_gen})))
             self.log.sent(f"START -> {cid} layers=[{a}, {end_layer}]"
                           + ("" if sp else " (no weights)"))

@@ -57,13 +57,33 @@ class RoundOutcome:
 def aggregate_cluster(updates: Sequence[Update]) -> tuple[Any, Any, int]:
     """Per-stage weighted FedAvg then stage concat for ONE cluster.
 
-    Returns (params_tree, stats_tree, total_stage1_samples)."""
+    Returns (params_tree, stats_tree, total_stage1_samples).
+
+    Delta-encoded updates (``transport.codec`` rpc family) must be
+    reconstructed against the server's versioned shadow BEFORE they
+    reach this fold (``runtime/server.py _fold_update``) — averaging a
+    delta as if it were a weight tree would corrupt the global model
+    silently, so an un-reconstructed one is a hard error here.
+    Weight-less updates (FLEX non-aggregation rounds, or a delta whose
+    version chain broke and was stripped) carry no tree to fold and
+    are skipped; their samples still count toward the round total."""
     by_stage: dict[int, list[Update]] = {}
+    n_weightless = 0
     for u in updates:
+        if getattr(u, "delta_base", None) is not None:
+            raise ValueError(
+                f"delta-encoded Update from {u.client_id} (base "
+                f"v{u.delta_base}) reached aggregation un-reconstructed")
+        if u.params is None:
+            if u.stage == 1:
+                n_weightless += u.num_samples
+            continue
         by_stage.setdefault(u.stage, []).append(u)
     params: dict = {}
     stats: dict = {}
-    n_samples = 0
+    n_samples = n_weightless   # trained samples count even when the
+    # weights were stripped (broken delta chain) — the round's data
+    # throughput is real; only the fold skips the client
     for stage, ups in sorted(by_stage.items()):
         # client-id order, not arrival order: float summation order must
         # not depend on which UPDATE won a thread race, or two identical

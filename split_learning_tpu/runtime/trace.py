@@ -53,6 +53,11 @@ FAULT_COUNTER_NAMES = frozenset({
     "daemon_errors", "ack_send_failures", "corrupt_rejected",
     # transport plumbing
     "reconnects", "timeouts", "async_send_errors", "prefetch_errors",
+    # wire codecs (runtime/codec/): non-finite payloads crossing the
+    # quantizer, top-k leaves too small to sparsify, and the delta
+    # codec's fold/full-frame/version-gap outcomes
+    "quant_nonfinite", "topk_dense_fallbacks",
+    "delta_folds", "delta_full_frames", "delta_resyncs",
 })
 
 #: Declared registry of latency-histogram names (same contract as
@@ -112,6 +117,7 @@ class WireCounters:
         self._lock = threading.Lock()
         self._bytes_out: collections.Counter = collections.Counter()
         self._bytes_in: collections.Counter = collections.Counter()
+        self._raw_bytes_out: collections.Counter = collections.Counter()
         self._msgs_out = 0
         self._msgs_in = 0
         self._encode_s = 0.0
@@ -124,6 +130,14 @@ class WireCounters:
         with self._lock:
             self._bytes_out[queue] += nbytes
             self._msgs_out += 1
+
+    def count_raw(self, queue: str, nbytes: int) -> None:
+        """Pre-codec dense-equivalent bytes of a payload published on
+        ``queue`` (what the plain wire-dtype path would have moved):
+        the denominator of the wire compression ratio.  Only codec
+        paths count here, so zero means no codec was active."""
+        with self._lock:
+            self._raw_bytes_out[queue] += nbytes
 
     def count_in(self, queue: str, nbytes: int) -> None:
         with self._lock:
@@ -161,8 +175,11 @@ class WireCounters:
             return {
                 "bytes_out_total": sum(self._bytes_out.values()),
                 "bytes_in_total": sum(self._bytes_in.values()),
+                "raw_bytes_out": sum(self._raw_bytes_out.values()),
                 "data_bytes_out": self._data_bytes(self._bytes_out),
                 "data_bytes_in": self._data_bytes(self._bytes_in),
+                "data_raw_bytes_out": self._data_bytes(
+                    self._raw_bytes_out),
                 "msgs_out": self._msgs_out,
                 "msgs_in": self._msgs_in,
                 "encode_s": round(self._encode_s, 6),

@@ -570,3 +570,58 @@ def test_counter_registry_clean_on_repo():
     from split_learning_tpu.analysis import counters
     from split_learning_tpu.analysis.__main__ import repo_root
     assert counters.run(repo_root()) == []
+
+
+# --------------------------------------------------------------------------
+# codec analyzer (CD001-CD003)
+# --------------------------------------------------------------------------
+
+def test_unregistered_codec_counter_flagged():
+    from split_learning_tpu.analysis import codec_check
+    findings = codec_check.check_counters(
+        registries=frozenset({"quant_nonfinite"}),
+        codec_counters={"int8": ("quant_nonfinite",),
+                        "topk": ("topk_dense_fallbackz",)})
+    assert [f.code for f in findings] == ["CD001"]
+    assert "topk_dense_fallbackz" in findings[0].message
+
+
+def test_host_quant_in_hot_loop_flagged():
+    from split_learning_tpu.analysis import codec_check
+    src = (
+        "def _train_first(self):\n"
+        "    for batch in loader:\n"
+        "        wire = _quant_int8(batch)\n"       # CD002
+        "        publish(wire)\n"
+        "def _send_update(self):\n"
+        "    leaf = quantize_np(params, 64, 8)\n"   # no loop: legal
+    )
+    findings = codec_check.scan_source(src, "x.py")
+    assert [f.code for f in findings] == ["CD002"]
+    assert findings[0].where == "_train_first"
+    assert "device" in findings[0].message
+
+
+def test_codec_analyzer_clean_on_repo():
+    from split_learning_tpu.analysis import codec_check
+    from split_learning_tpu.analysis.__main__ import repo_root
+    assert codec_check.run(repo_root(), trace=True) == []
+
+
+def test_device_quant_audit_catches_host_fallback(monkeypatch):
+    """CD003: a QuantCodec whose prepare pulls payloads to host (the
+    regression the device kernels exist to prevent) fails the abstract
+    trace."""
+    import numpy as np
+
+    from split_learning_tpu.analysis import codec_check
+    from split_learning_tpu.runtime.codec import quant
+
+    def host_prepare(self, tree, key=""):
+        import jax
+        return jax.tree_util.tree_map(
+            lambda a: np.asarray(a) * 1.0, tree)   # host round-trip
+
+    monkeypatch.setattr(quant.QuantCodec, "prepare", host_prepare)
+    findings = codec_check.check_device_quant()
+    assert findings and all(f.code == "CD003" for f in findings)

@@ -80,7 +80,9 @@ def test_auto_partition_sees_compressed_wire_bytes():
             model="KWT", dataset="SPEECHCOMMANDS", clients=[1, 1],
             model_kwargs=TINY_KWT, synthetic_size=32,
             topology={"mode": "auto"},
-            transport={"wire_dtype": wire},
+            # global int8 is opt-in since the codec block landed
+            transport={"wire_dtype": wire,
+                       "allow_global_lossy": wire == "int8"},
             distribution={"num_samples": 16}))
         regs = [Registration("c0", 1, profile=dict(prof)),
                 Registration("c_last", 2)]

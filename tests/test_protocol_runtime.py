@@ -585,8 +585,11 @@ def test_wire_dtype_compression(tmp_path, dtype, max_ratio):
     and the round still trains."""
     def run(wire):
         bus = InProcTransport()
+        # global int8 is an explicit opt-in now that per-queue codec
+        # policies exist (transport.codec is the preferred spelling)
         cfg = proto_cfg(tmp_path, clients=[1, 1],
-                        transport={"wire_dtype": wire})
+                        transport={"wire_dtype": wire,
+                                   "allow_global_lossy": wire == "int8"})
         result = run_deployment(cfg, lambda: bus, bus)
         data_bytes = sum(v for q, v in bus.bytes_out.items()
                          if q.startswith(("intermediate_queue",

@@ -127,19 +127,34 @@ def transport_cell(fault: str, prob: float, seed: int,
     return True, note or "quiet"
 
 
-def full_round_cell(fault: str, prob: float, seed: int, tmp: str
-                    ) -> tuple[bool, str]:
+#: --codec: the wire compression stack the chaos cells run under
+#: (int8 tiled activations + top-k EF gradients + int8-delta Updates)
+#: — proving the error-feedback state and delta chain deterministic
+#: UNDER faults, not just on a clean wire
+CODEC_STACK = {"intermediate": "int8:64", "gradient": "topk:0.1",
+               "rpc": "delta:int8"}
+
+
+def full_round_cell(fault: str, prob: float, seed: int, tmp: str,
+                    codec: bool = False) -> tuple[bool, str]:
     """Full 3-client round; PASS iff params match the fault-free run
-    bit-for-bit (baseline computed once and cached on the function)."""
+    bit-for-bit (baseline computed once and cached on the function).
+    ``codec=True`` runs BOTH the baseline and the chaotic cell with the
+    compression stack enabled — bit-identity then proves the codecs'
+    stateful parts (EF residuals, delta folds) are deterministic under
+    drop/dup/reorder."""
     import numpy as np
 
     sys.path.insert(0, "tests")
     from test_chaos import _chaos, _round_cfg, _run_cell  # noqa: E402
     root = pathlib.Path(tmp)
-    if not hasattr(full_round_cell, "_base"):
-        cfg = _round_cfg(root, root / "base")
-        full_round_cell._base = _run_cell(cfg)
-    base = full_round_cell._base
+    over = {"transport": {"codec": CODEC_STACK}} if codec else {}
+    cache = "_base_codec" if codec else "_base"
+    if not hasattr(full_round_cell, cache):
+        cfg = _round_cfg(root, root / f"base{'_codec' if codec else ''}",
+                         **over)
+        setattr(full_round_cell, cache, _run_cell(cfg))
+    base = getattr(full_round_cell, cache)
     kwargs = {f: 0.0 for f in ("drop", "duplicate", "reorder", "corrupt",
                                "delay")}
     if fault == "mixed":
@@ -148,7 +163,7 @@ def full_round_cell(fault: str, prob: float, seed: int, tmp: str
     else:
         kwargs[fault] = prob
     cell_dir = root / f"{fault}_{prob}_{seed}"
-    cfg = _round_cfg(root, cell_dir)
+    cfg = _round_cfg(root, cell_dir, **over)
     res = _run_cell(cfg, chaos_cfg=_chaos(seed=seed, delay_s=0.005,
                                           **kwargs), reliable=True)
     if not res.history[0].ok:
@@ -213,6 +228,11 @@ def main(argv=None):
                     help="restrict to one cell, e.g. drop:0.4")
     ap.add_argument("--full", action="store_true",
                     help="full tiny training round per cell (slow)")
+    ap.add_argument("--codec", action="store_true",
+                    help="with --full: run cells with the wire "
+                         "compression stack (int8 activations + top-k "
+                         "EF gradients + delta Updates) — proves the "
+                         "codec state deterministic under faults")
     ap.add_argument("--artifacts-dir", default=None,
                     help="with --full: run cells under this directory "
                          "so spans-*.jsonl / metrics.jsonl / "
@@ -246,7 +266,8 @@ def main(argv=None):
             seed = args.seed_base + i
             t0 = time.monotonic()
             if args.full:
-                ok, note = full_round_cell(fault, prob, seed, tmp)
+                ok, note = full_round_cell(fault, prob, seed, tmp,
+                                           codec=args.codec)
             else:
                 ok, note = transport_cell(fault, prob, seed,
                                           args.messages)
