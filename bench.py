@@ -1068,11 +1068,15 @@ def _sec_protocol_mode(ctx: dict) -> dict:
     rounds = []
     wire_by_client: dict = {}
     latency_by_part: dict = {}
+    fleet_rec = None
     for line in (pathlib.Path(logdir) / "metrics.jsonl"
                  ).read_text().splitlines():
         rec = json.loads(line)
-        if "wall_s" in rec and "num_samples" in rec:
+        if rec.get("kind") == "round" or (
+                "wall_s" in rec and "num_samples" in rec):
             rounds.append(rec)
+        elif rec.get("kind") == "fleet":
+            fleet_rec = rec   # cumulative; the LAST one is round-end
         elif rec.get("kind") == "wire_client":
             wire_by_client.setdefault(rec["client"], []).append(rec)
         elif rec.get("kind") == "latency":
@@ -1164,6 +1168,21 @@ def _sec_protocol_mode(ctx: dict) -> dict:
         out["tracing"] = ("spans-*.jsonl per participant; merge with "
                           "tools/sl_trace.py for Perfetto trace + "
                           "critical path")
+    # live telemetry plane (runtime/telemetry.py): the round-end fleet
+    # record pins every client's health state + EWMA rate — on this
+    # clean cell anything but all-healthy is a regression worth seeing
+    # in the trajectory
+    if fleet_rec is not None:
+        fl = fleet_rec.get("fleet", {})
+        out["fleet_states"] = " ".join(
+            f"{s}={n}" for s, n in fl.get("counts", {}).items() if n)
+        # None = no fresh beat folded (not a stalled client) — skip,
+        # don't coerce to a false 0.0 minimum
+        rates = [c["samples_per_s"]
+                 for c in fl.get("clients", {}).values()
+                 if c.get("samples_per_s") is not None]
+        if rates:
+            out["fleet_min_samples_per_sec"] = round(min(rates), 2)
     return out
 
 

@@ -1,18 +1,22 @@
-"""Counter/histogram name-registry conformance (CT001/CT002).
+"""Counter/histogram/gauge name-registry conformance (CT001-CT003).
 
-``FaultCounters.inc`` and ``HistogramSet.observe`` are string-keyed: a
-typo'd name does not fail — it silently mints a fresh key that no
-dashboard, test or metrics consumer ever reads, while the intended
-counter stays flat.  The runtime therefore declares its full name
-vocabulary in ``runtime/trace.py`` (:data:`FAULT_COUNTER_NAMES`,
-:data:`HISTOGRAM_NAMES`) and this analyzer enforces, statically, that
-every ``.inc("name", ...)`` / ``.observe("name", ...)`` call with a
-string-literal first argument anywhere in the package or ``tools/``
-uses a declared name.
+``FaultCounters.inc``, ``HistogramSet.observe`` and ``GaugeSet.set``
+are string-keyed: a typo'd name does not fail — it silently mints a
+fresh key that no dashboard, test or metrics consumer ever reads,
+while the intended counter stays flat.  The runtime therefore declares
+its full name vocabulary in ``runtime/trace.py``
+(:data:`FAULT_COUNTER_NAMES`, :data:`HISTOGRAM_NAMES`,
+:data:`GAUGE_NAMES`) and this analyzer enforces, statically, that
+every ``.inc("name", ...)`` / ``.observe("name", ...)`` /
+``.set("name", ...)`` call with a string-literal first argument
+anywhere in the package or ``tools/`` uses a declared name.
 
 Non-literal names are deliberately ignored (they are always derived
 from an iteration over declared names today); test files are excluded
-(tests may fabricate names to prove the analyzer works).
+(tests may fabricate names to prove the analyzer works).  The ``.set``
+rule only fires on string-literal first arguments, so
+``Event().set()`` (no args) and jax's ``.at[idx].set(v)`` (non-string)
+never match.
 """
 
 from __future__ import annotations
@@ -26,6 +30,7 @@ from split_learning_tpu.analysis.findings import Finding
 _RULES = {
     "inc": ("CT001", "FAULT_COUNTER_NAMES", "FaultCounters counter"),
     "observe": ("CT002", "HISTOGRAM_NAMES", "latency histogram"),
+    "set": ("CT003", "GAUGE_NAMES", "GaugeSet gauge"),
 }
 
 

@@ -47,7 +47,7 @@ from split_learning_tpu.analysis.findings import Finding
 # -- wire vocabulary --------------------------------------------------------
 
 CONTROL_KINDS = ("Register", "Ready", "Notify", "Update",
-                 "Start", "Syn", "Pause", "Stop")
+                 "Start", "Syn", "Pause", "Stop", "Heartbeat")
 DATA_KINDS = ("Activation", "Gradient", "EpochEnd")
 ALL_KINDS = CONTROL_KINDS + DATA_KINDS
 
@@ -65,6 +65,7 @@ QUEUE_FAMILIES = {
 SEND_RULES = frozenset({
     ("client", "rpc", "Register"), ("client", "rpc", "Ready"),
     ("client", "rpc", "Notify"), ("client", "rpc", "Update"),
+    ("client", "rpc", "Heartbeat"),
     ("server", "reply", "Start"), ("server", "reply", "Syn"),
     ("server", "reply", "Pause"), ("server", "reply", "Stop"),
     ("client", "reply", "Start"), ("client", "reply", "Stop"),
@@ -173,6 +174,16 @@ CLIENT_FSM: dict[str, dict[tuple[str, str], str]] = {
         ("recv", "Stop"): "stopped",
     },
 }
+
+# Heartbeats are lifecycle-orthogonal by design: a background thread
+# publishes them at a fixed interval whatever state the lifecycle loop
+# is in, and the server's pump consumes them in every state — so every
+# state carries a Heartbeat self-loop rather than the message gating
+# any transition (runtime/telemetry.py).
+for _state, _transitions in SERVER_FSM.items():
+    _transitions[("recv", "Heartbeat")] = _state
+for _state, _transitions in CLIENT_FSM.items():
+    _transitions[("send", "Heartbeat")] = _state
 
 FSM_BY_ROLE = {"server": SERVER_FSM, "client": CLIENT_FSM}
 INITIAL_STATE = "idle"

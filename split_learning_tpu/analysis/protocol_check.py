@@ -287,6 +287,10 @@ def _sample_messages():
         "Syn": P.Syn(),
         "Pause": P.Pause(),
         "Stop": P.Stop(),
+        "Heartbeat": P.Heartbeat(
+            client_id="c", round_idx=1,
+            telemetry={"part": "c", "t": 1.0, "seq": 1,
+                       "counters": {"drops": 2}}),
         "Activation": P.Activation(
             data_id="d0", data=np.ones((2, 3), np.float32),
             labels=np.zeros((2,), np.int64), trace=["c"], cluster=0),
@@ -441,7 +445,8 @@ def _check_handlers(root: pathlib.Path) -> list[Finding]:
         for role in ("client", "server")
     }
     must_handle = {"client": {"Start", "Syn", "Pause", "Stop"},
-                   "server": {"Register", "Ready", "Notify", "Update"}}
+                   "server": {"Register", "Ready", "Notify", "Update",
+                              "Heartbeat"}}
     for role in ("client", "server"):
         rel = f"split_learning_tpu/runtime/{role}.py"
         tree = ast.parse((root / rel).read_text())

@@ -395,11 +395,32 @@ class ObservabilityConfig:
     ``trace.json`` and prints the per-round critical-path report.
     ``sample-rate`` thins the per-frame/per-batch spans (structural
     round/phase spans always record); latency histograms and counters
-    are unaffected by sampling."""
+    are unaffected by sampling.
+
+    Live telemetry plane (``runtime/telemetry.py``):
+    ``heartbeat-interval`` is the period (seconds) of each client's
+    background HEARTBEAT publish on the rpc queue (0 disables the
+    plane entirely — no emitter threads, no FleetMonitor);
+    ``liveness-timeout`` is how long the server's FleetMonitor lets a
+    client stay silent before marking it ``lost`` — the state the
+    round barriers drop instead of stalling until the 600 s RPC
+    deadline; ``http-port`` (when set) serves ``/metrics`` (Prometheus
+    text) and ``/fleet`` (JSON) from the server process (0 = an
+    OS-assigned ephemeral port, logged at startup).
+
+    ``run-scoped`` routes every output file (``app.log``,
+    ``metrics.jsonl``, ``spans-*.jsonl``) under
+    ``{journal-dir or log_path}/artifacts/runs/{run_id}/`` with compat
+    symlinks at the old paths, so successive runs stop appending into
+    one shared metrics.jsonl."""
     enabled: bool = True
     sample_rate: float = 1.0
     journal_dir: str | None = None      # None -> the run's log_path
     flush_every: int = 128              # span-journal buffer size
+    heartbeat_interval: float = 2.0     # seconds; 0 = heartbeats off
+    liveness_timeout: float = 45.0      # silent seconds -> lost
+    http_port: int | None = None        # /metrics + /fleet; 0 = ephemeral
+    run_scoped: bool = True             # artifacts/runs/<run_id>/ layout
 
     def validate(self):
         _check(0.0 <= self.sample_rate <= 1.0,
@@ -407,6 +428,15 @@ class ObservabilityConfig:
                f"got {self.sample_rate!r}")
         _check(self.flush_every >= 1,
                "observability.flush-every must be >= 1")
+        _check(self.heartbeat_interval >= 0,
+               "observability.heartbeat-interval must be >= 0")
+        _check(self.liveness_timeout > self.heartbeat_interval,
+               "observability.liveness-timeout must exceed the "
+               "heartbeat interval")
+        _check(self.http_port is None
+               or 0 <= int(self.http_port) <= 65535,
+               f"observability.http-port must be in [0, 65535], "
+               f"got {self.http_port!r}")
 
 
 @dataclasses.dataclass(frozen=True)

@@ -48,7 +48,7 @@ def run_local(cfg: Config, devices=None,
         import jax
         print(f"multi-host: process {jax.process_index()}"
               f"/{jax.process_count()}")
-    logger = logger or Logger(cfg.log_path, debug=cfg.debug)
+    logger = logger or Logger.for_run(cfg, "server", console=True)
     regs = synthesize_registrations(cfg, profiles)
     plans = plan_clusters(cfg, regs)
     ctx = MeshContext(cfg, devices=devices)

@@ -26,6 +26,17 @@ Stack order: ``ReliableTransport(ChaosTransport(bus))`` — chaos sits
 *below* reliability, exactly where the physical network does, so
 redelivered frames roll fresh faults too.
 
+Determinism caveat: the telemetry heartbeat emitter
+(``runtime/telemetry.py``, on by default) publishes on ``rpc_queue``
+from a timer thread, so that queue's per-message fault draws — and any
+crash script counting ``rpc_queue`` publishes — interleave with
+wall-clock timing rather than protocol position.  Fault *masking* is
+timing-independent (that is what the reliable layer proves), but a
+cell that needs the exact ``rpc_queue`` fault pattern to replay
+frame-for-frame should set ``observability.heartbeat-interval: 0``;
+the data-plane queues' streams are unaffected (the emitter never
+touches them).
+
 Scripted crash points model "client c2 dies right after sending its 2nd
 stage-1 activation": when the owning participant's Nth publish to a
 matching queue completes, :class:`ChaosCrash` is raised out of
@@ -85,7 +96,20 @@ class ChaosTransport(Transport):
         # publish counter lives in the spec under "_n")
         self._crash = [dict(s) for s in cfg.crash
                        if s.get("client") in ("*", name)]
+        # sticky death: once a crash point fires, the participant IS
+        # dead — every later publish/get on this wrapper re-raises.
+        # Matters because the first ChaosCrash can surface on a
+        # background thread (the telemetry heartbeat emitter) whose
+        # error handling must not resurrect the "process"; the
+        # training thread then dies at its next transport op, exactly
+        # like AsyncTransport's deferred-error re-raise.
+        self._crashed = False
         self._timers: list[threading.Timer] = []
+
+    def _check_crashed(self) -> None:
+        if self._crashed:
+            raise ChaosCrash(
+                f"scripted crash: {self.name or '?'} is dead")
 
     def _rng(self, queue: str) -> random.Random:
         r = self._rngs.get(queue)
@@ -117,6 +141,7 @@ class ChaosTransport(Transport):
             self.faults.inc("late_drops")
 
     def publish(self, queue: str, payload: bytes) -> None:
+        self._check_crashed()
         with self._lock:
             # crash scripts fire on ANY queue (a process dies wherever
             # the script says); probabilistic faults only on cfg.queues
@@ -125,6 +150,7 @@ class ChaosTransport(Transport):
             self.inner.publish(queue, payload)
             if crash:
                 self.faults.inc("crashes")
+                self._crashed = True
                 raise ChaosCrash(
                     f"scripted crash: {self.name or '?'} dies at "
                     f"publish to {queue}")
@@ -181,11 +207,13 @@ class ChaosTransport(Transport):
             self.inner.publish(queue, s)
         if crash:
             self.faults.inc("crashes")
+            self._crashed = True
             raise ChaosCrash(
                 f"scripted crash: {self.name or '?'} dies at publish "
                 f"to {queue}")
 
     def get(self, queue: str, timeout: float | None = None):
+        self._check_crashed()
         return self.inner.get(queue, timeout)
 
     def purge(self, queues: Iterable[str] | None = None) -> None:

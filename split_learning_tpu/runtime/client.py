@@ -53,10 +53,10 @@ from split_learning_tpu.runtime.log import Logger
 from split_learning_tpu.runtime.memo import bounded_setdefault
 from split_learning_tpu.runtime.codec import make_codecs, wire_raw_nbytes
 from split_learning_tpu.runtime.protocol import (
-    Activation, EpochEnd, FrameAssembler, Gradient, Notify, Pause, Ready,
-    Register, SparseLeaf, Start, Stop, Syn, QuantLeaf, Update, encode,
-    encode_parts, gradient_queue, intermediate_queue, reply_queue,
-    RPC_QUEUE,
+    Activation, EpochEnd, FrameAssembler, Gradient, Heartbeat, Notify,
+    Pause, Ready, Register, SparseLeaf, Start, Stop, Syn, QuantLeaf,
+    Update, encode, encode_parts, gradient_queue, intermediate_queue,
+    reply_queue, RPC_QUEUE,
 )
 from split_learning_tpu.runtime.spans import make_tracer, unpack_ctx
 from split_learning_tpu.runtime.validation import dataset_for_model
@@ -420,8 +420,25 @@ class ProtocolClient:
         # single-threaded over its queues
         self._assembler = FrameAssembler()
         self._chunk_bytes = cfg.transport.chunk_mb << 20
-        self.log = logger or Logger(cfg.log_path, debug=cfg.debug,
-                                    console=False, name=client_id)
+        self.log = logger or Logger.for_run(cfg, client_id,
+                                            console=False)
+        # live telemetry plane (runtime/telemetry.py): gauges +
+        # background heartbeat emitter publishing a TelemetrySnapshot
+        # (counters, gauges, histogram digests, EWMA samples/s) on the
+        # rpc queue every observability.heartbeat-interval seconds —
+        # started at the first START, so the server's FleetMonitor
+        # hears this client even through a long first-round compile
+        from split_learning_tpu.runtime.telemetry import (
+            GaugeSet, TelemetryEmitter,
+        )
+        self.gauges = GaugeSet()
+        obs = getattr(cfg, "observability", None)
+        self.telemetry = TelemetryEmitter(
+            client_id, self._send_heartbeat,
+            interval=(obs.heartbeat_interval if obs is not None else 0),
+            faults=self.faults, wire=self.wire, hists=self.hists,
+            gauges=self.gauges,
+            samples_fn=lambda: self.num_samples)
         self.runner: ShardRunner | None = None
         self.frozen: dict = {}
         self.trainable: dict = {}
@@ -601,7 +618,28 @@ class ProtocolClient:
             cluster=self.cluster, profile=self.profile)))
         self.log.info(f"[>>>] REGISTER stage={self.stage}")
 
+    def _send_heartbeat(self, snapshot: dict) -> None:
+        """Publish one HEARTBEAT (called by the emitter's background
+        thread): liveness + the full telemetry snapshot, on the rpc
+        queue like every client->server frame.  Not logged — at one
+        frame per interval per client the [>>>] markers would drown
+        the protocol trace."""
+        self.bus.publish(RPC_QUEUE, encode(Heartbeat(
+            client_id=self.client_id,
+            round_idx=getattr(self, "round_idx", 0),
+            telemetry=snapshot)))
+
     def run(self):
+        """Lifecycle loop + telemetry guard: however the loop exits —
+        STOP, closed transport, or a fault unwinding a hot loop (e.g.
+        a scripted ChaosCrash) — the heartbeat thread must die with
+        it, or a 'crashed' client would keep reporting healthy."""
+        try:
+            return self._run()
+        finally:
+            self.telemetry.stop()
+
+    def _run(self):
         """Blocking lifecycle loop; returns on STOP.
 
         Until the first START arrives, REGISTER is re-sent every few
@@ -640,6 +678,10 @@ class ProtocolClient:
                 continue
             if isinstance(msg, Start):
                 started = True
+                # heartbeats begin at the first START (idempotent):
+                # the FleetMonitor must hear this client through the
+                # shard build + first-round compile that follow
+                self.telemetry.start()
                 self._on_start(msg)
                 self.bus.publish(RPC_QUEUE, encode(Ready(
                     client_id=self.client_id, round_idx=self.fence)))
@@ -793,6 +835,7 @@ class ProtocolClient:
         self._ok_dev = jnp.asarray(True)
         self.round_idx = msg.round_idx
         self.num_samples = 0
+        self.gauges.set("round", msg.round_idx)
         # responsive-set overrides (server recomputes after the READY
         # barrier): a dropped previous-stage client must not leave this
         # client waiting on fence copies that will never arrive
@@ -845,17 +888,22 @@ class ProtocolClient:
             # rpc codec: ship ``trained - base`` against the START's
             # version tag when the chain is intact, full fp32 otherwise
             params_h, delta_base = self._encode_update_wire(params_h)
+        # telemetry piggyback: every sync round's UPDATE delivers one
+        # fleet sample (counters/gauges/rate) for free, so the server
+        # gets end-of-round telemetry even with heartbeats disabled
+        tel = self.telemetry.snapshot().as_dict()
         # TENSOR-framed and chunked: a shard UPDATE is the biggest frame
         # a client ever publishes
         self._publish_parts(RPC_QUEUE, lambda ctx, p=params_h, s=stats_h,
                             n=self.num_samples, ok=self.round_ok,
                             fence=self.fence, cl=self.cluster,
-                            db=delta_base:
+                            db=delta_base, tel=tel:
                             encode_parts(Update(
                                 client_id=self.client_id,
                                 stage=self.stage, cluster=cl, params=p,
                                 batch_stats=s, num_samples=n, ok=ok,
-                                round_idx=fence, delta_base=db),
+                                round_idx=fence, delta_base=db,
+                                telemetry=tel),
                                 self._chunk_bytes,
                                 ctx=ctx), kind="Update")
         # error-feedback residuals are part of the client's durable
@@ -992,6 +1040,7 @@ class ProtocolClient:
                         round_idx=self.fence, epoch=ep)))
 
         for ep in range(self.epochs):
+            self.gauges.set("epoch", ep)
             data_iter = iter(self.loader)
             # prefetch one batch: exhaustion must be known at the LAST
             # dispatch, not when the in-flight cap next frees — with a
@@ -1026,6 +1075,7 @@ class ProtocolClient:
                     # abandons in-flight forwards, and the FedAvg weight
                     # must only cover samples whose update was applied
                     self.num_samples += ent.n
+                    self.gauges.set("inflight", len(inflight))
                     continue
                 if exhausted or len(inflight) >= cap:
                     # truly idle (no gradient, nothing to dispatch): check
@@ -1055,6 +1105,7 @@ class ProtocolClient:
                 inflight[data_id] = _Inflight(x=x, rng=rng,
                                               trace=[self.client_id],
                                               n=len(labels))
+                self.gauges.set("inflight", len(inflight))
                 # double buffer: start the non-blocking device→host
                 # copy now and hand the encode+send to the async
                 # sender; this thread moves straight on to batch k+1's

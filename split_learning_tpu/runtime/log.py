@@ -14,11 +14,22 @@ file record's timestamp (so console output lines up with app.log and
 the span journals), and the ``console=False`` gate is structural — no
 code path can print around it.
 
-``metrics.jsonl`` is append-only across runs; every record is stamped
-with a run-scoped :data:`RUN_ID`, the writing ``participant`` and an
-explicit ``kind`` (default ``round``) so interleaved runs separate
-cleanly, and each line is flushed as written so a crashed run keeps its
-tail.
+Every metrics record is stamped with a run-scoped :data:`RUN_ID`, the
+writing ``participant`` and an explicit ``kind`` (default ``round``)
+so interleaved runs separate cleanly, and each line is flushed as
+written so a crashed run keeps its tail.
+
+Run-scoped layout (``observability.run-scoped``, default on via
+:func:`make_logger`): the output files — ``app.log``,
+``metrics.jsonl``, and the span journals (``runtime/spans.py`` uses
+:func:`run_output_dir` for the same directory) — are written under
+``{log_path}/artifacts/runs/{RUN_ID}/`` with compat symlinks at the
+old top-level paths, so every existing consumer keeps working while
+successive runs stop appending into one shared metrics.jsonl.  A
+pre-existing REGULAR file at a compat path is rotated to ``*.prev``
+once (legacy data preserved) before the symlink is placed; on
+filesystems without symlink support the layout silently degrades to
+the flat one.
 """
 
 from __future__ import annotations
@@ -43,6 +54,116 @@ _COLORS = {
 RUN_ID = uuid.uuid4().hex[:12]
 
 _FMT = "%(asctime)s - %(name)s - %(levelname)s - %(message)s"
+
+
+def run_output_dir(base: str | pathlib.Path,
+                   run_id: str | None = None) -> pathlib.Path:
+    """The run-scoped output directory under ``base``."""
+    return pathlib.Path(base) / "artifacts" / "runs" / (run_id or RUN_ID)
+
+
+def _proc_start(pid: int) -> str | None:
+    """The pid's kernel start tick (/proc, Linux) — the identity that
+    survives pid reuse; None where /proc is unavailable."""
+    try:
+        stat = pathlib.Path(f"/proc/{pid}/stat").read_text()
+        # field 22 (starttime); comm (field 2) may contain spaces, so
+        # split after the closing paren
+        return stat.rsplit(")", 1)[1].split()[19]
+    except (OSError, IndexError):
+        return None
+
+
+def write_run_owner(run_dir: pathlib.Path,
+                    run_id: str | None = None) -> None:
+    """Stamp ``run_dir/.owner`` with this process's pid + start tick:
+    how :func:`compat_link` tells a LIVE concurrent process's link
+    (follow it — multi-process deployments keep one merged metrics
+    stream) from a DEAD previous run's (re-point it — a new run must
+    not append into last week's directory)."""
+    import os
+    try:
+        (run_dir / ".owner").write_text(
+            f"{os.getpid()} {_proc_start(os.getpid()) or '-'} "
+            f"{run_id or RUN_ID}\n")
+    except OSError:
+        pass
+
+
+def _owner_alive(run_dir: pathlib.Path) -> bool:
+    import os
+    try:
+        parts = (run_dir / ".owner").read_text().split()
+        pid = int(parts[0])
+    except (OSError, ValueError, IndexError):
+        return False       # pre-owner-stamp runs are by definition dead
+    try:
+        os.kill(pid, 0)
+    except ProcessLookupError:
+        return False
+    except (PermissionError, OSError):
+        pass               # exists but not ours — keep checking
+    # pid alive — but is it the SAME process?  After a reboot (or pid
+    # wraparound) a recycled pid must not pin a dead run's symlink.
+    stamped = parts[1] if len(parts) >= 3 else None
+    if stamped and stamped != "-":
+        return _proc_start(pid) == stamped
+    return True
+
+
+def compat_link(link: pathlib.Path, target: pathlib.Path) -> bool:
+    """Best-effort compat symlink ``link -> target`` (relative).
+
+    A pre-existing regular file is rotated aside to ``<name>.prev``
+    (legacy cross-run data is preserved, not clobbered).  A symlink
+    pointing at another run dir whose owner process is still ALIVE
+    (``.owner`` pid, :func:`write_run_owner`) is a concurrent process
+    of the same deployment and is left alone — returns False, and the
+    caller falls back to the flat path, whose writes then resolve
+    *through* the winner's link, keeping today's one-merged-file
+    behavior (bench and the trace validator read the union).  A link
+    whose owner is dead is a PREVIOUS run's leftover and is
+    re-pointed, so new runs never append into old directories.  Also
+    False when the filesystem refuses symlinks entirely."""
+    import os
+    try:
+        rel = os.path.relpath(target, link.parent)
+        if link.is_symlink():
+            if os.readlink(link) == rel:
+                return True
+            old_target = (link.parent / os.readlink(link)).parent
+            if _owner_alive(old_target):
+                return False   # live concurrent process: follow it
+            link.unlink()      # dead run's leftover: take over
+        elif link.exists():
+            prev = link.with_name(link.name + ".prev")
+            if prev.exists():
+                return False   # already rotated once; leave it alone
+            link.rename(prev)
+        try:
+            link.symlink_to(rel)
+        except FileExistsError:   # lost a creation race
+            return link.is_symlink() and os.readlink(link) == rel
+        return True
+    except OSError:
+        return False
+
+
+def _scoped_root(root: pathlib.Path, run_id: str,
+                 names: tuple = ("app.log", "metrics.jsonl")
+                 ) -> pathlib.Path:
+    """Resolve the run-scoped output dir + compat symlinks; falls back
+    to ``root`` itself when symlinks are unavailable."""
+    out = run_output_dir(root, run_id)
+    try:
+        out.mkdir(parents=True, exist_ok=True)
+    except OSError:
+        return root
+    write_run_owner(out, run_id)
+    for name in names:
+        if not compat_link(root / name, out / name):
+            return root
+    return out
 
 #: colors applied by level when the call site names none
 _LEVEL_COLORS = {logging.WARNING: "yellow", logging.ERROR: "red",
@@ -76,14 +197,18 @@ class Logger:
     def __init__(self, log_path: str | pathlib.Path = ".",
                  debug: bool = False, console: bool = True,
                  name: str = "split_learning_tpu",
-                 run_id: str | None = None):
+                 run_id: str | None = None, run_scoped: bool = False):
         self.debug_mode = debug
         self.console = console
         self.participant = name
         self.run_id = run_id or RUN_ID
         root = pathlib.Path(log_path)
         root.mkdir(parents=True, exist_ok=True)
-        self._metrics_path = root / "metrics.jsonl"
+        # run-scoped layout: files land under artifacts/runs/<run_id>/
+        # with compat symlinks at the flat paths (see module docstring)
+        out = _scoped_root(root, self.run_id) if run_scoped else root
+        self.output_dir = out
+        self._metrics_path = out / "metrics.jsonl"
         self._metrics_lock = threading.Lock()
         self._metrics_f = None
         self._log = logging.getLogger(f"{name}.{id(self):x}")
@@ -94,7 +219,7 @@ class Logger:
         for h in list(self._log.handlers):
             self._log.removeHandler(h)
             h.close()
-        handler = logging.FileHandler(root / "app.log")
+        handler = logging.FileHandler(out / "app.log")
         # %(name)s carries the participant ("server"/"{client_id}"):
         # an in-process cell interleaves every participant in ONE
         # app.log, and the protocol-model trace validator
@@ -149,6 +274,17 @@ class Logger:
                 self._metrics_f = open(self._metrics_path, "a")
             self._metrics_f.write(line)
             self._metrics_f.flush()
+
+    @classmethod
+    def for_run(cls, cfg, name: str, console: bool = False,
+                run_id: str | None = None) -> "Logger":
+        """Config-driven construction: honors
+        ``observability.run-scoped`` (the entry points' path; direct
+        ``Logger(...)`` keeps the flat layout for tools and tests)."""
+        obs = getattr(cfg, "observability", None)
+        return cls(cfg.log_path, debug=cfg.debug, console=console,
+                   name=name, run_id=run_id,
+                   run_scoped=bool(obs is not None and obs.run_scoped))
 
     def close(self) -> None:
         self._handler.close()

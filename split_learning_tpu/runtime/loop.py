@@ -49,7 +49,7 @@ def run_training(cfg: Config, ctx: TrainContext,
                  logger: Logger | None = None,
                  init_params: Any | None = None,
                  init_stats: Any | None = None) -> TrainResult:
-    logger = logger or Logger(cfg.log_path, debug=cfg.debug, console=False)
+    logger = logger or Logger.for_run(cfg, "server", console=False)
     strategy = make_strategy(cfg)
     # round tracing (runtime/spans.py): the context's tracer when it
     # has one (ProtocolContext), else a loop-owned one (in-process
@@ -119,7 +119,11 @@ def run_training(cfg: Config, ctx: TrainContext,
                     logger.error(f"Round {r}: Training failed! "
                                  f"(NaN detected; aggregation skipped)")
                     history.append(rec)
-                    logger.metric(**dataclasses.asdict(rec),
+                    # explicit kind stamp: these per-round records are
+                    # what kind-keyed consumers (bench.py, sl_top's
+                    # journal mode) select on
+                    logger.metric(kind="round",
+                                  **dataclasses.asdict(rec),
                                   phases=timer.summary())
                     timer.reset()  # don't leak this round's time onward
                     # the failed round is the one an operator debugs:
@@ -160,7 +164,7 @@ def run_training(cfg: Config, ctx: TrainContext,
                             save_checkpoint, cfg.checkpoint.directory,
                             cfg.model_key, params, stats, round_idx=r + 1)
                 history.append(rec)
-                logger.metric(**dataclasses.asdict(rec),
+                logger.metric(kind="round", **dataclasses.asdict(rec),
                               phases=timer.summary(),
                               **({"train_detail": outcome.metrics}
                                  if outcome.metrics else {}))

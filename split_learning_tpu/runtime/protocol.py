@@ -7,7 +7,10 @@ server→client START ``src/Server.py:262-272``, SYN ``:293-296``, PAUSE
 ``:140-153``, STOP ``:276-287``).  Here every message is a dataclass; a
 READY ack is added so the server's 25-second settle sleep
 (``src/Server.py:289`` — a time-based barrier papering over a race,
-SURVEY.md §5.2) becomes an explicit barrier.
+SURVEY.md §5.2) becomes an explicit barrier, and a HEARTBEAT frame
+(no reference equivalent — its failure model is "hang forever",
+SURVEY.md §5.3) carries each client's live telemetry snapshot to the
+server's fleet monitor (``runtime/telemetry.py``).
 
 Queue naming keeps the reference topology so the protocol surface maps
 1:1 (SURVEY.md §1 L0 table):
@@ -116,6 +119,11 @@ class Update:
     # what it sent in START.  None = full frame (the resync fallback
     # whenever the version chain broke: client restart, shadow loss).
     delta_base: int | None = None
+    # piggybacked TelemetrySnapshot dict (runtime/telemetry.py): every
+    # sync round delivers one fleet sample for free, heartbeat thread
+    # or not.  A plain dict, NOT the dataclass — the restricted
+    # unpickler's vocabulary stays closed.
+    telemetry: dict | None = None
 
 
 @dataclasses.dataclass
@@ -160,6 +168,22 @@ class Pause:
 class Stop:
     """server → client: terminate."""
     reason: str = ""
+
+
+@dataclasses.dataclass
+class Heartbeat:
+    """client → server, on the rpc queue, from a background thread at
+    ``observability.heartbeat-interval``: liveness + a full
+    :class:`~split_learning_tpu.runtime.telemetry.TelemetrySnapshot`
+    as a plain dict (counters, gauges, histogram digests, current
+    round, EWMA samples/s).  The snapshot's monotonic ``seq`` and
+    sender clock ``t`` are the server's staleness guard: a duplicated
+    or reordered heartbeat must never flap a ``lost`` client back to
+    life.  Deliberately small and pickled (SLT1) — it shares the rpc
+    queue with UPDATE uploads and must cost ~nothing."""
+    client_id: str
+    round_idx: int = 0
+    telemetry: dict | None = None
 
 
 # --------------------------------------------------------------------------
@@ -263,7 +287,8 @@ class _TensorRef:
     idx: int
 
 
-CONTROL_TYPES = (Register, Ready, Notify, Update, Start, Syn, Pause, Stop)
+CONTROL_TYPES = (Register, Ready, Notify, Update, Start, Syn, Pause,
+                 Stop, Heartbeat)
 DATA_TYPES = (Activation, Gradient, EpochEnd)
 #: messages whose ndarray payloads ride the zero-copy TENSOR framing
 #: (the high-volume data plane + the round's weight upload); control

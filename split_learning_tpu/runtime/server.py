@@ -44,10 +44,11 @@ from split_learning_tpu.runtime.plan import (
     ClusterPlan, Registration, plan_clusters,
 )
 from split_learning_tpu.runtime.protocol import (
-    FrameAssembler, Notify, Pause, Ready, Register, Start, Stop, Syn,
-    Update, encode, reply_queue, RPC_QUEUE,
+    FrameAssembler, Heartbeat, Notify, Pause, Ready, Register, Start,
+    Stop, Syn, Update, encode, reply_queue, RPC_QUEUE,
 )
 from split_learning_tpu.runtime.spans import unpack_ctx
+from split_learning_tpu.runtime.telemetry import FleetMonitor, GaugeSet
 
 
 class RoundTimeout(RuntimeError):
@@ -97,8 +98,22 @@ class ProtocolContext(MeshContext):
         self.hists = getattr(transport, "hists", None) or HistogramSet()
         self._fault_base: dict = {}   # snapshot at the last round log
         self._assembler = FrameAssembler()   # chunked UPDATE reassembly
-        self.log = logger or Logger(cfg.log_path, debug=cfg.debug,
-                                    console=False, name="server")
+        self.log = logger or Logger.for_run(cfg, "server",
+                                            console=False)
+        # live fleet telemetry (runtime/telemetry.py): per-client
+        # health state machine + time series fed by HEARTBEAT frames
+        # (and the snapshot piggybacked on every Update).  The round
+        # barriers consult it so a `lost` client is dropped after
+        # observability.liveness-timeout instead of stalling to the
+        # full client_timeout.  None when heartbeats are disabled.
+        self.gauges = GaugeSet()
+        obs = getattr(cfg, "observability", None)
+        self.fleet = None
+        if obs is not None and obs.heartbeat_interval > 0:
+            self.fleet = FleetMonitor(
+                interval=obs.heartbeat_interval,
+                liveness_timeout=obs.liveness_timeout,
+                log=self.log, gauges=self.gauges, faults=self.faults)
         self.client_timeout = client_timeout
         # registration/READY happen before any jit work on the client, so
         # they can run on a much shorter deadline than the training
@@ -144,6 +159,14 @@ class ProtocolContext(MeshContext):
     def _pump_one(self, timeout: float) -> bool:
         raw = self.bus.get(RPC_QUEUE, timeout=timeout)
         if raw is None:
+            if self.fleet is not None:
+                # liveness ages are only trustworthy at a DRAINED
+                # queue: after an unpumped phase (validation) the
+                # backlog still holds everyone's beats, and opening
+                # the gate on the first frame would flash spurious
+                # `lost` states before the drain finishes — see
+                # FleetMonitor.note_pump
+                self.fleet.note_pump()
             return False
         t_wall = time.time()
         t0 = time.perf_counter()
@@ -172,6 +195,27 @@ class ProtocolContext(MeshContext):
                 queue=RPC_QUEUE, kind=type(msg).__name__,
                 nbytes=len(raw), rtt_ms=round(rtt * 1e3, 3),
                 round=getattr(msg, "round_idx", None))
+        if isinstance(msg, Heartbeat):
+            # liveness + telemetry only — never logged (one frame per
+            # interval per client would drown the protocol trace).
+            # note_heartbeat applies the seq/send-time staleness guard,
+            # so a duplicated/reordered beat can't resurrect a lost
+            # client or extend its liveness.
+            if self.fleet is not None:
+                self.fleet.note_heartbeat(msg.client_id, msg.telemetry)
+            return True
+        if self.fleet is not None:
+            cid = getattr(msg, "client_id", None)
+            if cid is not None:
+                # any rpc frame proves a live process (clients with
+                # heartbeats disabled still register liveness); the
+                # piggybacked Update snapshot counts as a full beat —
+                # consumed even when the Update itself is stale-gen,
+                # liveness is not round-fenced
+                if isinstance(msg, Update) and msg.telemetry:
+                    self.fleet.note_heartbeat(cid, msg.telemetry)
+                else:
+                    self.fleet.note_frame(cid)
         if isinstance(msg, Register):
             if (self.cfg.topology.elastic_join
                     and not 1 <= msg.stage <= self.cfg.num_stages):
@@ -256,12 +300,22 @@ class ProtocolContext(MeshContext):
 
     def _pump_until(self, pred: Callable[[], bool],
                     what: str | Callable[[], str],
-                    deadline: float | None = None) -> bool:
+                    deadline: float | None = None,
+                    waiting: Callable[[], set] | None = None) -> bool:
         """Drain rpc_queue until ``pred()``; False if the deadline passes.
 
         ``what`` may be a callable so the timeout warning names who is
         missing AT the deadline (an eager f-string would snapshot the
-        missing set before any response arrived)."""
+        missing set before any response arrived).
+
+        ``waiting`` (when given) names the clients the barrier still
+        needs: once EVERY one of them is FleetMonitor-``lost`` (no
+        heartbeat for ``observability.liveness-timeout``), the wait
+        gives up early — a dead client costs the round the liveness
+        timeout, not the full barrier deadline.  A slow-but-alive
+        straggler is never dropped here; it keeps heartbeating and the
+        barrier keeps waiting (eviction policy belongs to the
+        scheduler, not the monitor)."""
         deadline = (time.monotonic() + self.client_timeout
                     if deadline is None else deadline)
         while not pred():
@@ -271,6 +325,17 @@ class ProtocolContext(MeshContext):
                 self.faults.inc("timeouts")
                 self.log.warning(f"timeout waiting for {w}")
                 return False
+            if waiting is not None and self.fleet is not None:
+                lost = self.fleet.advance()
+                missing = set(waiting())
+                if missing and missing <= lost:
+                    self.faults.inc("fleet_lost_drops", len(missing))
+                    self.log.warning(
+                        f"dropping lost client(s) {sorted(missing)}: "
+                        f"no heartbeat within "
+                        f"{self.fleet.liveness_timeout:g}s — barrier "
+                        "released early")
+                    return False
             self._pump_one(timeout=min(remain, 0.25))
         return True
 
@@ -391,6 +456,10 @@ class ProtocolContext(MeshContext):
                 # in server memory; under membership churn that leaks
                 # without bound (a rejoiner full-frames anyway)
                 self._delta_shadow.clear(cid)
+            if self.fleet is not None:
+                # stop scoring the pruned client (its zero rate would
+                # drag the fleet median down for the survivors)
+                self.fleet.forget(cid)
         self.log.info(f"elastic re-plan: joined={joined} "
                       f"pruned={pruned}", "cyan")
         self._planned_ids = live
@@ -619,7 +688,8 @@ class ProtocolContext(MeshContext):
             ready_ok = self._pump_until(
                 lambda: ids <= self._ready,
                 lambda: f"READY from {ids - self._ready}",
-                deadline=time.monotonic() + self.ready_timeout)
+                deadline=time.monotonic() + self.ready_timeout,
+                waiting=lambda: ids - self._ready)
         if not ready_ok:
             ids &= self._ready  # drop unresponsive clients mid-round
         stage_of = dict(active)
@@ -649,7 +719,8 @@ class ProtocolContext(MeshContext):
         with self.tracer.span("notify_wait", round=round_idx):
             self._pump_until(lambda: s1_ids <= self._notified,
                              "NOTIFY from stage-1 clients",
-                             deadline=deadline)
+                             deadline=deadline,
+                             waiting=lambda: s1_ids - self._notified)
         pause_span = self.tracer.start("pause_fanout", round=round_idx)
         for cid in ids:
             if isinstance(send_weights, dict):
@@ -667,7 +738,9 @@ class ProtocolContext(MeshContext):
                 got,
                 lambda: (f"UPDATE from "
                          f"{ids - {u.client_id for u in self._updates}}"),
-                deadline=time.monotonic() + self.client_timeout)
+                deadline=time.monotonic() + self.client_timeout,
+                waiting=lambda: (
+                    ids - {u.client_id for u in self._updates}))
         updates = list(self._updates)
         self._updates = []
         # elastic liveness bookkeeping, folded per ROUND at the next
@@ -738,6 +811,33 @@ class ProtocolContext(MeshContext):
             self.log.metric(kind="latency", gen=self._cur_gen,
                             round_idx=round_idx,
                             cluster=plan.cluster_id, **hsnap)
+        # fleet health at round end: one kind=fleet metrics record (the
+        # per-client states, rates, straggler scores AND the latest
+        # counter snapshots each heartbeat flushed — so a client that
+        # crashed mid-round still has its counters on disk) plus a
+        # one-line summary.  Same per-invocation cadence as the wire/
+        # fault records above.
+        if self.fleet is not None:
+            # drain queued-but-unpumped heartbeats first so the record
+            # reflects what clients SENT, not when we last listened
+            while self._pump_one(timeout=0.0):
+                pass
+            self.fleet.advance()
+            fsnap = self.fleet.snapshot()
+            self.log.metric(kind="fleet", gen=self._cur_gen,
+                            round_idx=round_idx,
+                            cluster=plan.cluster_id, fleet=fsnap)
+            counts = fsnap["counts"]
+            unhealthy = {c: v["state"]
+                         for c, v in fsnap["clients"].items()
+                         if v["state"] != "healthy"}
+            line = ("fleet: " + " ".join(
+                f"{s}={n}" for s, n in counts.items() if n))
+            if unhealthy:
+                line += " (" + " ".join(
+                    f"{c}:{s}" for c, s in sorted(unhealthy.items())) \
+                    + ")"
+            self.log.info(line, "yellow" if unhealthy else "cyan")
         # a finished invocation's spans must be durable before the next
         # one (or a crash) — the journal buffers between flushes
         self.tracer.flush()
@@ -769,8 +869,8 @@ class ProtocolServer:
                  client_timeout: float = 600.0,
                  ready_timeout: float | None = None):
         self.cfg = cfg
-        self.log = logger or Logger(cfg.log_path, debug=cfg.debug,
-                                    name="server")
+        self.log = logger or Logger.for_run(cfg, "server",
+                                            console=True)
         if transport is None:
             from split_learning_tpu.runtime.chaos import (
                 make_runtime_transport,
@@ -781,6 +881,37 @@ class ProtocolServer:
         self.ctx = ProtocolContext(cfg, bus, logger=self.log,
                                    client_timeout=client_timeout,
                                    ready_timeout=ready_timeout)
+        # real-time export (observability.http-port): /metrics serves
+        # Prometheus text, /fleet the JSON health snapshot — what
+        # tools/sl_top.py polls for the live terminal view.  Render
+        # callbacks advance the monitor first so a mid-wait scrape
+        # sees current health states, not the last pump's.
+        self.exporter = None
+        obs = getattr(cfg, "observability", None)
+        if obs is not None and obs.http_port is not None:
+            from split_learning_tpu.runtime.telemetry import (
+                TelemetryExporter, render_prometheus,
+            )
+            ctx = self.ctx
+
+            def _metrics() -> str:
+                if ctx.fleet is not None:
+                    ctx.fleet.advance()
+                return render_prometheus(
+                    fleet=ctx.fleet, faults=ctx.faults, wire=ctx.wire,
+                    hists=ctx.hists, gauges=ctx.gauges)
+
+            def _fleet() -> dict:
+                if ctx.fleet is None:
+                    return {"clients": {}, "counts": {},
+                            "transitions": []}
+                ctx.fleet.advance()
+                return ctx.fleet.snapshot()
+
+            self.exporter = TelemetryExporter(
+                _metrics, _fleet, port=int(obs.http_port)).start()
+            self.log.info("telemetry: serving /metrics and /fleet on "
+                          f"{self.exporter.url}", "cyan")
 
     def serve(self) -> TrainResult:
         from split_learning_tpu.parallel.multihost import (
@@ -798,6 +929,8 @@ class ProtocolServer:
             result = run_training(self.cfg, self.ctx, plans, self.log)
         finally:
             self.ctx.stop_all()
+            if self.exporter is not None:
+                self.exporter.close()
         return result
 
 

@@ -82,6 +82,10 @@ class SpanJournal:
     def __init__(self, path: str | pathlib.Path, flush_every: int = 128):
         self.path = pathlib.Path(path)
         self.path.parent.mkdir(parents=True, exist_ok=True)
+        # created eagerly: a run-scoped compat symlink to this journal
+        # must never dangle (tools glob then open the directory's
+        # spans-*.jsonl, symlinks included)
+        self.path.touch(exist_ok=True)
         self._flush_every = max(1, flush_every)
         self._lock = threading.Lock()
         self._buf: list[dict] = []
@@ -297,11 +301,35 @@ class Tracer:
 
 def make_tracer(cfg, participant: str) -> Tracer:
     """Build a participant's tracer from ``cfg.observability`` (falls
-    back to a disabled tracer when the config predates the block)."""
+    back to a disabled tracer when the config predates the block).
+
+    Under ``observability.run-scoped`` the journal lands in the same
+    ``artifacts/runs/<run_id>/`` directory as the logger's outputs
+    (``runtime/log.py``), with a compat symlink at the flat path —
+    one directory per run holds app.log + metrics.jsonl +
+    spans-*.jsonl together."""
     obs = getattr(cfg, "observability", None)
     if obs is None:
         return Tracer(participant, enabled=False)
+    journal_dir = pathlib.Path(obs.journal_dir or cfg.log_path)
+    if obs.enabled and getattr(obs, "run_scoped", False):
+        from split_learning_tpu.runtime.log import (
+            compat_link, run_output_dir, write_run_owner,
+        )
+        out = run_output_dir(journal_dir)
+        name = f"spans-{participant}.jsonl"
+        try:
+            out.mkdir(parents=True, exist_ok=True)
+            ok = True
+        except OSError:
+            ok = False
+        if ok:
+            write_run_owner(out)
+            # eager target so the link below never dangles
+            (out / name).touch(exist_ok=True)
+            if compat_link(journal_dir / name, out / name):
+                journal_dir = out
     return Tracer(participant, enabled=obs.enabled,
                   sample_rate=obs.sample_rate,
-                  journal_dir=obs.journal_dir or cfg.log_path,
+                  journal_dir=journal_dir,
                   flush_every=obs.flush_every)

@@ -1,0 +1,826 @@
+"""Live fleet telemetry plane: heartbeats, health scoring, exporters.
+
+Everything observability built so far is *post hoc*: span journals are
+merged into Perfetto after the run, ``metrics.jsonl`` is appended per
+round, and a dead client is only discovered when a barrier's 600 s
+deadline expires.  The closed-loop scheduler (ROADMAP item 1) and the
+async mode (item 2) both need *live* sensing — per-client liveness,
+rate and lag measured continuously.  This module is that plane:
+
+* :class:`GaugeSet` — last-value-semantics named gauges joining the
+  counter/histogram registries in ``runtime/trace.py``
+  (:data:`~split_learning_tpu.runtime.trace.GAUGE_NAMES`, enforced by
+  the ``counters`` slcheck analyzer on every ``.set`` site);
+* :class:`TelemetrySnapshot` / :class:`TelemetryEmitter` — one
+  participant's full telemetry state (counters, gauges, histogram
+  digests, current round, EWMA samples/s) built on demand and
+  published as a ``Heartbeat`` control frame on the rpc queue family
+  by a background thread every ``observability.heartbeat-interval``
+  seconds (and piggybacked on every Update frame, so sync rounds get
+  telemetry for free).  Counter snapshots ride EVERY heartbeat, so a
+  client that crashes mid-round loses at most one interval of
+  counters, not the whole round;
+* :class:`FleetMonitor` — the server-side consumer: per-client
+  ring-buffer time series and a health state machine
+  (``healthy → degraded → straggler → lost``) driven by missed
+  heartbeats and percentile-relative step-rate scoring.  Duplicate or
+  reordered heartbeats (chaos, redelivery) are rejected by a
+  seq/send-time staleness guard so they can never flap a ``lost``
+  client back to ``healthy``; genuine recovery climbs back through
+  ``degraded`` (hysteresis).  The server's barriers consult
+  :meth:`FleetMonitor.advance` so a ``lost`` client is dropped after
+  ``observability.liveness-timeout`` seconds instead of stalling the
+  round until the 600 s RPC deadline;
+* :func:`render_prometheus` / :func:`lint_prometheus` — Prometheus
+  text-format exposition (and a pure-python format linter for tests);
+* :class:`TelemetryExporter` — a tiny stdlib HTTP thread serving
+  ``/metrics`` (Prometheus text) and ``/fleet`` (JSON snapshot),
+  polled by ``tools/sl_top.py`` for the live terminal view.
+
+No jax, no protocol imports: the emitter publishes through a callback
+the client provides, so this module stays import-light and the wire
+vocabulary stays owned by ``runtime/protocol.py``.
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import json
+import math
+import re
+import statistics
+import threading
+import time
+from typing import Any, Callable
+
+from split_learning_tpu.runtime.trace import GAUGE_NAMES
+
+
+class GaugeSet:
+    """Thread-safe named gauges (last value wins), the third leg of the
+    ``trace.py`` registry family: :class:`~split_learning_tpu.runtime
+    .trace.FaultCounters` count, :class:`~split_learning_tpu.runtime
+    .trace.HistogramSet` distributes, gauges *state*.  Names must come
+    from :data:`~split_learning_tpu.runtime.trace.GAUGE_NAMES`
+    (statically enforced by the ``counters`` analyzer, CT003)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._values: dict[str, float] = {}
+
+    def set(self, name: str, value: float) -> None:
+        with self._lock:
+            self._values[name] = float(value)
+
+    def get(self, name: str, default: float | None = None
+            ) -> float | None:
+        with self._lock:
+            return self._values.get(name, default)
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return dict(self._values)
+
+
+@dataclasses.dataclass
+class TelemetrySnapshot:
+    """One participant's full telemetry state at one instant.
+
+    Travels the wire as a PLAIN DICT (:meth:`as_dict`): the protocol's
+    restricted unpickler admits builtins, not this class — keeping the
+    wire vocabulary closed is worth the round-trip through ``dict``.
+    ``seq`` increases monotonically per emitter; together with ``t``
+    (the sender's clock) it is the receiver's staleness guard against
+    duplicated/reordered heartbeats."""
+
+    part: str                       # participant id
+    t: float                        # sender clock (epoch seconds)
+    seq: int                        # per-emitter monotonic sequence
+    round: int | None = None        # current round index (gauge)
+    samples: int = 0                # cumulative samples this round
+    samples_per_s: float = 0.0      # EWMA training throughput
+    gauges: dict = dataclasses.field(default_factory=dict)
+    counters: dict = dataclasses.field(default_factory=dict)
+    wire: dict = dataclasses.field(default_factory=dict)
+    latency: dict = dataclasses.field(default_factory=dict)
+    v: int = 1                      # schema version
+
+    def as_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "TelemetrySnapshot | None":
+        """Tolerant decode: a foreign/newer snapshot degrades to None,
+        never raises into the server's rpc pump."""
+        if not isinstance(d, dict):
+            return None
+        known = {f.name for f in dataclasses.fields(cls)}
+        try:
+            return cls(**{k: v for k, v in d.items() if k in known})
+        except (TypeError, ValueError):
+            return None
+
+
+class TelemetryEmitter:
+    """Client-side heartbeat publisher + EWMA rate meter.
+
+    ``send`` is a callable taking the snapshot *dict* — the client
+    wraps it in a ``Heartbeat`` frame and publishes on the rpc queue
+    (keeping this module protocol-free).  ``samples_fn`` reads the
+    owner's cumulative sample counter; per-round resets are handled
+    (a negative delta restarts the window).  The background thread is
+    a daemon started on the first START and stopped with the client;
+    publish failures are counted (``heartbeat_errors``) and a run of
+    consecutive failures stops the thread — a dead transport must not
+    spin a hot error loop."""
+
+    #: consecutive publish failures before the beat thread gives up
+    MAX_ERRORS = 3
+    #: EWMA smoothing factor per tick (~3-tick half life)
+    ALPHA = 0.3
+
+    def __init__(self, participant: str, send: Callable[[dict], None],
+                 interval: float, faults=None, wire=None, hists=None,
+                 gauges: GaugeSet | None = None,
+                 samples_fn: Callable[[], int] | None = None):
+        self.participant = participant
+        self.interval = float(interval)
+        self._send = send
+        self._faults = faults
+        self._wire = wire
+        self._hists = hists
+        self.gauges = gauges if gauges is not None else GaugeSet()
+        self._samples_fn = samples_fn
+        self._lock = threading.Lock()
+        self._seq = 0
+        self._samples = 0           # fallback counter (note_samples)
+        self._rate: float | None = None
+        self._last_t: float | None = None
+        self._last_total = 0
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    # -- rate meter ----------------------------------------------------------
+
+    def note_samples(self, n: int) -> None:
+        """Count trained samples (only needed when no ``samples_fn``)."""
+        with self._lock:
+            self._samples += int(n)
+
+    def _total_samples(self) -> int:
+        if self._samples_fn is not None:
+            try:
+                return int(self._samples_fn())
+            except Exception:  # noqa: BLE001 — a racing reset must not
+                return 0       # kill the beat thread
+        with self._lock:
+            return self._samples
+
+    def _tick_rate(self, now: float) -> float:
+        total = self._total_samples()
+        with self._lock:
+            if self._last_t is None:
+                inst = 0.0
+            else:
+                delta = total - self._last_total
+                if delta < 0:           # per-round counter reset
+                    delta = total
+                inst = delta / max(now - self._last_t, 1e-9)
+            self._last_t, self._last_total = now, total
+            self._rate = (inst if self._rate is None
+                          else (1 - self.ALPHA) * self._rate
+                          + self.ALPHA * inst)
+            rate = self._rate
+        self.gauges.set("samples_per_s", round(rate, 3))
+        return rate
+
+    # -- snapshots -----------------------------------------------------------
+
+    def snapshot(self, now: float | None = None) -> TelemetrySnapshot:
+        """Build (and rate-tick) one snapshot; also used to piggyback
+        telemetry on Update frames, so sync rounds report for free."""
+        now = time.time() if now is None else now
+        rate = self._tick_rate(now)
+        with self._lock:
+            self._seq += 1
+            seq = self._seq
+        rnd = self.gauges.get("round")
+        return TelemetrySnapshot(
+            part=self.participant, t=now, seq=seq,
+            round=None if rnd is None else int(rnd),
+            samples=self._total_samples(),
+            samples_per_s=round(rate, 3),
+            gauges=self.gauges.snapshot(),
+            counters=(self._faults.snapshot() if self._faults else {}),
+            wire=({k: v for k, v in self._wire.snapshot().items() if v}
+                  if self._wire else {}),
+            latency=(self._hists.snapshot() if self._hists else {}))
+
+    def beat_once(self) -> None:
+        self._send(self.snapshot().as_dict())
+
+    # -- thread lifecycle ----------------------------------------------------
+
+    def start(self) -> None:
+        """Idempotent; no-op when the interval disables heartbeats."""
+        if self.interval <= 0 or self._thread is not None:
+            return
+        self._thread = threading.Thread(
+            target=self._run, daemon=True,
+            name=f"heartbeat-{self.participant}")
+        self._thread.start()
+
+    def _run(self) -> None:
+        errors = 0
+        while not self._stop.wait(self.interval):
+            try:
+                self.beat_once()
+                errors = 0
+            except Exception as e:  # noqa: BLE001 — transport gone/
+                # teardown.  A scripted ChaosCrash is the simulated
+                # process dying — stop beating IMMEDIATELY (the sticky
+                # crashed transport kills the training thread at its
+                # next op); retrying would mis-model a dead process as
+                # three more liveness signals.  Matched by name so the
+                # telemetry plane keeps zero chaos imports.
+                if type(e).__name__ == "ChaosCrash":
+                    return
+                errors += 1
+                if self._faults is not None:
+                    self._faults.inc("heartbeat_errors")
+                if errors >= self.MAX_ERRORS:
+                    return
+
+    def stop(self) -> None:
+        self._stop.set()
+        t = self._thread
+        if t is not None:
+            t.join(timeout=max(1.0, 2 * self.interval))
+            self._thread = None
+
+
+# --------------------------------------------------------------------------
+# server-side fleet monitor
+# --------------------------------------------------------------------------
+
+#: ring-buffer length of the per-client (t, rate, samples) series
+HISTORY = 256
+
+HEALTH_STATES = ("healthy", "degraded", "straggler", "lost")
+_STATE_CODE = {s: i for i, s in enumerate(HEALTH_STATES)}
+
+
+@dataclasses.dataclass
+class _ClientHealth:
+    state: str = "healthy"
+    first_seen: float = 0.0
+    last_seen: float = 0.0          # receiver clock, any FRESH frame
+    last_t_send: float = 0.0        # sender clock of last fresh beat
+    last_seq: int = -1
+    rate: float | None = None       # EWMA samples/s (sender-reported)
+    score: float | None = None      # rate / fleet median (lower=worse)
+    round: int | None = None
+    samples: int = 0
+    counters: dict = dataclasses.field(default_factory=dict)
+    wire: dict = dataclasses.field(default_factory=dict)
+    latency: dict = dataclasses.field(default_factory=dict)
+    series: collections.deque = dataclasses.field(
+        default_factory=lambda: collections.deque(maxlen=HISTORY))
+
+
+class FleetMonitor:
+    """Per-client health state machine + time series, fed by the
+    server's rpc pump and advanced on a wall clock.
+
+    State machine (``healthy → degraded → straggler → lost``):
+
+    * *missed heartbeats*: silence past ``DEGRADED_MISSES`` intervals
+      degrades, past ``STRAGGLER_MISSES`` intervals marks a straggler,
+      past ``liveness_timeout`` seconds marks **lost** — the state the
+      server's barriers are allowed to drop;
+    * *step-rate scoring*: a reporting client whose EWMA samples/s
+      falls below ``STRAGGLER_SCORE`` × the fleet median is a
+      straggler even while heartbeating on time (the slow-but-alive
+      case eviction must SEE but not kill — that policy belongs to the
+      scheduler, ROADMAP item 1);
+    * *recovery*: fresh contact lifts ``lost`` only to ``degraded``;
+      the next :meth:`advance` with recent contact and a score at or
+      above ``RECOVER_SCORE`` × median completes the climb to
+      ``healthy``.  The two-step path plus the seq/send-time staleness
+      guard (duplicated or reordered heartbeats are dropped and
+      counted ``stale_heartbeats``) is what keeps chaos dup/reorder
+      from flapping ``lost`` → ``healthy``.
+
+    Thread-safe: the rpc pump feeds it, HTTP exporter threads read it.
+    """
+
+    DEGRADED_MISSES = 1.5    # intervals of silence -> degraded
+    STRAGGLER_MISSES = 2.0   # intervals of silence -> straggler
+    STRAGGLER_SCORE = 0.5    # rate below this x median -> straggler
+    RECOVER_SCORE = 0.75     # rate at/above this x median -> healthy
+    MAX_TRANSITIONS = 512    # bounded transition journal
+
+    def __init__(self, interval: float, liveness_timeout: float,
+                 log=None, gauges: GaugeSet | None = None,
+                 faults=None):
+        self.interval = max(float(interval), 1e-3)
+        self.liveness_timeout = float(liveness_timeout)
+        self._log = log
+        self._faults = faults
+        self.gauges = gauges if gauges is not None else GaugeSet()
+        self._lock = threading.RLock()
+        self._clients: dict[str, _ClientHealth] = {}
+        self._last_pump: float | None = None
+        self.transitions: collections.deque = collections.deque(
+            maxlen=self.MAX_TRANSITIONS)
+
+    # -- ingest --------------------------------------------------------------
+
+    def _ensure(self, cid: str, now: float) -> _ClientHealth:
+        h = self._clients.get(cid)
+        if h is None:
+            h = self._clients[cid] = _ClientHealth(
+                first_seen=now, last_seen=now)
+        return h
+
+    def note_pump(self, now: float | None = None) -> None:
+        """Mark the feeding queue as freshly drained.  Age-based
+        transitions are only meaningful while someone is actually
+        pumping the rpc queue: during a long server-side phase
+        (validation, aggregation) heartbeats pile up undelivered and
+        every client would LOOK silent — :meth:`advance` freezes
+        age-driven downgrades whenever the last pump is stale.  Never
+        calling this (standalone/unit use) leaves the gate open."""
+        now = time.time() if now is None else now
+        with self._lock:
+            self._last_pump = now
+
+    def note_frame(self, cid: str, now: float | None = None) -> None:
+        """Any rpc frame from ``cid`` proves a live process — clients
+        whose config disables heartbeats still register liveness."""
+        now = time.time() if now is None else now
+        with self._lock:
+            h = self._ensure(cid, now)
+            h.last_seen = max(h.last_seen, now)
+            if h.state == "lost":
+                self._transition(cid, h, "degraded", "contact resumed",
+                                 now)
+
+    def note_heartbeat(self, cid: str, telemetry: dict | None,
+                       now: float | None = None) -> bool:
+        """Fold one heartbeat/piggybacked snapshot; False when it was
+        stale (duplicate/reordered) and therefore ignored — a stale
+        beat must neither refresh liveness nor flap the state."""
+        now = time.time() if now is None else now
+        snap = TelemetrySnapshot.from_dict(telemetry or {})
+        with self._lock:
+            h = self._ensure(cid, now)
+            if snap is None:
+                h.last_seen = max(h.last_seen, now)
+                return True
+            # freshness is lexicographic on (sender clock, seq): a
+            # duplicate ties, a reordered older beat is behind on both
+            # — and a crashed-and-restarted client (new emitter, seq
+            # back at 1) is STILL fresh because its clock moved on,
+            # while the old emitter's late-draining frames (higher
+            # seq, older clock) stay stale.  Plain seq comparison
+            # would lock a restarted client out until its new seq
+            # caught the old one.
+            if (snap.t, snap.seq) <= (h.last_t_send, h.last_seq):
+                if self._faults is not None:
+                    self._faults.inc("stale_heartbeats")
+                return False
+            h.last_seq = snap.seq
+            h.last_t_send = snap.t
+            h.last_seen = max(h.last_seen, now)
+            h.rate = float(snap.samples_per_s)
+            h.round = snap.round
+            h.samples = int(snap.samples)
+            if snap.counters:
+                h.counters = dict(snap.counters)
+            if snap.wire:
+                h.wire = dict(snap.wire)
+            if snap.latency:
+                h.latency = dict(snap.latency)
+            h.series.append((round(now, 3), h.rate, h.samples))
+            if h.state == "lost":
+                self._transition(cid, h, "degraded", "fresh heartbeat",
+                                 now)
+            return True
+
+    def forget(self, cid: str) -> None:
+        """Elastic prune: a client removed from the plans stops being
+        scored (and stops dragging the fleet median down)."""
+        with self._lock:
+            self._clients.pop(cid, None)
+
+    # -- state machine -------------------------------------------------------
+
+    def _transition(self, cid: str, h: _ClientHealth, to: str,
+                    why: str, now: float) -> None:
+        if h.state == to:
+            return
+        rec = {"t": round(now, 3), "client": cid, "from": h.state,
+               "to": to, "why": why}
+        h.state = to
+        self.transitions.append(rec)
+        if self._log is not None:
+            line = (f"fleet: {cid} {rec['from']} -> {to} ({why})")
+            if to == "healthy":
+                self._log.info(line, "green")
+            else:
+                self._log.warning(line)
+
+    def advance(self, now: float | None = None) -> frozenset:
+        """Re-evaluate every client's time/rate-driven transitions;
+        returns the current ``lost`` set (what barriers may drop)."""
+        now = time.time() if now is None else now
+        with self._lock:
+            # pump-freshness gate (see note_pump): a stale pump means
+            # silence is unmeasurable — freeze downgrades, keep the
+            # standing lost set, still let resumed contact recover
+            pumping = (self._last_pump is None
+                       or now - self._last_pump
+                       <= max(2 * self.interval, 1.0))
+            rates = [h.rate for h in self._clients.values()
+                     if h.rate and h.state != "lost"]
+            med = statistics.median(rates) if rates else None
+            lost = set()
+            for cid, h in self._clients.items():
+                age = now - h.last_seen
+                h.score = (round(h.rate / med, 4)
+                           if med and h.rate is not None else None)
+                if not pumping:
+                    pass
+                elif age > self.liveness_timeout:
+                    self._transition(
+                        cid, h, "lost",
+                        f"silent {age:.1f}s > liveness-timeout "
+                        f"{self.liveness_timeout:g}s", now)
+                elif h.state == "lost":
+                    # contact resumed since the last advance
+                    self._transition(cid, h, "degraded",
+                                     "contact resumed", now)
+                elif age > self.STRAGGLER_MISSES * self.interval:
+                    self._transition(
+                        cid, h, "straggler",
+                        f"missed heartbeats ({age:.1f}s silent)", now)
+                elif age > self.DEGRADED_MISSES * self.interval:
+                    if h.state == "healthy":
+                        self._transition(cid, h, "degraded",
+                                         "missed a heartbeat", now)
+                elif (h.score is not None
+                        and h.score < self.STRAGGLER_SCORE
+                        and len(rates) >= 2):
+                    self._transition(
+                        cid, h, "straggler",
+                        f"rate {h.rate:.1f}/s is {h.score:.2f}x the "
+                        "fleet median", now)
+                elif h.state in ("degraded", "straggler"):
+                    if h.score is None or h.score >= self.RECOVER_SCORE:
+                        self._transition(cid, h, "healthy",
+                                         "heartbeats + rate recovered",
+                                         now)
+                if h.state == "lost":
+                    lost.add(cid)
+            counts = collections.Counter(
+                h.state for h in self._clients.values())
+            self.gauges.set("fleet_size", len(self._clients))
+            self.gauges.set("fleet_healthy", counts.get("healthy", 0))
+            self.gauges.set("fleet_degraded", counts.get("degraded", 0))
+            self.gauges.set("fleet_straggler",
+                            counts.get("straggler", 0))
+            self.gauges.set("fleet_lost", counts.get("lost", 0))
+            return frozenset(lost)
+
+    # -- views ---------------------------------------------------------------
+
+    def lost(self) -> frozenset:
+        with self._lock:
+            return frozenset(c for c, h in self._clients.items()
+                             if h.state == "lost")
+
+    def state(self, cid: str) -> str | None:
+        with self._lock:
+            h = self._clients.get(cid)
+            return h.state if h else None
+
+    def states(self) -> dict:
+        with self._lock:
+            return {c: h.state for c, h in self._clients.items()}
+
+    def snapshot(self, now: float | None = None) -> dict:
+        """The ``/fleet`` JSON view (also the ``kind=fleet`` metrics
+        record): per-client state/rate/score/age + the latest
+        counter/wire snapshots each heartbeat flushed (so a client
+        that crashes mid-round loses at most one interval of
+        counters), recent transitions, and state counts."""
+        now = time.time() if now is None else now
+        with self._lock:
+            clients = {}
+            for cid, h in sorted(self._clients.items()):
+                rtt = (h.latency.get("frame_rtt") or {})
+                clients[cid] = {
+                    "state": h.state,
+                    "age_s": round(max(0.0, now - h.last_seen), 3),
+                    "round": h.round,
+                    "samples": h.samples,
+                    "samples_per_s": h.rate,
+                    "straggler_score": h.score,
+                    "rtt_p95_ms": rtt.get("p95_ms"),
+                    "wire_bytes_out": h.wire.get("bytes_out_total"),
+                    "counters": dict(h.counters),
+                    "series": [list(x) for x in h.series][-32:],
+                }
+            counts = collections.Counter(
+                h.state for h in self._clients.values())
+            return {
+                "t": round(now, 3),
+                "heartbeat_interval_s": self.interval,
+                "liveness_timeout_s": self.liveness_timeout,
+                "counts": {s: counts.get(s, 0) for s in HEALTH_STATES},
+                "clients": clients,
+                "transitions": list(self.transitions)[-64:],
+            }
+
+
+# --------------------------------------------------------------------------
+# Prometheus text-format exposition
+# --------------------------------------------------------------------------
+
+def _esc(v: Any) -> str:
+    """Escape one label value per the text-format spec."""
+    return (str(v).replace("\\", r"\\").replace("\n", r"\n")
+            .replace('"', r'\"'))
+
+
+def _sample(name: str, labels: dict, value: Any) -> str:
+    if labels:
+        body = ",".join(f'{k}="{_esc(v)}"'
+                        for k, v in sorted(labels.items()))
+        return f"{name}{{{body}}} {value}"
+    return f"{name} {value}"
+
+
+def render_prometheus(fleet: FleetMonitor | None = None, faults=None,
+                      wire=None, hists=None,
+                      gauges: GaugeSet | None = None) -> str:
+    """One ``/metrics`` page: process counters/gauges/latency digests
+    plus the per-client fleet view.  Pure string building — safe to
+    call from the exporter's HTTP threads mid-round."""
+    out: list[str] = []
+
+    def family(name: str, kind: str, help_: str, samples: list):
+        if not samples:
+            return
+        out.append(f"# HELP {name} {help_}")
+        out.append(f"# TYPE {name} {kind}")
+        out.extend(samples)
+
+    if faults is not None:
+        family("sl_faults_total", "counter",
+               "Cumulative fault/recovery counters (runtime/trace.py).",
+               [_sample("sl_faults_total", {"name": k}, v)
+                for k, v in sorted(faults.snapshot().items())])
+    if wire is not None:
+        w = wire.snapshot()
+        family("sl_wire_bytes_total", "counter",
+               "Cumulative wire bytes by direction.",
+               [_sample("sl_wire_bytes_total", {"direction": "out"},
+                        w.get("bytes_out_total", 0)),
+                _sample("sl_wire_bytes_total", {"direction": "in"},
+                        w.get("bytes_in_total", 0))])
+        family("sl_wire_messages_total", "counter",
+               "Cumulative wire messages by direction.",
+               [_sample("sl_wire_messages_total", {"direction": "out"},
+                        w.get("msgs_out", 0)),
+                _sample("sl_wire_messages_total", {"direction": "in"},
+                        w.get("msgs_in", 0))])
+    if gauges is not None:
+        family("sl_gauge", "gauge",
+               "Last-value gauges (runtime/trace.py GAUGE_NAMES).",
+               [_sample("sl_gauge", {"name": k}, v)
+                for k, v in sorted(gauges.snapshot().items())
+                if k in GAUGE_NAMES and _finite(v)])
+    if hists is not None:
+        h = hists.snapshot()
+        q_samples, n_samples = [], []
+        for name, digest in sorted(h.items()):
+            for q, key in (("0.5", "p50_ms"), ("0.95", "p95_ms"),
+                           ("0.99", "p99_ms")):
+                ms = digest.get(key)
+                if ms is not None:
+                    q_samples.append(_sample(
+                        "sl_latency_seconds",
+                        {"name": name, "quantile": q}, ms / 1e3))
+            n_samples.append(_sample("sl_latency_observations_total",
+                                     {"name": name},
+                                     digest.get("count", 0)))
+        family("sl_latency_seconds", "summary",
+               "Latency digests (log-spaced fixed buckets).", q_samples)
+        family("sl_latency_observations_total", "counter",
+               "Observations per latency histogram.", n_samples)
+    if fleet is not None:
+        snap = fleet.snapshot()
+        by_state = [_sample("sl_fleet_clients", {"state": s}, n)
+                    for s, n in sorted(snap["counts"].items())]
+        family("sl_fleet_clients", "gauge",
+               "Clients per health state.", by_state)
+        up, code, rate, score, age = [], [], [], [], []
+        for cid, c in sorted(snap["clients"].items()):
+            lbl = {"client": cid}
+            up.append(_sample("sl_client_up", lbl,
+                              0 if c["state"] == "lost" else 1))
+            code.append(_sample("sl_client_state_code", lbl,
+                                _STATE_CODE[c["state"]]))
+            if c["samples_per_s"] is not None:
+                rate.append(_sample("sl_client_samples_per_second",
+                                    lbl, c["samples_per_s"]))
+            if c["straggler_score"] is not None:
+                score.append(_sample("sl_client_straggler_score", lbl,
+                                     c["straggler_score"]))
+            age.append(_sample("sl_client_heartbeat_age_seconds", lbl,
+                               c["age_s"]))
+        family("sl_client_up", "gauge",
+               "1 unless the client is health-state lost.", up)
+        family("sl_client_state_code", "gauge",
+               "0=healthy 1=degraded 2=straggler 3=lost.", code)
+        family("sl_client_samples_per_second", "gauge",
+               "EWMA training throughput per client.", rate)
+        family("sl_client_straggler_score", "gauge",
+               "Client rate / fleet median (lower is slower).", score)
+        family("sl_client_heartbeat_age_seconds", "gauge",
+               "Seconds since the last fresh frame.", age)
+    return "\n".join(out) + ("\n" if out else "")
+
+
+_METRIC_NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
+_LABEL_NAME_RE = re.compile(r"^[a-zA-Z_][a-zA-Z0-9_]*$")
+_TYPES = ("counter", "gauge", "summary", "histogram", "untyped")
+
+
+def _finite(v) -> bool:
+    try:
+        return math.isfinite(float(v))
+    except (TypeError, ValueError):
+        return False
+
+
+def _parse_labels(body: str) -> dict | None:
+    """Parse ``k="v",...`` with escape handling; None on bad syntax."""
+    labels: dict[str, str] = {}
+    i, n = 0, len(body)
+    while i < n:
+        j = body.find("=", i)
+        if j < 0:
+            return None
+        name = body[i:j]
+        if not _LABEL_NAME_RE.match(name):
+            return None
+        if j + 1 >= n or body[j + 1] != '"':
+            return None
+        i = j + 2
+        val = []
+        while i < n and body[i] != '"':
+            if body[i] == "\\":
+                if i + 1 >= n or body[i + 1] not in ('\\', '"', 'n'):
+                    return None
+                val.append(body[i:i + 2])
+                i += 2
+            else:
+                val.append(body[i])
+                i += 1
+        if i >= n:            # unterminated value
+            return None
+        i += 1                # closing quote
+        if name in labels:
+            return None       # duplicate label name
+        labels[name] = "".join(val)
+        if i < n:
+            if body[i] != ",":
+                return None
+            i += 1
+    return labels
+
+
+def lint_prometheus(text: str) -> list[str]:
+    """Pure-python Prometheus text-format lint: metric/label name
+    grammar, label-value escaping, float-parseable values, TYPE
+    declared before a family's first sample, no duplicate series.
+    Returns a list of errors (empty = parseable)."""
+    errors: list[str] = []
+    typed: set[str] = set()
+    seen: set[tuple] = set()
+    for lineno, line in enumerate(text.splitlines(), start=1):
+        if not line.strip():
+            continue
+        if line.startswith("#"):
+            parts = line.split(None, 3)
+            if len(parts) >= 3 and parts[1] in ("HELP", "TYPE"):
+                if not _METRIC_NAME_RE.match(parts[2]):
+                    errors.append(f"line {lineno}: bad metric name "
+                                  f"{parts[2]!r} in {parts[1]}")
+                if parts[1] == "TYPE":
+                    if len(parts) < 4 or parts[3] not in _TYPES:
+                        errors.append(f"line {lineno}: bad TYPE "
+                                      f"{line!r}")
+                    typed.add(parts[2])
+            continue
+        m = re.match(r"^([^\s{]+)(\{(.*)\})?\s+(\S+)(\s+-?\d+)?$", line)
+        if m is None:
+            errors.append(f"line {lineno}: unparseable sample {line!r}")
+            continue
+        name, _, label_body, value = m.group(1, 2, 3, 4)
+        if not _METRIC_NAME_RE.match(name):
+            errors.append(f"line {lineno}: bad metric name {name!r}")
+            continue
+        labels = _parse_labels(label_body) if label_body else {}
+        if labels is None:
+            errors.append(f"line {lineno}: bad label syntax "
+                          f"{label_body!r}")
+            continue
+        if value not in ("NaN", "+Inf", "-Inf"):
+            try:
+                float(value)
+            except ValueError:
+                errors.append(f"line {lineno}: unparseable value "
+                              f"{value!r}")
+        base = re.sub(r"_(count|sum|bucket)$", "", name)
+        if name not in typed and base not in typed:
+            errors.append(f"line {lineno}: sample {name!r} has no "
+                          "preceding # TYPE")
+        key = (name, tuple(sorted(labels.items())))
+        if key in seen:
+            errors.append(f"line {lineno}: duplicate series {key}")
+        seen.add(key)
+    return errors
+
+
+# --------------------------------------------------------------------------
+# HTTP exporter
+# --------------------------------------------------------------------------
+
+class TelemetryExporter:
+    """Stdlib HTTP thread serving ``/metrics`` (Prometheus text,
+    ``text/plain; version=0.0.4``) and ``/fleet`` (JSON snapshot).
+    Callbacks run on the handler threads — keep them lock-cheap (the
+    FleetMonitor/registries are all internally locked)."""
+
+    def __init__(self, metrics_fn: Callable[[], str],
+                 fleet_fn: Callable[[], dict],
+                 host: str = "127.0.0.1", port: int = 0):
+        import http.server
+
+        exporter = self
+
+        class _Handler(http.server.BaseHTTPRequestHandler):
+            def do_GET(self):  # noqa: N802 — stdlib API
+                try:
+                    if self.path.split("?")[0] == "/metrics":
+                        body = exporter._metrics_fn().encode()
+                        ctype = "text/plain; version=0.0.4"
+                    elif self.path.split("?")[0] == "/fleet":
+                        body = json.dumps(exporter._fleet_fn()).encode()
+                        ctype = "application/json"
+                    else:
+                        self.send_error(404)
+                        return
+                except Exception as e:  # noqa: BLE001 — a render bug
+                    # must 500 the scrape, not kill the handler thread
+                    self.send_error(500, str(e)[:100])
+                    return
+                self.send_response(200)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *a):   # scrapes must not spam stderr
+                pass
+
+        self._metrics_fn = metrics_fn
+        self._fleet_fn = fleet_fn
+        self._httpd = http.server.ThreadingHTTPServer((host, port),
+                                                      _Handler)
+        self._httpd.daemon_threads = True
+        self.host, self.port = self._httpd.server_address[:2]
+        self._thread: threading.Thread | None = None
+
+    def start(self) -> "TelemetryExporter":
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True,
+            name=f"telemetry-http-{self.port}")
+        self._thread.start()
+        return self
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    def close(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None

@@ -22,10 +22,12 @@ and a one-shot message-size probe (SURVEY.md §5.1); its in-message
   (log-spaced) latency histograms for frame RTT, broker queue wait,
   step time and encode/decode, surfaced as ``kind: latency``
   metrics.jsonl records next to the counters;
-* :data:`FAULT_COUNTER_NAMES` / :data:`HISTOGRAM_NAMES` — the declared
-  name registries the ``counters`` slcheck analyzer holds every
-  ``.inc``/``.observe`` call site to (typo'd names silently mint dead
-  keys otherwise).
+* :data:`FAULT_COUNTER_NAMES` / :data:`HISTOGRAM_NAMES` /
+  :data:`GAUGE_NAMES` — the declared name registries the ``counters``
+  slcheck analyzer holds every ``.inc``/``.observe``/``.set`` call
+  site to (typo'd names silently mint dead keys otherwise).  The
+  ``GaugeSet`` type the gauge registry covers lives in
+  ``runtime/telemetry.py`` with the rest of the live telemetry plane.
 """
 
 from __future__ import annotations
@@ -53,6 +55,11 @@ FAULT_COUNTER_NAMES = frozenset({
     "daemon_errors", "ack_send_failures", "corrupt_rejected",
     # transport plumbing
     "reconnects", "timeouts", "async_send_errors", "prefetch_errors",
+    # live telemetry plane (runtime/telemetry.py): heartbeat publishes
+    # that failed, duplicate/reordered heartbeats the fleet monitor
+    # rejected as stale, and barrier waits cut short because every
+    # missing client was health-state `lost`
+    "heartbeat_errors", "stale_heartbeats", "fleet_lost_drops",
     # wire codecs (runtime/codec/): non-finite payloads crossing the
     # quantizer, top-k leaves too small to sparsify, and the delta
     # codec's fold/full-frame/version-gap outcomes
@@ -69,6 +76,22 @@ HISTOGRAM_NAMES = frozenset({
     "step",            # one hot-loop training step (bwd+apply / window)
     "encode",          # frame encode (device fetch + TENSOR framing)
     "decode",          # frame decode (assembler feed)
+})
+
+#: Declared registry of gauge names (``runtime/telemetry.py GaugeSet``;
+#: same contract as the two registries above, enforced on
+#: ``.set("name", ...)`` sites by the ``counters`` analyzer CT003).
+#: Unlike the counters/histograms, gauges are LAST-VALUE semantics:
+#: each set overwrites, snapshots report the current value.
+GAUGE_NAMES = frozenset({
+    # client-side (set by the hot loops + heartbeat emitter)
+    "round",           # current round index (set at SYN)
+    "epoch",           # current local epoch within the round
+    "inflight",        # stage-1 1F1B in-flight window depth
+    "samples_per_s",   # EWMA training throughput (emitter tick)
+    # server-side (set by the FleetMonitor on every advance)
+    "fleet_size", "fleet_healthy", "fleet_degraded",
+    "fleet_straggler", "fleet_lost",
 })
 
 

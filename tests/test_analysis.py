@@ -566,10 +566,46 @@ def test_undeclared_counter_name_flagged():
     assert "FAULT_COUNTER_NAMES" in findings[0].message
 
 
+def test_undeclared_gauge_name_flagged():
+    from split_learning_tpu.analysis import counters
+    src = (
+        "def tick(gauges, ev):\n"
+        "    gauges.set('round', 3)\n"          # declared: clean
+        "    gauges.set('rnd', 3)\n"            # typo: CT003
+        "    ev.set()\n"                        # no args: ignored
+        "    arr.at[idx].set(0.0)\n"            # non-string: ignored
+    )
+    findings = counters.scan_source(src, "x.py")
+    assert [f.code for f in findings] == ["CT003"]
+    assert "rnd" in findings[0].message
+    assert "GAUGE_NAMES" in findings[0].message
+
+
 def test_counter_registry_clean_on_repo():
     from split_learning_tpu.analysis import counters
     from split_learning_tpu.analysis.__main__ import repo_root
     assert counters.run(repo_root()) == []
+
+
+def test_heartbeat_legal_in_every_fsm_state():
+    # heartbeats come from a background thread, orthogonal to the
+    # lifecycle — every state must carry the self-loop, or the trace
+    # validator would flag any interleaving chaos produces
+    from split_learning_tpu.analysis.model import (
+        CLIENT_FSM, SERVER_FSM, Event, validate_events,
+    )
+    for state, trans in SERVER_FSM.items():
+        assert trans[("recv", "Heartbeat")] == state
+    for state, trans in CLIENT_FSM.items():
+        assert trans[("send", "Heartbeat")] == state
+    events = [Event("client", "send", "Register", "c1"),
+              Event("client", "send", "Heartbeat", "c1"),
+              Event("client", "recv", "Start", "c1"),
+              Event("client", "send", "Heartbeat", "c1"),
+              Event("client", "send", "Ready", "c1"),
+              Event("server", "recv", "Heartbeat", "server"),
+              Event("server", "recv", "Register", "server")]
+    assert validate_events(events) == []
 
 
 # --------------------------------------------------------------------------

@@ -1,0 +1,758 @@
+"""Live fleet telemetry plane (``runtime/telemetry.py``).
+
+Fast tier-1 surface: the FleetMonitor health state machine (every
+transition, flapping recovery, dup/reorder staleness guard), the
+gauge registry, EWMA rate metering, Prometheus text rendering against
+the pure-python format lint (plus lint negatives), Heartbeat frame
+round-trips under ChaosTransport dup/reorder, the HTTP exporter, the
+run-scoped output layout, and sl_top's table renderer.
+
+Slow: a 3-client protocol round with one client's rpc traffic
+delay-injected — the FleetMonitor must flag it mid-round, the round
+must complete, and a mid-round ``/metrics`` scrape must lint clean
+(the ISSUE 7 acceptance cell; CI runs the same thing via
+``tools/run_chaos.py --fleet``).
+"""
+
+import json
+import sys
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from split_learning_tpu.config import ChaosConfig, from_dict
+from split_learning_tpu.runtime import protocol as P
+from split_learning_tpu.runtime.bus import InProcTransport
+from split_learning_tpu.runtime.chaos import ChaosTransport
+from split_learning_tpu.runtime.telemetry import (
+    FleetMonitor, GaugeSet, TelemetryEmitter, TelemetryExporter,
+    TelemetrySnapshot, lint_prometheus, render_prometheus,
+)
+from split_learning_tpu.runtime.trace import (
+    FaultCounters, GAUGE_NAMES, HistogramSet,
+)
+
+sys.path.insert(0, "tools")
+import sl_top  # noqa: E402
+
+
+def _beat(seq, t, rate=10.0, part="c"):
+    return {"part": part, "t": t, "seq": seq, "samples": seq * 10,
+            "samples_per_s": rate}
+
+
+# --------------------------------------------------------------------------
+# gauges
+# --------------------------------------------------------------------------
+
+class TestGaugeSet:
+    def test_last_value_semantics(self):
+        g = GaugeSet()
+        g.set("round", 1)
+        g.set("round", 5)
+        assert g.get("round") == 5.0
+        assert g.snapshot() == {"round": 5.0}
+        assert g.get("epoch") is None
+        assert g.get("epoch", 0.0) == 0.0
+
+    def test_registry_covers_runtime_sites(self):
+        # the fleet gauges the monitor sets must all be declared
+        for name in ("fleet_size", "fleet_healthy", "fleet_degraded",
+                     "fleet_straggler", "fleet_lost", "round",
+                     "epoch", "inflight", "samples_per_s"):
+            assert name in GAUGE_NAMES
+
+
+# --------------------------------------------------------------------------
+# telemetry snapshot + emitter
+# --------------------------------------------------------------------------
+
+class TestSnapshot:
+    def test_dict_roundtrip(self):
+        s = TelemetrySnapshot(part="c1", t=1.5, seq=3, round=2,
+                              samples=40, samples_per_s=8.25,
+                              counters={"drops": 1})
+        back = TelemetrySnapshot.from_dict(s.as_dict())
+        assert back == s
+
+    def test_foreign_fields_tolerated(self):
+        s = TelemetrySnapshot.from_dict(
+            {"part": "c", "t": 1.0, "seq": 1, "from_the_future": 9})
+        assert s is not None and s.seq == 1
+
+    def test_garbage_degrades_to_none(self):
+        assert TelemetrySnapshot.from_dict("nope") is None
+        assert TelemetrySnapshot.from_dict(None) is None
+
+
+class TestEmitter:
+    def test_snapshot_carries_registries_and_rate(self):
+        fc = FaultCounters()
+        fc.inc("drops", 3)
+        hs = HistogramSet()
+        hs.observe("step", 0.002)
+        g = GaugeSet()
+        g.set("round", 4)
+        samples = {"n": 0}
+        em = TelemetryEmitter("c1", lambda d: None, interval=10.0,
+                              faults=fc, hists=hs, gauges=g,
+                              samples_fn=lambda: samples["n"])
+        t0 = 1000.0
+        em.snapshot(now=t0)
+        samples["n"] = 50
+        snap = em.snapshot(now=t0 + 5.0)
+        assert snap.part == "c1" and snap.round == 4
+        assert snap.seq == 2
+        assert snap.counters["drops"] == 3
+        assert "step" in snap.latency
+        # 50 samples over 5 s, EWMA-smoothed from 0: alpha * 10/s
+        assert 0 < snap.samples_per_s <= 10.0
+        assert snap.gauges["samples_per_s"] == snap.samples_per_s
+
+    def test_per_round_counter_reset_handled(self):
+        samples = {"n": 100}
+        em = TelemetryEmitter("c", lambda d: None, interval=1.0,
+                              samples_fn=lambda: samples["n"])
+        em.snapshot(now=0.0)
+        samples["n"] = 10        # new round reset the counter
+        snap = em.snapshot(now=1.0)
+        assert snap.samples_per_s >= 0     # never negative
+
+    def test_beat_thread_publishes_and_stops(self):
+        got = []
+        em = TelemetryEmitter("c", got.append, interval=0.02)
+        em.start()
+        deadline = time.monotonic() + 5.0
+        while len(got) < 3 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        em.stop()
+        assert len(got) >= 3
+        n = len(got)
+        time.sleep(0.1)
+        assert len(got) == n    # thread actually stopped
+        seqs = [d["seq"] for d in got]
+        assert seqs == sorted(seqs)
+
+    def test_send_failures_counted_and_bounded(self):
+        fc = FaultCounters()
+
+        def boom(d):
+            raise ConnectionError("gone")
+
+        em = TelemetryEmitter("c", boom, interval=0.01, faults=fc)
+        em.start()
+        deadline = time.monotonic() + 5.0
+        while (fc.snapshot().get("heartbeat_errors", 0)
+               < TelemetryEmitter.MAX_ERRORS
+               and time.monotonic() < deadline):
+            time.sleep(0.01)
+        em.stop()
+        # gave up after MAX_ERRORS, did not spin forever
+        assert fc.snapshot()["heartbeat_errors"] \
+            == TelemetryEmitter.MAX_ERRORS
+
+    def test_zero_interval_disables(self):
+        em = TelemetryEmitter("c", lambda d: None, interval=0)
+        em.start()
+        assert em._thread is None
+
+
+# --------------------------------------------------------------------------
+# fleet monitor state machine
+# --------------------------------------------------------------------------
+
+class TestFleetMonitor:
+    def mk(self, interval=1.0, liveness=10.0, faults=None):
+        return FleetMonitor(interval=interval, liveness_timeout=liveness,
+                            faults=faults)
+
+    def test_first_contact_is_healthy(self):
+        fm = self.mk()
+        fm.note_heartbeat("c1", _beat(1, 100.0), now=100.0)
+        fm.advance(now=100.1)
+        assert fm.state("c1") == "healthy"
+
+    def test_missed_heartbeats_degrade_then_straggle_then_lose(self):
+        fm = self.mk(interval=1.0, liveness=10.0)
+        fm.note_heartbeat("c1", _beat(1, 100.0), now=100.0)
+        fm.advance(now=101.0)
+        assert fm.state("c1") == "healthy"
+        fm.advance(now=101.8)          # > 1.5 intervals silent
+        assert fm.state("c1") == "degraded"
+        fm.advance(now=102.5)          # > 2 intervals silent
+        assert fm.state("c1") == "straggler"
+        lost = fm.advance(now=111.0)   # > liveness-timeout silent
+        assert fm.state("c1") == "lost" and lost == {"c1"}
+
+    def test_rate_relative_straggler_and_recovery(self):
+        fm = self.mk(interval=1.0)
+        t = 100.0
+        for seq in range(1, 4):
+            fm.note_heartbeat("fast1", _beat(seq, t, 10.0), now=t)
+            fm.note_heartbeat("fast2", _beat(seq, t, 10.0), now=t)
+            fm.note_heartbeat("slow", _beat(seq, t, 2.0), now=t)
+            t += 1.0
+            fm.advance(now=t)
+        # 2.0/s vs median 10/s = 0.2 < 0.5 -> straggler, peers healthy
+        assert fm.state("slow") == "straggler"
+        assert fm.state("fast1") == fm.state("fast2") == "healthy"
+        snap = fm.snapshot(now=t)
+        assert snap["clients"]["slow"]["straggler_score"] < 0.5
+        # rate recovers -> healthy again
+        for seq in range(4, 7):
+            fm.note_heartbeat("fast1", _beat(seq, t, 10.0), now=t)
+            fm.note_heartbeat("fast2", _beat(seq, t, 10.0), now=t)
+            fm.note_heartbeat("slow", _beat(seq, t, 9.5), now=t)
+            t += 1.0
+            fm.advance(now=t)
+        assert fm.state("slow") == "healthy"
+        tos = [x["to"] for x in fm.transitions
+               if x["client"] == "slow"]
+        assert tos == ["straggler", "healthy"]
+
+    def test_lost_recovery_climbs_through_degraded(self):
+        fm = self.mk(interval=1.0, liveness=5.0)
+        fm.note_heartbeat("c1", _beat(1, 100.0), now=100.0)
+        fm.advance(now=106.0)
+        assert fm.state("c1") == "lost"
+        # one fresh beat lifts only to degraded (flap hysteresis) ...
+        fm.note_heartbeat("c1", _beat(2, 106.1), now=106.1)
+        assert fm.state("c1") == "degraded"
+        # ... the next advance with recent contact completes the climb
+        fm.advance(now=106.2)
+        assert fm.state("c1") == "healthy"
+        tos = [x["to"] for x in fm.transitions]
+        assert tos == ["lost", "degraded", "healthy"]
+
+    def test_duplicate_and_reordered_heartbeats_cannot_flap_lost(self):
+        fc = FaultCounters()
+        fm = self.mk(interval=1.0, liveness=5.0, faults=fc)
+        fm.note_heartbeat("c1", _beat(1, 100.0), now=100.0)
+        fm.note_heartbeat("c1", _beat(3, 102.0), now=102.0)
+        fm.advance(now=108.0)
+        assert fm.state("c1") == "lost"
+        # a DUPLICATE of beat 3 delivered late: stale -> ignored
+        assert fm.note_heartbeat("c1", _beat(3, 102.0),
+                                 now=108.1) is False
+        assert fm.state("c1") == "lost"
+        # a REORDERED older beat (seq 2) surfacing now: stale -> ignored
+        assert fm.note_heartbeat("c1", _beat(2, 101.0),
+                                 now=108.2) is False
+        assert fm.state("c1") == "lost"
+        assert fm.advance(now=108.3) == {"c1"}
+        assert fc.snapshot()["stale_heartbeats"] == 2
+        # stale beats must not have refreshed liveness either
+        assert fm.snapshot(now=108.3)["clients"]["c1"]["age_s"] \
+            == pytest.approx(6.3, abs=0.01)
+
+    def test_restarted_client_fresh_emitter_accepted(self):
+        """A crashed-and-restarted client's new emitter restarts seq
+        at 1; its beats must be FRESH (clock moved on), while the old
+        emitter's late-draining frames (higher seq, older clock) must
+        stay stale — plain seq comparison would lock the restarted
+        client out until its new seq caught the old one."""
+        fm = self.mk(interval=1.0, liveness=5.0)
+        fm.note_heartbeat("c1", _beat(50, 100.0), now=100.0)
+        fm.advance(now=106.0)
+        assert fm.state("c1") == "lost"            # crashed
+        # restart: seq back at 1, sender clock newer -> accepted
+        assert fm.note_heartbeat("c1", _beat(1, 107.0),
+                                 now=107.0) is True
+        assert fm.state("c1") == "degraded"
+        # old emitter's delayed frame: seq 51 but stale clock -> dropped
+        assert fm.note_heartbeat("c1", _beat(51, 101.0),
+                                 now=107.1) is False
+        assert fm.note_heartbeat("c1", _beat(2, 107.5),
+                                 now=107.5) is True
+        fm.advance(now=107.6)
+        assert fm.state("c1") == "healthy"
+
+    def test_any_rpc_frame_counts_as_liveness(self):
+        fm = self.mk(interval=1.0, liveness=5.0)
+        fm.note_frame("c1", now=100.0)
+        fm.advance(now=104.0)
+        assert fm.state("c1") in ("degraded", "straggler")  # no beats
+        fm.advance(now=106.0)
+        assert fm.state("c1") == "lost"
+        fm.note_frame("c1", now=106.5)     # contact resumed
+        assert fm.state("c1") == "degraded"
+
+    def test_forget_removes_client(self):
+        fm = self.mk()
+        fm.note_heartbeat("c1", _beat(1, 100.0), now=100.0)
+        fm.forget("c1")
+        assert fm.state("c1") is None
+        assert fm.snapshot(now=101.0)["clients"] == {}
+
+    def test_snapshot_carries_flushed_counters(self):
+        # satellite: counters ride every heartbeat, so a client that
+        # crashes mid-round still has its last counters server-side
+        fm = self.mk()
+        tel = _beat(1, 100.0)
+        tel["counters"] = {"drops": 7, "redeliveries": 2}
+        tel["wire"] = {"bytes_out_total": 1234}
+        fm.note_heartbeat("c1", tel, now=100.0)
+        snap = fm.snapshot(now=100.5)
+        assert snap["clients"]["c1"]["counters"]["drops"] == 7
+        assert snap["clients"]["c1"]["wire_bytes_out"] == 1234
+
+    def test_single_client_never_rate_straggled(self):
+        fm = self.mk(interval=1.0)
+        t = 100.0
+        for seq in range(1, 5):
+            fm.note_heartbeat("only", _beat(seq, t, 0.5), now=t)
+            t += 1.0
+            fm.advance(now=t)
+        assert fm.state("only") == "healthy"   # no peers to compare
+
+    def test_gauges_reflect_counts(self):
+        g = GaugeSet()
+        fm = FleetMonitor(interval=1.0, liveness_timeout=5.0, gauges=g)
+        fm.note_heartbeat("c1", _beat(1, 100.0), now=100.0)
+        fm.note_heartbeat("c2", _beat(1, 100.0), now=103.0)
+        fm.advance(now=103.1)
+        assert g.get("fleet_size") == 2
+        assert g.get("fleet_healthy") == 1
+        assert g.get("fleet_straggler") == 1   # c1: 3.1s silent
+
+
+# --------------------------------------------------------------------------
+# heartbeat frames on the wire (+ under chaos)
+# --------------------------------------------------------------------------
+
+class TestHeartbeatWire:
+    def test_roundtrip(self):
+        snap = TelemetrySnapshot(part="c1", t=2.5, seq=9, round=1,
+                                 counters={"drops": 1}).as_dict()
+        raw = P.encode(P.Heartbeat(client_id="c1", round_idx=1,
+                                   telemetry=snap))
+        back = P.decode(raw)
+        assert isinstance(back, P.Heartbeat)
+        assert back.telemetry == snap
+        assert TelemetrySnapshot.from_dict(back.telemetry).seq == 9
+
+    def test_corrupt_heartbeat_rejected(self):
+        raw = P.encode(P.Heartbeat(client_id="c1"))
+        bad = raw[:10] + bytes([raw[10] ^ 0xFF]) + raw[11:]
+        with pytest.raises(P.CorruptFrame):
+            P.decode(bad)
+
+    def test_update_telemetry_piggyback_roundtrip(self):
+        import numpy as np
+        snap = TelemetrySnapshot(part="c1", t=1.0, seq=2).as_dict()
+        msg = P.Update(client_id="c1", stage=1, cluster=0,
+                       params={"w": np.ones((2, 2), np.float32)},
+                       num_samples=8, telemetry=snap)
+        back = P.decode(P.encode(msg))
+        assert back.telemetry == snap
+
+    def test_chaos_dup_reorder_stream_cannot_flap_lost(self):
+        """Heartbeats pushed through a dup/reorder ChaosTransport:
+        after the sender goes silent and the client goes lost, the
+        straggler frames still draining from the channel must not
+        resurrect it (seq/send-time staleness guard)."""
+        bus = InProcTransport()
+        fc = FaultCounters()
+        tx = ChaosTransport(
+            bus, ChaosConfig(enabled=True, seed=11, duplicate=0.4,
+                             reorder=0.4, queues=("rpc_queue",)),
+            name="c1", faults=fc)
+        t0 = 1000.0
+        for seq in range(1, 11):
+            tx.publish(P.RPC_QUEUE, P.encode(P.Heartbeat(
+                client_id="c1",
+                telemetry=_beat(seq, t0 + 0.1 * seq, part="c1"))))
+        fm = FleetMonitor(interval=0.1, liveness_timeout=1.0,
+                          faults=fc)
+        # deliver roughly half the (dup'd, reordered) stream "live"
+        delivered = []
+        while True:
+            raw = bus.get(P.RPC_QUEUE, timeout=0.05)
+            if raw is None:
+                break
+            delivered.append(P.decode(raw))
+        assert len(delivered) >= 10      # duplicates actually occurred
+        half = len(delivered) // 2
+        now = t0 + 1.5
+        for msg in delivered[:half]:
+            fm.note_heartbeat(msg.client_id, msg.telemetry, now=now)
+        assert fm.advance(now=now + 2.0) == {"c1"}   # silent -> lost
+        # the tail of the stream drains AFTER the loss: every frame is
+        # stale or does not beat the newest seq by fresh send time
+        # within the liveness window -> c1 must stay lost unless a
+        # genuinely NEWER beat arrives
+        max_seen = max(m.telemetry["seq"] for m in delivered[:half])
+        for msg in delivered[half:]:
+            fresh = fm.note_heartbeat(msg.client_id, msg.telemetry,
+                                      now=now + 2.1)
+            if msg.telemetry["seq"] <= max_seen:
+                assert fresh is False
+        fm.advance(now=now + 2.2)
+        states_seen = {x["to"] for x in fm.transitions}
+        # lost -> healthy directly is impossible; recovery (if a newer
+        # seq was in the tail) must pass through degraded
+        if fm.state("c1") != "lost":
+            tos = [x["to"] for x in fm.transitions]
+            assert tos.index("degraded") > tos.index("lost")
+        assert "lost" in states_seen
+        assert fc.snapshot().get("stale_heartbeats", 0) >= 1
+
+
+    def test_scripted_crash_is_sticky_across_threads(self):
+        """A ChaosCrash first surfacing on the heartbeat thread must
+        still kill the 'process': every later op on the crashed
+        wrapper re-raises, so the training thread dies at its next
+        transport call instead of the crash being swallowed by the
+        emitter's error handling."""
+        from split_learning_tpu.runtime.chaos import ChaosCrash
+        tx = ChaosTransport(
+            InProcTransport(),
+            ChaosConfig(enabled=True, crash=(
+                {"client": "c1", "queue": "rpc_queue", "after": 1},)),
+            name="c1", faults=FaultCounters())
+        with pytest.raises(ChaosCrash):
+            tx.publish(P.RPC_QUEUE, b"beat")   # emitter thread's view
+        with pytest.raises(ChaosCrash):        # training thread's next
+            tx.publish("intermediate_queue_1_0", b"x")
+        with pytest.raises(ChaosCrash):
+            tx.get("reply_c1", timeout=0.01)
+
+    def test_emitter_stops_immediately_on_chaos_crash(self):
+        from split_learning_tpu.runtime.chaos import ChaosCrash
+        fc = FaultCounters()
+        calls = {"n": 0}
+
+        def send(d):
+            calls["n"] += 1
+            raise ChaosCrash("dead")
+
+        em = TelemetryEmitter("c", send, interval=0.01, faults=fc)
+        em.start()
+        deadline = time.monotonic() + 5.0
+        while calls["n"] < 1 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        time.sleep(0.1)
+        em.stop()
+        # died on the FIRST crash: no retries, not counted as a
+        # transport hiccup (the process is dead, not flaky)
+        assert calls["n"] == 1
+        assert fc.snapshot().get("heartbeat_errors", 0) == 0
+
+
+# --------------------------------------------------------------------------
+# prometheus rendering + lint
+# --------------------------------------------------------------------------
+
+class TestPrometheus:
+    def _full_render(self):
+        fc = FaultCounters()
+        fc.inc("drops", 2)
+        hs = HistogramSet()
+        for v in (0.001, 0.002, 0.004):
+            hs.observe("frame_rtt", v)
+        g = GaugeSet()
+        g.set("round", 2)
+        fm = FleetMonitor(interval=1.0, liveness_timeout=10.0)
+        fm.note_heartbeat(
+            'we"ird\\name\n', _beat(1, 100.0, 10.0), now=100.0)
+        fm.note_heartbeat("c2", _beat(1, 100.0, 1.0), now=100.0)
+        fm.advance(now=100.5)
+        return render_prometheus(fleet=fm, faults=fc, wire=None,
+                                 hists=hs, gauges=g)
+
+    def test_render_lints_clean_with_hostile_label_values(self):
+        txt = self._full_render()
+        assert lint_prometheus(txt) == []
+        assert "sl_faults_total" in txt
+        assert "sl_client_samples_per_second" in txt
+        assert r"we\"ird\\name\n" in txt   # escaped, not raw
+
+    def test_lint_negatives(self):
+        assert lint_prometheus("0bad_name 1\n")
+        assert lint_prometheus(
+            "# TYPE m counter\nm{l=unquoted} 1\n")
+        assert lint_prometheus(
+            "# TYPE m counter\nm{l=\"v\"} notafloat\n")
+        assert lint_prometheus("no_type_declared 1\n")
+        dup = ('# TYPE m gauge\nm{a="1"} 1\nm{a="1"} 2\n')
+        assert any("duplicate" in e for e in lint_prometheus(dup))
+        bad_esc = '# TYPE m gauge\nm{a="x\\q"} 1\n'
+        assert any("label" in e for e in lint_prometheus(bad_esc))
+
+    def test_lint_accepts_reference_format(self):
+        ok = ('# HELP http_requests_total Total requests.\n'
+              '# TYPE http_requests_total counter\n'
+              'http_requests_total{method="post",code="200"} 1027\n'
+              'http_requests_total{method="post",code="400"} 3\n'
+              '# TYPE rpc_duration_seconds summary\n'
+              'rpc_duration_seconds{quantile="0.5"} 4.3e-05\n'
+              'rpc_duration_seconds_count 2693\n')
+        assert lint_prometheus(ok) == []
+
+
+# --------------------------------------------------------------------------
+# http exporter + sl_top
+# --------------------------------------------------------------------------
+
+class TestExporterAndTop:
+    def test_exporter_serves_metrics_and_fleet(self):
+        fm = FleetMonitor(interval=1.0, liveness_timeout=10.0)
+        fm.note_heartbeat("c1", _beat(1, 100.0), now=100.0)
+        fm.advance(now=100.1)
+        fc = FaultCounters()
+        fc.inc("drops")
+        ex = TelemetryExporter(
+            lambda: render_prometheus(fleet=fm, faults=fc),
+            lambda: fm.snapshot()).start()
+        try:
+            with urllib.request.urlopen(f"{ex.url}/metrics",
+                                        timeout=5) as r:
+                body = r.read().decode()
+                assert r.headers["Content-Type"].startswith(
+                    "text/plain")
+            assert lint_prometheus(body) == []
+            fleet = sl_top.fetch_fleet(ex.url)
+            assert fleet["clients"]["c1"]["state"] == "healthy"
+            with pytest.raises(urllib.error.HTTPError):
+                urllib.request.urlopen(f"{ex.url}/nope", timeout=5)
+        finally:
+            ex.close()
+
+    def test_sl_top_renders_table(self):
+        fm = FleetMonitor(interval=1.0, liveness_timeout=10.0)
+        fm.note_heartbeat("c1", _beat(2, 100.0, 12.0), now=100.0)
+        fm.note_heartbeat("c2", _beat(2, 100.0, 1.0), now=100.0)
+        fm.advance(now=100.2)
+        out = sl_top.render_fleet(fm.snapshot(now=100.2), color=False)
+        assert "CLIENT" in out and "STATE" in out
+        assert "c1" in out and "c2" in out
+        assert "straggler" in out          # c2's rate-scored state
+        assert "->" in out                 # transitions tail rendered
+
+    def test_sl_top_journal_fallback(self, tmp_path):
+        from split_learning_tpu.runtime.log import Logger
+        fm = FleetMonitor(interval=1.0, liveness_timeout=10.0)
+        fm.note_heartbeat("c9", _beat(1, 100.0), now=100.0)
+        lg = Logger(tmp_path, console=False, name="server")
+        lg.metric(kind="fleet", fleet=fm.snapshot(now=100.5))
+        lg.close()
+        fleet = sl_top.fleet_from_journal(tmp_path)
+        assert fleet is not None and "c9" in fleet["clients"]
+        out = sl_top.render_fleet(fleet, color=False,
+                                  source=str(tmp_path))
+        assert "c9" in out
+
+
+# --------------------------------------------------------------------------
+# run-scoped output layout (satellite)
+# --------------------------------------------------------------------------
+
+class TestRunScopedOutputs:
+    def test_files_land_in_run_dir_with_compat_symlinks(self, tmp_path):
+        from split_learning_tpu.runtime.log import Logger
+        lg = Logger(tmp_path, console=False, name="server",
+                    run_scoped=True)
+        lg.metric(kind="round", round_idx=0)
+        lg.info("line")
+        run_dir = tmp_path / "artifacts" / "runs" / lg.run_id
+        assert (run_dir / "metrics.jsonl").is_file()
+        assert (run_dir / "app.log").is_file()
+        assert (tmp_path / "metrics.jsonl").is_symlink()
+        assert (tmp_path / "app.log").is_symlink()
+        # compat reads resolve to the run-scoped files
+        rec = json.loads(
+            (tmp_path / "metrics.jsonl").read_text().splitlines()[0])
+        assert rec["kind"] == "round" and rec["run_id"] == lg.run_id
+        assert "line" in (tmp_path / "app.log").read_text()
+        lg.close()
+
+    def test_legacy_regular_file_rotated_not_clobbered(self, tmp_path):
+        from split_learning_tpu.runtime.log import Logger
+        (tmp_path / "metrics.jsonl").write_text('{"old": 1}\n')
+        lg = Logger(tmp_path, console=False, run_scoped=True)
+        lg.metric(kind="round", round_idx=0)
+        assert (tmp_path / "metrics.jsonl").is_symlink()
+        assert (tmp_path / "metrics.jsonl.prev").read_text() \
+            == '{"old": 1}\n'
+        # the new stream holds only the new record
+        assert "old" not in (tmp_path / "metrics.jsonl").read_text()
+        lg.close()
+
+    def test_tracer_journal_shares_run_dir(self, tmp_path):
+        from split_learning_tpu.runtime.log import RUN_ID
+        from split_learning_tpu.runtime.spans import make_tracer
+        cfg = from_dict({"log_path": str(tmp_path)})
+        tr = make_tracer(cfg, "p0")
+        tr.start("x").end()
+        tr.close()
+        run_dir = tmp_path / "artifacts" / "runs" / RUN_ID
+        assert (run_dir / "spans-p0.jsonl").is_file()
+        assert (tmp_path / "spans-p0.jsonl").is_symlink()
+        rec = json.loads((tmp_path / "spans-p0.jsonl")
+                         .read_text().splitlines()[0])
+        assert rec["name"] == "x"
+
+    def test_new_run_takes_over_a_dead_runs_symlink(self, tmp_path):
+        """A later run must re-point the compat link a DEAD previous
+        run left behind — otherwise every run after the first would
+        append into the first run's directory, recreating exactly the
+        cross-run pollution run scoping exists to stop."""
+        import os
+
+        from split_learning_tpu.runtime.log import Logger
+        lg1 = Logger(tmp_path, console=False, run_scoped=True,
+                     run_id="run1")
+        lg1.metric(kind="round", round_idx=0)
+        lg1.close()
+        # simulate the owning process having died
+        (tmp_path / "artifacts" / "runs" / "run1" / ".owner"
+         ).write_text("999999999 run1\n")
+        lg2 = Logger(tmp_path, console=False, run_scoped=True,
+                     run_id="run2")
+        lg2.metric(kind="round", round_idx=0)
+        assert "run2" in os.readlink(tmp_path / "metrics.jsonl")
+        rec = json.loads((tmp_path / "metrics.jsonl")
+                         .read_text().splitlines()[0])
+        assert rec["run_id"] == "run2"
+        # run1's records are untouched in its own directory
+        old = (tmp_path / "artifacts" / "runs" / "run1"
+               / "metrics.jsonl").read_text()
+        assert json.loads(old.splitlines()[0])["run_id"] == "run1"
+        lg2.close()
+
+    def test_live_concurrent_loggers_merge_through_winner(self,
+                                                          tmp_path):
+        """While the owning process is ALIVE, a second logger follows
+        the winner's link — a multi-process deployment keeps one
+        merged metrics stream (what bench and the trace validator
+        read)."""
+        from split_learning_tpu.runtime.log import Logger
+        lg1 = Logger(tmp_path, console=False, run_scoped=True,
+                     run_id="runA")
+        lg2 = Logger(tmp_path, console=False, run_scoped=True,
+                     run_id="runB")   # same (live) pid owns runA
+        lg1.metric(kind="round", round_idx=0)
+        lg2.metric(kind="round", round_idx=1)
+        recs = [json.loads(x) for x in (tmp_path / "metrics.jsonl")
+                .read_text().splitlines()]
+        assert {r["run_id"] for r in recs} == {"runA", "runB"}
+        lg1.close()
+        lg2.close()
+
+    def test_owner_pid_reuse_detected(self, tmp_path):
+        """A recycled pid (reboot, wraparound) must read as DEAD: the
+        stamped start tick no longer matches the live process's."""
+        import os
+
+        from split_learning_tpu.runtime.log import (
+            _owner_alive, write_run_owner,
+        )
+        write_run_owner(tmp_path, "r1")
+        assert _owner_alive(tmp_path) is True
+        # same (live) pid, wrong start tick = a different process
+        (tmp_path / ".owner").write_text(f"{os.getpid()} 12345 r1\n")
+        assert _owner_alive(tmp_path) is False
+
+    def test_flat_layout_when_disabled(self, tmp_path):
+        from split_learning_tpu.runtime.log import Logger
+        cfg = from_dict({"log_path": str(tmp_path),
+                         "observability": {"run_scoped": False}})
+        lg = Logger.for_run(cfg, "server")
+        lg.metric(kind="round", round_idx=0)
+        assert not (tmp_path / "metrics.jsonl").is_symlink()
+        assert (tmp_path / "metrics.jsonl").is_file()
+        lg.close()
+
+
+# --------------------------------------------------------------------------
+# end-to-end: delayed client flagged mid-round (slow)
+# --------------------------------------------------------------------------
+
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_fleet_flags_delayed_client_end_to_end(tmp_path):
+    """ISSUE 7 acceptance: 3-client round, one client's rpc traffic
+    delay-injected.  The FleetMonitor must flag that client
+    (degraded/straggler) mid-round, the round must complete well under
+    the rpc deadline, /metrics must lint clean mid-round, and sl_top
+    must render the live fleet table."""
+    sys.path.insert(0, "tests")
+    from test_chaos import _round_cfg
+
+    from split_learning_tpu.runtime.client import ProtocolClient
+    from split_learning_tpu.runtime.server import ProtocolServer
+    from split_learning_tpu.runtime.telemetry import lint_prometheus
+
+    interval = 0.25
+    cfg = _round_cfg(tmp_path, tmp_path / "cell", observability={
+        "heartbeat_interval": interval, "liveness_timeout": 8.0,
+        "http_port": 0})
+    slow = "client_1_1"
+    chaos = ChaosConfig(enabled=True, seed=5, delay=0.6,
+                        delay_s=8 * interval, queues=("rpc_queue",))
+    bus = InProcTransport()
+    server = ProtocolServer(cfg, transport=bus, client_timeout=300.0)
+    url = server.exporter.url
+    threads = []
+    for stage, count in enumerate(cfg.clients, start=1):
+        for i in range(count):
+            cid = f"client_{stage}_{i}"
+            t = (ChaosTransport(bus, chaos, name=cid)
+                 if cid == slow else bus)
+            c = ProtocolClient(cfg, cid, stage, transport=t)
+            th = threading.Thread(target=c.run, daemon=True)
+            th.start()
+            threads.append(th)
+
+    mid = {"lint": None, "fleet": None}
+    done = threading.Event()
+
+    def poll():
+        while not done.is_set():
+            try:
+                with urllib.request.urlopen(f"{url}/metrics",
+                                            timeout=2) as r:
+                    errs = lint_prometheus(r.read().decode())
+                mid["lint"] = errs if mid["lint"] is None \
+                    else (mid["lint"] + errs)
+                mid["fleet"] = sl_top.fetch_fleet(url)
+            except Exception:  # noqa: BLE001 — see run_chaos poller
+                pass
+            done.wait(0.4)
+
+    poller = threading.Thread(target=poll, daemon=True)
+    poller.start()
+    t0 = time.monotonic()
+    try:
+        res = server.serve()
+    finally:
+        done.set()
+        poller.join(timeout=5)
+    wall = time.monotonic() - t0
+    for th in threads:
+        th.join(timeout=30)
+        assert not th.is_alive()
+
+    assert res.history[0].ok
+    assert wall < 240            # nowhere near the 600 s rpc deadline
+    assert mid["lint"] == []     # /metrics scraped + linted mid-round
+    fleet = server.ctx.fleet.snapshot()
+    flagged = {t["client"] for t in fleet["transitions"]
+               if t["to"] in ("degraded", "straggler")}
+    assert slow in flagged
+    # no healthy client was ever marked lost
+    assert not any(t["to"] == "lost" and t["client"] != slow
+                   for t in fleet["transitions"])
+    # live polling actually saw the fleet mid-round, and sl_top renders
+    assert mid["fleet"] is not None and slow in mid["fleet"]["clients"]
+    out = sl_top.render_fleet(fleet, color=False, source=url)
+    assert slow in out
+    # the round's fleet record landed in metrics.jsonl with the
+    # per-client counters each heartbeat flushed
+    recs = [json.loads(x) for x in
+            (tmp_path / "cell" / "metrics.jsonl")
+            .read_text().splitlines()]
+    fleet_recs = [r for r in recs if r["kind"] == "fleet"]
+    assert fleet_recs and slow in fleet_recs[-1]["fleet"]["clients"]

@@ -215,6 +215,117 @@ def full_round_cell(fault: str, prob: float, seed: int, tmp: str,
     return True, "bit-identical+conformant+traced"
 
 
+def fleet_cell(tmp: str, seed: int = 7) -> tuple[bool, str]:
+    """Live-telemetry chaos cell: a 3-client round (2 feeders + 1
+    head) with one client's rpc traffic delay-injected, heartbeats at
+    a short interval and the HTTP exporter on an ephemeral port.
+    PASSes iff (a) the FleetMonitor marked the delayed client
+    degraded/straggler mid-round AND the round still completed, (b)
+    ``/metrics`` served parseable Prometheus text mid-round (format
+    lint), and (c) ``sl_top``'s renderer produced the fleet table from
+    the live ``/fleet`` snapshot.  Writes ``fleet.json`` (the final
+    snapshot) into the cell dir for CI artifact upload."""
+    import threading as _threading
+    import urllib.request
+
+    sys.path.insert(0, "tests")
+    sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent))
+    import sl_top
+    from test_chaos import _round_cfg  # noqa: E402
+
+    from split_learning_tpu.runtime.client import ProtocolClient
+    from split_learning_tpu.runtime.server import ProtocolServer
+    from split_learning_tpu.runtime.telemetry import lint_prometheus
+
+    interval = 0.25
+    cell_dir = pathlib.Path(tmp) / "fleet"
+    cfg = _round_cfg(pathlib.Path(tmp), cell_dir, observability={
+        "heartbeat_interval": interval, "liveness_timeout": 8.0,
+        "http_port": 0})
+    slow = "client_1_1"
+    # every rpc frame from the slow client (heartbeats included) held
+    # ~8 intervals with p=0.6: fresh-beat gaps blow past the
+    # straggler threshold, and the late arrivals land stale (the
+    # dup/reorder-rejection path) before a fresh burst recovers it
+    slow_chaos = ChaosConfig(enabled=True, seed=seed, delay=0.6,
+                             delay_s=8 * interval,
+                             queues=("rpc_queue",))
+    bus = InProcTransport()
+    fc = FaultCounters()
+    server = ProtocolServer(cfg, transport=bus, client_timeout=300.0)
+    url = server.exporter.url
+    threads = []
+    for stage, count in enumerate(cfg.clients, start=1):
+        for i in range(count):
+            cid = f"client_{stage}_{i}"
+            t = (ChaosTransport(bus, slow_chaos, name=cid, faults=fc)
+                 if cid == slow else bus)
+            client = ProtocolClient(cfg, cid, stage, transport=t)
+            th = _threading.Thread(target=client.run, daemon=True)
+            th.start()
+            threads.append(th)
+
+    scrapes = {"ok": 0, "errs": [], "fleet": None}
+
+    def poll_endpoint():
+        while not done.is_set():
+            try:
+                with urllib.request.urlopen(f"{url}/metrics",
+                                            timeout=2.0) as r:
+                    errs = lint_prometheus(r.read().decode())
+                if errs:
+                    scrapes["errs"] = errs[:3]
+                else:
+                    scrapes["ok"] += 1
+                scrapes["fleet"] = sl_top.fetch_fleet(url)
+            except Exception:  # noqa: BLE001 — a truncated body /
+                # json hiccup mid-teardown must not kill the poller
+                # (only OSError would leave 'ok' forever 0)
+                pass
+            done.wait(0.5)
+
+    done = _threading.Event()
+    poller = _threading.Thread(target=poll_endpoint, daemon=True)
+    poller.start()
+    t0 = time.monotonic()
+    try:
+        res = server.serve()
+    finally:
+        done.set()
+        poller.join(timeout=5)
+    wall = time.monotonic() - t0
+    for th in threads:
+        th.join(timeout=30)
+    # prefer the last LIVE /fleet scrape (proves the endpoint served
+    # mid-round); the in-process snapshot is the fallback view
+    fleet = scrapes["fleet"] or server.ctx.fleet.snapshot()
+    (cell_dir / "fleet.json").write_text(json.dumps(fleet, indent=2))
+    table = sl_top.render_fleet(fleet, color=False, source=url)
+    (cell_dir / "fleet_table.txt").write_text(table + "\n")
+    if not res.history or not res.history[0].ok:
+        return False, "round not ok"
+    if wall > 240:
+        return False, f"round stalled ({wall:.0f}s)"
+    flagged = {t["client"] for t in fleet.get("transitions", ())
+               if t["to"] in ("degraded", "straggler")}
+    if slow not in flagged:
+        return False, f"{slow} never flagged (transitions: "\
+                      f"{fleet.get('transitions')})"
+    if any(t["client"] != slow and t["to"] == "lost"
+           for t in fleet.get("transitions", ())):
+        return False, "healthy client marked lost"
+    if scrapes["errs"]:
+        return False, f"/metrics lint: {scrapes['errs'][0]}"
+    if scrapes["ok"] == 0:
+        return False, "no successful mid-round /metrics scrape"
+    if slow not in table:
+        return False, "sl_top table missing the delayed client"
+    straggled = any(t["client"] == slow and t["to"] == "straggler"
+                    for t in fleet.get("transitions", ()))
+    return True, ("straggler+recovered" if straggled
+                  else "degraded") + f"+{scrapes['ok']}scrapes"
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(
         description="Sweep fault probabilities over seeds; print a "
@@ -237,7 +348,27 @@ def main(argv=None):
                     help="with --full: run cells under this directory "
                          "so spans-*.jsonl / metrics.jsonl / "
                          "trace.json survive for CI artifact upload")
+    ap.add_argument("--fleet", action="store_true",
+                    help="run ONLY the live-telemetry fleet cell: a "
+                         "3-client round with one rpc-delayed client; "
+                         "asserts the FleetMonitor flags it, /metrics "
+                         "lints mid-round, and sl_top renders the "
+                         "/fleet snapshot (writes fleet.json)")
     args = ap.parse_args(argv)
+
+    if args.fleet:
+        if args.artifacts_dir:
+            tmp = args.artifacts_dir
+            pathlib.Path(tmp).mkdir(parents=True, exist_ok=True)
+        else:
+            import tempfile
+            tmp = tempfile.mkdtemp(prefix="chaos_fleet_")
+        t0 = time.monotonic()
+        ok, note = fleet_cell(tmp)
+        dt = time.monotonic() - t0
+        print(f"fleet cell: {'PASS' if ok else 'FAIL'} ({note}) "
+              f"[{dt:.1f}s, artifacts in {tmp}]")
+        return 0 if ok else 1
 
     faults = ["drop", "duplicate", "reorder", "corrupt", "delay",
               "mixed"]

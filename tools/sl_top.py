@@ -1,0 +1,161 @@
+#!/usr/bin/env python
+"""``sl_top`` — live terminal view of the training fleet.
+
+Polls the server's telemetry endpoint (``observability.http-port``,
+``runtime/telemetry.py TelemetryExporter``) for the ``/fleet`` JSON
+snapshot and renders a per-client health table: state, current round,
+EWMA samples/s, straggler score (rate / fleet median), frame-RTT p95,
+cumulative wire MB and heartbeat age.  In watch mode a transient
+scrape failure keeps the last table on screen and retries (a top-style
+monitor must not die on a blip); ``--journal`` reads the server's
+``kind=fleet`` records from a run's ``metrics.jsonl`` instead — the
+post-hoc view of the same data.
+
+    python tools/sl_top.py --url http://127.0.0.1:9090        # live
+    python tools/sl_top.py --url http://127.0.0.1:9090 --once # one shot
+    python tools/sl_top.py --journal artifacts/runs/<run_id>  # tail
+
+Stdlib only (urllib + json): runs anywhere the repo does.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import pathlib
+import sys
+import time
+import urllib.request
+
+_STATE_COLOR = {"healthy": "\033[92m", "degraded": "\033[93m",
+                "straggler": "\033[95m", "lost": "\033[91m"}
+_RESET = "\033[0m"
+
+_COLUMNS = ("CLIENT", "STATE", "ROUND", "SAMPLES", "RATE/s", "SCORE",
+            "RTT p95 ms", "WIRE MB", "AGE s")
+
+
+def fetch_fleet(url: str, timeout: float = 3.0) -> dict:
+    with urllib.request.urlopen(f"{url.rstrip('/')}/fleet",
+                                timeout=timeout) as resp:
+        return json.loads(resp.read().decode())
+
+
+def fleet_from_journal(path: pathlib.Path) -> dict | None:
+    """Latest ``kind=fleet`` record from a metrics.jsonl (or a run
+    directory holding one)."""
+    if path.is_dir():
+        path = path / "metrics.jsonl"
+    if not path.exists():
+        return None
+    latest = None
+    for line in path.read_text().splitlines():
+        try:
+            rec = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        if rec.get("kind") == "fleet" and isinstance(
+                rec.get("fleet"), dict):
+            latest = rec["fleet"]
+    return latest
+
+
+def _fmt(v, nd=1) -> str:
+    if v is None:
+        return "-"
+    if isinstance(v, float):
+        return f"{v:.{nd}f}"
+    return str(v)
+
+
+def render_fleet(fleet: dict, color: bool = True,
+                 source: str = "") -> str:
+    """The fleet table as one string (tested, and reused by --once)."""
+    counts = fleet.get("counts", {})
+    clients = fleet.get("clients", {})
+    head = ("fleet @ " + time.strftime(
+        "%H:%M:%S", time.localtime(fleet.get("t", time.time())))
+        + (f"  [{source}]" if source else "")
+        + "  |  " + " ".join(f"{s}={n}" for s, n in counts.items()))
+    rows = [_COLUMNS]
+    for cid, c in sorted(clients.items()):
+        wire_mb = (c.get("wire_bytes_out") or 0) / 1e6
+        rows.append((
+            cid, c.get("state", "?"), _fmt(c.get("round")),
+            _fmt(c.get("samples")), _fmt(c.get("samples_per_s")),
+            _fmt(c.get("straggler_score"), 2),
+            _fmt(c.get("rtt_p95_ms"), 2),
+            f"{wire_mb:.2f}", _fmt(c.get("age_s")),
+        ))
+    widths = [max(len(str(r[i])) for r in rows)
+              for i in range(len(_COLUMNS))]
+    lines = [head, "-" * (sum(widths) + 2 * (len(widths) - 1))]
+    for ri, row in enumerate(rows):
+        cells = [f"{str(v):<{w}}" for v, w in zip(row, widths)]
+        line = "  ".join(cells)
+        if color and ri > 0:
+            c = _STATE_COLOR.get(row[1])
+            if c:
+                line = f"{c}{line}{_RESET}"
+        lines.append(line)
+    tail = fleet.get("transitions", [])[-5:]
+    if tail:
+        lines.append("")
+        lines.append("recent transitions:")
+        for t in tail:
+            lines.append(f"  {t.get('client')}: {t.get('from')} -> "
+                         f"{t.get('to')} ({t.get('why')})")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="Live fleet telemetry view (polls /fleet, or "
+                    "tails a run's metrics.jsonl).")
+    ap.add_argument("--url", default="http://127.0.0.1:9090",
+                    help="server telemetry endpoint "
+                         "(observability.http-port)")
+    ap.add_argument("--journal", default=None, metavar="DIR",
+                    help="instead of polling: read the latest "
+                         "kind=fleet record from DIR/metrics.jsonl")
+    ap.add_argument("--interval", type=float, default=1.0)
+    ap.add_argument("--once", action="store_true",
+                    help="render one snapshot and exit")
+    ap.add_argument("--no-color", action="store_true")
+    args = ap.parse_args(argv)
+
+    def snap() -> tuple[dict | None, str, str]:
+        if args.journal:
+            return (fleet_from_journal(pathlib.Path(args.journal)),
+                    args.journal, "no kind=fleet record found")
+        try:
+            return fetch_fleet(args.url), args.url, ""
+        except Exception as e:  # noqa: BLE001 — URLError, truncated
+            # body, bad JSON mid-teardown: all just "not reachable now"
+            return None, args.url, str(e)
+
+    last = ""
+    while True:
+        fleet, source, why = snap()
+        if fleet is None and args.once:
+            print(f"sl_top: cannot read {source}: {why}",
+                  file=sys.stderr)
+            return 1
+        if fleet is not None:
+            last = render_fleet(fleet, color=not args.no_color,
+                                source=source)
+            if args.once:
+                print(last)
+                return 0
+            out = last
+        else:
+            # transient blip: keep the last table, keep polling
+            out = (last + "\n\n" if last else "") \
+                + f"[{source} unreachable: {why} — retrying]"
+        sys.stdout.write("\033[2J\033[H" + out + "\n")
+        sys.stdout.flush()
+        time.sleep(args.interval)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
