@@ -84,6 +84,16 @@ HERE = pathlib.Path(__file__).resolve().parent
 CACHE = HERE / ".baseline_cache.json"
 PARTIAL = pathlib.Path(os.environ.get("SLT_BENCH_PARTIAL_PATH",
                                       HERE / ".bench_partial.json"))
+# Machine-readable artifact root (run-scoped like the runtime's
+# observability outputs): the payload lands in
+# {ARTIFACT_ROOT}/artifacts/runs/<run_id>/bench.json plus a flat
+# compat copy at {ARTIFACT_ROOT}/bench.json — BENCH_r05.json's harness
+# shows "parsed": null because until now the payload was only
+# recoverable from the stdout tail.
+ARTIFACT_ROOT = pathlib.Path(os.environ.get("SLT_BENCH_ARTIFACT_DIR",
+                                            HERE))
+#: bench.json payload schema version (bump on breaking change)
+BENCH_SCHEMA_VERSION = 1
 
 # Global wall-clock budget for the WHOLE bench (probe + sections + late
 # recovery), sized under the driver's kill timeout so the orchestrator
@@ -172,6 +182,10 @@ class Artifact:
                             "configs": self.cfgs}
         self.results: dict = {}
         self.emitted = False
+        # run-scoped artifact id (the orchestrator never imports the
+        # package — jax rides its __init__ — so it mints its own)
+        import uuid
+        self.run_id = uuid.uuid4().hex[:12]
 
     def payload(self) -> dict:
         head = self.results.get("headline")
@@ -212,19 +226,35 @@ class Artifact:
             "unit": "samples/sec/chip",
             "vs_baseline": (round(value / self.baseline, 3)
                             if value is not None and self.baseline else None),
+            "schema_version": BENCH_SCHEMA_VERSION,
+            "run_id": self.run_id,
             "extra": self.extra,
         }
 
-    def flush(self) -> None:
+    @staticmethod
+    def _atomic_write(path: pathlib.Path, text: str) -> None:
         # atomic replace: a SIGKILL mid-write (the one kill the signal
         # handlers can't catch, i.e. exactly when this file is the
         # surviving record) must not leave truncated JSON behind
         try:
-            tmp = PARTIAL.with_suffix(".tmp")
-            tmp.write_text(json.dumps(self.payload()))
-            os.replace(tmp, PARTIAL)
+            path.parent.mkdir(parents=True, exist_ok=True)
+            tmp = path.with_suffix(path.suffix + ".tmp")
+            tmp.write_text(text)
+            os.replace(tmp, path)
         except OSError:
             pass
+
+    def flush(self) -> None:
+        text = json.dumps(self.payload())
+        self._atomic_write(PARTIAL, text)
+        # machine-readable artifact (tools/sl_perf.py reads these):
+        # run-scoped file + flat compat copy, refreshed every section
+        # so a killed run still leaves a parseable record of what
+        # completed
+        self._atomic_write(
+            ARTIFACT_ROOT / "artifacts" / "runs" / self.run_id
+            / "bench.json", text)
+        self._atomic_write(ARTIFACT_ROOT / "bench.json", text)
 
     def emit(self) -> None:
         if self.emitted:
@@ -232,17 +262,11 @@ class Artifact:
         self.emitted = True
         print(json.dumps(self.payload()), flush=True)
 
-# Datasheet bf16 peak TFLOP/s per chip, keyed by jax device_kind.
-# v5e: 197 TFLOP/s bf16; v4: 275; v6e: 918 (public TPU spec tables).
-DATASHEET_BF16_TFLOPS = {
-    "TPU v5 lite": 197.0,
-    "TPU v5e": 197.0,
-    "TPU v5": 459.0,  # v5p
-    "TPU v5p": 459.0,
-    "TPU v4": 275.0,
-    "TPU v6 lite": 918.0,
-    "TPU v6e": 918.0,
-}
+# The datasheet bf16 peak table lives with the runtime's perf plane
+# (split_learning_tpu/runtime/perf.py DATASHEET_BF16_TFLOPS) so the
+# bench's MFU section and the live sl_mfu gauge share ONE denominator;
+# imported lazily in the section child (the orchestrator process never
+# imports the package — jax rides its __init__).
 
 
 def log(msg: str) -> None:
@@ -580,9 +604,10 @@ def _sec_headline(ctx: dict) -> dict:
 
 def _sec_mfu(ctx: dict) -> dict:
     import jax
+    from split_learning_tpu.runtime.perf import resolve_peak_tflops
     roofline = measure_matmul_roofline()
     kind = ctx.get("device_kind", "cpu")
-    peak = DATASHEET_BF16_TFLOPS.get(kind)
+    peak = resolve_peak_tflops(kind)
     mfu = {"datasheet_bf16_tflops": peak,
            "measured_matmul_roofline_tflops": round(roofline, 1)}
     head = ctx.get("headline") or {}

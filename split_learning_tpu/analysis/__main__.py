@@ -23,7 +23,8 @@ from split_learning_tpu.analysis.findings import (
     Baseline, Finding, render_human, render_json,
 )
 
-ANALYZERS = ("protocol", "jaxpr", "concurrency", "counters", "codec")
+ANALYZERS = ("protocol", "jaxpr", "concurrency", "counters", "codec",
+             "perf")
 
 
 def repo_root() -> pathlib.Path:
@@ -48,6 +49,9 @@ def run_analyzers(root: pathlib.Path, names=ANALYZERS,
     if "codec" in names:
         from split_learning_tpu.analysis import codec_check
         findings += codec_check.run(root, trace=trace)
+    if "perf" in names:
+        from split_learning_tpu.analysis import perf_check
+        findings += perf_check.run(root)
     return findings
 
 

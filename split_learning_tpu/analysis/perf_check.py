@@ -1,0 +1,142 @@
+"""Perf-plane discipline: device syncs in hot loops must be sampled
+(PF001).
+
+``runtime/perf.py`` measures device time by fencing
+(``jax.block_until_ready``) every ``perf.sample-every``-th step — the
+other steps stay sync-free, which is the whole point: an UNSAMPLED
+fence (or a per-step ``memory_stats()`` / ``live_arrays()`` poll) in a
+hot loop stalls the async dispatch pipeline every tick and silently
+halves throughput, exactly the class of regression the jaxpr auditor's
+JX001 exists for.  JX001 flags syncs applied to jitted results; this
+analyzer closes the remaining gap: it holds every
+``block_until_ready`` / ``memory_stats`` / ``live_buffers`` /
+``live_arrays`` call inside a hot region to the sampler discipline —
+the call must sit under an ``if`` whose condition names the sampler
+(``...sampled...``), or carry an explicit ``# slcheck: sampled-gate``
+annotation for audited exceptions.
+
+Scanned regions: the jaxpr auditor's hot-function registry
+(``client.py`` tick loops, ``context.py _drive_columns``) plus the
+perf plane's own step path (``perf.py SampledStepTimer.note_step`` — scanned
+in ``all`` mode precisely so the repo's one legitimate hot-loop fence
+is PROVEN to sit behind the gate, not just assumed to).
+"""
+
+from __future__ import annotations
+
+import ast
+import pathlib
+import re
+
+from split_learning_tpu.analysis.findings import Finding
+from split_learning_tpu.analysis.jaxpr_audit import HOT_FUNCTIONS
+
+#: device-sync / device-introspection calls the sampler must gate
+SYNC_NAMES = frozenset({"block_until_ready", "memory_stats",
+                        "live_buffers", "live_arrays"})
+
+#: perf.py's own step path: "all" mode (the whole body is hot — it
+#: runs once per training step)
+PERF_HOT = {
+    "split_learning_tpu/runtime/perf.py": {"note_step": "all"},
+}
+
+_ANNOT_RE = re.compile(r"#\s*slcheck:\s*(.+?)\s*$")
+
+
+def _annotated(lines: list[str], lineno: int, tag: str) -> bool:
+    if 0 < lineno <= len(lines):
+        m = _ANNOT_RE.search(lines[lineno - 1])
+        return bool(m and tag in m.group(1))
+    return False
+
+
+class _Visitor(ast.NodeVisitor):
+    """Flag ungated sync calls inside the hot region of one function."""
+
+    def __init__(self, rel: str, fn_name: str, mode: str,
+                 lines: list[str]):
+        self.rel = rel
+        self.fn_name = fn_name
+        self.mode = mode
+        self.lines = lines
+        self.loop_depth = 0
+        self.gate_depth = 0
+        self.findings: list[Finding] = []
+
+    def _visit_loop(self, node):
+        self.loop_depth += 1
+        self.generic_visit(node)
+        self.loop_depth -= 1
+
+    visit_For = visit_While = _visit_loop
+
+    def visit_If(self, node: ast.If):
+        # only the branch that runs WHEN the sampler fired is gated:
+        # `if ...sampled...:` gates its body, `if not ...sampled...:`
+        # gates its else — the other branch runs every unsampled step
+        # and must stay sync-free.  A sync in the test itself is never
+        # gated (it evaluates on every step).
+        inverted = (isinstance(node.test, ast.UnaryOp)
+                    and isinstance(node.test.op, ast.Not)
+                    and "sampled" in ast.unparse(node.test.operand))
+        body_gated = (not inverted
+                      and "sampled" in ast.unparse(node.test))
+        self.visit(node.test)
+        for branch, gated in ((node.body, body_gated),
+                              (node.orelse, inverted)):
+            if gated:
+                self.gate_depth += 1
+            for child in branch:
+                self.visit(child)
+            if gated:
+                self.gate_depth -= 1
+
+    def _hot(self) -> bool:
+        return self.mode == "all" or self.loop_depth > 0
+
+    def visit_Call(self, node: ast.Call):
+        f = node.func
+        name = (f.attr if isinstance(f, ast.Attribute)
+                else f.id if isinstance(f, ast.Name) else None)
+        if (name in SYNC_NAMES and self._hot()
+                and self.gate_depth == 0
+                and not _annotated(self.lines, node.lineno,
+                                   "sampled-gate")):
+            self.findings.append(Finding(
+                "PF001", self.rel, node.lineno, self.fn_name,
+                f"unsampled {name}() in a hot loop: device syncs must "
+                "sit behind the perf sampler gate (an `if ...sampled` "
+                "guard, runtime/perf.py SampledStepTimer) or carry "
+                "`# slcheck: sampled-gate`"))
+        self.generic_visit(node)
+
+
+def scan_source(source: str, rel: str,
+                funcs: dict[str, str]) -> list[Finding]:
+    """PF001 findings for the named functions of one source file."""
+    findings: list[Finding] = []
+    lines = source.splitlines()
+    tree = ast.parse(source)
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                and node.name in funcs:
+            v = _Visitor(rel, node.name, funcs[node.name], lines)
+            v.visit(node)
+            findings += v.findings
+    return findings
+
+
+def run(root: pathlib.Path) -> list[Finding]:
+    findings: list[Finding] = []
+    regions: dict[str, dict[str, str]] = {}
+    for rel, funcs in list(HOT_FUNCTIONS.items()) + list(PERF_HOT.items()):
+        regions.setdefault(rel, {}).update(funcs)
+    for rel, funcs in sorted(regions.items()):
+        path = root / rel
+        try:
+            source = path.read_text()
+        except OSError:
+            continue
+        findings += scan_source(source, rel, funcs)
+    return findings

@@ -440,6 +440,41 @@ class ObservabilityConfig:
 
 
 @dataclasses.dataclass(frozen=True)
+class PerfConfig:
+    """Compute performance-attribution plane (``runtime/perf.py``).
+
+    ``sample-every`` is the step-sampling period of the hot-loop
+    device fence: every Nth step is ``block_until_ready``-fenced to
+    measure device wall (the other N-1 steps stay sync-free — the
+    ``perf`` slcheck analyzer enforces that discipline statically).
+    ``profile-dir`` overrides where on-demand ``POST /profile``
+    captures land (default: the run-scoped output directory's
+    ``profile/``).  ``datasheet`` overrides/extends the built-in
+    per-``device_kind`` bf16 peak-TFLOPs table used as the MFU
+    denominator — the supported way to pin a measured CPU roofline,
+    e.g. ``datasheet: {cpu: 0.1}``."""
+    enabled: bool = True
+    sample_every: int = 16
+    profile_dir: str | None = None
+    datasheet: Any = None               # {device_kind: peak bf16 TFLOPs}
+
+    def validate(self):
+        _check(self.sample_every >= 1,
+               "perf.sample-every must be >= 1")
+        if self.datasheet is not None:
+            _check(isinstance(self.datasheet, dict)
+                   and all(isinstance(k, str) for k in self.datasheet),
+                   "perf.datasheet must map device_kind -> TFLOPs")
+            for k, v in self.datasheet.items():
+                try:
+                    ok = float(v) > 0
+                except (TypeError, ValueError):
+                    ok = False
+                _check(ok, f"perf.datasheet[{k!r}] must be a positive "
+                           f"number, got {v!r}")
+
+
+@dataclasses.dataclass(frozen=True)
 class Config:
     model: str = "VGG16"
     dataset: str = "CIFAR10"
@@ -467,6 +502,7 @@ class Config:
     transport: TransportConfig = TransportConfig()
     chaos: ChaosConfig = ChaosConfig()
     observability: ObservabilityConfig = ObservabilityConfig()
+    perf: PerfConfig = PerfConfig()
 
     @property
     def model_key(self) -> str:
@@ -486,7 +522,7 @@ class Config:
                f"got {self.compute_dtype!r}")
         for sub in (self.learning, self.distribution, self.topology,
                     self.aggregation, self.transport, self.chaos,
-                    self.observability):
+                    self.observability, self.perf):
             sub.validate()
         if self.topology.mode == "manual":
             cuts = self.topology.cluster_cut_layers or (
@@ -508,6 +544,7 @@ _SECTION_TYPES = {
     "transport": TransportConfig,
     "chaos": ChaosConfig,
     "observability": ObservabilityConfig,
+    "perf": PerfConfig,
 }
 
 

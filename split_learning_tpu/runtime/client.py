@@ -439,6 +439,23 @@ class ProtocolClient:
             faults=self.faults, wire=self.wire, hists=self.hists,
             gauges=self.gauges,
             samples_fn=lambda: self.num_samples)
+        # compute performance-attribution plane (runtime/perf.py):
+        # sampled step timing (device fence only every
+        # perf.sample-every steps), compile/retrace accounting on the
+        # runner's jitted ops, HBM watermarks, MFU — emitted as one
+        # kind=perf record per round and ridden on heartbeats as gauges
+        from split_learning_tpu.runtime.perf import (
+            make_perf_plane, process_capture,
+        )
+        # process_capture() is non-None only when this client shares
+        # the server's process (in-proc cells): its hot-loop ticks then
+        # close a POST /profile steps=K window after K steps.  Separate
+        # client processes get None — the round boundary closes the
+        # window there (it profiles the server process).
+        self.perf = make_perf_plane(
+            cfg, client_id, gauges=self.gauges, hists=self.hists,
+            faults=self.faults, tracer=self.tracer, log=self.log,
+            capture=process_capture())
         self.runner: ShardRunner | None = None
         self.frozen: dict = {}
         self.trainable: dict = {}
@@ -753,6 +770,7 @@ class ProtocolClient:
                     model_kwargs=dict(self.cfg.model_kwargs or {}),
                     seed=self.cfg.seed
                     + zlib.crc32(self.client_id.encode()) % 100000)
+                self.perf.wrap_runner(self.runner)
                 self.opt_state = self.runner.optimizer.init(self.trainable)
                 self.log.info("hyperparams changed: rebuilt runner "
                               "(weights kept)")
@@ -783,6 +801,9 @@ class ProtocolClient:
             msg.learning, model_kwargs=model_kwargs,
             seed=self.cfg.seed
             + zlib.crc32(self.client_id.encode()) % 100000)
+        # compile/retrace accounting on the five jitted ops (instance
+        # attributes only; the shared _OPS_CACHE bundle is untouched)
+        self.perf.wrap_runner(self.runner)
         if self.codecs.get("rpc") is not None \
                 and self._delta_advert is not None:
             # base = the shard EXACTLY as received (the server's shadow
@@ -836,6 +857,10 @@ class ProtocolClient:
         self.round_idx = msg.round_idx
         self.num_samples = 0
         self.gauges.set("round", msg.round_idx)
+        # perf plane round window: SYN -> UPDATE published.  The
+        # attribution record's components (compute|compile|dispatch|
+        # host|wait) sum to this window's wall by construction.
+        self.perf.start_round(msg.round_idx)
         # responsive-set overrides (server recomputes after the READY
         # barrier): a dropped previous-stage client must not leave this
         # client waiting on fence copies that will never arrive
@@ -860,6 +885,13 @@ class ProtocolClient:
             else:
                 pause = self._train_middle()
             if isinstance(pause, _AbortPause):
+                # close the perf window (no record emitted mid-abort
+                # confusion is avoided by still journaling what ran)
+                rec = self.perf.end_round(samples=self.num_samples)
+                if rec:
+                    self.log.metric(kind="perf", client=self.client_id,
+                                    round_idx=msg.round_idx,
+                                    aborted=True, **rec)
                 self.tracer.flush()
                 return   # round abandoned: the server stopped counting us
             if pause is not None and not pause.send_weights:
@@ -870,6 +902,12 @@ class ProtocolClient:
                 self._send_update(with_weights=False)
             else:
                 self._send_update()
+            # close the perf window INSIDE the client_round span so the
+            # record's wall matches what the trace shows for this round
+            rec = self.perf.end_round(samples=self.num_samples)
+            if rec:
+                self.log.metric(kind="perf", client=self.client_id,
+                                round_idx=msg.round_idx, **rec)
         # a finished round's spans must be durable even if the process
         # dies while idle between rounds
         self.tracer.flush()
@@ -998,12 +1036,22 @@ class ProtocolClient:
     def _train_whole(self) -> Pause:
         r = self.runner
         for _ in range(self.epochs):
-            for x, labels in self.loader:
+            data_iter = iter(self.loader)
+            while True:
+                # loader fetch + host->device conversion land in the
+                # perf plane's host-data attribution component
+                with self.perf.host():
+                    item = next(data_iter, None)
+                    if item is not None:
+                        x, labels = item
+                        xd = jnp.asarray(x)
+                        yd = jnp.asarray(labels.astype(np.int32))
+                if item is None:
+                    break
                 t_sp = time.perf_counter()
                 loss, grads, self.stats = r.whole_step(
                     self.frozen, self.trainable, self.stats,
-                    jnp.asarray(x),
-                    jnp.asarray(labels.astype(np.int32)), r.next_rng())
+                    xd, yd, r.next_rng())
                 # folded on DEVICE; synced once in _send_update — a
                 # bool() here would stall the loop every batch
                 self._ok_dev = jnp.logical_and(self._ok_dev,
@@ -1011,6 +1059,10 @@ class ProtocolClient:
                 self.trainable, self.opt_state = r.apply_update(
                     self.trainable, self.opt_state, grads)
                 self.hists.observe("step", time.perf_counter() - t_sp)
+                # sampled device fence lives INSIDE the perf plane
+                # (runtime/perf.py SampledStepTimer), behind the sampler gate
+                self.perf.note_step(t_sp, (loss, self.trainable),
+                                    n=len(labels))
                 self.num_samples += len(labels)
         self.bus.publish(RPC_QUEUE, encode(Notify(
             client_id=self.client_id, cluster=self.cluster,
@@ -1046,7 +1098,8 @@ class ProtocolClient:
             # dispatch, not when the in-flight cap next frees — with a
             # strict head holding this feeder's batches, the cap never
             # frees until the fence goes out
-            next_item = next(data_iter, None)
+            with self.perf.host():
+                next_item = next(data_iter, None)
             exhausted = next_item is None
             if exhausted:
                 fence_epoch(ep)   # empty loader: fence immediately
@@ -1070,6 +1123,8 @@ class ProtocolClient:
                     sp.end()
                     self.hists.observe("step",
                                        time.perf_counter() - t_sp)
+                    self.perf.note_step(t_sp, (self.trainable,),
+                                        n=ent.n)
                     n_bwd += 1
                     # counted here, not at dispatch: a mid-loop PAUSE
                     # abandons in-flight forwards, and the FedAvg weight
@@ -1091,8 +1146,9 @@ class ProtocolClient:
                         return pause
                     continue
                 x, labels = next_item
-                next_item = next(data_iter, None)
-                x = jnp.asarray(x)
+                with self.perf.host():
+                    next_item = next(data_iter, None)
+                    x = jnp.asarray(x)
                 rng = r.next_rng()
                 out_q = out_qs[n_fwd % len(out_qs)]
                 sp = self.tracer.start("fwd", always=False,
@@ -1190,6 +1246,7 @@ class ProtocolClient:
                     self.trainable, self.opt_state, gt)
                 sp.end()
                 self.hists.observe("step", time.perf_counter() - t_sp)
+                self.perf.note_step(t_sp, (self.trainable,), n=ent.n)
                 self.num_samples += ent.n   # see _train_first
                 origin = ent.trace[-1]
                 grad_out_q = gradient_queue(self.stage - 1, origin)
@@ -1428,6 +1485,8 @@ class ProtocolClient:
             self.trainable, self.opt_state, gt)
         sp.end()
         self.hists.observe("step", time.perf_counter() - t_sp)
+        self.perf.note_step(t_sp, (loss, self.trainable),
+                            n=int(sum(sizes)))
         self.num_samples += int(sum(sizes))
         grad_codec = self.codecs.get("gradient")
         if grad_codec is None:

@@ -83,6 +83,20 @@ def run_training(cfg: Config, ctx: TrainContext,
 
     history: list[RoundRecord] = []
     timer = StepTimer()
+    # compute-attribution plane (runtime/perf.py): the server side
+    # tracks per-round HBM watermarks and drives the on-demand
+    # profiler window the exporter's POST /profile armed (protocol
+    # clients attribute their own hot loops and emit their own
+    # kind=perf records; this one covers the server process)
+    from split_learning_tpu.runtime.perf import MemoryWatch, perf_enabled
+    # honor the plane's off switch server-side too: `perf: {enabled:
+    # false}` must silence the per-round memory_stats()/live_arrays
+    # walk and the kind=perf record stream, not just the client half
+    # (the on-demand profiler capture stays independent — POST
+    # /profile is its own opt-in)
+    memwatch = (MemoryWatch(gauges=getattr(ctx, "gauges", None))
+                if perf_enabled(cfg) else None)
+    capture = getattr(ctx, "perf_capture", None)
     t_start = time.perf_counter()
     # one-slot async checkpoint writer: the save overlaps the next
     # round's training instead of blocking the loop (params trees are
@@ -104,6 +118,12 @@ def run_training(cfg: Config, ctx: TrainContext,
                             f"cuts={plan.cuts} clients="
                             f"{[len(ids) for ids in plan.clients]}",
                             "cyan")
+            if capture is not None:
+                # armed via POST /profile: the window opens at this
+                # round boundary and closes at the round's end (in the
+                # in-process mesh it covers the compiled steps; in
+                # protocol mode it profiles the server process)
+                capture.maybe_start(r)
             # one span per round, with the loop phases as
             # children: the per-round anchor the critical-path
             # walker (tools/sl_trace.py) starts from
@@ -126,6 +146,9 @@ def run_training(cfg: Config, ctx: TrainContext,
                                   **dataclasses.asdict(rec),
                                   phases=timer.summary())
                     timer.reset()  # don't leak this round's time onward
+                    if capture is not None:
+                        capture.stop()   # a failed round still lands
+                                         # its profile artifact
                     # the failed round is the one an operator debugs:
                     # its spans must hit disk like a clean round's (the
                     # continue below skips the loop-tail flush; end()
@@ -169,6 +192,19 @@ def run_training(cfg: Config, ctx: TrainContext,
                               **({"train_detail": outcome.metrics}
                                  if outcome.metrics else {}))
                 timer.reset()
+            if capture is not None:
+                capture.stop()
+            if memwatch is not None:
+                try:
+                    memwatch.sample()
+                except Exception:  # noqa: BLE001 — watermark best-effort
+                    pass
+                # server-side kind=perf record: round wall + HBM
+                # watermark (protocol clients emit their own
+                # attribution records)
+                logger.metric(kind="perf", round_idx=r, v=1,
+                              wall_s=round(rec.wall_s, 6),
+                              **memwatch.snapshot())
             tracer.flush()
             if cfg.limited_time and (time.perf_counter() - t_start
                                      > cfg.limited_time):
@@ -176,6 +212,11 @@ def run_training(cfg: Config, ctx: TrainContext,
                                f"exhausted at round {r}.")
                 break
     finally:
+        # an exception escaping the loop must not leave the
+        # process-global jax profiler tracing (start_trace would then
+        # fail forever after) — stop() is idempotent on a closed window
+        if capture is not None:
+            capture.stop()
         # drain on EVERY exit: a crash mid-round must still surface a
         # failed background save and join the worker thread (the
         # protocol server calls run_training repeatedly in-process)

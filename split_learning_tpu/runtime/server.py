@@ -888,6 +888,21 @@ class ProtocolServer:
         # sees current health states, not the last pump's.
         self.exporter = None
         obs = getattr(cfg, "observability", None)
+        # on-demand profiler hook (runtime/perf.py): POST /profile
+        # arms a jax.profiler window the round loop opens at the next
+        # round boundary; artifact lands under the run-scoped
+        # profile/ directory.  Attached to the context so run_training
+        # drives the window whatever backend is underneath.
+        from split_learning_tpu.runtime.perf import (
+            ProfileCapture, profile_output_dir, register_process_capture,
+        )
+        self.ctx.perf_capture = ProfileCapture(
+            profile_output_dir(cfg, self.log), log=self.log)
+        # in-process cells (client threads sharing this process) tick
+        # this capture from their hot loops, closing a steps=K window
+        # after K steps; separate client processes can't — there the
+        # round boundary closes it (see register_process_capture)
+        register_process_capture(self.ctx.perf_capture)
         if obs is not None and obs.http_port is not None:
             from split_learning_tpu.runtime.telemetry import (
                 TelemetryExporter, render_prometheus,
@@ -909,9 +924,11 @@ class ProtocolServer:
                 return ctx.fleet.snapshot()
 
             self.exporter = TelemetryExporter(
-                _metrics, _fleet, port=int(obs.http_port)).start()
-            self.log.info("telemetry: serving /metrics and /fleet on "
-                          f"{self.exporter.url}", "cyan")
+                _metrics, _fleet, port=int(obs.http_port),
+                profile_fn=self.ctx.perf_capture.arm).start()
+            self.log.info("telemetry: serving /metrics, /fleet and "
+                          f"POST /profile on {self.exporter.url}",
+                          "cyan")
 
     def serve(self) -> TrainResult:
         from split_learning_tpu.parallel.multihost import (
@@ -929,6 +946,13 @@ class ProtocolServer:
             result = run_training(self.cfg, self.ctx, plans, self.log)
         finally:
             self.ctx.stop_all()
+            from split_learning_tpu.runtime.perf import (
+                process_capture, register_process_capture,
+            )
+            # only clear our own registration: a newer server in this
+            # process may already have registered its capture
+            if process_capture() is self.ctx.perf_capture:
+                register_process_capture(None)
             if self.exporter is not None:
                 self.exporter.close()
         return result

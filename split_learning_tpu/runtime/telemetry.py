@@ -285,6 +285,7 @@ class _ClientHealth:
     counters: dict = dataclasses.field(default_factory=dict)
     wire: dict = dataclasses.field(default_factory=dict)
     latency: dict = dataclasses.field(default_factory=dict)
+    gauges: dict = dataclasses.field(default_factory=dict)
     series: collections.deque = dataclasses.field(
         default_factory=lambda: collections.deque(maxlen=HISTORY))
 
@@ -403,6 +404,12 @@ class FleetMonitor:
                 h.wire = dict(snap.wire)
             if snap.latency:
                 h.latency = dict(snap.latency)
+            if snap.gauges:
+                # the perf plane's gauges (mfu, compute rate, compile
+                # seconds, HBM peak) ride every snapshot — what lets
+                # the monitor and sl_top tell compute-slow from
+                # wire-slow without another wire frame
+                h.gauges = dict(snap.gauges)
             h.series.append((round(now, 3), h.rate, h.samples))
             if h.state == "lost":
                 self._transition(cid, h, "degraded", "fresh heartbeat",
@@ -432,6 +439,20 @@ class FleetMonitor:
             else:
                 self._log.warning(line)
 
+    @staticmethod
+    def _rate_why(h: _ClientHealth, cmed: float | None) -> str:
+        """Attribute a rate-scored straggler transition: a client whose
+        COMPUTE rate (samples over device-busy seconds, perf-plane
+        gauge) also trails the fleet is compute-slow; one whose compute
+        rate is healthy is losing its round to the wire."""
+        crate = h.gauges.get("compute_samples_per_s")
+        if not crate or not cmed:
+            return ""
+        if crate < FleetMonitor.STRAGGLER_SCORE * cmed:
+            return (f" (compute-slow: {crate:.1f}/s device rate vs "
+                    f"fleet {cmed:.1f}/s)")
+        return (f" (wire-slow: device rate healthy at {crate:.1f}/s)")
+
     def advance(self, now: float | None = None) -> frozenset:
         """Re-evaluate every client's time/rate-driven transitions;
         returns the current ``lost`` set (what barriers may drop)."""
@@ -446,6 +467,14 @@ class FleetMonitor:
             rates = [h.rate for h in self._clients.values()
                      if h.rate and h.state != "lost"]
             med = statistics.median(rates) if rates else None
+            # compute-rate median (perf-plane gauge riding heartbeats):
+            # the second axis that tells a compute-slow straggler from
+            # a wire-slow one in the transition journal
+            crates = [h.gauges.get("compute_samples_per_s")
+                      for h in self._clients.values()
+                      if h.gauges.get("compute_samples_per_s")
+                      and h.state != "lost"]
+            cmed = statistics.median(crates) if crates else None
             lost = set()
             for cid, h in self._clients.items():
                 age = now - h.last_seen
@@ -465,7 +494,8 @@ class FleetMonitor:
                 elif age > self.STRAGGLER_MISSES * self.interval:
                     self._transition(
                         cid, h, "straggler",
-                        f"missed heartbeats ({age:.1f}s silent)", now)
+                        f"wire-silent: missed heartbeats "
+                        f"({age:.1f}s silent)", now)
                 elif age > self.DEGRADED_MISSES * self.interval:
                     if h.state == "healthy":
                         self._transition(cid, h, "degraded",
@@ -476,7 +506,7 @@ class FleetMonitor:
                     self._transition(
                         cid, h, "straggler",
                         f"rate {h.rate:.1f}/s is {h.score:.2f}x the "
-                        "fleet median", now)
+                        "fleet median" + self._rate_why(h, cmed), now)
                 elif h.state in ("degraded", "straggler"):
                     if h.score is None or h.score >= self.RECOVER_SCORE:
                         self._transition(cid, h, "healthy",
@@ -521,6 +551,8 @@ class FleetMonitor:
             clients = {}
             for cid, h in sorted(self._clients.items()):
                 rtt = (h.latency.get("frame_rtt") or {})
+                step = (h.latency.get("step_device")
+                        or h.latency.get("step") or {})
                 clients[cid] = {
                     "state": h.state,
                     "age_s": round(max(0.0, now - h.last_seen), 3),
@@ -530,6 +562,14 @@ class FleetMonitor:
                     "straggler_score": h.score,
                     "rtt_p95_ms": rtt.get("p95_ms"),
                     "wire_bytes_out": h.wire.get("bytes_out_total"),
+                    # perf-plane gauges (runtime/perf.py), ridden in on
+                    # heartbeats; absent for clients predating the
+                    # plane — consumers render "-"
+                    "mfu": h.gauges.get("mfu"),
+                    "step_p95_ms": step.get("p95_ms"),
+                    "compute_samples_per_s":
+                        h.gauges.get("compute_samples_per_s"),
+                    "hbm_peak_bytes": h.gauges.get("hbm_peak_bytes"),
                     "counters": dict(h.counters),
                     "series": [list(x) for x in h.series][-32:],
                 }
@@ -553,6 +593,20 @@ def _esc(v: Any) -> str:
     """Escape one label value per the text-format spec."""
     return (str(v).replace("\\", r"\\").replace("\n", r"\n")
             .replace('"', r'\"'))
+
+
+#: perf-plane gauge -> dedicated /metrics family (runtime/perf.py):
+#: (gauge name, metric name, type, help)
+_PERF_FAMILIES = (
+    ("mfu", "sl_mfu", "gauge",
+     "Model-FLOPs utilization vs the datasheet bf16 peak."),
+    ("step_seconds", "sl_step_seconds", "gauge",
+     "Wall seconds of the last sampled (device-fenced) step."),
+    ("hbm_peak_bytes", "sl_hbm_peak_bytes", "gauge",
+     "Peak device memory bytes observed this round."),
+    ("compile_seconds_total", "sl_compile_seconds_total", "counter",
+     "Cumulative XLA compile wall-clock seconds."),
+)
 
 
 def _sample(name: str, labels: dict, value: Any) -> str:
@@ -579,10 +633,16 @@ def render_prometheus(fleet: FleetMonitor | None = None, faults=None,
         out.extend(samples)
 
     if faults is not None:
+        fsnap = faults.snapshot()
         family("sl_faults_total", "counter",
                "Cumulative fault/recovery counters (runtime/trace.py).",
                [_sample("sl_faults_total", {"name": k}, v)
-                for k, v in sorted(faults.snapshot().items())])
+                for k, v in sorted(fsnap.items())])
+        family("sl_retraces_total", "counter",
+               "Compiles observed after round 0 (runtime/perf.py "
+               "CompileWatch — the live JX004 retrace rule).",
+               [_sample("sl_retraces_total", {},
+                        fsnap.get("retraces", 0))])
     if wire is not None:
         w = wire.snapshot()
         family("sl_wire_bytes_total", "counter",
@@ -598,11 +658,19 @@ def render_prometheus(fleet: FleetMonitor | None = None, faults=None,
                 _sample("sl_wire_messages_total", {"direction": "in"},
                         w.get("msgs_in", 0))])
     if gauges is not None:
+        gsnap = gauges.snapshot()
         family("sl_gauge", "gauge",
                "Last-value gauges (runtime/trace.py GAUGE_NAMES).",
                [_sample("sl_gauge", {"name": k}, v)
-                for k, v in sorted(gauges.snapshot().items())
+                for k, v in sorted(gsnap.items())
                 if k in GAUGE_NAMES and _finite(v)])
+        # perf-plane gauges additionally published under dedicated
+        # names (runtime/perf.py; the compute half of the compute/wire
+        # ratio the scheduler consumes)
+        for gname, mname, kind, help_ in _PERF_FAMILIES:
+            v = gsnap.get(gname)
+            if v is not None and _finite(v):
+                family(mname, kind, help_, [_sample(mname, {}, v)])
     if hists is not None:
         h = hists.snapshot()
         q_samples, n_samples = [], []
@@ -628,6 +696,7 @@ def render_prometheus(fleet: FleetMonitor | None = None, faults=None,
         family("sl_fleet_clients", "gauge",
                "Clients per health state.", by_state)
         up, code, rate, score, age = [], [], [], [], []
+        mfu, crate = [], []
         for cid, c in sorted(snap["clients"].items()):
             lbl = {"client": cid}
             up.append(_sample("sl_client_up", lbl,
@@ -640,6 +709,12 @@ def render_prometheus(fleet: FleetMonitor | None = None, faults=None,
             if c["straggler_score"] is not None:
                 score.append(_sample("sl_client_straggler_score", lbl,
                                      c["straggler_score"]))
+            if c.get("mfu") is not None:
+                mfu.append(_sample("sl_client_mfu", lbl, c["mfu"]))
+            if c.get("compute_samples_per_s") is not None:
+                crate.append(_sample(
+                    "sl_client_compute_samples_per_second", lbl,
+                    c["compute_samples_per_s"]))
             age.append(_sample("sl_client_heartbeat_age_seconds", lbl,
                                c["age_s"]))
         family("sl_client_up", "gauge",
@@ -650,6 +725,10 @@ def render_prometheus(fleet: FleetMonitor | None = None, faults=None,
                "EWMA training throughput per client.", rate)
         family("sl_client_straggler_score", "gauge",
                "Client rate / fleet median (lower is slower).", score)
+        family("sl_client_mfu", "gauge",
+               "Per-client model-FLOPs utilization (perf plane).", mfu)
+        family("sl_client_compute_samples_per_second", "gauge",
+               "Per-client samples/s over device-busy time.", crate)
         family("sl_client_heartbeat_age_seconds", "gauge",
                "Seconds since the last fresh frame.", age)
     return "\n".join(out) + ("\n" if out else "")
@@ -763,13 +842,17 @@ def lint_prometheus(text: str) -> list[str]:
 
 class TelemetryExporter:
     """Stdlib HTTP thread serving ``/metrics`` (Prometheus text,
-    ``text/plain; version=0.0.4``) and ``/fleet`` (JSON snapshot).
-    Callbacks run on the handler threads — keep them lock-cheap (the
-    FleetMonitor/registries are all internally locked)."""
+    ``text/plain; version=0.0.4``) and ``/fleet`` (JSON snapshot),
+    plus ``POST /profile?steps=K`` when a ``profile_fn`` is wired
+    (arms the perf plane's on-demand ``jax.profiler`` capture,
+    ``runtime/perf.py ProfileCapture.arm``).  Callbacks run on the
+    handler threads — keep them lock-cheap (the FleetMonitor/
+    registries are all internally locked; ``arm`` only flips state)."""
 
     def __init__(self, metrics_fn: Callable[[], str],
                  fleet_fn: Callable[[], dict],
-                 host: str = "127.0.0.1", port: int = 0):
+                 host: str = "127.0.0.1", port: int = 0,
+                 profile_fn: Callable[[int], dict] | None = None):
         import http.server
 
         exporter = self
@@ -796,11 +879,35 @@ class TelemetryExporter:
                 self.end_headers()
                 self.wfile.write(body)
 
+            def do_POST(self):  # noqa: N802 — stdlib API
+                path, _, query = self.path.partition("?")
+                if path != "/profile" or exporter._profile_fn is None:
+                    self.send_error(404)
+                    return
+                try:
+                    import urllib.parse
+                    q = urllib.parse.parse_qs(query)
+                    steps = int(q.get("steps", ["1"])[0])
+                    body = json.dumps(
+                        exporter._profile_fn(steps)).encode()
+                except (ValueError, TypeError):
+                    self.send_error(400, "steps must be an integer")
+                    return
+                except Exception as e:  # noqa: BLE001 — see do_GET
+                    self.send_error(500, str(e)[:100])
+                    return
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
             def log_message(self, *a):   # scrapes must not spam stderr
                 pass
 
         self._metrics_fn = metrics_fn
         self._fleet_fn = fleet_fn
+        self._profile_fn = profile_fn
         self._httpd = http.server.ThreadingHTTPServer((host, port),
                                                       _Handler)
         self._httpd.daemon_threads = True

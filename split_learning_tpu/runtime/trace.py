@@ -65,6 +65,10 @@ FAULT_COUNTER_NAMES = frozenset({
     # codec's fold/full-frame/version-gap outcomes
     "quant_nonfinite", "topk_dense_fallbacks",
     "delta_folds", "delta_full_frames", "delta_resyncs",
+    # performance-attribution plane (runtime/perf.py CompileWatch):
+    # compiles observed after round 0 — the live twin of slcheck's
+    # static retrace rule; rendered as sl_retraces_total on /metrics
+    "retraces",
 })
 
 #: Declared registry of latency-histogram names (same contract as
@@ -76,6 +80,10 @@ HISTOGRAM_NAMES = frozenset({
     "step",            # one hot-loop training step (bwd+apply / window)
     "encode",          # frame encode (device fetch + TENSOR framing)
     "decode",          # frame decode (assembler feed)
+    # performance-attribution plane (runtime/perf.py StepTimer):
+    # per-step dispatch wall (every step) and dispatch+device wall
+    # (sampled steps only — the fenced ones)
+    "step_dispatch", "step_device",
 })
 
 #: Declared registry of gauge names (``runtime/telemetry.py GaugeSet``;
@@ -89,6 +97,12 @@ GAUGE_NAMES = frozenset({
     "epoch",           # current local epoch within the round
     "inflight",        # stage-1 1F1B in-flight window depth
     "samples_per_s",   # EWMA training throughput (emitter tick)
+    # performance-attribution plane (runtime/perf.py): model-FLOPs
+    # utilization vs the datasheet peak, last sampled step's wall,
+    # peak device bytes, cumulative compile wall, and samples/s over
+    # device-busy time (distinguishes slow-compute from slow-wire)
+    "mfu", "step_seconds", "hbm_peak_bytes", "compile_seconds_total",
+    "compute_samples_per_s",
     # server-side (set by the FleetMonitor on every advance)
     "fleet_size", "fleet_healthy", "fleet_degraded",
     "fleet_straggler", "fleet_lost",

@@ -382,7 +382,9 @@ def _run_bench_main(env_extra, tmp_path, kill_when_started=False,
     env = os.environ.copy()
     env.update({"JAX_PLATFORMS": "cpu", "SLT_BENCH_FAKE_BASELINE": "100",
                 "SLT_BENCH_FAST_PROBE": "1",
-                "SLT_BENCH_PARTIAL_PATH": str(partial)})
+                "SLT_BENCH_PARTIAL_PATH": str(partial),
+                # bench.json artifacts land in tmp, not the checkout
+                "SLT_BENCH_ARTIFACT_DIR": str(tmp_path)})
     env.update(env_extra)
     proc = subprocess.Popen(
         [sys.executable, str(HERE.parent / "bench.py")],

@@ -322,6 +322,22 @@ class TestSlTrace:
         assert rep["slowest_edges"][0]["from"] == "c"
         assert rep["slowest_edges"][0]["to"] == "server"
 
+    def test_edge_hop_attributes_receiver_compile_not_wire(self):
+        # a compile span on the RECEIVER overlapping the frame's
+        # transit window [pub_end, consume.ts] is compile tax, not a
+        # slow wire (the cold-round head stall)
+        spans = _synthetic_spans()
+        spans.append({"v": 1, "trace": "t0", "span": "x1",
+                      "parent": None, "name": "compile",
+                      "part": "server", "thread": "main",
+                      "ts": 8.6, "dur": 0.3, "round": 0})
+        rep = sl_trace.critical_path(spans)[0]
+        c = rep["components_s"]
+        assert rep["components_sum_s"] == pytest.approx(10.0, abs=1e-6)
+        assert c["compile"] == pytest.approx(0.3, abs=1e-6)
+        assert c["wire"] == pytest.approx(1.2, abs=1e-6)
+        assert c["compute"] == pytest.approx(5.0, abs=1e-6)
+
     def test_report_renders(self):
         txt = sl_trace.render_report(
             sl_trace.critical_path(_synthetic_spans()))

@@ -96,3 +96,42 @@ def test_profile_network_inproc():
     bus = InProcTransport()
     bw = profile_network(bus, sizes_mb=[1], repeats=2)
     assert bw > 0
+
+
+def test_cost_analysis_flops_vs_analytic_and_planner():
+    """Ties the runtime MFU numerator (XLA cost_analysis of the
+    compiled step, runtime/perf.py) to the planner's cost model
+    (profiler.py flops mode) AND to an analytic transformer FLOP
+    count, within 2x on the tiny KWT fixture — if either drifts past
+    that, the MFU gauge and the partition planner are no longer
+    talking about the same compute."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from split_learning_tpu.models import build_model
+    from split_learning_tpu.runtime.perf import flops_of_compiled
+
+    batch, tokens, n_blocks, embed = 4, 99, 12, 16
+    model = build_model("KWT_SPEECHCOMMANDS", **TINY_KWT)
+    x = jnp.zeros((batch, 40, 98), jnp.float32)
+    variables = model.init(jax.random.key(0), x, train=False)
+    fn = jax.jit(lambda v, xx: model.apply(v, xx, train=False))
+    measured = flops_of_compiled(fn, variables, x)
+    assert measured and measured > 0
+
+    # analytic forward FLOPs: 2 * dense-kernel params per token for
+    # every projection, plus the two attention matmuls (QK^T and AV:
+    # 2 * T^2 * E each) per block
+    dense = sum(int(np.prod(leaf.shape)) for leaf in
+                jax.tree_util.tree_leaves(variables["params"])
+                if getattr(leaf, "ndim", 0) >= 2)
+    analytic = (2 * dense * tokens * batch
+                + n_blocks * 2 * (2 * tokens * tokens * embed) * batch)
+    assert 0.5 < measured / analytic < 2.0
+
+    # the planner's per-layer flops-mode costs sum to the same total
+    planner = sum(profile_model(
+        "KWT_SPEECHCOMMANDS", batch_size=batch, model_kwargs=TINY_KWT,
+        method="flops")["exe_time"]) * 1e12
+    assert 0.5 < measured / planner < 2.0

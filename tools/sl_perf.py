@@ -1,0 +1,351 @@
+#!/usr/bin/env python
+"""``sl_perf`` — per-round compute attribution report + perf
+regression gate.
+
+Two data sources, merged into one report:
+
+* ``kind=perf`` records from a run's ``metrics.jsonl``
+  (``runtime/perf.py PerfPlane``): per-participant, per-round
+  ``compute | compile | dispatch | host | wait`` attribution, MFU,
+  HBM watermark, compile counts and retraces;
+* the ``BENCH_r*.json`` history (and the new run-scoped
+  ``bench.json`` artifacts bench.py writes): the stable
+  regression-tracking keys mirrored at the top of ``extra``.
+
+Modes:
+
+    python tools/sl_perf.py --metrics artifacts/runs/<run_id>  # report
+    python tools/sl_perf.py --metrics <dir> --report out.json
+    python tools/sl_perf.py --diff BENCH_r*.json               # gate
+    python tools/sl_perf.py --diff BENCH_r04.json BENCH_r05.json \
+        --threshold 0.15
+
+``--diff`` compares the LAST bench record against the previous one on
+the stable keys and exits 1 on any regression beyond the noise
+threshold (default 15%) — the CI perf-gate job.  Improvements and
+within-noise drift pass; keys missing or null on either side are
+skipped (a section that never ran is not a regression).
+
+Stdlib only: runs anywhere the repo does (CI perf-gate installs
+nothing).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import pathlib
+import re
+import sys
+
+#: noise threshold: relative change beyond which a worsened stable key
+#: fails the gate
+DEFAULT_THRESHOLD = 0.15
+
+#: stable bench keys: dotted path into the bench payload -> direction
+#: ("up" = higher is better, "down" = lower is better).  These are the
+#: keys successive BENCH_r*.json rounds mirror at fixed paths exactly
+#: so this gate can diff them without knowing section nesting.
+STABLE_KEYS = {
+    "value": "up",                              # headline samples/s
+    "extra.protocol_samples_per_sec": "up",
+    "extra.split_ratio_vs_unsplit": "down",     # split slowdown factor
+    "extra.cold_round_wall_s": "down",
+    "extra.wire_mb_per_round": "down",
+    "extra.wire_mb_per_round_compressed": "down",
+    "extra.per_device_hbm_gb.total_est": "down",
+    "extra.mfu.mfu_vs_datasheet": "up",
+    "extra.mfu.measured_matmul_roofline_tflops": "up",
+}
+
+#: attribution components of a kind=perf record, in report order
+COMPONENTS = ("compute_s", "compile_s", "dispatch_s", "host_s",
+              "wait_s")
+
+
+# --------------------------------------------------------------------------
+# bench history loading
+# --------------------------------------------------------------------------
+
+#: raw-text rescue patterns for stable keys whose JSON wrapper is
+#: unrecoverable (the historical BENCH_r*.json shape: a driver wrapper
+#: with ``parsed: null`` and a FRONT-TRUNCATED stdout tail — exactly
+#: the gap the run-scoped bench.json artifact closes).  Only keys with
+#: globally unique spellings are scavenged; ambiguous ones (e.g. the
+#: many nested "samples_per_sec") are left to structured parses.
+_NUM = r"(-?[0-9]+(?:\.[0-9]+)?(?:[eE][+-]?[0-9]+)?)"
+_SCAVENGE_RES = {
+    "value": re.compile(r'"value":\s*' + _NUM
+                        + r',\s*"unit":\s*"samples/sec/chip"'),
+    "extra.protocol_samples_per_sec":
+        re.compile(r'"protocol_samples_per_sec":\s*' + _NUM),
+    "extra.split_ratio_vs_unsplit":
+        re.compile(r'"split_ratio_vs_unsplit":\s*' + _NUM),
+    "extra.cold_round_wall_s":
+        re.compile(r'"cold_round_wall_s":\s*' + _NUM),
+    "extra.wire_mb_per_round":
+        re.compile(r'"wire_mb_per_round":\s*' + _NUM),
+    "extra.wire_mb_per_round_compressed":
+        re.compile(r'"wire_mb_per_round_compressed":\s*' + _NUM),
+    "extra.per_device_hbm_gb.total_est":
+        re.compile(r'"per_device_hbm_gb":\s*\{[^{}]*"total_est":\s*'
+                   + _NUM),
+    "extra.mfu.mfu_vs_datasheet":
+        re.compile(r'"mfu_vs_datasheet":\s*' + _NUM),
+    "extra.mfu.measured_matmul_roofline_tflops":
+        re.compile(r'"measured_matmul_roofline_tflops":\s*' + _NUM),
+}
+
+
+def _dig(d: dict, dotted: str):
+    cur = d
+    for part in dotted.split("."):
+        if not isinstance(cur, dict):
+            return None
+        cur = cur.get(part)
+    return cur if isinstance(cur, (int, float)) \
+        and not isinstance(cur, bool) else None
+
+
+def stable_values(payload: dict) -> dict:
+    """Flat {stable key: value} map from a structured bench payload."""
+    return {k: v for k in STABLE_KEYS
+            if (v := _dig(payload, k)) is not None}
+
+
+def scavenge_stable_values(text: str) -> dict:
+    """Stable keys regex-rescued from raw (possibly torn) bench text."""
+    out = {}
+    for key, pat in _SCAVENGE_RES.items():
+        m = pat.search(text)
+        if m:
+            out[key] = float(m.group(1))
+    return out
+
+
+def _extract_payload(rec: dict) -> dict | None:
+    """The structured bench payload, when one survives: a plain
+    payload (the new bench.json artifact), a driver wrapper with
+    ``parsed`` set, or a full ``{"metric": ...}`` line in the captured
+    stdout tail."""
+    if not isinstance(rec, dict):
+        return None
+    if "metric" in rec and "extra" in rec:
+        return rec
+    parsed = rec.get("parsed")
+    if isinstance(parsed, dict) and "extra" in parsed:
+        return parsed
+    tail = rec.get("tail")
+    if isinstance(tail, str):
+        # last parseable {"metric": ...} start wins (partial flushes
+        # may precede the final emit)
+        idx = tail.rfind('{"metric"')
+        if idx >= 0:
+            chunk = tail[idx:].strip()
+            for end in (len(chunk), chunk.rfind("}") + 1):
+                try:
+                    cand = json.loads(chunk[:end])
+                except json.JSONDecodeError:
+                    continue
+                if isinstance(cand, dict) and "extra" in cand:
+                    return cand
+    return None
+
+
+def load_bench(path: str | pathlib.Path) -> dict | None:
+    """Flat stable-key map for one bench record on disk; None when
+    nothing at all is recoverable (e.g. the rc=124 empty round)."""
+    try:
+        raw = pathlib.Path(path).read_text()
+        rec = json.loads(raw)
+    except (OSError, json.JSONDecodeError):
+        return None
+    payload = _extract_payload(rec)
+    if payload is not None:
+        return stable_values(payload)
+    text = rec.get("tail") if isinstance(rec, dict) \
+        and isinstance(rec.get("tail"), str) else raw
+    return scavenge_stable_values(text) or None
+
+
+def diff_bench(prev: dict, cur: dict,
+               threshold: float = DEFAULT_THRESHOLD) -> dict:
+    """Stable-key comparison of two flat maps: per-key old/new/
+    relative change and a regression verdict.  ``regressions`` lists
+    the keys that worsened beyond the threshold."""
+    keys = {}
+    regressions = []
+    for key, direction in STABLE_KEYS.items():
+        old, new = prev.get(key), cur.get(key)
+        if old is None or new is None or old == 0:
+            continue
+        change = (new - old) / abs(old)
+        worse = change < -threshold if direction == "up" \
+            else change > threshold
+        keys[key] = {"old": old, "new": new,
+                     "change": round(change, 4),
+                     "direction": direction,
+                     "regression": worse}
+        if worse:
+            regressions.append(key)
+    return {"threshold": threshold, "keys": keys,
+            "regressions": regressions}
+
+
+# --------------------------------------------------------------------------
+# kind=perf attribution report
+# --------------------------------------------------------------------------
+
+def load_perf_records(path: str | pathlib.Path) -> list[dict]:
+    """All ``kind=perf`` records from a metrics.jsonl (or a run/log
+    directory holding one)."""
+    p = pathlib.Path(path)
+    if p.is_dir():
+        p = p / "metrics.jsonl"
+    if not p.exists():
+        return []
+    out = []
+    for line in p.read_text().splitlines():
+        try:
+            rec = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        if isinstance(rec, dict) and rec.get("kind") == "perf":
+            out.append(rec)
+    return out
+
+
+def attribution_report(records: list[dict],
+                       bench: list[dict] | None = None) -> dict:
+    """Per-(participant, round) attribution rows + MFU trend, plus the
+    bench history's stable keys when given."""
+    rows = []
+    mfu_trend = []
+    for rec in records:
+        wall = rec.get("wall_s") or 0.0
+        comps = {c: rec.get(c, 0.0) or 0.0 for c in COMPONENTS}
+        row = {
+            "participant": rec.get("participant") or rec.get("client"),
+            "round": rec.get("round", rec.get("round_idx")),
+            "wall_s": wall,
+            **{c: round(v, 4) for c, v in comps.items()},
+            "attributed_frac": (round(sum(comps.values()) / wall, 4)
+                                if wall else None),
+            "steps": rec.get("steps"),
+            "retraces": rec.get("retraces"),
+        }
+        for opt in ("mfu", "tflops_per_sec", "hbm_peak_bytes",
+                    "compute_samples_per_s", "hbm_peak_vs_plan"):
+            if rec.get(opt) is not None:
+                row[opt] = rec[opt]
+        rows.append(row)
+        if rec.get("mfu") is not None:
+            mfu_trend.append({"round": row["round"],
+                              "participant": row["participant"],
+                              "mfu": rec["mfu"]})
+    report: dict = {"rounds": rows, "mfu_trend": mfu_trend}
+    if bench:
+        report["bench_history"] = [dict(b) for b in bench]
+    return report
+
+
+def render_report(report: dict) -> str:
+    lines = []
+    rows = report.get("rounds", [])
+    if rows:
+        head = ("PART", "ROUND", "WALL s", "COMPUTE", "COMPILE",
+                "DISPATCH", "HOST", "WAIT", "MFU")
+        table = [head]
+        for r in rows:
+            table.append((
+                str(r.get("participant") or "?"),
+                str(r.get("round")),
+                f"{r.get('wall_s', 0):.2f}",
+                f"{r.get('compute_s', 0):.2f}",
+                f"{r.get('compile_s', 0):.2f}",
+                f"{r.get('dispatch_s', 0):.2f}",
+                f"{r.get('host_s', 0):.2f}",
+                f"{r.get('wait_s', 0):.2f}",
+                ("-" if r.get("mfu") is None
+                 else f"{r['mfu']:.4f}"),
+            ))
+        widths = [max(len(row[i]) for row in table)
+                  for i in range(len(head))]
+        for row in table:
+            lines.append("  ".join(f"{v:<{w}}"
+                                   for v, w in zip(row, widths)))
+    else:
+        lines.append("no kind=perf records found")
+    diff = report.get("diff")
+    if diff:
+        lines.append("")
+        lines.append(f"regression gate (threshold "
+                     f"{diff['threshold']:.0%}):")
+        for key, d in sorted(diff["keys"].items()):
+            mark = "REGRESSION" if d["regression"] else "ok"
+            lines.append(f"  {key}: {d['old']} -> {d['new']} "
+                         f"({d['change']:+.1%}, want {d['direction']}) "
+                         f"[{mark}]")
+        if not diff["keys"]:
+            lines.append("  (no comparable stable keys)")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="Compute-attribution report (kind=perf records) "
+                    "and bench regression gate (stable keys).")
+    ap.add_argument("--metrics", default=None, metavar="DIR",
+                    help="run dir or metrics.jsonl with kind=perf "
+                         "records")
+    ap.add_argument("--diff", nargs="+", default=None, metavar="BENCH",
+                    help="bench records (oldest..newest); compares the "
+                         "last against the previous and exits 1 on a "
+                         "regression beyond --threshold")
+    ap.add_argument("--bench", nargs="*", default=None, metavar="BENCH",
+                    help="bench history to fold into the report "
+                         "(no gating)")
+    ap.add_argument("--threshold", type=float,
+                    default=DEFAULT_THRESHOLD)
+    ap.add_argument("--report", default=None, metavar="OUT.json",
+                    help="also write the full report as JSON")
+    args = ap.parse_args(argv)
+    if not args.metrics and not args.diff:
+        ap.error("need --metrics and/or --diff")
+
+    records = load_perf_records(args.metrics) if args.metrics else []
+    bench_hist = [b for p in (args.bench or [])
+                  if (b := load_bench(p)) is not None]
+    report = attribution_report(records, bench=bench_hist or None)
+
+    rc = 0
+    if args.diff:
+        loaded = [(p, load_bench(p)) for p in args.diff]
+        usable = [(p, b) for p, b in loaded if b is not None]
+        for p, b in loaded:
+            if b is None:
+                print(f"sl_perf: skipping unparseable bench record "
+                      f"{p}", file=sys.stderr)
+        if len(usable) < 2:
+            print("sl_perf: need at least 2 parseable bench records "
+                  "to diff", file=sys.stderr)
+            rc = 2
+        else:
+            report["diff"] = diff_bench(usable[-2][1], usable[-1][1],
+                                        threshold=args.threshold)
+            report["diff"]["compared"] = [usable[-2][0], usable[-1][0]]
+            if report["diff"]["regressions"]:
+                rc = 1
+
+    if args.report:
+        pathlib.Path(args.report).write_text(json.dumps(report,
+                                                        indent=1))
+    print(render_report(report))
+    if rc == 1:
+        print(f"\nsl_perf: PERF REGRESSION on "
+              f"{report['diff']['regressions']}", file=sys.stderr)
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -32,7 +32,7 @@ _STATE_COLOR = {"healthy": "\033[92m", "degraded": "\033[93m",
 _RESET = "\033[0m"
 
 _COLUMNS = ("CLIENT", "STATE", "ROUND", "SAMPLES", "RATE/s", "SCORE",
-            "RTT p95 ms", "WIRE MB", "AGE s")
+            "MFU", "STEP p95 ms", "RTT p95 ms", "WIRE MB", "AGE s")
 
 
 def fetch_fleet(url: str, timeout: float = 3.0) -> dict:
@@ -84,6 +84,9 @@ def render_fleet(fleet: dict, color: bool = True,
             cid, c.get("state", "?"), _fmt(c.get("round")),
             _fmt(c.get("samples")), _fmt(c.get("samples_per_s")),
             _fmt(c.get("straggler_score"), 2),
+            # perf-plane gauges (runtime/perf.py); "-" for clients
+            # predating the plane
+            _fmt(c.get("mfu"), 4), _fmt(c.get("step_p95_ms"), 2),
             _fmt(c.get("rtt_p95_ms"), 2),
             f"{wire_mb:.2f}", _fmt(c.get("age_s")),
         ))

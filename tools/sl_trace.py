@@ -12,8 +12,11 @@ This tool merges them:
 * **critical-path report** — per round, walk the span graph BACKWARD
   from the server's ``round`` span end: follow the latest activity on
   the current participant, hop across participants along frame flow
-  edges, and accrue every walked interval into one of
-  ``compute`` / ``wire`` / ``queue_wait`` / ``aggregate`` / ``control``.
+  edges, and accrue every walked interval into one of ``compute`` /
+  ``compile`` / ``wire`` / ``queue_wait`` / ``aggregate`` /
+  ``control`` (``compile`` spans come from the perf plane's
+  CompileWatch, so a cold round's compile tax is separated from
+  device compute).
   The walk covers the round interval exactly, so the components sum to
   the round's wall time by construction; ``queue_wait`` absorbs the
   un-spanned intervals (queue residency, barrier waits, client-side
@@ -40,10 +43,14 @@ CONTAINER_NAMES = frozenset({
     "ready_wait", "notify_wait", "update_wait",
 })
 
-#: leaf-span name -> critical-path category
+#: leaf-span name -> critical-path category.  `compile` spans come
+#: from the perf plane's CompileWatch (runtime/perf.py): XLA compiles
+#: get their own category so a cold round's compile tax stops
+#: masquerading as device compute in the breakdown.
 CATEGORY = {
     "fwd": "compute", "bwd": "compute", "sda_step": "compute",
     "whole_step": "compute", "step": "compute",
+    "compile": "compile",
     "publish": "wire", "consume": "wire", "wire_send": "wire",
     "encode": "wire", "decode": "wire",
     "aggregate": "aggregate", "validate": "aggregate",
@@ -52,7 +59,8 @@ CATEGORY = {
     "pause_fanout": "control",
 }
 
-CATEGORIES = ("compute", "wire", "queue_wait", "aggregate", "control")
+CATEGORIES = ("compute", "compile", "wire", "queue_wait", "aggregate",
+              "control")
 
 #: required keys of one spans.jsonl record (schema v1)
 SPAN_REQUIRED = frozenset({"v", "trace", "span", "name", "part", "ts",
@@ -235,6 +243,21 @@ def _pick(leaves: list[dict], t: float, t_lo: float):
     return best
 
 
+def _compile_overlap(leaves, lo: float, hi: float) -> float:
+    """Total time within ``[lo, hi]`` covered by ``compile`` spans
+    (overlapping spans merged so the result never exceeds hi-lo)."""
+    ivals = sorted((max(s["ts"], lo), min(s["ts"] + s["dur"], hi))
+                   for s in leaves if s["name"] == "compile"
+                   and s["ts"] < hi and s["ts"] + s["dur"] > lo)
+    total, cursor = 0.0, lo
+    for a, b in ivals:
+        a = max(a, cursor)
+        if b > a:
+            total += b - a
+            cursor = b
+    return total
+
+
 def critical_path_round(round_span: dict, spans: list[dict]) -> dict:
     """Backward walk from the round's end: every interval of
     [round start, round end] lands in exactly one category, so the
@@ -277,9 +300,13 @@ def critical_path_round(round_span: dict, spans: list[dict]) -> dict:
         pub_end = pub["ts"] + pub["dur"]
         if not t_lo < pub_end <= t:
             continue
-        # hop across the frame edge: transit time is wire, then keep
-        # walking on the sender's timeline
-        acc["wire"] += t - pub_end
+        # hop across the frame edge: transit time is wire — minus any
+        # part of it the RECEIVER spent compiling (CompileWatch spans):
+        # a frame sitting in the queue while a cold consumer compiles
+        # is compile tax, not a slow wire
+        busy = _compile_overlap(leaves.get(cur, ()), pub_end, t)
+        acc["compile"] += busy
+        acc["wire"] += (t - pub_end) - busy
         acc["wire"] += pub_end - max(pub["ts"], t_lo)
         path.append(pub)
         t = max(pub["ts"], t_lo)
@@ -329,9 +356,9 @@ def critical_path(spans: list[dict]) -> list[dict]:
 def render_report(rounds: list[dict]) -> str:
     if not rounds:
         return "no 'round' spans found — was tracing enabled?"
-    lines = ["per-round critical path (compute | wire | queue-wait | "
-             "aggregate | control; queue-wait includes barrier/idle "
-             "time):"]
+    lines = ["per-round critical path (compute | compile | wire | "
+             "queue-wait | aggregate | control; queue-wait includes "
+             "barrier/idle time):"]
     for r in rounds:
         c = r["components_s"]
         pct = {k: (100.0 * v / r["wall_s"] if r["wall_s"] else 0.0)
