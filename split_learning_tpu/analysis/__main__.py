@@ -24,7 +24,7 @@ from split_learning_tpu.analysis.findings import (
 )
 
 ANALYZERS = ("protocol", "jaxpr", "concurrency", "counters", "codec",
-             "perf")
+             "perf", "agg")
 
 
 def repo_root() -> pathlib.Path:
@@ -52,6 +52,9 @@ def run_analyzers(root: pathlib.Path, names=ANALYZERS,
     if "perf" in names:
         from split_learning_tpu.analysis import perf_check
         findings += perf_check.run(root)
+    if "agg" in names:
+        from split_learning_tpu.analysis import agg_check
+        findings += agg_check.run(root)
     return findings
 
 

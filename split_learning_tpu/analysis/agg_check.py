@@ -1,0 +1,100 @@
+"""``agg`` analyzer — server aggregation-path memory discipline.
+
+**AG001**: no accumulation of per-client full parameter trees in the
+server's round paths.  The streaming aggregation plane
+(``runtime/aggregate.py``) exists so the UPDATE barrier holds O(1)
+trees; a round path that quietly rebuilds a list/dict of per-client
+``Update.params``/``batch_stats`` trees reintroduces the O(clients)
+wall this plane removed — usually as an innocent-looking
+comprehension feeding an aggregate call.
+
+Flagged shapes (in ``runtime/server.py``, ``runtime/strategies.py``,
+``runtime/loop.py``):
+
+* a list/set/generator/dict comprehension whose ELEMENT expression
+  extracts ``.params`` / ``.batch_stats`` (``[u.params for u in ups]``)
+  — presence checks in the ``if`` clause are fine;
+* ``something.append(<expr containing .params/.batch_stats>)``;
+* a subscript store of such an expression
+  (``store[u.client_id] = u.params``).
+
+Escapes (trailing ``# slcheck: ...`` annotations):
+
+* ``agg-oracle`` — the reference barrier fold the streaming plane is
+  bit-compared against (kept deliberately, as the oracle);
+* ``agg-state`` — deliberate bounded per-client persistence that IS a
+  strategy's semantics (e.g. FLEX's client-level weight persistence).
+"""
+
+from __future__ import annotations
+
+import ast
+import pathlib
+
+from split_learning_tpu.analysis.findings import Finding
+from split_learning_tpu.analysis.protocol_check import _annotations
+
+#: server round-path files held to the no-accumulation rule
+FILES = ("split_learning_tpu/runtime/server.py",
+         "split_learning_tpu/runtime/strategies.py",
+         "split_learning_tpu/runtime/loop.py")
+
+#: Update attributes that carry a full per-client tree
+TREE_ATTRS = frozenset({"params", "batch_stats"})
+
+_ALLOW = ("agg-oracle", "agg-state")
+
+
+def _extracts_tree(node: ast.AST) -> bool:
+    return any(isinstance(n, ast.Attribute) and n.attr in TREE_ATTRS
+               for n in ast.walk(node))
+
+
+def check_source(source: str, rel: str) -> list[Finding]:
+    tree = ast.parse(source)
+    notes = _annotations(source)
+
+    def allowed(lineno: int) -> bool:
+        note = notes.get(lineno, "")
+        return any(a in note for a in _ALLOW)
+
+    findings: list[Finding] = []
+
+    def flag(node: ast.AST, what: str) -> None:
+        if not allowed(node.lineno):
+            findings.append(Finding(
+                "AG001", rel, node.lineno, "",
+                f"{what} accumulates per-client full parameter trees "
+                "in a server round path — fold incrementally "
+                "(runtime/aggregate.py StreamingFold / ops/fedavg.py "
+                "TreeFold) or annotate '# slcheck: agg-oracle' / "
+                "'agg-state'"))
+
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.ListComp, ast.SetComp,
+                             ast.GeneratorExp)):
+            if _extracts_tree(node.elt):
+                flag(node, "comprehension")
+        elif isinstance(node, ast.DictComp):
+            if _extracts_tree(node.value):
+                flag(node, "dict comprehension")
+        elif isinstance(node, ast.Call) \
+                and isinstance(node.func, ast.Attribute) \
+                and node.func.attr == "append":
+            if any(_extracts_tree(a) for a in node.args):
+                flag(node, "append")
+        elif isinstance(node, ast.Assign) \
+                and any(isinstance(t, ast.Subscript)
+                        for t in node.targets):
+            if _extracts_tree(node.value):
+                flag(node, "subscript store")
+    return findings
+
+
+def run(root: pathlib.Path) -> list[Finding]:
+    findings: list[Finding] = []
+    for rel in FILES:
+        path = root / rel
+        if path.exists():
+            findings += check_source(path.read_text(), rel)
+    return findings

@@ -47,7 +47,8 @@ from split_learning_tpu.analysis.findings import Finding
 # -- wire vocabulary --------------------------------------------------------
 
 CONTROL_KINDS = ("Register", "Ready", "Notify", "Update",
-                 "Start", "Syn", "Pause", "Stop", "Heartbeat")
+                 "Start", "Syn", "Pause", "Stop", "Heartbeat",
+                 "PartialAggregate")
 DATA_KINDS = ("Activation", "Gradient", "EpochEnd")
 ALL_KINDS = CONTROL_KINDS + DATA_KINDS
 
@@ -56,6 +57,9 @@ QUEUE_FAMILIES = {
     "reply": "reply_*",
     "intermediate": "intermediate_queue_*",
     "gradient": "gradient_queue_*",
+    # aggregator tree (aggregation.fan-in, runtime/aggregate.py):
+    # clients of one L1 group -> that group's aggregator
+    "aggregate": "aggregate_queue_*",
 }
 
 #: legal (sender-role, queue-family, kind) triples.  The two
@@ -72,13 +76,23 @@ SEND_RULES = frozenset({
     ("client", "intermediate", "Activation"),
     ("client", "intermediate", "EpochEnd"),
     ("client", "gradient", "Gradient"),
+    # aggregator tree (aggregation.fan-in): a grouped client uploads
+    # its round UPDATE to its L1's aggregate queue instead of rpc; the
+    # L1 (runtime/aggregate.py L1Aggregator — the third protocol role)
+    # folds the group and publishes one PartialAggregate to the root
+    ("client", "aggregate", "Update"),
+    ("aggregator", "rpc", "PartialAggregate"),
 })
 
-#: queue families each role may consume from
+#: queue families each role may consume from.  The server's aggregate
+#: entry is the direct-to-root fallback: when an L1 dies mid-round the
+#: server drains the orphaned group queue itself
+#: (``runtime/aggregate.py drain_group_queue``).
 RECV_RULES = frozenset({
-    ("server", "rpc"),
+    ("server", "rpc"), ("server", "aggregate"),
     ("client", "reply"), ("client", "intermediate"),
     ("client", "gradient"),
+    ("aggregator", "aggregate"),
 })
 
 #: kinds legal on each DATA queue family (post-transport stream)
@@ -123,6 +137,7 @@ SERVER_FSM: dict[str, dict[tuple[str, str], str]] = {
     },
     "pausing": {                        # UPDATE collection
         ("recv", "Update"): "pausing",
+        ("recv", "PartialAggregate"): "pausing",  # L1 group flushes
         ("recv", "Notify"): "pausing",   # straggler NOTIFY still legal
         ("recv", "Register"): "pausing",
         ("send", "Start"): "starting",   # next invocation / cluster
@@ -133,6 +148,30 @@ SERVER_FSM: dict[str, dict[tuple[str, str], str]] = {
         ("recv", "Register"): "stopped",
         ("recv", "Notify"): "stopped",
         ("recv", "Update"): "stopped",
+        ("recv", "PartialAggregate"): "stopped",
+    },
+}
+
+#: the aggregator tree's interior node (runtime/aggregate.py
+#: L1Aggregator): drains its group's Updates, publishes ONE
+#: PartialAggregate, exits.  Late member Updates draining after the
+#: flush are legal (they are dropped as stale, but the consume itself
+#: is not a protocol violation).  Each round spawns a FRESH
+#: L1Aggregator instance under the SAME participant name
+#: (``aggregator_{cluster}_{group}``), so a merged-log replay sees one
+#: send per round from one name — the ``flushed`` send self-loop is
+#: that round boundary, not a double-flush allowance (the validator
+#: cannot see instance boundaries, so a true within-round double
+#: publish is guarded by L1Aggregator.run's publish-then-return
+#: structure instead).
+AGGREGATOR_FSM: dict[str, dict[tuple[str, str], str]] = {
+    "idle": {
+        ("recv", "Update"): "idle",
+        ("send", "PartialAggregate"): "flushed",
+    },
+    "flushed": {
+        ("recv", "Update"): "flushed",
+        ("send", "PartialAggregate"): "flushed",
     },
 }
 
@@ -185,7 +224,8 @@ for _state, _transitions in SERVER_FSM.items():
 for _state, _transitions in CLIENT_FSM.items():
     _transitions[("send", "Heartbeat")] = _state
 
-FSM_BY_ROLE = {"server": SERVER_FSM, "client": CLIENT_FSM}
+FSM_BY_ROLE = {"server": SERVER_FSM, "client": CLIENT_FSM,
+               "aggregator": AGGREGATOR_FSM}
 INITIAL_STATE = "idle"
 
 
@@ -253,7 +293,9 @@ def events_from_log(text: str) -> list[Event]:
         if kind is None:
             continue   # non-protocol marker line
         participant = m.group("name").rsplit(".", 1)[0]
-        role = "server" if participant == "server" else "client"
+        role = ("server" if participant == "server"
+                else "aggregator" if participant.startswith("aggregator_")
+                else "client")
         events.append(Event(
             role=role,
             direction="send" if m.group("dir") == ">>>" else "recv",

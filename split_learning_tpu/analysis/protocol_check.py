@@ -44,7 +44,7 @@ from split_learning_tpu.analysis.model import (
 
 _QUEUE_CTORS = {"reply_queue": "reply", "intermediate_queue":
                 "intermediate", "gradient_queue": "gradient",
-                "_ack_queue": "ack"}
+                "aggregate_queue": "aggregate", "_ack_queue": "ack"}
 _ANNOT_RE = re.compile(r"#\s*slcheck:\s*(.+?)\s*$")
 
 
@@ -291,6 +291,15 @@ def _sample_messages():
             client_id="c", round_idx=1,
             telemetry={"part": "c", "t": 1.0, "seq": 1,
                        "counters": {"drops": 2}}),
+        "PartialAggregate": P.PartialAggregate(
+            aggregator_id="aggregator_0_0", cluster=0, group=0,
+            stage=1, round_idx=1,
+            sums={"w": np.arange(4, dtype=np.float32)}, weight=3.0,
+            dtypes={"w": "float32"},
+            stat_sums={"m": np.ones((2,), np.float32)},
+            stat_weight=3.0, stat_dtypes={"m": "float32"},
+            n_samples=12, members=[{"client_id": "c", "stage": 1,
+                                    "num_samples": 12, "ok": True}]),
         "Activation": P.Activation(
             data_id="d0", data=np.ones((2, 3), np.float32),
             labels=np.zeros((2,), np.int64), trace=["c"], cluster=0),
@@ -393,7 +402,8 @@ def _check_codec() -> list[Finding]:
             fams = {fam for role, fam, k in SEND_RULES if k == kind}
             examples = {"rpc": "rpc_queue", "reply": "reply_c",
                         "intermediate": "intermediate_queue_1_0",
-                        "gradient": "gradient_queue_1_c"}
+                        "gradient": "gradient_queue_1_c",
+                        "aggregate": "aggregate_queue_0_0"}
             import fnmatch
             pats = ChaosConfig().queues
             for fam in fams:
@@ -446,7 +456,7 @@ def _check_handlers(root: pathlib.Path) -> list[Finding]:
     }
     must_handle = {"client": {"Start", "Syn", "Pause", "Stop"},
                    "server": {"Register", "Ready", "Notify", "Update",
-                              "Heartbeat"}}
+                              "Heartbeat", "PartialAggregate"}}
     for role in ("client", "server"):
         rel = f"split_learning_tpu/runtime/{role}.py"
         tree = ast.parse((root / rel).read_text())

@@ -205,6 +205,33 @@ class AggregationConfig:
     # flushes a partial window and the barrier adapts to live feeders.
     sda_strict: bool = False
     local_rounds: int = 1           # DCSL epochs per round
+    # Streaming aggregation plane (runtime/aggregate.py, ROADMAP item
+    # 4).  ``streaming`` (default on) folds each UPDATE into a running
+    # per-stage weighted sum the moment the server decodes it, so the
+    # UPDATE barrier holds O(1) parameter trees instead of O(clients);
+    # a canonical (stage, client_id) reorder window keeps the result
+    # bit-identical to the barrier fold.  Only strategies whose
+    # aggregation consumes the whole update list at once stream
+    # (fedavg/sda/cluster_relay); the others keep barrier semantics
+    # automatically.
+    streaming: bool = True
+    # Aggregator tree: >= 2 interposes L1 aggregator participants
+    # (clients -> L1 -> root) so per-node fan-in stays constant at
+    # 100+ clients; groups of at most fan-in clients per stage fold
+    # locally and publish one PartialAggregate.  0 = flat
+    # direct-to-root.  An L1 that dies mid-round degrades to a counted
+    # direct-to-root fallback drain.
+    fan_in: int = 0
+    # Run the running sum + FedAvg divide + server optimizer step as
+    # jitted ops on arrays sharded across the server's device mesh
+    # (MeshFoldBackend) instead of replicated host numpy trees.
+    sharded: bool = False
+    # Server-side optimizer on the aggregate (FedAvgM):
+    # v' = m*v + (base - avg); new = base - v'.  0 (default) is plain
+    # FedAvg — and keeps the bit-identity contract with the barrier
+    # oracle.  Velocity state lives in the fold backend's (sharded)
+    # representation between rounds.
+    server_momentum: float = 0.0
 
     def validate(self):
         _check(self.strategy in ("fedavg", "relay", "cluster_relay",
@@ -214,6 +241,15 @@ class AggregationConfig:
                "t-client/t-global must be >= 1")
         _check(self.sda_size >= 1, "sda-size must be >= 1")
         _check(self.local_rounds >= 1, "local-rounds must be >= 1")
+        _check(self.fan_in == 0 or self.fan_in >= 2,
+               f"aggregation.fan-in must be 0 (flat) or >= 2, "
+               f"got {self.fan_in!r}")
+        _check(not self.fan_in or self.streaming,
+               "aggregation.fan-in requires aggregation.streaming "
+               "(the root folds PartialAggregates incrementally)")
+        _check(0.0 <= self.server_momentum < 1.0,
+               f"aggregation.server-momentum must be in [0, 1), "
+               f"got {self.server_momentum!r}")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -285,7 +321,7 @@ class TransportConfig:
     # chaos runs.
     reliable: bool = False
     reliable_queues: tuple = ("intermediate_queue*", "gradient_queue*",
-                              "rpc_queue")
+                              "rpc_queue", "aggregate_queue*")
     redeliver_s: float = 0.3        # first redelivery deadline (backoff x1.5)
     max_redeliver: int = 20         # bounded redelivery, then give up
 
@@ -358,9 +394,10 @@ class ChaosConfig:
     # rpc_queue included so EVERY tensor-framed message kind has a
     # default fault-injection point (slcheck PC006): Update rides
     # rpc_queue, and a wire type chaos can never touch is a recovery
-    # path no soak ever exercises
+    # path no soak ever exercises; aggregate_queue* covers the
+    # aggregator-tree upload leg (Update -> L1) the same way
     queues: tuple = ("intermediate_queue*", "gradient_queue*",
-                     "rpc_queue")
+                     "rpc_queue", "aggregate_queue*")
     crash: tuple = ()               # scripted crash points (dicts)
 
     def validate(self):

@@ -35,9 +35,15 @@ import jax
 import jax.numpy as jnp
 
 
-def _is_int_dtype(dtype) -> bool:
+def is_int_dtype(dtype) -> bool:
+    """True for integer/bool dtypes (jnp or np): these average in
+    float then round back — shared by every fold implementation so
+    they can never disagree on the rounding path."""
     return (jnp.issubdtype(dtype, jnp.integer)
             or jnp.issubdtype(dtype, jnp.bool_))
+
+
+_is_int_dtype = is_int_dtype
 
 
 def _avg_leaves(leaves: Sequence[jnp.ndarray], weights: Sequence[float],
@@ -79,6 +85,83 @@ def fedavg_trees(trees: Sequence[Any],
         return _avg_leaves(nodes, ws, total_w)
 
     return merge(list(zip(trees, weights)))
+
+
+def walk_items(tree: Any, prefix: tuple = ()):
+    """(path, leaf) pairs under ``fedavg_trees`` dict semantics: dicts
+    are internal nodes, everything else is a leaf.  The ONE canonical
+    tree walk both fold implementations share — :class:`TreeFold`
+    (the reference oracle) and the runtime's
+    :class:`~split_learning_tpu.runtime.aggregate.StreamingFold` — so
+    their bit-identity contract cannot be broken by the two sides
+    disagreeing about what a leaf is."""
+    if isinstance(tree, dict):
+        for k in tree:
+            yield from walk_items(tree[k], prefix + (k,))
+    else:
+        yield prefix, tree
+
+
+def unflatten_items(flat: dict) -> dict:
+    """Inverse of :func:`walk_items` over a {path tuple: leaf} map;
+    keys are emitted in sorted path order (shared by both folds, same
+    reasoning as above)."""
+    out: dict = {}
+    for path in sorted(flat, key=lambda p: tuple(map(str, p))):
+        d = out
+        for k in path[:-1]:
+            d = d.setdefault(k, {})
+        d[path[-1]] = flat[path]
+    return out
+
+
+class TreeFold:
+    """Streaming weighted FedAvg over dict pytrees: contributions fold
+    one at a time into per-path running f32 sums (``_avg_leaves`` op
+    for op — contrib ``nan_to_num(leaf.astype(f32)) * w``, running
+    add, one divide by the total weight at :meth:`finalize`), so a
+    caller never holds more than one contributor's tree plus the
+    accumulator.  **Bit-identical** to ``fedavg_trees`` over the same
+    contribution order: the float summation sequence is exactly the
+    list fold's.  Key-union semantics match too — a path missing from
+    some contributors still divides by the TOTAL weight (absent
+    contributors dilute, ``src/Utils.py:35-66``).
+
+    This is the streaming shape the round strategies' reference oracle
+    (:func:`~split_learning_tpu.runtime.strategies.aggregate_cluster`)
+    folds with, so no server round path accumulates a list of full
+    per-client parameter trees (slcheck AG001); the runtime's
+    :class:`~split_learning_tpu.runtime.aggregate.StreamingFold` is
+    the wire-facing twin (numpy/mesh backends, reorder window) proven
+    bit-identical against it."""
+
+    def __init__(self):
+        self._acc: dict = {}
+        self._dtype: dict = {}
+        self.total_w: float = 0.0
+
+    def add(self, tree: Any, weight: float = 1.0) -> None:
+        self.total_w += float(weight)
+        for path, leaf in walk_items(tree):
+            t = jnp.nan_to_num(
+                jnp.asarray(leaf, dtype=jnp.float32)) * weight
+            if path in self._acc:
+                self._acc[path] = self._acc[path] + t
+            else:
+                self._acc[path] = t
+                self._dtype[path] = jnp.asarray(leaf).dtype
+
+    def finalize(self) -> dict:
+        if not self._acc:
+            return {}
+
+        def div(path):
+            avg = self._acc[path] / self.total_w
+            dt = self._dtype[path]
+            return (jnp.round(avg).astype(dt) if _is_int_dtype(dt)
+                    else avg.astype(dt))
+
+        return unflatten_items({p: div(p) for p in self._acc})
 
 
 def fedavg_psum(params: Any, weight: jnp.ndarray, axis_name: str) -> Any:

@@ -1,0 +1,714 @@
+"""Streaming sharded aggregation plane (ROADMAP item 4).
+
+The server used to materialize one full parameter tree per client and
+FedAvg-fold them all at once at the UPDATE barrier
+(``runtime/server.py:_fold_update`` collecting, then
+``runtime/strategies.py:aggregate_cluster`` folding) — aggregate wall
+and host memory grew linearly with fleet width while every client idled
+behind the slowest one.  This module rebuilds that data plane as a
+streaming, hierarchical, optionally mesh-sharded fold:
+
+* :class:`StreamingFold` — an incremental weighted-sum accumulator.
+  Each Update folds into a per-stage running sum the moment the server
+  decodes it, so the barrier holds O(1) parameter trees instead of
+  O(clients) and per-client fold cost is constant.  **Determinism
+  contract**: contributions fold in the canonical ``(stage,
+  client_id)`` order whatever order frames arrive — a small reorder
+  window holds early arrivals until their predecessors land (or are
+  dropped), so the float summation sequence is exactly the barrier
+  oracle's (``aggregate_cluster`` over the client-id-sorted list) and
+  the result is **bit-identical** to it, chaos dup/reorder/drop
+  included.  Window memory is O(arrival skew): zero when updates land
+  in client order, and never worse than the old barrier's O(clients).
+
+* :class:`L1Aggregator` — the aggregator tree (``aggregation.fan-in``):
+  clients publish their Update to a per-group ``aggregate_queue_*``
+  instead of ``rpc_queue``; an L1 aggregator folds its ≤ fan-in members
+  into one :class:`~split_learning_tpu.runtime.protocol.PartialAggregate`
+  (per-stage weighted SUMS + total weight, so the root continues the
+  fold without re-dividing) published to the server.  Per-node fan-in
+  stays constant at 100+ clients.  An L1 that dies mid-round degrades
+  to direct-to-root: the server drains the orphaned group queue itself
+  (counted ``agg_l1_fallbacks``) and folds the members at the group's
+  canonical position, so tree rounds stay deterministic.  Note the
+  tree changes the summation SHAPE (``(a+b)+(c+d)`` vs the flat
+  ``((a+b)+c)+d``), so tree mode is deterministic-but-not-bit-identical
+  to the flat fold — the documented trade for constant fan-in.
+
+* :class:`MeshFoldBackend` — the running sum, the FedAvg divide and the
+  server-side optimizer step run as jitted elementwise ops on arrays
+  sharded across the server's device mesh (leaf axis 0 over an ``agg``
+  axis, the shard/gather-fn pattern), instead of replicated host
+  pytrees; accumulator buffers are donated so the fold updates in
+  place.  :class:`HostFoldBackend` is the numpy twin — both replicate
+  ``ops/fedavg.py:_avg_leaves`` op for op, so host and mesh folds are
+  bit-identical on CPU.
+
+* server-side optimizer (``aggregation.server-momentum``, FedAvgM):
+  ``v = m·v + (base - avg); new = base - v`` applied leafwise inside
+  the fold's finalize — with ``m = 0`` (default) this is plain FedAvg.
+  Velocity lives in the backend's (sharded) representation between
+  rounds.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from typing import Any
+
+import numpy as np
+
+from split_learning_tpu.ops.fedavg import (
+    is_int_dtype as _is_int_dtype, unflatten_items as _unflatten,
+    walk_items as _flat_items,
+)
+from split_learning_tpu.runtime.protocol import (
+    FrameAssembler, PartialAggregate, Update, aggregate_queue,
+    encode_parts, RPC_QUEUE,
+)
+
+#: strategies whose per-invocation aggregation consumes the WHOLE update
+#: list at once (``aggregate_cluster(ups)``) — the only shape a
+#: streaming fold can replace.  relay / periodic / fedasync read
+#: individual ``u.params`` (per-client persistence, subset merges), so
+#: they keep the barrier semantics and streaming stays off.
+FOLD_STRATEGIES = frozenset({"fedavg", "sda", "cluster_relay"})
+
+
+class UpdateBatch(list):
+    """``train_cluster``'s return value when a streaming fold ran: the
+    (weight-stripped) Update list plus the precomputed fold result that
+    ``aggregate_cluster`` consumes instead of re-folding."""
+    fold: "FoldResult | None" = None
+
+
+@dataclasses.dataclass
+class FoldResult:
+    params: Any
+    stats: Any
+    n_samples: int
+    fold_s: float = 0.0            # wall spent folding (overlapped)
+    peak_tree_copies: float = 0.0  # window HWM in full-tree equivalents
+    window_hwm: int = 0            # most simultaneous held contributions
+    folded: int = 0                # contributions folded
+    partials: int = 0              # PartialAggregate contributions
+
+
+# --------------------------------------------------------------------------
+# fold backends
+# --------------------------------------------------------------------------
+# Both replicate ops/fedavg.py:_avg_leaves op for op:
+#   t   = nan_to_num(leaf.astype(f32)) * w
+#   acc = t | acc + t          (canonical order)
+#   avg = acc / total_w        (int leaves: round first)
+# so a streamed fold is bit-identical to the barrier fold, and the mesh
+# backend is bit-identical to the host one on CPU (elementwise IEEE ops).
+
+class HostFoldBackend:
+    """Numpy accumulate/divide — the single-host default."""
+
+    name = "host"
+
+    def contrib(self, leaf, w) -> np.ndarray:
+        return np.nan_to_num(np.asarray(leaf, dtype=np.float32)) * w
+
+    def ingest(self, sums_leaf) -> np.ndarray:
+        """Adopt a PartialAggregate's precomputed f32 sum leaf."""
+        return np.asarray(sums_leaf, dtype=np.float32)
+
+    def add(self, acc, t):
+        return acc + t
+
+    def finalize(self, acc, total_w: float, dtype) -> np.ndarray:
+        avg = acc / np.float32(total_w)
+        if _is_int_dtype(dtype):
+            return np.round(avg).astype(dtype)
+        return avg.astype(dtype)
+
+    def momentum_step(self, base, avg32, vel, m: float):
+        """FedAvgM: returns (new_param_f32, new_velocity)."""
+        b = np.asarray(base, dtype=np.float32)
+        v = m * vel + (b - avg32) if vel is not None else (b - avg32)
+        return b - v, v
+
+    def to_host(self, x) -> np.ndarray:
+        return np.asarray(x)
+
+    def nbytes(self, x) -> int:
+        return np.asarray(x).nbytes
+
+
+class MeshFoldBackend:
+    """Accumulate/divide/optimizer as jitted ops on arrays sharded over
+    the server's device mesh (``aggregation.sharded``).
+
+    Each leaf shards along axis 0 over a 1-D ``agg`` mesh axis when the
+    axis divides evenly (replicated otherwise — small leaves are not
+    worth a ragged layout).  The add donates the accumulator buffer, so
+    per-client fold cost is one sharded elementwise add with no fresh
+    allocation; only ``finalize`` gathers to host.
+    """
+
+    name = "mesh"
+
+    def __init__(self, devices=None):
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec
+        self._jax = jax
+        devs = list(devices) if devices is not None else jax.devices()
+        self.n_devices = len(devs)
+        self.mesh = Mesh(np.asarray(devs), ("agg",))
+        self._NS, self._P = NamedSharding, PartitionSpec
+        self._contrib = jax.jit(
+            lambda x, w: jnp.nan_to_num(x.astype(jnp.float32)) * w)
+        self._add = jax.jit(lambda a, t: a + t, donate_argnums=(0,))
+        self._div = jax.jit(lambda a, tw: a / tw)
+        self._div_round = jax.jit(lambda a, tw: jnp.round(a / tw))
+        # FedAvgM inner step: v' = m v + (b - a); p' = b - v'
+        def _mom(b, a, v, m):
+            nv = m * v + (b - a)
+            return b - nv, nv
+        self._mom = jax.jit(_mom)
+
+    def _sharding(self, shape):
+        spec = (self._P("agg")
+                if shape and shape[0] and shape[0] % self.n_devices == 0
+                else self._P())
+        return self._NS(self.mesh, spec)
+
+    def _put(self, a: np.ndarray):
+        return self._jax.device_put(a, self._sharding(a.shape))
+
+    def contrib(self, leaf, w):
+        a = np.asarray(leaf)
+        return self._contrib(self._put(a), np.float32(w))
+
+    def ingest(self, sums_leaf):
+        return self._put(np.asarray(sums_leaf, dtype=np.float32))
+
+    def add(self, acc, t):
+        return self._add(acc, t)
+
+    def finalize(self, acc, total_w: float, dtype) -> np.ndarray:
+        fn = self._div_round if _is_int_dtype(dtype) else self._div
+        out = fn(acc, np.float32(total_w))
+        return np.asarray(self._jax.device_get(out)).astype(dtype)
+
+    def momentum_step(self, base, avg32, vel, m: float):
+        b = self._put(np.asarray(base, dtype=np.float32))
+        a = avg32 if not isinstance(avg32, np.ndarray) else self._put(avg32)
+        if vel is None:
+            vel = self._put(np.zeros(np.shape(base), np.float32))
+        return self._mom(b, a, vel, np.float32(m))
+
+    def to_host(self, x) -> np.ndarray:
+        return np.asarray(self._jax.device_get(x))
+
+    def nbytes(self, x) -> int:
+        return int(np.prod(np.shape(x), dtype=np.int64)
+                   * np.dtype(np.float32).itemsize)
+
+
+def make_fold_backend(cfg) -> HostFoldBackend | MeshFoldBackend:
+    if getattr(cfg.aggregation, "sharded", False):
+        return MeshFoldBackend()
+    return HostFoldBackend()
+
+
+# --------------------------------------------------------------------------
+# tree flatten helpers: the canonical walk/unflatten live in
+# ops/fedavg.py (imported above as _flat_items/_unflatten) — ONE copy
+# of the dict-pytree semantics, shared with the TreeFold oracle, so
+# the bit-identity contract cannot be broken by the two folds
+# disagreeing about what a leaf is.
+# --------------------------------------------------------------------------
+
+def _tree_nbytes(tree) -> int:
+    return sum(np.asarray(leaf).nbytes for _, leaf in _flat_items(tree))
+
+
+# --------------------------------------------------------------------------
+# the streaming fold
+# --------------------------------------------------------------------------
+
+class _StageFold:
+    """Per-stage canonical-order fold state."""
+
+    def __init__(self, order: list):
+        self.order = list(order)          # canonical fold order (keys)
+        self.order_set = set(self.order)
+        self.next = 0                     # next canonical position
+        self.pending: dict = {}           # key -> held contribution
+        self.extras: dict = {}            # keys outside the plan
+        self.folded: set = set()
+        self.gone: set = set()            # dropped; stop waiting for them
+        self.acc: dict = {}               # path -> backend accumulator
+        self.dtype: dict = {}             # path -> original np dtype
+        self.total_w: float = 0.0
+        self.stat_acc: dict = {}
+        self.stat_dtype: dict = {}
+        self.stat_total_w: float = 0.0
+
+
+class StreamingFold:
+    """Incremental per-stage weighted FedAvg with a canonical-order
+    reorder window (module docstring has the determinism contract).
+
+    ``expected`` maps stage -> the ordered list of contribution keys
+    (client ids, or group keys at an aggregator-tree root).  Duplicate
+    contributions for a key are dropped and counted (``agg_dup_drops``)
+    — at-least-once delivery must not double-weight a client.
+    Thread-safe (the rpc pump and L1 threads may race an exporter).
+    """
+
+    def __init__(self, expected: dict, *, backend=None, faults=None,
+                 hists=None):
+        self.backend = backend if backend is not None else HostFoldBackend()
+        if faults is None:
+            from split_learning_tpu.runtime.trace import (
+                default_fault_counters,
+            )
+            faults = default_fault_counters
+        self.faults = faults
+        self.hists = hists
+        self._lock = threading.Lock()
+        self._stages = {int(s): _StageFold(keys)
+                        for s, keys in expected.items()}
+        self.n_samples = 0
+        self.fold_s = 0.0
+        self.folded = 0
+        self.partials = 0
+        self._held_bytes = 0
+        self._held_hwm_bytes = 0
+        self.window_hwm = 0
+        self._finished = None
+
+    # -- ingest --------------------------------------------------------------
+
+    def add_update(self, u: Update) -> None:
+        """Fold one client Update (params may be None — a weight-less
+        update occupies its canonical slot, counts stage-1 samples, and
+        contributes nothing, exactly like the barrier oracle skips it)."""
+        if getattr(u, "delta_base", None) is not None:
+            raise ValueError(
+                f"delta-encoded Update from {u.client_id} reached the "
+                "streaming fold un-reconstructed")
+        self._enqueue(int(u.stage), u.client_id, ("u", u),
+                      0 if u.params is None else _tree_nbytes(u.params))
+
+    def add_partial(self, stage: int, key: str, sums, weight: float,
+                    dtypes, stat_sums=None, stat_weight: float = 0.0,
+                    stat_dtypes=None, n_samples: int = 0) -> None:
+        """Fold one L1 aggregator's per-stage partial SUMS at the
+        group's canonical position."""
+        item = ("p", dict(sums=sums, weight=weight, dtypes=dtypes,
+                          stat_sums=stat_sums, stat_weight=stat_weight,
+                          stat_dtypes=stat_dtypes, n_samples=n_samples))
+        self._enqueue(int(stage), key, item,
+                      _tree_nbytes(sums) if sums else 0)
+
+    def has_key(self, stage: int, key) -> bool:
+        """True once the key is accounted for at this stage: folded,
+        held in the window, an extra, or declared gone."""
+        with self._lock:
+            st = self._stages.get(int(stage))
+            return st is not None and (
+                key in st.folded or key in st.pending
+                or key in st.extras or key in st.gone)
+
+    def drop(self, stage: int, key: str) -> None:
+        """The key will never contribute (client dropped at a barrier):
+        stop holding the window for it."""
+        with self._lock:
+            st = self._stages.get(int(stage))
+            if st is None:
+                return
+            st.gone.add(key)
+            self._drain(st)
+
+    def _enqueue(self, stage: int, key, item, nbytes: int) -> None:
+        with self._lock:
+            st = self._stages.get(stage)
+            if st is None:
+                # a stage outside the plan: fold deterministically at
+                # finish (sorted), never silently dropped
+                st = self._stages[stage] = _StageFold([])
+            if key in st.folded or key in st.pending or key in st.extras:
+                self.faults.inc("agg_dup_drops")
+                return
+            if key not in st.order_set:
+                st.extras[key] = item
+            else:
+                st.pending[key] = item
+            self._held_bytes += nbytes
+            self._held_hwm_bytes = max(self._held_hwm_bytes,
+                                       self._held_bytes)
+            self.window_hwm = max(
+                self.window_hwm,
+                sum(len(s.pending) + len(s.extras)
+                    for s in self._stages.values()))
+            self._drain(st)
+
+    # -- canonical-order drain ----------------------------------------------
+
+    def _drain(self, st: _StageFold) -> None:
+        while st.next < len(st.order):
+            k = st.order[st.next]
+            item = st.pending.pop(k, None)
+            if item is None:
+                if k in st.gone or k in st.folded:
+                    st.next += 1
+                    continue
+                return   # window holds until the predecessor lands
+            self._fold_item(st, k, item)
+            st.next += 1
+
+    def _fold_item(self, st: _StageFold, key, item) -> None:
+        t0 = time.perf_counter()
+        kind, payload = item
+        if kind == "u":
+            self._fold_update_item(st, payload)
+        else:
+            self._fold_partial_item(st, payload)
+        st.folded.add(key)
+        self.folded += 1
+        dt = time.perf_counter() - t0
+        self.fold_s += dt
+        if self.hists is not None:
+            self.hists.observe("agg_fold", dt)
+
+    def _fold_update_item(self, st: _StageFold, u: Update) -> None:
+        if u.stage == 1:
+            self.n_samples += u.num_samples
+        if u.params is None:
+            return
+        self._held_bytes -= _tree_nbytes(u.params)
+        w = max(1, u.num_samples)
+        st.total_w += w
+        be = self.backend
+        for path, leaf in _flat_items(u.params):
+            c = be.contrib(leaf, w)
+            if path in st.acc:
+                st.acc[path] = be.add(st.acc[path], c)
+            else:
+                st.acc[path] = c
+                st.dtype[path] = np.asarray(leaf).dtype
+        if u.batch_stats:
+            st.stat_total_w += w
+            for path, leaf in _flat_items(u.batch_stats):
+                c = be.contrib(leaf, w)
+                if path in st.stat_acc:
+                    st.stat_acc[path] = be.add(st.stat_acc[path], c)
+                else:
+                    st.stat_acc[path] = c
+                    st.stat_dtype[path] = np.asarray(leaf).dtype
+
+    def _fold_partial_item(self, st: _StageFold, p: dict) -> None:
+        self.partials += 1
+        self.n_samples += int(p.get("n_samples") or 0)
+        be = self.backend
+        for acc, dty, sums_key, dt_key, w_key in (
+                (st.acc, st.dtype, "sums", "dtypes", "weight"),
+                (st.stat_acc, st.stat_dtype, "stat_sums", "stat_dtypes",
+                 "stat_weight")):
+            sums = p.get(sums_key)
+            if not sums:
+                continue
+            if sums_key == "sums":
+                self._held_bytes -= _tree_nbytes(sums)
+                st.total_w += float(p[w_key])
+            else:
+                st.stat_total_w += float(p[w_key])
+            dtypes = {path: np.dtype(d)
+                      for path, d in _flat_items(p.get(dt_key) or {})}
+            for path, leaf in _flat_items(sums):
+                t = be.ingest(leaf)
+                if path in acc:
+                    acc[path] = be.add(acc[path], t)
+                else:
+                    acc[path] = t
+                    dty[path] = dtypes.get(path, np.dtype(np.float32))
+
+    def _drain_all(self) -> None:
+        for st in self._stages.values():
+            st.gone |= set(st.order)      # stop waiting; fold arrivals
+            self._drain(st)
+            for k in sorted(st.extras, key=str):
+                self._fold_item(st, k, st.extras.pop(k))
+
+    # -- results -------------------------------------------------------------
+
+    def partial(self) -> tuple[dict, int]:
+        """L1 flush: per-stage weighted SUMS (host np) + metadata, no
+        divide — the root continues the fold.  Terminal."""
+        with self._lock:
+            self._drain_all()
+            out: dict = {}
+            be = self.backend
+            for s in sorted(self._stages):
+                st = self._stages[s]
+                if not st.acc and not st.stat_acc and not st.total_w:
+                    continue
+                out[s] = {
+                    "sums": _unflatten({p: be.to_host(a)
+                                        for p, a in st.acc.items()}),
+                    "weight": st.total_w,
+                    "dtypes": _unflatten({p: str(d)
+                                          for p, d in st.dtype.items()}),
+                    "stat_sums": _unflatten(
+                        {p: be.to_host(a)
+                         for p, a in st.stat_acc.items()}),
+                    "stat_weight": st.stat_total_w,
+                    "stat_dtypes": _unflatten(
+                        {p: str(d) for p, d in st.stat_dtype.items()}),
+                }
+            return out, self.n_samples
+
+    def finish(self, base=None, momentum: float = 0.0,
+               velocity: dict | None = None) -> FoldResult:
+        """FedAvg divide (+ optional server momentum vs ``base``), in
+        canonical stage order; idempotent (returns the first result)."""
+        with self._lock:
+            if self._finished is not None:
+                return self._finished
+            self._drain_all()
+            be = self.backend
+            t0 = time.perf_counter()
+            params: dict = {}
+            stats: dict = {}
+            base_flat = (dict(_flat_items(base))
+                         if (momentum and base is not None) else None)
+            for s in sorted(self._stages):
+                st = self._stages[s]
+                flat: dict = {}
+                for path, acc in st.acc.items():
+                    dt = st.dtype[path]
+                    if base_flat is not None and path in base_flat \
+                            and not _is_int_dtype(dt):
+                        # server momentum (FedAvgM): average in f32,
+                        # optimizer step in the backend (sharded on the
+                        # mesh backend), one dtype cast at the end
+                        avg32 = be.finalize(acc, st.total_w,
+                                            np.dtype(np.float32))
+                        vel = (velocity or {}).get(path)
+                        if vel is not None and np.shape(vel) != \
+                                np.shape(base_flat[path]):
+                            # an elastic re-plan moved this path's
+                            # layer range: the old velocity is another
+                            # tensor's momentum — restart from zero
+                            vel = None
+                        new32, nv = be.momentum_step(
+                            base_flat[path], avg32, vel, momentum)
+                        if velocity is not None:
+                            velocity[path] = nv
+                        flat[path] = be.to_host(new32).astype(dt)
+                    else:
+                        flat[path] = be.finalize(acc, st.total_w, dt)
+                params.update(_unflatten(flat))
+                if st.stat_acc:
+                    stats.update(_unflatten(
+                        {p: be.finalize(a, st.stat_total_w,
+                                        st.stat_dtype[p])
+                         for p, a in st.stat_acc.items()}))
+            self.fold_s += time.perf_counter() - t0
+            result_bytes = _tree_nbytes(params)
+            peak = (1.0 + self._held_hwm_bytes / result_bytes
+                    if result_bytes else float(bool(self.window_hwm)))
+            self._finished = FoldResult(
+                params=params, stats=stats, n_samples=self.n_samples,
+                fold_s=round(self.fold_s, 6),
+                peak_tree_copies=round(peak, 3),
+                window_hwm=self.window_hwm, folded=self.folded,
+                partials=self.partials)
+            return self._finished
+
+
+def plan_fanin_groups(active: list, fan_in: int) -> list:
+    """Partition the round's (client_id, stage) send set into L1
+    aggregator groups of at most ``fan_in`` clients, per stage (a group
+    never spans stages — its partial covers one stage's key slice), in
+    canonical sorted order.  Returns ``[AggGroup]``."""
+    by_stage: dict[int, list] = {}
+    for cid, s in active:
+        by_stage.setdefault(int(s), []).append(cid)
+    groups: list[AggGroup] = []
+    gi = 0
+    for s in sorted(by_stage):
+        cids = sorted(by_stage[s])
+        for i in range(0, len(cids), fan_in):
+            groups.append(AggGroup(idx=gi, stage=s,
+                                   members=cids[i:i + fan_in]))
+            gi += 1
+    return groups
+
+
+def group_key(idx: int) -> str:
+    """Canonical root-fold key of L1 group ``idx`` (zero-padded so
+    lexicographic order == numeric order)."""
+    return f"g{idx:05d}"
+
+
+@dataclasses.dataclass
+class AggGroup:
+    idx: int
+    stage: int
+    members: list
+
+    @property
+    def key(self) -> str:
+        return group_key(self.idx)
+
+
+# --------------------------------------------------------------------------
+# L1 aggregator
+# --------------------------------------------------------------------------
+
+class L1Aggregator(threading.Thread):
+    """One aggregator-tree interior node: drains its group's
+    ``aggregate_queue``, folds member Updates in canonical member order,
+    and publishes one PartialAggregate to the server's rpc queue.
+
+    Flushes when every expected member has folded, on
+    :meth:`request_flush` (the server gave up on stragglers), or at
+    ``deadline``.  ``TEST_KILL`` (a set of aggregator names) makes the
+    thread die silently mid-round — the failure-injection hook the
+    direct-to-root fallback tests use.
+    """
+
+    TEST_KILL: set = set()
+
+    def __init__(self, bus, *, cluster: int, group: AggGroup,
+                 members: list, gen: int, deadline: float,
+                 log=None, faults=None, chunk_bytes: int | None = None,
+                 owns_bus: bool = False):
+        self.agg_id = f"aggregator_{cluster}_{group.idx}"
+        super().__init__(daemon=True, name=self.agg_id)
+        self.bus = bus
+        self.cluster = cluster
+        self.group = group
+        self.members = list(members)
+        self.gen = gen
+        self.deadline = deadline
+        self.log = log
+        if faults is None:
+            from split_learning_tpu.runtime.trace import (
+                default_fault_counters,
+            )
+            faults = default_fault_counters
+        self.faults = faults
+        self.chunk_bytes = chunk_bytes
+        self.owns_bus = owns_bus
+        self.flushed = False
+        self._flush = threading.Event()
+        self._kill = threading.Event()
+
+    def request_flush(self) -> None:
+        self._flush.set()
+
+    def kill(self) -> None:
+        """Die without flushing (tests: the L1-failure path)."""
+        self._kill.set()
+
+    def run(self) -> None:
+        fold = StreamingFold({self.group.stage: self.members},
+                             faults=self.faults)
+        asm = FrameAssembler()
+        meta: list[dict] = []
+        seen: set = set()
+        try:
+            while True:
+                if self._kill.is_set() \
+                        or self.agg_id in L1Aggregator.TEST_KILL:
+                    return   # died mid-round: the server's fallback
+                    # drains the queue direct-to-root
+                q = aggregate_queue(self.cluster, self.group.idx)
+                raw = self.bus.get(q, timeout=0.2)
+                if raw is not None:
+                    self._feed(raw, asm, fold, seen, meta)
+                done = seen >= set(self.members)
+                if done or self._flush.is_set() \
+                        or time.monotonic() >= self.deadline:
+                    self._publish(fold, meta)
+                    return
+        finally:
+            if self.owns_bus:
+                try:
+                    self.bus.close()
+                except Exception:  # noqa: BLE001 — teardown best-effort
+                    pass
+
+    def _feed(self, raw: bytes, asm: FrameAssembler, fold: StreamingFold,
+              seen: set, meta: list) -> None:
+        try:
+            msg = asm.feed(raw)
+        except Exception as e:  # noqa: BLE001 — one corrupt frame must
+            # cost one message, not the aggregator
+            self.faults.inc("corrupt_rejected")
+            if self.log is not None:
+                self.log.warning(f"{self.agg_id}: dropping undecodable "
+                                 f"frame: {e}")
+            return
+        if msg is None or not isinstance(msg, Update):
+            return
+        if msg.round_idx != self.gen:
+            self.faults.inc("agg_stale_drops")
+            return
+        if msg.client_id in seen:
+            self.faults.inc("agg_dup_drops")
+            return
+        seen.add(msg.client_id)
+        fold.add_update(msg)
+        meta.append({"client_id": msg.client_id, "stage": msg.stage,
+                     "num_samples": msg.num_samples, "ok": msg.ok,
+                     "telemetry": msg.telemetry})
+        if self.log is not None:
+            self.log.received(f"UPDATE {msg.client_id} (L1 fold)")
+
+    def _publish(self, fold: StreamingFold, meta: list) -> None:
+        stages, n_samples = fold.partial()
+        ent = stages.get(self.group.stage, {})
+        msg = PartialAggregate(
+            aggregator_id=self.agg_id, cluster=self.cluster,
+            group=self.group.idx, stage=self.group.stage,
+            round_idx=self.gen, sums=ent.get("sums"),
+            weight=float(ent.get("weight") or 0.0),
+            dtypes=ent.get("dtypes"), stat_sums=ent.get("stat_sums"),
+            stat_weight=float(ent.get("stat_weight") or 0.0),
+            stat_dtypes=ent.get("stat_dtypes"), n_samples=n_samples,
+            members=meta)
+        for part in encode_parts(msg, self.chunk_bytes):
+            self.bus.publish(RPC_QUEUE, part)  # slcheck: wire=PartialAggregate
+        self.flushed = True
+        if self.log is not None:
+            self.log.sent(f"PARTIALAGGREGATE members={len(meta)}/"
+                          f"{len(self.members)}")
+
+
+def drain_group_queue(bus, cluster: int, group_idx: int, gen: int,
+                      assembler: FrameAssembler, faults,
+                      log=None) -> list[Update]:
+    """Direct-to-root fallback: drain whatever a dead (or flushed) L1's
+    queue currently holds and return the fresh-generation Updates, so
+    the root can fold the members itself."""
+    out: list[Update] = []
+    while True:
+        q = aggregate_queue(cluster, group_idx)
+        raw = bus.get(q, timeout=0.0)
+        if raw is None:
+            return out
+        try:
+            msg = assembler.feed(raw)
+        except Exception as e:  # noqa: BLE001 — count and continue
+            faults.inc("corrupt_rejected")
+            if log is not None:
+                log.warning(f"fallback drain: undecodable frame: {e}")
+            continue
+        if msg is None or not isinstance(msg, Update):
+            continue
+        if msg.round_idx != gen:
+            faults.inc("agg_stale_drops")
+            continue
+        out.append(msg)

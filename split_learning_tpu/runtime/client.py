@@ -55,8 +55,8 @@ from split_learning_tpu.runtime.codec import make_codecs, wire_raw_nbytes
 from split_learning_tpu.runtime.protocol import (
     Activation, EpochEnd, FrameAssembler, Gradient, Heartbeat, Notify,
     Pause, Ready, Register, SparseLeaf, Start, Stop, Syn, QuantLeaf,
-    Update, encode, encode_parts, gradient_queue, intermediate_queue,
-    reply_queue, RPC_QUEUE,
+    Update, aggregate_queue, encode, encode_parts, gradient_queue,
+    intermediate_queue, reply_queue, RPC_QUEUE,
 )
 from split_learning_tpu.runtime.spans import make_tracer, unpack_ctx
 from split_learning_tpu.runtime.validation import dataset_for_model
@@ -480,6 +480,7 @@ class ProtocolClient:
         # delta is sent ONLY when these agree (else: full frame)
         self._delta_base = None
         self._delta_advert = None
+        self._agg_group = None   # L1 group index (aggregation.fan-in)
         if cfg.checkpoint.load:
             self._load_ef_state()
         # device-resident NaN sentinel: hot loops fold jnp.isfinite
@@ -734,6 +735,12 @@ class ProtocolClient:
         # version it holds for us; _send_update sends a delta only when
         # our local base carries the same tag (else: full-frame resync)
         self._delta_advert = extra.get("delta_base_version")
+        # aggregator tree (aggregation.fan-in): the round UPDATE goes
+        # to this L1 group's aggregate queue instead of rpc_queue
+        # (None = direct-to-root; re-read every START, the tree can
+        # re-shape per round).  Tree rounds never advertise a delta
+        # base, so the full-frame path follows automatically.
+        self._agg_group = extra.get("agg_group")
         # server-issued per-invocation generation: stamps every message
         # this client sends so the server/peers can drop strays from an
         # invocation that was already abandoned (round_idx alone can't —
@@ -931,8 +938,13 @@ class ProtocolClient:
         # gets end-of-round telemetry even with heartbeats disabled
         tel = self.telemetry.snapshot().as_dict()
         # TENSOR-framed and chunked: a shard UPDATE is the biggest frame
-        # a client ever publishes
-        self._publish_parts(RPC_QUEUE, lambda ctx, p=params_h, s=stats_h,
+        # a client ever publishes.  Under the aggregator tree the
+        # upload lands on this client's L1 group queue; the model
+        # allows Update on both rpc and aggregate families.
+        dest = RPC_QUEUE
+        if getattr(self, "_agg_group", None) is not None:
+            dest = aggregate_queue(self.cluster, self._agg_group)
+        self._publish_parts(dest, lambda ctx, p=params_h, s=stats_h,
                             n=self.num_samples, ok=self.round_ok,
                             fence=self.fence, cl=self.cluster,
                             db=delta_base, tel=tel:

@@ -34,6 +34,7 @@ the per-microbatch data plane, not here.
 
 from __future__ import annotations
 
+import threading
 from typing import Any
 
 import numpy as np
@@ -157,26 +158,68 @@ class DeltaShadow:
             faults = default_fault_counters
         self.faults = faults
         self._shadow: dict[str, tuple[int, Any]] = {}
+        # running byte total, maintained incrementally: nbytes() sits
+        # on the server's round path (gauge refresh per START fan-out
+        # and per lost-client prune), so an O(clients x leaves) rescan
+        # there would scale with exactly the fleet width the streaming
+        # aggregation plane exists to remove
+        self._nbytes_total = 0
+        self._nbytes_by_client: dict[str, int] = {}
+        # the lost-client prune runs on whatever thread advances the
+        # FleetMonitor — including the exporter's HTTP handler — while
+        # note_sent/fold run on the round/pump thread; the compound
+        # ledger updates (total += new - old) need the lock or a
+        # clear/note_sent interleave drifts the gauge and can pin a
+        # pruned client's tree uncounted
+        self._lock = threading.Lock()
+
+    @staticmethod
+    def _tree_nbytes(tree: Any) -> int:
+        import jax
+        return sum(int(np.asarray(leaf).nbytes)
+                   for leaf in jax.tree_util.tree_leaves(tree))
 
     def note_sent(self, client_id: str, version: int, tree: Any) -> None:
-        self._shadow[client_id] = (version, tree)
+        n = self._tree_nbytes(tree)
+        with self._lock:
+            self._nbytes_total += (n
+                                   - self._nbytes_by_client.get(
+                                       client_id, 0))
+            self._nbytes_by_client[client_id] = n
+            self._shadow[client_id] = (version, tree)
 
     def version_for(self, client_id: str) -> int | None:
-        ent = self._shadow.get(client_id)
+        with self._lock:
+            ent = self._shadow.get(client_id)
         return ent[0] if ent is not None else None
 
     def clear(self, client_id: str | None = None) -> None:
-        if client_id is None:
-            self._shadow.clear()
-        else:
-            self._shadow.pop(client_id, None)
+        with self._lock:
+            if client_id is None:
+                self._shadow.clear()
+                self._nbytes_total = 0
+                self._nbytes_by_client.clear()
+            else:
+                self._shadow.pop(client_id, None)
+                self._nbytes_total -= self._nbytes_by_client.pop(
+                    client_id, 0)
+
+    def nbytes(self) -> int:
+        """Host bytes pinned across every client's shadow tree — the
+        ``sl_agg_shadow_bytes`` gauge (memory audit: without the
+        lost-client and elastic prunes this grows without bound under
+        membership churn).  O(1): maintained incrementally by
+        note_sent/clear."""
+        with self._lock:
+            return self._nbytes_total
 
     def fold(self, client_id: str, base_version: int,
              delta: Any) -> Any | None:
         """base + dequant(delta) as a full float tree, or None when the
         shadow does not hold ``base_version`` for this client (version
         gap -> the caller must trigger a full-frame resync)."""
-        ent = self._shadow.get(client_id)
+        with self._lock:
+            ent = self._shadow.get(client_id)
         if ent is None or ent[0] != base_version:
             self.faults.inc("delta_resyncs")
             return None

@@ -63,6 +63,15 @@ def gradient_queue(stage: int, client_id: str) -> str:
     return f"gradient_queue_{stage}_{client_id}"
 
 
+def aggregate_queue(cluster: int, group: int) -> str:
+    """Aggregator-tree upload queue (``aggregation.fan-in``): the
+    clients of L1 group ``group`` publish their round UPDATE here
+    instead of ``rpc_queue``; the group's
+    :class:`~split_learning_tpu.runtime.aggregate.L1Aggregator` folds
+    them into one :class:`PartialAggregate` for the root."""
+    return f"aggregate_queue_{cluster}_{group}"
+
+
 # --------------------------------------------------------------------------
 # control messages
 # --------------------------------------------------------------------------
@@ -168,6 +177,34 @@ class Pause:
 class Stop:
     """server → client: terminate."""
     reason: str = ""
+
+
+@dataclasses.dataclass
+class PartialAggregate:
+    """L1 aggregator → server (rpc queue): one aggregator-tree group's
+    folded contribution (``aggregation.fan-in``,
+    ``runtime/aggregate.py``).  Carries the group's per-path weighted
+    **sums** (f32, NOT averaged — the root continues the running fold
+    and divides once) plus the total weight, so tree depth never
+    changes how many divides touch the data.  ``members`` is the
+    per-client metadata the root needs for barrier bookkeeping and
+    fleet telemetry (client_id, stage, num_samples, ok, telemetry) —
+    the clients behind an L1 still count individually everywhere
+    except the fold itself.  ``round_idx`` carries the server's
+    invocation generation, same fence as Update."""
+    aggregator_id: str
+    cluster: int
+    group: int                      # L1 group index (canonical position)
+    stage: int                      # the one stage this group covers
+    round_idx: int = 0
+    sums: Any = None                # pytree of f32 weighted sums
+    weight: float = 0.0             # total fold weight behind the sums
+    dtypes: Any = None              # pytree of original dtype strings
+    stat_sums: Any = None           # batch-stats sums (BN models)
+    stat_weight: float = 0.0
+    stat_dtypes: Any = None
+    n_samples: int = 0              # stage-1 samples folded (0 otherwise)
+    members: list | None = None     # per-client {client_id, stage, ...}
 
 
 @dataclasses.dataclass
@@ -288,13 +325,14 @@ class _TensorRef:
 
 
 CONTROL_TYPES = (Register, Ready, Notify, Update, Start, Syn, Pause,
-                 Stop, Heartbeat)
+                 Stop, Heartbeat, PartialAggregate)
 DATA_TYPES = (Activation, Gradient, EpochEnd)
 #: messages whose ndarray payloads ride the zero-copy TENSOR framing
-#: (the high-volume data plane + the round's weight upload); control
-#: messages keep the pickled frame — their payloads are small and their
-#: schema churns more
-TENSOR_TYPES = (Activation, Gradient, Update)
+#: (the high-volume data plane + the round's weight uploads — Update
+#: and the aggregator tree's PartialAggregate); control messages keep
+#: the pickled frame — their payloads are small and their schema
+#: churns more
+TENSOR_TYPES = (Activation, Gradient, Update, PartialAggregate)
 _TYPE_BY_NAME = {t.__name__: t for t in CONTROL_TYPES + DATA_TYPES}
 #: nested wire-format helpers (never valid as a top-level message)
 _WIRE_HELPERS = {"QuantLeaf": QuantLeaf, "SparseLeaf": SparseLeaf,

@@ -27,6 +27,7 @@ warning instead of wedging the server.
 from __future__ import annotations
 
 import argparse
+import copy
 import dataclasses
 import time
 from typing import Any, Callable
@@ -43,9 +44,10 @@ from split_learning_tpu.runtime.loop import TrainResult, run_training
 from split_learning_tpu.runtime.plan import (
     ClusterPlan, Registration, plan_clusters,
 )
+from split_learning_tpu.runtime import aggregate as agg_plane
 from split_learning_tpu.runtime.protocol import (
-    FrameAssembler, Heartbeat, Notify, Pause, Ready, Register, Start,
-    Stop, Syn, Update, encode, reply_queue, RPC_QUEUE,
+    FrameAssembler, Heartbeat, Notify, PartialAggregate, Pause, Ready,
+    Register, Start, Stop, Syn, Update, encode, reply_queue, RPC_QUEUE,
 )
 from split_learning_tpu.runtime.spans import unpack_ctx
 from split_learning_tpu.runtime.telemetry import FleetMonitor, GaugeSet
@@ -133,6 +135,40 @@ class ProtocolContext(MeshContext):
                                    None)).get("rpc") is not None:
             from split_learning_tpu.runtime.codec.delta import DeltaShadow
             self._delta_shadow = DeltaShadow(faults=self.faults)
+        if self.fleet is not None:
+            # a `lost` client's delta shadow is a full shard copy
+            # pinned in host memory; before this hook only the elastic
+            # prune reclaimed it — a lost-but-never-pruned client (or
+            # a non-elastic deployment) leaked its shadow forever
+            self.fleet.on_lost = self._on_client_lost
+        # streaming aggregation plane (runtime/aggregate.py, ROADMAP
+        # item 4): fold each UPDATE into a running per-stage weighted
+        # sum the moment it decodes, so the UPDATE barrier holds O(1)
+        # parameter trees instead of O(clients).  Only strategies whose
+        # aggregation consumes the whole update list at once stream;
+        # the others (relay/periodic/fedasync read individual
+        # u.params) keep barrier semantics untouched.
+        self._agg = cfg.aggregation
+        self._streaming = (self._agg.streaming and self._agg.strategy
+                           in agg_plane.FOLD_STRATEGIES)
+        self._fold_backend = (agg_plane.make_fold_backend(cfg)
+                              if self._streaming else None)
+        self._fold: agg_plane.StreamingFold | None = None
+        self._group_of: dict = {}      # client_id -> AggGroup (tree on)
+        self._l1: list = []            # this invocation's L1Aggregators
+        self._l1_fallback: dict = {}   # group idx -> fallback drain state
+        # members of a dead L1's group whose UPDATE frames the L1
+        # consumed before dying — unrecoverable, so the UPDATE barrier
+        # stops waiting for them (counted agg_fallback_abandons)
+        self._agg_gone: set = set()
+        self._l1_logs: dict = {}       # agg_id -> cached Logger (the
+        # L1's [<<<]/[>>>] markers carry the aggregator participant
+        # name, so --validate-log replays the AGGREGATOR_FSM on real
+        # runs instead of vacuously)
+        # FedAvgM velocity, keyed cluster_id -> {path: vel}: each
+        # cluster's fold is its own optimizer stream — a shared dict
+        # would feed cluster B the velocity cluster A wrote THIS round
+        self._agg_velocity: dict = {}
         # elastic membership (topology.elastic-join): ids the CURRENT
         # plans were computed from; per-ROUND alive/silent bookkeeping
         # (sequential strategies run several train_cluster invocations
@@ -264,9 +300,27 @@ class ProtocolContext(MeshContext):
                                  f"gen={msg.round_idx} (dropped)")
             else:
                 self._fold_update(msg)
+                if self._fold is not None:
+                    # streaming fold: the weights fold into the running
+                    # sum NOW (a shallow copy keeps the tree alive in
+                    # the fold's reorder window) and the barrier list
+                    # holds a weight-stripped record — O(1) full trees
+                    # at the UPDATE barrier instead of O(clients)
+                    self._fold.add_update(copy.copy(msg))
+                    msg.params = None
+                    msg.batch_stats = None
                 self._updates.append(msg)
                 self.log.received(f"UPDATE {msg.client_id} "
                                   f"samples={msg.num_samples} ok={msg.ok}")
+        elif isinstance(msg, PartialAggregate):
+            # one L1 aggregator's folded group landing at the root
+            if msg.round_idx != self._cur_gen:
+                self.faults.inc("agg_stale_drops")
+                self.log.warning(
+                    f"stale PARTIALAGGREGATE {msg.aggregator_id} "
+                    f"gen={msg.round_idx} (dropped)")
+            else:
+                self._fold_partial(msg)
         return True
 
     def _fold_update(self, msg: Update) -> None:
@@ -298,10 +352,171 @@ class ProtocolContext(MeshContext):
             msg.params = full
         msg.delta_base = None   # downstream sees a plain (full) update
 
+    def _on_client_lost(self, cid: str) -> None:
+        """FleetMonitor ``lost`` transition hook: reclaim the client's
+        delta shadow (a full shard copy pinned in host memory).  A
+        rejoiner full-frames its next UPDATE anyway — the chain repairs
+        itself, only the memory was leaking."""
+        if self._delta_shadow is not None:
+            self._delta_shadow.clear(cid)
+            self.gauges.set("agg_shadow_bytes",
+                            self._delta_shadow.nbytes())
+
+    def _fold_partial(self, msg: PartialAggregate) -> None:
+        """Fold one PartialAggregate at its group's canonical position
+        and book its members: each one gets a weight-less Update record
+        (barrier membership, ok flag, elastic liveness) and its
+        piggybacked telemetry feeds the fleet monitor — clients behind
+        an L1 stay individually visible everywhere but the fold."""
+        if self._fold is None:
+            self.log.warning(
+                f"PARTIALAGGREGATE {msg.aggregator_id} outside a "
+                "streaming invocation (dropped)")
+            return
+        self._fold.add_partial(
+            msg.stage, agg_plane.group_key(msg.group), msg.sums,
+            msg.weight, msg.dtypes, stat_sums=msg.stat_sums,
+            stat_weight=msg.stat_weight, stat_dtypes=msg.stat_dtypes,
+            n_samples=msg.n_samples)
+        for m in msg.members or []:
+            cid = m.get("client_id")
+            if cid is None:
+                continue
+            if self.fleet is not None and m.get("telemetry"):
+                self.fleet.note_heartbeat(cid, m["telemetry"])
+            # num_samples=0: the group's stage-1 samples already rode
+            # the partial's n_samples — a per-member recount would
+            # double the round total
+            self._updates.append(Update(
+                client_id=cid, stage=int(m.get("stage", msg.stage)),
+                cluster=msg.cluster, params=None, num_samples=0,
+                ok=bool(m.get("ok", True)), round_idx=msg.round_idx))
+        self.log.received(
+            f"PARTIALAGGREGATE {msg.aggregator_id} "
+            f"members={len(msg.members or [])} weight={msg.weight:g}")
+
+    #: liveness grace on a fallback drain: a dead L1 may have consumed
+    #: a member's UPDATE frames before dying — those are unrecoverable,
+    #: and the member (already in its post-round wait) will never
+    #: resend, so the barrier must not wait client_timeout for it.
+    #: The clock resets on every recovered frame, so an actively
+    #: draining queue never expires; only a drained-and-silent one
+    #: abandons its missing members (same bound as _finish_l1).
+    L1_FALLBACK_GRACE_S = 30.0
+
+    def _poll_l1(self) -> None:
+        """Aggregator-tree health check, run every UPDATE-barrier pump
+        iteration: an L1 that died without flushing degrades its group
+        to direct-to-root — the server drains the orphaned queue
+        itself and folds the members at the group's canonical
+        position, so tree rounds stay deterministic through L1 loss."""
+        for t in self._l1:
+            if t.flushed:
+                continue
+            fb = self._l1_fallback.get(t.group.idx)
+            if fb is None:
+                if t.is_alive():
+                    continue
+                self.faults.inc("agg_l1_fallbacks")
+                self.log.warning(
+                    f"aggregator {t.agg_id} died mid-round; draining "
+                    f"group {t.group.idx} direct-to-root")
+                fb = self._l1_fallback[t.group.idx] = {
+                    "group": t.group, "cluster": t.cluster,
+                    "members": set(t.members),
+                    "fold": agg_plane.StreamingFold(
+                        {t.group.stage: sorted(t.members)},
+                        faults=self.faults),
+                    "asm": FrameAssembler(), "seen": set(),
+                    "deadline": (time.monotonic()
+                                 + self.L1_FALLBACK_GRACE_S),
+                    "flushed": False}
+            if not fb["flushed"]:
+                self._drain_fallback(fb)
+            if (not fb["flushed"]
+                    and time.monotonic() >= fb["deadline"]):
+                gone = fb["members"] - fb["seen"]
+                for cid in sorted(gone):
+                    self.faults.inc("agg_fallback_abandons")
+                self.log.warning(
+                    f"fallback group {fb['group'].idx}: abandoning "
+                    f"UPDATE from {sorted(gone)} (dead aggregator "
+                    f"consumed their frames; folding "
+                    f"{len(fb['seen'])}/{len(fb['members'])} members)")
+                self._agg_gone |= gone
+                self._flush_fallback(fb)
+
+    def _drain_fallback(self, fb: dict) -> None:
+        g = fb["group"]
+        for u in agg_plane.drain_group_queue(
+                self.bus, fb["cluster"], g.idx, self._cur_gen,
+                fb["asm"], self.faults, log=self.log):
+            if u.client_id in fb["seen"]:
+                self.faults.inc("agg_dup_drops")
+                continue
+            fb["seen"].add(u.client_id)
+            fb["deadline"] = (time.monotonic()
+                              + self.L1_FALLBACK_GRACE_S)
+            self._fold_update(u)   # delta reconstruction, like the pump
+            fb["fold"].add_update(copy.copy(u))
+            u.params = None
+            u.batch_stats = None
+            if self.fleet is not None and u.telemetry:
+                self.fleet.note_heartbeat(u.client_id, u.telemetry)
+            self._updates.append(u)
+            self.log.received(f"UPDATE {u.client_id} (fallback drain)")
+        if not fb["flushed"] and fb["seen"] >= fb["members"]:
+            self._flush_fallback(fb)
+
+    def _flush_fallback(self, fb: dict) -> None:
+        """Close a fallback group: its sub-fold's partial sums land at
+        the group's canonical root position — the same summation shape
+        the L1 would have produced."""
+        g = fb["group"]
+        stages, n = fb["fold"].partial()
+        ent = stages.get(g.stage)
+        if ent:
+            self._fold.add_partial(
+                g.stage, g.key, ent["sums"], ent["weight"],
+                ent["dtypes"], stat_sums=ent["stat_sums"],
+                stat_weight=ent["stat_weight"],
+                stat_dtypes=ent["stat_dtypes"], n_samples=n)
+        else:
+            self._fold.drop(g.stage, g.key)
+        fb["flushed"] = True
+
+    def _finish_l1(self) -> None:
+        """Post-barrier aggregator-tree resolution: live unflushed L1s
+        are told to flush (the server gave up on their stragglers) and
+        their PartialAggregates pumped in; dead ones fall back to the
+        direct-to-root drain; every fallback closes into the root
+        fold.  Bounded — an L1 that can neither flush nor die within
+        the grace window is abandoned (its group key is dropped at
+        finish)."""
+        for t in self._l1:
+            if t.is_alive() and not t.flushed:
+                t.request_flush()
+        want = [(t.group.stage, t.group.key) for t in self._l1]
+
+        def landed() -> bool:
+            self._poll_l1()
+            return all(self._fold.has_key(s, k) for s, k in want)
+
+        if not landed():
+            self._pump_until(
+                landed, "aggregator flushes",
+                deadline=time.monotonic() + 30.0)
+        for fb in self._l1_fallback.values():
+            if not fb["flushed"]:
+                self._flush_fallback(fb)
+        for t in self._l1:
+            t.join(timeout=5.0)
+
     def _pump_until(self, pred: Callable[[], bool],
                     what: str | Callable[[], str],
                     deadline: float | None = None,
-                    waiting: Callable[[], set] | None = None) -> bool:
+                    waiting: Callable[[], set] | None = None,
+                    poll: Callable[[], None] | None = None) -> bool:
         """Drain rpc_queue until ``pred()``; False if the deadline passes.
 
         ``what`` may be a callable so the timeout warning names who is
@@ -319,6 +534,10 @@ class ProtocolContext(MeshContext):
         deadline = (time.monotonic() + self.client_timeout
                     if deadline is None else deadline)
         while not pred():
+            if poll is not None:
+                poll()   # e.g. L1 aggregator health -> fallback drain
+                if pred():
+                    return True
             remain = deadline - time.monotonic()
             if remain <= 0:
                 w = what() if callable(what) else what
@@ -532,6 +751,32 @@ class ProtocolContext(MeshContext):
         self._gen += 1
         self._cur_gen = self._gen
 
+        # streaming fold for this invocation: contributions fold in
+        # canonical per-stage key order — sorted client ids, or L1
+        # group keys when the aggregator tree (aggregation.fan-in) is
+        # interposed.  Built BEFORE the START fan-out so the first
+        # UPDATE to land already has somewhere to fold.
+        groups = None
+        self._group_of = {}
+        self._l1 = []
+        self._l1_fallback = {}
+        self._agg_gone = set()
+        if self._streaming:
+            fan_in = self._agg.fan_in
+            expected: dict[int, list] = {}
+            if fan_in and len(active) > fan_in:
+                groups = agg_plane.plan_fanin_groups(active, fan_in)
+                self._group_of = {cid: g for g in groups
+                                  for cid in g.members}
+                for g in groups:
+                    expected.setdefault(g.stage, []).append(g.key)
+            else:
+                for cid, s in sorted(active):
+                    expected.setdefault(s, []).append(cid)
+            self._fold = agg_plane.StreamingFold(
+                expected, backend=self._fold_backend,
+                faults=self.faults, hists=self.hists)
+
         # 2LS fixed 1:1 edge<->head pairing: when in_clusters in-groups
         # each have their own head, the forward data plane runs over
         # pair-indexed queues instead of the shared cluster queue
@@ -617,10 +862,17 @@ class ProtocolContext(MeshContext):
             # delta codec: keep a versioned shadow of EXACTLY what this
             # START carries, and advertise the version we hold — the
             # client sends a delta only against a matching base (a
-            # weight-less START advertises the standing shadow)
+            # weight-less START advertises the standing shadow).
+            # Aggregator-tree members get NO advertisement: an L1
+            # holds no shadow to reconstruct a delta against, so tree
+            # rounds always full-frame (and the standing shadow is
+            # reclaimed — it could never be used again)
             delta_ver = None
+            group = self._group_of.get(cid)
             if self._delta_shadow is not None:
-                if sp:
+                if group is not None:
+                    self._delta_shadow.clear(cid)
+                elif sp:
                     self._delta_shadow.note_sent(cid, self._cur_gen,
                                                  shard_p)
                     delta_ver = self._cur_gen
@@ -678,10 +930,19 @@ class ProtocolContext(MeshContext):
                        # trace, across processes
                        "trace_id": self.tracer.trace_id,
                        "delta_base_version": delta_ver,
+                       # aggregator tree: publish the round UPDATE to
+                       # this group's aggregate queue instead of rpc
+                       "agg_group": (group.idx if group is not None
+                                     else None),
                        "gen": self._cur_gen})))
             self.log.sent(f"START -> {cid} layers=[{a}, {end_layer}]"
                           + ("" if sp else " (no weights)"))
         fanout_span.end()
+        if self._delta_shadow is not None:
+            # shadow memory audit: bytes pinned by per-client base
+            # copies, refreshed whenever the set can have changed
+            self.gauges.set("agg_shadow_bytes",
+                            self._delta_shadow.nbytes())
 
         ids = {cid for cid, _ in active}
         with self.tracer.span("ready_wait", round=round_idx):
@@ -692,6 +953,50 @@ class ProtocolContext(MeshContext):
                 waiting=lambda: ids - self._ready)
         if not ready_ok:
             ids &= self._ready  # drop unresponsive clients mid-round
+        if self._fold is not None and groups is None:
+            # flat streaming: stop the reorder window waiting for
+            # clients dropped at the READY barrier
+            for cid, s in active:
+                if cid not in ids:
+                    self._fold.drop(s, cid)
+        if groups is not None:
+            # aggregator tree: spawn the L1 participants now, with
+            # membership narrowed to the responsive set (a client
+            # dropped at READY will never publish; its L1 must not
+            # hold the group's flush for it).  Over TCP each L1 gets
+            # its own transport stack (a blocked get serializes a
+            # TcpTransport's socket); in-proc they share the bus.
+            l1_deadline = time.monotonic() + self.client_timeout
+            for g in groups:
+                members = [m for m in g.members if m in ids]
+                if not members:
+                    self._fold.drop(g.stage, g.key)
+                    continue
+                agg_id = f"aggregator_{plan.cluster_id}_{g.idx}"
+                l1_bus, owns = self.bus, False
+                if self.cfg.transport.kind == "tcp":
+                    from split_learning_tpu.runtime.chaos import (
+                        make_runtime_transport,
+                    )
+                    l1_bus = make_runtime_transport(
+                        self.cfg, agg_id, faults=self.faults)
+                    owns = True
+                l1_log = self._l1_logs.get(agg_id)
+                if l1_log is None:
+                    l1_log = self._l1_logs[agg_id] = Logger.for_run(
+                        self.cfg, agg_id, console=False)
+                t = agg_plane.L1Aggregator(
+                    l1_bus, cluster=plan.cluster_id, group=g,
+                    members=members, gen=self._cur_gen,
+                    deadline=l1_deadline, log=l1_log,
+                    faults=self.faults,
+                    chunk_bytes=self.cfg.transport.chunk_mb << 20,
+                    owns_bus=owns)
+                t.start()
+                self._l1.append(t)
+            self.log.info(
+                f"aggregator tree: {len(self._l1)} L1 group(s), "
+                f"fan-in {self._agg.fan_in}", "cyan")
         stage_of = dict(active)
         syn_span = self.tracer.start("syn_fanout", round=round_idx)
         for cid in ids:
@@ -732,17 +1037,60 @@ class ProtocolContext(MeshContext):
         self.log.sent(f"PAUSE -> {sorted(ids)}")
         pause_span.end()
 
-        got = lambda: {u.client_id for u in self._updates} >= ids  # noqa
+        # _agg_gone: members a dead L1 consumed-then-lost — their
+        # UPDATE can never arrive, so the barrier stops counting them
+        got = lambda: ({u.client_id for u in self._updates}  # noqa
+                       | self._agg_gone) >= ids
         with self.tracer.span("update_wait", round=round_idx):
             self._pump_until(
                 got,
-                lambda: (f"UPDATE from "
-                         f"{ids - {u.client_id for u in self._updates}}"),
+                lambda: ("UPDATE from " + str(
+                    ids - {u.client_id for u in self._updates}
+                    - self._agg_gone)),
                 deadline=time.monotonic() + self.client_timeout,
                 waiting=lambda: (
-                    ids - {u.client_id for u in self._updates}))
+                    ids - {u.client_id for u in self._updates}
+                    - self._agg_gone),
+                poll=self._poll_l1 if self._l1 else None)
+        if self._l1:
+            self._finish_l1()
         updates = list(self._updates)
         self._updates = []
+        if self._fold is not None:
+            # the overlapped fold already consumed (and freed) every
+            # tree; what is left is the O(1) divide + optimizer step.
+            # The aggregate span carries the overlapped fold wall so
+            # sl_trace/sl_perf attribute the phase honestly.
+            fold, self._fold = self._fold, None
+            m = float(self._agg.server_momentum)
+            with self.tracer.span(
+                    "aggregate", round=round_idx,
+                    cluster=plan.cluster_id,
+                    overlapped_fold_s=round(fold.fold_s, 6)):
+                result = fold.finish(
+                    base=params if m else None, momentum=m,
+                    velocity=(self._agg_velocity.setdefault(
+                        plan.cluster_id, {}) if m else None))
+            updates = agg_plane.UpdateBatch(updates)
+            updates.fold = result
+            self.log.metric(
+                kind="agg", gen=self._cur_gen, round_idx=round_idx,
+                cluster=plan.cluster_id,
+                backend=(self._fold_backend.name
+                         if self._fold_backend is not None else "host"),
+                fan_in=(self._agg.fan_in if groups is not None else 0),
+                fold_s=result.fold_s, folded=result.folded,
+                partials=result.partials,
+                window_hwm=result.window_hwm,
+                peak_tree_copies=result.peak_tree_copies,
+                n_samples=result.n_samples)
+            self.log.info(
+                f"streamed aggregate: folded={result.folded} "
+                f"(partials={result.partials}) fold={result.fold_s:.3f}s"
+                f" peak_tree_copies={result.peak_tree_copies:g}",
+                "cyan")
+            self._l1 = []
+            self._l1_fallback = {}
         # elastic liveness bookkeeping, folded per ROUND at the next
         # refresh_plans: any UPDATE during the round marks a client
         # alive even if it sat out other invocations of a sequential
@@ -853,6 +1201,9 @@ class ProtocolContext(MeshContext):
         if flush is not None:
             flush(timeout=10.0)
         self.log.sent(f"STOP -> all ({reason})")
+        for l1_log in self._l1_logs.values():
+            l1_log.close()
+        self._l1_logs = {}
         self.tracer.close()
 
 

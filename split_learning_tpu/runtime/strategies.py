@@ -26,7 +26,7 @@ import jax
 import numpy as np
 
 from split_learning_tpu.config import Config
-from split_learning_tpu.ops.fedavg import fedavg_trees
+from split_learning_tpu.ops.fedavg import TreeFold, fedavg_trees
 from split_learning_tpu.runtime.context import TrainContext
 from split_learning_tpu.runtime.plan import ClusterPlan
 from split_learning_tpu.runtime.protocol import Update
@@ -59,14 +59,30 @@ def aggregate_cluster(updates: Sequence[Update]) -> tuple[Any, Any, int]:
 
     Returns (params_tree, stats_tree, total_stage1_samples).
 
+    When the protocol server already folded the round incrementally
+    (``aggregation.streaming``, ``runtime/aggregate.py``), ``updates``
+    arrives as an :class:`~split_learning_tpu.runtime.aggregate.
+    UpdateBatch` whose ``fold`` member carries the finished
+    :class:`~split_learning_tpu.runtime.aggregate.FoldResult` — the
+    per-client trees were folded (and freed) the moment each UPDATE
+    decoded, so this function just unwraps the result instead of
+    re-folding.  Otherwise it runs the **reference oracle**: the
+    barrier fold the streaming plane is proven bit-identical against
+    in tests, itself streamed per stage through
+    :class:`~split_learning_tpu.ops.fedavg.TreeFold` (one contributor
+    tree + the accumulator in flight — never a list of full trees,
+    slcheck AG001).
+
     Delta-encoded updates (``transport.codec`` rpc family) must be
     reconstructed against the server's versioned shadow BEFORE they
     reach this fold (``runtime/server.py _fold_update``) — averaging a
     delta as if it were a weight tree would corrupt the global model
     silently, so an un-reconstructed one is a hard error here.
-    Weight-less updates (FLEX non-aggregation rounds, or a delta whose
-    version chain broke and was stripped) carry no tree to fold and
-    are skipped; their samples still count toward the round total."""
+    Weight-less updates (FLEX non-aggregation rounds, streamed rounds
+    whose trees already folded, or a delta whose version chain broke
+    and was stripped) carry no tree to fold and are skipped; their
+    samples still count toward the round total."""
+    fold = getattr(updates, "fold", None)
     by_stage: dict[int, list[Update]] = {}
     n_weightless = 0
     for u in updates:
@@ -74,11 +90,16 @@ def aggregate_cluster(updates: Sequence[Update]) -> tuple[Any, Any, int]:
             raise ValueError(
                 f"delta-encoded Update from {u.client_id} (base "
                 f"v{u.delta_base}) reached aggregation un-reconstructed")
-        if u.params is None:
+        if fold is not None or u.params is None:
             if u.stage == 1:
                 n_weightless += u.num_samples
             continue
         by_stage.setdefault(u.stage, []).append(u)
+    if fold is not None:
+        # the streamed result IS the barrier fold (bit-identical by
+        # the canonical-order contract); its own sample count already
+        # includes every stage-1 contribution
+        return fold.params, fold.stats, fold.n_samples
     params: dict = {}
     stats: dict = {}
     n_samples = n_weightless   # trained samples count even when the
@@ -90,13 +111,15 @@ def aggregate_cluster(updates: Sequence[Update]) -> tuple[Any, Any, int]:
         # rounds (e.g. a chaos run vs its fault-free twin) diverge in
         # the last bits
         ups = sorted(ups, key=lambda u: u.client_id)
-        weights = [max(1, u.num_samples) for u in ups]
-        params.update(fedavg_trees([u.params for u in ups], weights))
-        st = [u.batch_stats for u in ups if u.batch_stats]
-        if st:
-            stats.update(fedavg_trees(
-                st, [max(1, u.num_samples) for u in ups
-                     if u.batch_stats]))
+        pfold, sfold = TreeFold(), TreeFold()
+        for u in ups:
+            w = max(1, u.num_samples)
+            pfold.add(u.params, w)
+            if u.batch_stats:
+                sfold.add(u.batch_stats, w)
+        params.update(pfold.finalize())
+        if sfold.total_w:
+            stats.update(sfold.finalize())
         if stage == 1:
             n_samples += sum(u.num_samples for u in ups)
     return params, stats, n_samples
@@ -341,7 +364,10 @@ class PeriodicStrategy(RoundStrategy):
             got_w = [u for u in ups if u.params is not None]
             for u in got_w:
                 base = self._client_params.get(u.client_id, params)
-                self._client_params[u.client_id] = _fill(base, u.params)
+                # FLEX client-level persistence IS the strategy (one
+                # bounded tree per stage-1 client, not a round-path
+                # accumulation)
+                self._client_params[u.client_id] = _fill(base, u.params)  # slcheck: agg-state
             if got_w:
                 p, s, _ = aggregate_cluster(got_w)
                 cluster_params.append(_fill(params, p))

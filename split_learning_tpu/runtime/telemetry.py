@@ -335,6 +335,12 @@ class FleetMonitor:
         self._last_pump: float | None = None
         self.transitions: collections.deque = collections.deque(
             maxlen=self.MAX_TRANSITIONS)
+        # optional hook fired (under the monitor lock) when a client
+        # transitions INTO `lost`: the server prunes per-client server
+        # state that would otherwise leak — today the delta codec's
+        # shadow trees (runtime/server.py _on_client_lost).  Must be
+        # cheap and non-blocking.
+        self.on_lost = None
 
     # -- ingest --------------------------------------------------------------
 
@@ -432,6 +438,11 @@ class FleetMonitor:
                "to": to, "why": why}
         h.state = to
         self.transitions.append(rec)
+        if to == "lost" and self.on_lost is not None:
+            try:
+                self.on_lost(cid)
+            except Exception:  # noqa: BLE001 — pruning is best-effort;
+                pass           # a hook bug must not kill the monitor
         if self._log is not None:
             line = (f"fleet: {cid} {rec['from']} -> {to} ({why})")
             if to == "healthy":
@@ -606,6 +617,11 @@ _PERF_FAMILIES = (
      "Peak device memory bytes observed this round."),
     ("compile_seconds_total", "sl_compile_seconds_total", "counter",
      "Cumulative XLA compile wall-clock seconds."),
+    # streaming aggregation plane (runtime/aggregate.py): host bytes
+    # pinned by the delta codec's per-client shadow trees — what the
+    # fleet-monitor `lost` prune and the elastic prune reclaim
+    ("agg_shadow_bytes", "sl_agg_shadow_bytes", "gauge",
+     "Host bytes pinned by per-client delta-codec shadow trees."),
 )
 
 

@@ -69,6 +69,14 @@ FAULT_COUNTER_NAMES = frozenset({
     # compiles observed after round 0 — the live twin of slcheck's
     # static retrace rule; rendered as sl_retraces_total on /metrics
     "retraces",
+    # streaming aggregation plane (runtime/aggregate.py): duplicate
+    # contributions the fold refused to double-weight, stale-generation
+    # frames dropped at an L1 or the fallback drain, L1 aggregators
+    # that died mid-round and degraded to the direct-to-root drain,
+    # and group members abandoned because a dead L1 consumed their
+    # UPDATE frames before dying (one inc per member)
+    "agg_dup_drops", "agg_stale_drops", "agg_l1_fallbacks",
+    "agg_fallback_abandons",
 })
 
 #: Declared registry of latency-histogram names (same contract as
@@ -84,6 +92,9 @@ HISTOGRAM_NAMES = frozenset({
     # per-step dispatch wall (every step) and dispatch+device wall
     # (sampled steps only — the fenced ones)
     "step_dispatch", "step_device",
+    # streaming aggregation plane (runtime/aggregate.py): wall of one
+    # contribution's fold into the running sum (per Update / partial)
+    "agg_fold",
 })
 
 #: Declared registry of gauge names (``runtime/telemetry.py GaugeSet``;
@@ -106,6 +117,10 @@ GAUGE_NAMES = frozenset({
     # server-side (set by the FleetMonitor on every advance)
     "fleet_size", "fleet_healthy", "fleet_degraded",
     "fleet_straggler", "fleet_lost",
+    # streaming aggregation plane (runtime/aggregate.py): host bytes
+    # pinned by the delta codec's per-client shadow trees — the memory
+    # the `lost`-client prune and elastic prune reclaim
+    "agg_shadow_bytes",
 })
 
 

@@ -1,0 +1,733 @@
+"""Streaming sharded aggregation plane (``runtime/aggregate.py``).
+
+The determinism contract under test: the streaming fold — incremental,
+canonical (stage, client_id) order via a reorder window — is
+**bit-identical** to the barrier-fold reference oracle
+(``strategies.aggregate_cluster``) whatever order frames arrive, chaos
+dup/reorder/drop included, codec on and off; the mesh-sharded backend
+is bit-identical to the host backend on CPU; the aggregator tree is
+deterministic (identical runs agree bitwise) and degrades to a counted
+direct-to-root fallback when an L1 dies mid-round.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+from split_learning_tpu.runtime.aggregate import (
+    AggGroup, FOLD_STRATEGIES, HostFoldBackend, L1Aggregator,
+    MeshFoldBackend, StreamingFold, UpdateBatch, drain_group_queue,
+    group_key, plan_fanin_groups,
+)
+from split_learning_tpu.runtime.protocol import (
+    FrameAssembler, PartialAggregate, Update, aggregate_queue, decode,
+    encode, encode_parts,
+)
+from split_learning_tpu.runtime.strategies import aggregate_cluster
+from split_learning_tpu.runtime.trace import FaultCounters
+
+
+def _tree(rng, scale=1.0, extra_key=None, dtype=np.float32):
+    t = {"layer0": {
+        "kernel": (rng.standard_normal((8, 5)) * scale).astype(dtype),
+        "bias": (rng.standard_normal((5,)) * scale).astype(dtype)}}
+    if extra_key:
+        t[extra_key] = {"w": rng.standard_normal((3,)).astype(dtype)}
+    return t
+
+
+def _mk_updates(rng, n_per_stage=(3, 2), gen=1, stats=False):
+    """Realistic multi-stage update set: varied weights, a NaN leaf,
+    one client with an extra key (key-union path), int leaves."""
+    ups = []
+    for s, n in enumerate(n_per_stage, start=1):
+        for i in range(n):
+            cid = f"client_{s}_{i}"
+            params = _tree(rng, scale=10.0,
+                           extra_key=("extra" if (s, i) == (1, 1)
+                                      else None))
+            params["layer0"]["step"] = np.asarray(
+                rng.integers(0, 100), np.int32)
+            if (s, i) == (1, 0):
+                params["layer0"]["kernel"][0, 0] = np.nan
+            bs = ({"bn": {"mean": rng.standard_normal((5,))
+                          .astype(np.float32)}} if stats else None)
+            ups.append(Update(
+                client_id=cid, stage=s, cluster=0, params=params,
+                num_samples=int(rng.integers(1, 64)), round_idx=gen,
+                batch_stats=bs))
+    return ups
+
+
+def _bit_equal(a, b, path=""):
+    if isinstance(a, dict) or isinstance(b, dict):
+        assert isinstance(a, dict) and isinstance(b, dict), path
+        assert a.keys() == b.keys(), (path, a.keys(), b.keys())
+        for k in a:
+            _bit_equal(a[k], b[k], f"{path}/{k}")
+        return
+    a, b = np.asarray(a), np.asarray(b)
+    assert a.dtype == b.dtype, (path, a.dtype, b.dtype)
+    assert a.shape == b.shape, (path, a.shape, b.shape)
+    assert a.tobytes() == b.tobytes(), path   # bitwise, NaN-safe
+
+
+def _expected(ups):
+    exp = {}
+    for u in sorted(ups, key=lambda u: (u.stage, u.client_id)):
+        exp.setdefault(u.stage, []).append(u.client_id)
+    return exp
+
+
+def _stream(ups, arrival, *, backend=None, expected=None,
+            faults=None) -> tuple:
+    fold = StreamingFold(expected if expected is not None
+                         else _expected(ups),
+                         backend=backend, faults=faults)
+    by_id = {u.client_id: u for u in ups}
+    for cid in arrival:
+        fold.add_update(by_id[cid])
+    return fold.finish()
+
+
+# --------------------------------------------------------------------------
+# streaming fold vs the barrier oracle
+# --------------------------------------------------------------------------
+
+class TestBitIdentityVsOracle:
+
+    def test_in_order_arrival(self):
+        rng = np.random.default_rng(0)
+        ups = _mk_updates(rng, stats=True)
+        want_p, want_s, want_n = aggregate_cluster(ups)
+        res = _stream(ups, [u.client_id for u in ups])
+        _bit_equal(res.params, want_p)
+        _bit_equal(res.stats, want_s)
+        assert res.n_samples == want_n
+
+    @pytest.mark.parametrize("seed", [1, 2, 3, 4])
+    def test_shuffled_arrival(self, seed):
+        rng = np.random.default_rng(seed)
+        ups = _mk_updates(rng, n_per_stage=(5, 3), stats=True)
+        order = [u.client_id for u in ups]
+        rng.shuffle(order)
+        res = _stream(ups, order)
+        want_p, want_s, want_n = aggregate_cluster(ups)
+        _bit_equal(res.params, want_p)
+        _bit_equal(res.stats, want_s)
+        assert res.n_samples == want_n
+
+    def test_chaos_dup_reorder_drop_stream(self):
+        """The acceptance cell: a 3-client round's Update stream under
+        10% drop + dup + reorder, replayed through real wire frames —
+        the streamed result must be bit-identical to the barrier
+        oracle over the surviving client set."""
+        for seed in (7, 8, 9):
+            rng = np.random.default_rng(seed)
+            ups = _mk_updates(rng, n_per_stage=(3,), stats=True)
+            frames = [encode(u) for u in ups]
+            # chaos schedule: drop/dup/reorder ~10% each
+            stream = []
+            for f in frames:
+                r = rng.random()
+                if r < 0.10:
+                    continue           # dropped: at-most-once leg
+                stream.append(f)
+                if r < 0.20:
+                    stream.append(f)   # duplicated
+            for i in range(len(stream) - 1):
+                if rng.random() < 0.10:
+                    stream[i], stream[i + 1] = stream[i + 1], stream[i]
+            faults = FaultCounters()
+            fold = StreamingFold(_expected(ups), faults=faults)
+            survivors: dict = {}
+            for raw in stream:
+                msg = decode(raw)
+                fold.add_update(msg)
+                survivors.setdefault(msg.client_id, msg)
+            res = fold.finish()
+            want_p, want_s, want_n = aggregate_cluster(
+                sorted(survivors.values(), key=lambda u: u.client_id))
+            _bit_equal(res.params, want_p)
+            _bit_equal(res.stats, want_s)
+            assert res.n_samples == want_n
+            dups = len(stream) - len(survivors)
+            assert faults.snapshot().get("agg_dup_drops", 0) == dups
+
+    def test_chaos_stream_with_delta_codec(self):
+        """Codec-on leg: delta-encoded Updates reconstructed against
+        the versioned shadow BEFORE the fold (the server's
+        _fold_update order), then chaos dup/reorder on the
+        reconstructed stream — still bit-identical to the oracle."""
+        from split_learning_tpu.runtime.codec.delta import (
+            DeltaCodec, DeltaShadow,
+        )
+        from split_learning_tpu.runtime.codec.specs import parse_codec_map
+
+        rng = np.random.default_rng(11)
+        spec = parse_codec_map({"rpc": "delta:int8"})["rpc"]
+        shadow = DeltaShadow(faults=FaultCounters())
+        ups = []
+        for i in range(3):
+            cid = f"client_1_{i}"
+            base = _tree(rng)
+            trained = {
+                "layer0": {k: v + rng.standard_normal(v.shape)
+                           .astype(np.float32) * 0.01
+                           for k, v in base["layer0"].items()}}
+            shadow.note_sent(cid, 5, base)
+            codec = DeltaCodec(spec, faults=FaultCounters())
+            delta = codec.encode_update(trained, base)
+            full = shadow.fold(cid, 5, delta)
+            assert full is not None
+            ups.append(Update(client_id=cid, stage=1, cluster=0,
+                              params=full, num_samples=8 + i,
+                              round_idx=1))
+        order = [u.client_id for u in ups]
+        rng.shuffle(order)
+        res = _stream(ups, order + [order[0]])   # + a duplicate
+        want_p, want_s, want_n = aggregate_cluster(ups)
+        _bit_equal(res.params, want_p)
+        assert res.n_samples == want_n
+
+    def test_unreconstructed_delta_is_hard_error(self):
+        fold = StreamingFold({1: ["c"]})
+        u = Update(client_id="c", stage=1, cluster=0,
+                   params={"w": np.ones((2,), np.float32)},
+                   num_samples=1, delta_base=3)
+        with pytest.raises(ValueError, match="un-reconstructed"):
+            fold.add_update(u)
+
+    def test_weightless_and_missing_clients(self):
+        """Weight-less updates occupy their slot without folding;
+        clients that never arrive are skipped at finish — both exactly
+        like the oracle."""
+        rng = np.random.default_rng(21)
+        ups = _mk_updates(rng, n_per_stage=(4,))
+        ups[1].params = None            # weight-less (broken delta)
+        arrived = [u for u in ups if u.client_id != "client_1_3"]
+        res = _stream(ups, [u.client_id for u in reversed(arrived)])
+        want_p, _, want_n = aggregate_cluster(
+            sorted(arrived, key=lambda u: u.client_id))
+        _bit_equal(res.params, want_p)
+        assert res.n_samples == want_n
+
+    def test_partial_quorum_folds_before_last_arrival(self):
+        """The point of streaming: early arrivals fold while a
+        straggler is still training — by the time the last Update
+        lands, only O(1) work remains."""
+        rng = np.random.default_rng(31)
+        ups = _mk_updates(rng, n_per_stage=(4,))
+        fold = StreamingFold(_expected(ups))
+        for u in ups[:3]:
+            fold.add_update(u)
+        assert fold.folded == 3          # landed before the straggler
+        assert fold.window_hwm <= 1
+        fold.add_update(ups[3])
+        res = fold.finish()
+        want_p, _, _ = aggregate_cluster(ups)
+        _bit_equal(res.params, want_p)
+
+    def test_reorder_window_holds_out_of_order(self):
+        """An early arrival whose canonical predecessor is missing
+        waits in the window (folded does not advance) until the
+        predecessor lands or is dropped."""
+        rng = np.random.default_rng(41)
+        ups = _mk_updates(rng, n_per_stage=(3,))
+        by_id = {u.client_id: u for u in ups}
+        fold = StreamingFold(_expected(ups))
+        fold.add_update(by_id["client_1_2"])
+        assert fold.folded == 0 and fold.window_hwm == 1
+        fold.add_update(by_id["client_1_0"])
+        assert fold.folded == 1          # 0 folded; 2 still waits on 1
+        fold.drop(1, "client_1_1")       # barrier gave up on it
+        assert fold.folded == 2          # 2 drained in canonical order
+        res = fold.finish()
+        arrived = [by_id["client_1_0"], by_id["client_1_2"]]
+        want_p, _, _ = aggregate_cluster(arrived)
+        _bit_equal(res.params, want_p)
+
+    def test_has_key_and_dup_counting(self):
+        faults = FaultCounters()
+        fold = StreamingFold({1: ["a", "b"]}, faults=faults)
+        u = Update(client_id="a", stage=1, cluster=0,
+                   params={"w": np.ones((2,), np.float32)}, num_samples=1)
+        assert not fold.has_key(1, "a")
+        fold.add_update(u)
+        assert fold.has_key(1, "a")
+        fold.add_update(u)
+        assert faults.snapshot()["agg_dup_drops"] == 1
+        fold.drop(1, "b")
+        assert fold.has_key(1, "b")
+
+    def test_aggregate_cluster_consumes_precomputed_fold(self):
+        rng = np.random.default_rng(51)
+        ups = _mk_updates(rng, n_per_stage=(3,))
+        res = _stream(ups, [u.client_id for u in ups])
+        stripped = UpdateBatch(
+            Update(client_id=u.client_id, stage=u.stage,
+                   cluster=u.cluster, params=None,
+                   num_samples=u.num_samples, round_idx=u.round_idx)
+            for u in ups)
+        stripped.fold = res
+        p, s, n = aggregate_cluster(stripped)
+        _bit_equal(p, res.params)
+        assert n == res.n_samples
+
+    def test_fold_strategies_vocabulary(self):
+        # relay/periodic/fedasync read individual u.params — they must
+        # never be offered a weight-stripped streamed batch
+        assert FOLD_STRATEGIES == {"fedavg", "sda", "cluster_relay"}
+
+
+# --------------------------------------------------------------------------
+# mesh-sharded backend
+# --------------------------------------------------------------------------
+
+class TestMeshBackend:
+
+    def test_mesh_vs_host_bit_identical(self, eight_devices):
+        rng = np.random.default_rng(61)
+        # leaf axis 0 divisible by 2 and 8 -> sharded; bias replicated
+        def tree():
+            return {"layer0": {
+                "kernel": rng.standard_normal((16, 6))
+                .astype(np.float32),
+                "bias": rng.standard_normal((5,)).astype(np.float32),
+                "step": np.asarray(7, np.int32)}}
+        ups = [Update(client_id=f"c{i}", stage=1, cluster=0,
+                      params=tree(), num_samples=3 + i, round_idx=1)
+               for i in range(4)]
+        host = _stream(ups, [u.client_id for u in ups],
+                       backend=HostFoldBackend())
+        mesh = _stream(ups, [u.client_id for u in ups],
+                       backend=MeshFoldBackend(devices=eight_devices[:2]))
+        _bit_equal(mesh.params, host.params)
+
+    def test_momentum_step_host_and_mesh(self, eight_devices):
+        """FedAvgM: m=0 is plain FedAvg bit-for-bit; m>0 matches the
+        hand-rolled update on both backends, velocity carried."""
+        rng = np.random.default_rng(71)
+        base = {"w": rng.standard_normal((8, 4)).astype(np.float32)}
+        ups = [Update(client_id=f"c{i}", stage=1, cluster=0,
+                      params={"w": rng.standard_normal((8, 4))
+                              .astype(np.float32)},
+                      num_samples=4, round_idx=1) for i in range(3)]
+        plain = _stream(ups, [u.client_id for u in ups])
+        m0 = StreamingFold(_expected(ups))
+        for u in ups:
+            m0.add_update(u)
+        r0 = m0.finish(base=base, momentum=0.0, velocity={})
+        _bit_equal(r0.params, plain.params)
+        for backend in (HostFoldBackend(),
+                        MeshFoldBackend(devices=eight_devices[:2])):
+            vel: dict = {}
+            f = StreamingFold(_expected(ups), backend=backend)
+            for u in ups:
+                f.add_update(u)
+            r = f.finish(base=base, momentum=0.5, velocity=vel)
+            # hand-rolled FedAvgM vs the backend's fused step
+            acc = sum(np.nan_to_num(u.params["w"].astype(np.float32))
+                      * max(1, u.num_samples) for u in ups)
+            avg = acc / np.float32(sum(max(1, u.num_samples)
+                                       for u in ups))
+            v = base["w"].astype(np.float32) - avg
+            want = base["w"].astype(np.float32) - v
+            np.testing.assert_allclose(r.params["w"], want, rtol=1e-6)
+            assert ("w",) in vel
+
+
+# --------------------------------------------------------------------------
+# aggregator tree
+# --------------------------------------------------------------------------
+
+class TestAggregatorTree:
+
+    def test_plan_fanin_groups(self):
+        active = ([(f"c1_{i}", 1) for i in range(5)]
+                  + [(f"c2_{i}", 2) for i in range(2)])
+        groups = plan_fanin_groups(active, 2)
+        assert [g.stage for g in groups] == [1, 1, 1, 2]
+        assert [len(g.members) for g in groups] == [2, 2, 1, 2]
+        # groups never span stages; members canonical-sorted
+        for g in groups:
+            assert g.members == sorted(g.members)
+        assert groups[0].key == group_key(0) == "g00000"
+
+    def test_partial_roundtrip_and_tree_determinism(self):
+        """L1 partial sums -> root continues the fold: deterministic
+        (two identical runs bit-identical) and numerically the same
+        average as the flat fold."""
+        rng = np.random.default_rng(81)
+        ups = _mk_updates(rng, n_per_stage=(5,), stats=True)
+        active = [(u.client_id, u.stage) for u in ups]
+        by_id = {u.client_id: u for u in ups}
+
+        def tree_round():
+            groups = plan_fanin_groups(active, 2)
+            root = StreamingFold(
+                {1: [g.key for g in groups if g.stage == 1]})
+            for g in groups:
+                sub = StreamingFold({g.stage: list(g.members)})
+                for cid in g.members:
+                    sub.add_update(by_id[cid])
+                stages, n = sub.partial()
+                ent = stages[g.stage]
+                # over the wire: the partial rides a real frame
+                frame = encode(PartialAggregate(
+                    aggregator_id=f"agg_{g.idx}", cluster=0,
+                    group=g.idx, stage=g.stage, round_idx=1,
+                    sums=ent["sums"], weight=ent["weight"],
+                    dtypes=ent["dtypes"], stat_sums=ent["stat_sums"],
+                    stat_weight=ent["stat_weight"],
+                    stat_dtypes=ent["stat_dtypes"], n_samples=n))
+                p = decode(frame)
+                root.add_partial(p.stage, group_key(p.group), p.sums,
+                                 p.weight, p.dtypes,
+                                 stat_sums=p.stat_sums,
+                                 stat_weight=p.stat_weight,
+                                 stat_dtypes=p.stat_dtypes,
+                                 n_samples=p.n_samples)
+            return root.finish()
+
+        a, b = tree_round(), tree_round()
+        _bit_equal(a.params, b.params)          # deterministic
+        assert a.partials == 3
+        flat_p, flat_s, flat_n = aggregate_cluster(ups)
+        assert a.n_samples == flat_n
+        for path in (("layer0", "kernel"), ("layer0", "bias")):
+            x = a.params[path[0]][path[1]]
+            y = flat_p[path[0]][path[1]]
+            # tree changes the summation SHAPE, so equal-to-tolerance,
+            # deliberately not bitwise (the documented trade)
+            np.testing.assert_allclose(x, y, rtol=1e-5)
+
+    def test_l1_aggregator_thread_folds_and_publishes(self):
+        from split_learning_tpu.runtime.bus import InProcTransport
+
+        rng = np.random.default_rng(91)
+        bus = InProcTransport()
+        g = AggGroup(idx=0, stage=1, members=["a", "b"])
+        ups = {cid: Update(client_id=cid, stage=1, cluster=0,
+                           params=_tree(rng), num_samples=4,
+                           round_idx=7) for cid in g.members}
+        t = L1Aggregator(bus, cluster=0, group=g, members=g.members,
+                         gen=7, deadline=time.monotonic() + 20,
+                         faults=FaultCounters())
+        t.start()
+        q = aggregate_queue(0, 0)
+        # a stale-generation frame must be dropped, not folded
+        stale = Update(client_id="a", stage=1, cluster=0,
+                       params=_tree(rng), num_samples=99, round_idx=6)
+        bus.publish(q, encode(stale))
+        for u in ups.values():
+            for part in encode_parts(u, 256):   # chunked path too
+                bus.publish(q, part)
+        raw = bus.get("rpc_queue", timeout=20.0)
+        assert raw is not None
+        msg = FrameAssembler().feed(raw)
+        assert isinstance(msg, PartialAggregate)
+        assert msg.round_idx == 7 and msg.weight == 8.0
+        assert {m["client_id"] for m in msg.members} == {"a", "b"}
+        t.join(timeout=10)
+        assert t.flushed and not t.is_alive()
+        # root folding the partial == flat fold of the members
+        root = StreamingFold({1: [group_key(0)]})
+        root.add_partial(msg.stage, group_key(msg.group), msg.sums,
+                         msg.weight, msg.dtypes, n_samples=msg.n_samples)
+        res = root.finish()
+        want_p, _, _ = aggregate_cluster(
+            sorted(ups.values(), key=lambda u: u.client_id))
+        _bit_equal(res.params, want_p)
+
+    def test_test_kill_and_fallback_drain(self):
+        from split_learning_tpu.runtime.bus import InProcTransport
+
+        rng = np.random.default_rng(101)
+        bus = InProcTransport()
+        g = AggGroup(idx=3, stage=1, members=["a", "b"])
+        agg_id = "aggregator_0_3"
+        L1Aggregator.TEST_KILL.add(agg_id)
+        try:
+            t = L1Aggregator(bus, cluster=0, group=g,
+                             members=g.members, gen=2,
+                             deadline=time.monotonic() + 20,
+                             faults=FaultCounters())
+            assert t.agg_id == agg_id
+            t.start()
+            t.join(timeout=10)
+            assert not t.is_alive() and not t.flushed
+        finally:
+            L1Aggregator.TEST_KILL.discard(agg_id)
+        # the members' frames sit orphaned; the root drains them
+        q = aggregate_queue(0, 3)
+        ups = [Update(client_id=cid, stage=1, cluster=0,
+                      params=_tree(rng), num_samples=4, round_idx=2)
+               for cid in g.members]
+        bus.publish(q, encode(Update(client_id="a", stage=1, cluster=0,
+                                     params=_tree(rng), num_samples=9,
+                                     round_idx=1)))   # stale gen
+        for u in ups:
+            bus.publish(q, encode(u))
+        faults = FaultCounters()
+        got = drain_group_queue(bus, 0, 3, 2, FrameAssembler(), faults)
+        assert [u.client_id for u in got] == ["a", "b"]
+        assert faults.snapshot()["agg_stale_drops"] == 1
+
+    def test_fallback_abandons_members_whose_frames_the_l1_ate(self):
+        """An L1 that dies AFTER consuming a member's UPDATE frames
+        leaves nothing for the fallback drain to recover — the member
+        never resends, so the grace deadline must abandon it (counted)
+        and close the group into the root fold instead of stalling the
+        UPDATE barrier for the full client timeout."""
+        from split_learning_tpu.runtime.bus import InProcTransport
+        from split_learning_tpu.runtime.server import ProtocolContext
+
+        class _NullLog:
+            def __getattr__(self, _name):
+                return lambda *a, **k: None
+
+        class _DeadL1:
+            group = AggGroup(idx=0, stage=1, members=["a", "b"])
+            cluster = 0
+            members = ["a", "b"]
+            agg_id = "aggregator_0_0"
+            flushed = False
+
+            def is_alive(self):
+                return False
+
+        rng = np.random.default_rng(17)
+        s = type("_Stub", (), {})()
+        s.bus = InProcTransport()
+        s.faults = FaultCounters()
+        s.log = _NullLog()
+        s.fleet = None
+        s._l1 = [_DeadL1()]
+        s._l1_fallback = {}
+        s._agg_gone = set()
+        s._cur_gen = 2
+        s._updates = []
+        s._fold = StreamingFold({1: [group_key(0)]}, faults=s.faults)
+        s._fold_update = lambda u: None
+        s.L1_FALLBACK_GRACE_S = 0.05
+        for name in ("_poll_l1", "_drain_fallback", "_flush_fallback"):
+            setattr(s, name, getattr(ProtocolContext, name).__get__(s))
+
+        # "a"'s frames are still queued (recoverable); "b"'s were
+        # consumed by the dead L1 and are gone forever
+        u_a = Update(client_id="a", stage=1, cluster=0,
+                     params=_tree(rng), num_samples=4, round_idx=2)
+        s.bus.publish(aggregate_queue(0, 0), encode(u_a))
+        s._poll_l1()
+        assert {u.client_id for u in s._updates} == {"a"}
+        assert s._agg_gone == set()
+        assert not s._l1_fallback[0]["flushed"]
+        time.sleep(0.06)           # grace (refreshed by "a") expires
+        s._poll_l1()
+        assert s._agg_gone == {"b"}
+        assert s.faults.snapshot()["agg_fallback_abandons"] == 1
+        assert s._l1_fallback[0]["flushed"]
+        # the group key landed: the barrier predicate releases and the
+        # root fold closes over the one recovered member
+        assert s._fold.has_key(1, group_key(0))
+        want_p, _, _ = aggregate_cluster([u_a])
+        _bit_equal(s._fold.finish().params, want_p)
+
+
+# --------------------------------------------------------------------------
+# end-to-end protocol rounds (slow: compiles real split programs)
+# --------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_streaming_round_bit_identical_to_barrier_round(tmp_path):
+    """The tentpole contract on a REAL 3-client protocol round (the
+    chaos suite's deterministic cell: control_count=1 + strict SDA,
+    the config whose fault-free runs are bit-reproducible): the same
+    round with aggregation.streaming on vs off produces bit-identical
+    aggregated parameters — and a third leg under 10% drop + dup +
+    reorder chaos (reliable transport) with streaming ON still matches
+    the barrier leg bit-for-bit."""
+    from tests.test_chaos import (
+        _assert_trees_identical, _chaos, _round_cfg, _run_cell,
+    )
+
+    barrier = _run_cell(_round_cfg(
+        tmp_path, tmp_path / "barrier",
+        aggregation={"streaming": False}))
+    streaming = _run_cell(_round_cfg(tmp_path, tmp_path / "streaming"))
+    assert streaming.history[0].ok
+    assert (streaming.history[0].num_samples
+            == barrier.history[0].num_samples)
+    _assert_trees_identical(streaming.params, barrier.params)
+
+    faults = FaultCounters()
+    chaotic = _run_cell(
+        _round_cfg(tmp_path, tmp_path / "chaotic"),
+        chaos_cfg=_chaos(seed=99, drop=0.10, duplicate=0.10,
+                         reorder=0.10),
+        reliable=True, faults=faults)
+    assert chaotic.history[0].ok
+    _assert_trees_identical(chaotic.params, barrier.params)
+    assert faults.snapshot().get("drops")
+
+
+@pytest.mark.slow
+def test_tree_round_with_l1_killed_mid_round(tmp_path):
+    """Aggregator-tree round over the live protocol with one L1 killed
+    mid-round (TEST_KILL): the direct-to-root fallback drains the
+    orphaned group, the round completes, and the fallback is
+    counted."""
+    import json
+
+    from tests.test_protocol_runtime import proto_cfg, run_deployment
+    from split_learning_tpu.runtime.bus import InProcTransport
+    from split_learning_tpu.runtime.trace import default_fault_counters
+
+    bus = InProcTransport()
+    cfg = proto_cfg(tmp_path, clients=[2, 1],
+                    aggregation={"fan_in": 2})
+    # group 0 covers the two stage-1 clients in cluster 0
+    L1Aggregator.TEST_KILL.add("aggregator_0_0")
+    base = default_fault_counters.snapshot().get("agg_l1_fallbacks", 0)
+    try:
+        result = run_deployment(cfg, lambda: bus, bus)
+    finally:
+        L1Aggregator.TEST_KILL.discard("aggregator_0_0")
+    rec = result.history[0]
+    assert rec.ok and rec.num_samples > 0
+    assert (default_fault_counters.snapshot().get("agg_l1_fallbacks", 0)
+            > base)
+    # the kind=agg record still reports a full fold (2 partials: the
+    # fallback group + the surviving L1)
+    agg_recs = [json.loads(line)
+                for line in (tmp_path / "metrics.jsonl")
+                .read_text().splitlines()
+                if '"kind": "agg"' in line]
+    assert agg_recs and agg_recs[-1]["partials"] == 2
+    assert agg_recs[-1]["folded"] == 2
+
+
+# --------------------------------------------------------------------------
+# delta-shadow memory audit (satellite): sl_agg_shadow_bytes + the
+# lost-client prune
+# --------------------------------------------------------------------------
+
+class TestShadowAudit:
+
+    def test_shadow_nbytes(self):
+        from split_learning_tpu.runtime.codec.delta import DeltaShadow
+
+        sh = DeltaShadow(faults=FaultCounters())
+        assert sh.nbytes() == 0
+        sh.note_sent("a", 1, {"w": np.zeros((4, 4), np.float32)})
+        sh.note_sent("b", 1, {"w": np.zeros((2,), np.float32)})
+        assert sh.nbytes() == 64 + 8
+        sh.clear("a")
+        assert sh.nbytes() == 8
+
+    def test_fleet_lost_transition_prunes_shadow(self):
+        """The FleetMonitor `lost` transition fires the server's
+        on_lost hook — before this, only the elastic prune forgot a
+        dead client's shadow."""
+        from split_learning_tpu.runtime.codec.delta import DeltaShadow
+        from split_learning_tpu.runtime.telemetry import (
+            FleetMonitor, GaugeSet,
+        )
+
+        sh = DeltaShadow(faults=FaultCounters())
+        sh.note_sent("c1", 1, {"w": np.zeros((8,), np.float32)})
+        gauges = GaugeSet()
+        mon = FleetMonitor(interval=1.0, liveness_timeout=5.0,
+                           gauges=gauges)
+        pruned = []
+
+        def on_lost(cid):
+            sh.clear(cid)
+            gauges.set("agg_shadow_bytes", sh.nbytes())
+            pruned.append(cid)
+
+        mon.on_lost = on_lost
+        t0 = 1000.0
+        mon.note_heartbeat("c1", {"part": "c1", "t": t0, "seq": 1},
+                           now=t0)
+        mon.note_pump(now=t0 + 10.0)
+        mon.advance(now=t0 + 10.0)    # 10s silent > 5s timeout -> lost
+        assert mon.state("c1") == "lost"
+        assert pruned == ["c1"]
+        assert sh.nbytes() == 0
+        assert gauges.get("agg_shadow_bytes") == 0
+
+    def test_shadow_ledger_survives_concurrent_prune(self):
+        """The lost-client prune runs on whatever thread advances the
+        FleetMonitor (the exporter's HTTP handler included) while
+        note_sent runs on the pump thread: the incremental byte ledger
+        must stay consistent under that race."""
+        import threading
+
+        from split_learning_tpu.runtime.codec.delta import DeltaShadow
+
+        sh = DeltaShadow(faults=FaultCounters())
+        tree = {"w": np.zeros((64,), np.float32)}
+        stop = threading.Event()
+
+        def pruner():
+            while not stop.is_set():
+                sh.clear("x")
+
+        th = threading.Thread(target=pruner)
+        th.start()
+        try:
+            for i in range(2000):
+                sh.note_sent("x", i, tree)
+        finally:
+            stop.set()
+            th.join()
+        sh.clear("x")
+        assert sh.nbytes() == 0
+        sh.note_sent("y", 1, tree)
+        assert sh.nbytes() == 256
+
+    def test_shadow_gauge_renders_on_metrics(self):
+        from split_learning_tpu.runtime.telemetry import (
+            GaugeSet, lint_prometheus, render_prometheus,
+        )
+
+        g = GaugeSet()
+        g.set("agg_shadow_bytes", 12345)
+        text = render_prometheus(gauges=g)
+        assert "sl_agg_shadow_bytes 12345" in text
+        assert lint_prometheus(text) == []
+
+
+# --------------------------------------------------------------------------
+# config surface
+# --------------------------------------------------------------------------
+
+class TestConfig:
+
+    def test_backend_selection(self):
+        from split_learning_tpu.config import from_dict
+        from split_learning_tpu.runtime.aggregate import make_fold_backend
+
+        host = make_fold_backend(from_dict({}))
+        assert isinstance(host, HostFoldBackend)
+        mesh = make_fold_backend(
+            from_dict({"aggregation": {"sharded": True}}))
+        assert isinstance(mesh, MeshFoldBackend)
+        assert mesh.n_devices >= 1
+
+    def test_validation(self):
+        from split_learning_tpu.config import ConfigError, from_dict
+
+        with pytest.raises(ConfigError, match="fan-in"):
+            from_dict({"aggregation": {"fan-in": 1}})
+        with pytest.raises(ConfigError, match="streaming"):
+            from_dict({"aggregation": {"fan-in": 4,
+                                       "streaming": False}})
+        with pytest.raises(ConfigError, match="server-momentum"):
+            from_dict({"aggregation": {"server-momentum": 1.5}})
+        cfg = from_dict({"aggregation": {"fan-in": 8, "sharded": True,
+                                         "server-momentum": 0.9}})
+        assert cfg.aggregation.fan_in == 8

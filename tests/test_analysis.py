@@ -661,3 +661,81 @@ def test_device_quant_audit_catches_host_fallback(monkeypatch):
     monkeypatch.setattr(quant.QuantCodec, "prepare", host_prepare)
     findings = codec_check.check_device_quant()
     assert findings and all(f.code == "CD003" for f in findings)
+
+
+# --------------------------------------------------------------------------
+# aggregation-path rule (AG001) + PartialAggregate protocol model
+# --------------------------------------------------------------------------
+
+def test_ag001_accumulation_flagged():
+    from split_learning_tpu.analysis import agg_check
+    src = (
+        "def fold(updates, store):\n"
+        "    trees = [u.params for u in updates]\n"        # AG001
+        "    stats = [u.batch_stats for u in updates]\n"   # AG001
+        "    held = []\n"
+        "    for u in updates:\n"
+        "        held.append(u.params)\n"                  # AG001
+        "        store[u.client_id] = u.params\n"          # AG001
+        "    got = [u for u in updates if u.params is not None]\n"
+        "    return trees, stats, held, got\n"
+    )
+    findings = agg_check.check_source(src, "x.py")
+    assert [f.code for f in findings] == ["AG001"] * 4
+    assert {f.line for f in findings} == {2, 3, 6, 7}
+
+
+def test_ag001_annotations_suppress():
+    from split_learning_tpu.analysis import agg_check
+    src = (
+        "def oracle(updates, store):\n"
+        "    trees = [u.params for u in updates]  "
+        "# slcheck: agg-oracle\n"
+        "    store[u.client_id] = u.params  # slcheck: agg-state\n"
+    )
+    assert agg_check.check_source(src, "x.py") == []
+
+
+def test_ag001_registered_and_repo_clean():
+    from split_learning_tpu.analysis import agg_check
+    from split_learning_tpu.analysis.__main__ import ANALYZERS, repo_root
+    assert "agg" in ANALYZERS
+    assert agg_check.run(repo_root()) == []
+
+
+def test_partial_aggregate_in_protocol_model():
+    # the tree's frame kind is first-class: model vocabulary, send/recv
+    # rules for all three roles, and legal transitions where the
+    # runtime produces them
+    assert "PartialAggregate" in M.CONTROL_KINDS
+    assert M.queue_family("aggregate_queue_0_3") == "aggregate"
+    assert ("client", "aggregate", "Update") in M.SEND_RULES
+    assert ("aggregator", "rpc", "PartialAggregate") in M.SEND_RULES
+    assert ("server", "aggregate") in M.RECV_RULES
+    events = [
+        M.Event("server", "send", "Start", "server"),
+        M.Event("server", "recv", "Ready", "server"),
+        M.Event("server", "send", "Syn", "server"),
+        M.Event("server", "recv", "Notify", "server"),
+        M.Event("server", "send", "Pause", "server"),
+        M.Event("server", "recv", "Update", "server"),       # fallback
+        M.Event("server", "recv", "PartialAggregate", "server"),
+        M.Event("server", "send", "Stop", "server"),
+        M.Event("server", "recv", "PartialAggregate", "server"),
+        M.Event("aggregator", "recv", "Update", "aggregator_0_0"),
+        M.Event("aggregator", "recv", "Update", "aggregator_0_0"),
+        M.Event("aggregator", "send", "PartialAggregate",
+                "aggregator_0_0"),
+    ]
+    assert M.validate_events(events) == []
+
+
+def test_aggregator_log_lines_resolve_to_aggregator_role():
+    text = (
+        "2026-08-03 10:00:00,000 - aggregator_0_1.abc - INFO - "
+        "[<<<] UPDATE client_1_0 (L1 fold)\n"
+        "2026-08-03 10:00:01,000 - aggregator_0_1.abc - INFO - "
+        "[>>>] PARTIALAGGREGATE members=2/2\n")
+    events = M.events_from_log(text)
+    assert [e.role for e in events] == ["aggregator", "aggregator"]
+    assert M.validate_events(events) == []

@@ -56,6 +56,11 @@ STABLE_KEYS = {
     "extra.per_device_hbm_gb.total_est": "down",
     "extra.mfu.mfu_vs_datasheet": "up",
     "extra.mfu.measured_matmul_roofline_tflops": "up",
+    # streaming aggregation plane (round-9): server aggregate wall per
+    # client (flat-vs-fleet-width headline) and peak simultaneous
+    # full-tree copies at the UPDATE barrier (O(1) memory headline)
+    "extra.agg_wall_per_client_ms": "down",
+    "extra.agg_peak_tree_copies": "down",
 }
 
 #: attribution components of a kind=perf record, in report order
@@ -94,6 +99,10 @@ _SCAVENGE_RES = {
         re.compile(r'"mfu_vs_datasheet":\s*' + _NUM),
     "extra.mfu.measured_matmul_roofline_tflops":
         re.compile(r'"measured_matmul_roofline_tflops":\s*' + _NUM),
+    "extra.agg_wall_per_client_ms":
+        re.compile(r'"agg_wall_per_client_ms":\s*' + _NUM),
+    "extra.agg_peak_tree_copies":
+        re.compile(r'"agg_peak_tree_copies":\s*' + _NUM),
 }
 
 
