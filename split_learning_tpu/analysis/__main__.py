@@ -24,7 +24,7 @@ from split_learning_tpu.analysis.findings import (
 )
 
 ANALYZERS = ("protocol", "jaxpr", "concurrency", "counters", "codec",
-             "perf", "agg")
+             "perf", "agg", "async")
 
 
 def repo_root() -> pathlib.Path:
@@ -55,6 +55,9 @@ def run_analyzers(root: pathlib.Path, names=ANALYZERS,
     if "agg" in names:
         from split_learning_tpu.analysis import agg_check
         findings += agg_check.run(root)
+    if "async" in names:
+        from split_learning_tpu.analysis import async_check
+        findings += async_check.run(root)
     return findings
 
 

@@ -1,0 +1,95 @@
+"""``async`` analyzer — bounded-staleness admission discipline.
+
+**AS001**: every server-side fold call site must go through (or sit
+inside) the staleness admission window.  The async mode
+(``learning.mode: async``) replaces the hard generation fence with an
+admission check — ``server_version - version <= learning.max-staleness``
+— applied in ``runtime/server.py _admit_update``.  A new fold call site
+(``*.add_update(...)`` / ``*.add_partial(...)``) added to the server
+WITHOUT that check would silently fold arbitrarily stale contributions
+(or re-fold duplicates) the moment someone wires it into the pump:
+exactly the class of bug the window exists to prevent.
+
+Rule: a fold call site in ``runtime/server.py`` passes iff its
+enclosing function references the admission window (the
+``max_staleness`` knob or the ``_admit_update`` door) — or carries the
+``# slcheck: async-exempt`` annotation naming it a sync-path site whose
+inputs are already generation-fenced upstream (the L1 fallback drain,
+PartialAggregate folding: L1 members are never stale-admitted).
+"""
+
+from __future__ import annotations
+
+import ast
+import pathlib
+
+from split_learning_tpu.analysis.findings import Finding
+from split_learning_tpu.analysis.protocol_check import _annotations
+
+#: server files held to the admission-window rule ("server-side fold
+#: call site" — the aggregation plane itself and the client are not
+#: admission doors)
+FILES = ("split_learning_tpu/runtime/server.py",)
+
+#: methods that fold a contribution into a streaming fold
+FOLD_CALLS = frozenset({"add_update", "add_partial"})
+
+#: references that prove the enclosing function checks the window
+ADMISSION_REFS = frozenset({"max_staleness", "_admit_update"})
+
+_EXEMPT = "async-exempt"
+
+
+def _admission_guarded(fn: ast.AST) -> bool:
+    for n in ast.walk(fn):
+        if isinstance(n, ast.Attribute) and n.attr in ADMISSION_REFS:
+            return True
+        if isinstance(n, ast.Name) and n.id in ADMISSION_REFS:
+            return True
+    return False
+
+
+def check_source(source: str, rel: str) -> list[Finding]:
+    tree = ast.parse(source)
+    notes = _annotations(source)
+    findings: list[Finding] = []
+
+    # lexically enclosing function per fold call
+    parents: dict[int, ast.AST] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for child in ast.walk(node):
+                if isinstance(child, ast.Call) \
+                        and isinstance(child.func, ast.Attribute) \
+                        and child.func.attr in FOLD_CALLS:
+                    # innermost function wins (walk visits outer first,
+                    # so later assignment = inner function)
+                    parents[id(child)] = node
+
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in FOLD_CALLS):
+            continue
+        if _EXEMPT in notes.get(node.lineno, ""):
+            continue
+        fn = parents.get(id(node))
+        if fn is not None and _admission_guarded(fn):
+            continue
+        findings.append(Finding(
+            "AS001", rel, node.lineno, "",
+            f"fold call `{node.func.attr}` outside the staleness "
+            "admission window — route it through _admit_update (or "
+            "check learning.max_staleness in the enclosing function), "
+            "or annotate '# slcheck: async-exempt' if its inputs are "
+            "generation-fenced upstream"))
+    return findings
+
+
+def run(root: pathlib.Path) -> list[Finding]:
+    findings: list[Finding] = []
+    for rel in FILES:
+        path = root / rel
+        if path.exists():
+            findings += check_source(path.read_text(), rel)
+    return findings

@@ -126,12 +126,24 @@ SERVER_FSM: dict[str, dict[tuple[str, str], str]] = {
         ("send", "Start"): "starting",
         ("recv", "Register"): "starting",
         ("recv", "Ready"): "starting",
+        # async bounded-staleness admission (learning.mode: async): a
+        # straggler's Update seeded from an older version can land —
+        # and fold, staleness-weighted — at ANY point of the next
+        # invocation, not just during the UPDATE barrier
+        ("recv", "Update"): "starting",
         ("send", "Syn"): "running",
         ("send", "Stop"): "stopped",
     },
     "running": {                        # training; NOTIFY barrier
         ("recv", "Notify"): "running",
         ("recv", "Register"): "running",
+        # async: stale-admitted straggler Update (see "starting")
+        ("recv", "Update"): "running",
+        # async: a late READY still gets its SYN after the fan-out
+        # (the READY barrier collapsed to the responsive set; the
+        # straggler joins late instead of idling out the round)
+        ("send", "Syn"): "running",
+        ("recv", "Ready"): "running",
         ("send", "Pause"): "pausing",
         ("send", "Stop"): "stopped",
     },
@@ -140,6 +152,10 @@ SERVER_FSM: dict[str, dict[tuple[str, str], str]] = {
         ("recv", "PartialAggregate"): "pausing",  # L1 group flushes
         ("recv", "Notify"): "pausing",   # straggler NOTIFY still legal
         ("recv", "Register"): "pausing",
+        # async late READY during the UPDATE barrier (the SYN window
+        # stays open until the version cut)
+        ("recv", "Ready"): "pausing",
+        ("send", "Syn"): "pausing",
         ("send", "Start"): "starting",   # next invocation / cluster
         ("send", "Stop"): "stopped",
     },
@@ -193,11 +209,19 @@ CLIENT_FSM: dict[str, dict[tuple[str, str], str]] = {
     "training": {
         ("send", "Notify"): "notified",  # stage-1 data exhausted
         ("recv", "Pause"): "updating",   # middle/last stages skip NOTIFY
+        # async pipelined rounds: a mid-round START makes the client
+        # UPLOAD its work (an Update at the OLD version — the server's
+        # admission window folds it staleness-weighted) before swapping
+        # to the buffered seed; the requeued Start is consumed next.
+        # Update may therefore be sent from training/notified without a
+        # PAUSE having arrived.
+        ("send", "Update"): "after_update",
         ("recv", "Start"): "started",    # timed out of the round; rejoin
         ("recv", "Stop"): "stopped",
     },
     "notified": {
         ("recv", "Pause"): "updating",
+        ("send", "Update"): "after_update",  # async mid-round START
         ("recv", "Start"): "started",
         ("recv", "Stop"): "stopped",
     },

@@ -84,10 +84,48 @@ class LearningConfig:
     # whose boundary exceeds the width threshold; "all" is the blanket
     # recompute; "none" stores every stage's activations
     remat: str = "wide"
+    # Asynchronous decoupled split learning (ROADMAP item 2; *Decoupled
+    # Split Learning via Auxiliary Loss*, arxiv 2601.19261 + staleness-
+    # tolerant pipelining, arxiv 2412.14374).  "sync" (default) is the
+    # reference's lockstep round; "async" decouples the backward wire:
+    # every non-final stage trains against a LOCAL auxiliary head (no
+    # Gradient frames at all — the gradient queues and their codecs go
+    # dormant), the server folds Updates under a bounded-staleness
+    # admission window instead of a full barrier, and clients keep
+    # ticking on their current version while the next START streams in
+    # (double-buffered seed swap at a tick boundary).
+    mode: str = "sync"              # sync | async
+    # auxiliary-head architecture built from the plan's cut shapes:
+    # pooled-linear (mean-pool the boundary -> one Dense to classes) or
+    # projection-mlp (pool -> Dense(hidden) -> gelu -> Dense(classes))
+    aux_head: str = "pooled-linear"
+    aux_hidden: int = 64            # projection-mlp hidden width
+    # server admission window: an Update seeded from version v folds
+    # iff server_version - v <= max-staleness, with its FedAvg weight
+    # scaled by staleness-decay ** lag; older ones are rejected and
+    # counted (agg_stale_updates)
+    max_staleness: int = 2
+    staleness_decay: float = 0.5
+    # fresh (lag-0) contributions that cut a new global version; 0 =
+    # every started client (the full barrier, maximally deterministic)
+    async_quorum: int = 0
 
     def validate(self):
         _check(self.remat in ("all", "wide", "none"),
                f"remat must be all|wide|none, got {self.remat!r}")
+        _check(self.mode in ("sync", "async"),
+               f"learning.mode must be sync|async, got {self.mode!r}")
+        _check(self.aux_head in ("pooled-linear", "projection-mlp"),
+               "learning.aux-head must be pooled-linear|projection-mlp, "
+               f"got {self.aux_head!r}")
+        _check(self.aux_hidden >= 1, "learning.aux-hidden must be >= 1")
+        _check(self.max_staleness >= 0,
+               "learning.max-staleness must be >= 0")
+        _check(0.0 <= self.staleness_decay <= 1.0,
+               "learning.staleness-decay must be in [0, 1], "
+               f"got {self.staleness_decay!r}")
+        _check(self.async_quorum >= 0,
+               "learning.async-quorum must be >= 0 (0 = all clients)")
         _check(self.lora_rank >= 0, "lora-rank must be >= 0")
         _check(self.learning_rate > 0, "learning-rate must be > 0")
         _check(self.batch_size > 0, "batch-size must be > 0")
@@ -561,6 +599,31 @@ class Config:
                     self.aggregation, self.transport, self.chaos,
                     self.observability, self.perf):
             sub.validate()
+        if self.learning.mode == "async":
+            # the bounded-staleness admission window lives in the
+            # streaming fold; strategies that consume individual
+            # u.params (relay/periodic/fedasync) have no place to fold
+            # a staleness-weighted late contribution
+            _check(self.aggregation.strategy in ("fedavg", "sda",
+                                                 "cluster_relay"),
+                   "learning.mode: async requires a streaming-capable "
+                   "aggregation strategy (fedavg|sda|cluster_relay), "
+                   f"got {self.aggregation.strategy!r}")
+            # the admission window LIVES in the streaming fold: with
+            # streaming off there is nothing to fold a late Update
+            # into (every stale contribution would be rejected), and
+            # with an aggregator tree the L1s hard-fence on the
+            # generation before the root ever sees the frame — both
+            # would silently void the mode's staleness contract
+            _check(self.aggregation.streaming,
+                   "learning.mode: async requires "
+                   "aggregation.streaming: true (the bounded-staleness "
+                   "window folds into the streaming plane)")
+            _check(self.aggregation.fan_in == 0,
+                   "learning.mode: async does not compose with the "
+                   "aggregator tree yet (L1 groups generation-fence "
+                   "Updates before the admission window) — set "
+                   "aggregation.fan-in: 0")
         if self.topology.mode == "manual":
             cuts = self.topology.cluster_cut_layers or (
                 self.topology.cut_layers,)

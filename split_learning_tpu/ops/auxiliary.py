@@ -1,0 +1,133 @@
+"""Per-stage auxiliary heads for decoupled (async) split learning.
+
+*Decoupled Split Learning via Auxiliary Loss* (arxiv 2601.19261)
+removes the backward wire dependence of split learning: instead of
+parking on ``gradient_queue`` until the downstream stage returns a
+cotangent, a non-final stage attaches a small local head to its cut
+boundary, computes a local classification loss against the batch's
+labels (which already ride every Activation frame), and steps
+immediately after its forward tick.  The only wire traffic left is the
+forward activation stream and the round's Update upload — the gradient
+plane (and its EF-sparsifying codec) goes dormant.
+
+Two head architectures, selected by ``learning.aux-head``:
+
+* ``pooled-linear`` — mean-pool every float leaf of the boundary
+  pytree over its non-batch, non-feature axes, concatenate along the
+  feature axis, one ``Dense(num_classes)``.  The cheapest probe; its
+  gradient still reaches every boundary feature.
+* ``projection-mlp`` — the same pooling into
+  ``Dense(learning.aux-hidden) -> gelu -> Dense(num_classes)``; a
+  slightly richer local objective for deep cuts whose pooled features
+  are not linearly separable.
+
+The head is built from the *plan's cut shapes*: the client shapes it
+lazily from ``jax.eval_shape`` of its shard's forward at the first
+batch, so any model/cut combination (including pytree boundaries like
+BERT's ``(hidden, mask)``) works without per-model code.  Aux
+parameters and their optimizer state are CLIENT-LOCAL — they never
+ride Update frames (the server folds shard weights only) and they
+reset whenever a re-plan moves the cut (the boundary shape changed, so
+the old head is another tensor's probe).
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+#: classes per dataset — mirrors runtime/plan.DATASET_CLASSES without
+#: importing the (heavier) planning module from the ops layer
+_DATASET_CLASSES = {
+    "CIFAR10": 10, "CIFAR100": 100, "MNIST": 10,
+    "AGNEWS": 4, "EMOTION": 6, "SPEECHCOMMANDS": 10,
+}
+
+
+def num_classes_for(model_key: str) -> int:
+    """Label-space size for a ``{MODEL}_{DATASET}`` registry key.
+
+    Raises for a dataset without a known classification label space
+    (e.g. token-modelling datasets whose "labels" are token ids): a
+    silently-defaulted head would feed out-of-range labels to the aux
+    cross-entropy and train every non-final stage toward garbage —
+    async mode NEEDS a classification label space (README "when NOT
+    to use it")."""
+    dataset = model_key.split("_", 1)[1] if "_" in model_key else ""
+    try:
+        return _DATASET_CLASSES[dataset]
+    except KeyError:
+        raise ValueError(
+            f"learning.mode: async needs a classification label space, "
+            f"but dataset {dataset!r} (model key {model_key!r}) has no "
+            "registered class count — stay on sync for this workload "
+            "or register it in ops/auxiliary._DATASET_CLASSES") from None
+
+
+def _pool(a: jnp.ndarray) -> jnp.ndarray:
+    """(B, ...) -> (B, F): mean over every axis between batch and
+    feature.  1-D leaves become a (B, 1) column so scalars-per-sample
+    still contribute a feature."""
+    if a.ndim <= 1:
+        return a.reshape(-1, 1)
+    if a.ndim == 2:
+        return a
+    return a.mean(axis=tuple(range(1, a.ndim - 1)))
+
+
+class AuxHead(nn.Module):
+    """Local classification probe on one cut boundary.
+
+    ``hidden == 0`` is the pooled-linear form; ``hidden > 0`` inserts
+    the projection MLP.  The input may be any pytree — float leaves are
+    pooled and concatenated, non-float leaves (masks, token ids) are
+    ignored (no gradient could flow through them anyway)."""
+    num_classes: int
+    hidden: int = 0
+
+    @nn.compact
+    def __call__(self, boundary):
+        feats = []
+        for leaf in jax.tree_util.tree_leaves(boundary):
+            a = jnp.asarray(leaf)
+            if not jnp.issubdtype(a.dtype, jnp.floating):
+                continue
+            feats.append(_pool(a.astype(jnp.float32)))
+        if not feats:
+            raise ValueError(
+                "aux head: boundary pytree has no float leaves to probe")
+        x = feats[0] if len(feats) == 1 else jnp.concatenate(feats, -1)
+        if self.hidden:
+            x = nn.gelu(nn.Dense(self.hidden, name="proj")(x))
+        return nn.Dense(self.num_classes, name="probe")(x)
+
+
+def build_aux_head(kind: str, num_classes: int,
+                   hidden: int = 64) -> AuxHead:
+    """``learning.aux-head`` -> module (same vocabulary the config
+    validates)."""
+    if kind == "pooled-linear":
+        return AuxHead(num_classes=num_classes, hidden=0)
+    if kind == "projection-mlp":
+        return AuxHead(num_classes=num_classes, hidden=max(1, hidden))
+    raise ValueError(f"unknown aux head kind {kind!r}")
+
+
+def init_aux_params(head: AuxHead, rng, boundary_shapes) -> dict:
+    """Initialize head params from a boundary SHAPE pytree (the
+    ``jax.eval_shape`` result of the shard's forward): zeros of the
+    right shape/dtype are enough — flax initialization only reads
+    shapes."""
+    zeros = jax.tree_util.tree_map(
+        lambda s: jnp.zeros(s.shape, s.dtype), boundary_shapes)
+    return head.init(rng, zeros)["params"]
+
+
+def aux_shapes_signature(boundary_shapes) -> tuple:
+    """Hashable (shape, dtype) signature of a boundary shape pytree —
+    what the client compares to decide whether a re-plan moved the cut
+    (and therefore whether the aux head + its optimizer state must
+    reset)."""
+    return tuple((tuple(s.shape), str(s.dtype))
+                 for s in jax.tree_util.tree_leaves(boundary_shapes))

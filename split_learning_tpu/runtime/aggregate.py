@@ -288,15 +288,25 @@ class StreamingFold:
 
     # -- ingest --------------------------------------------------------------
 
-    def add_update(self, u: Update) -> None:
+    def add_update(self, u: Update, *, scale: float = 1.0,
+                   key: str | None = None) -> None:
         """Fold one client Update (params may be None — a weight-less
         update occupies its canonical slot, counts stage-1 samples, and
-        contributes nothing, exactly like the barrier oracle skips it)."""
+        contributes nothing, exactly like the barrier oracle skips it).
+
+        ``scale`` multiplies the FedAvg weight — the async mode's
+        staleness decay (``staleness_decay ** version_lag``); 1.0 (the
+        sync default) keeps the integer weight path bit-identical to
+        the barrier oracle.  ``key`` overrides the fold key: a
+        stale-admitted contribution folds under ``client@vN`` so it
+        can never collide with (or dup-drop) the same client's fresh
+        contribution in the canonical window — it lands in the extras
+        set and folds deterministically (sorted) at finish."""
         if getattr(u, "delta_base", None) is not None:
             raise ValueError(
                 f"delta-encoded Update from {u.client_id} reached the "
                 "streaming fold un-reconstructed")
-        self._enqueue(int(u.stage), u.client_id, ("u", u),
+        self._enqueue(int(u.stage), key or u.client_id, ("u", u, scale),
                       0 if u.params is None else _tree_nbytes(u.params))
 
     def add_partial(self, stage: int, key: str, sums, weight: float,
@@ -339,7 +349,13 @@ class StreamingFold:
             if key in st.folded or key in st.pending or key in st.extras:
                 self.faults.inc("agg_dup_drops")
                 return
-            if key not in st.order_set:
+            if key not in st.order_set or key in st.gone:
+                # outside the plan, or a key the window already gave up
+                # on (dropped at a barrier, then revived — e.g. an
+                # async late-READY rejoin): the canonical window has
+                # passed its slot, so fold it deterministically
+                # (sorted) at finish instead of parking it in a
+                # pending slot the drain will never reach
                 st.extras[key] = item
             else:
                 st.pending[key] = item
@@ -368,9 +384,10 @@ class StreamingFold:
 
     def _fold_item(self, st: _StageFold, key, item) -> None:
         t0 = time.perf_counter()
-        kind, payload = item
+        kind, payload = item[0], item[1]
         if kind == "u":
-            self._fold_update_item(st, payload)
+            scale = item[2] if len(item) > 2 else 1.0
+            self._fold_update_item(st, payload, scale)
         else:
             self._fold_partial_item(st, payload)
         st.folded.add(key)
@@ -380,13 +397,19 @@ class StreamingFold:
         if self.hists is not None:
             self.hists.observe("agg_fold", dt)
 
-    def _fold_update_item(self, st: _StageFold, u: Update) -> None:
+    def _fold_update_item(self, st: _StageFold, u: Update,
+                          scale: float = 1.0) -> None:
         if u.stage == 1:
             self.n_samples += u.num_samples
         if u.params is None:
             return
         self._held_bytes -= _tree_nbytes(u.params)
+        # sync path keeps the INT weight so the float summation is
+        # bit-identical to the barrier oracle; the async staleness
+        # decay scales it only when it actually decays
         w = max(1, u.num_samples)
+        if scale != 1.0:
+            w = w * float(scale)
         st.total_w += w
         be = self.backend
         for path, leaf in _flat_items(u.params):

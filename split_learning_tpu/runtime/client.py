@@ -188,6 +188,14 @@ def _wire_vdot(out_tree, ct_tree):
     return tot
 
 
+#: async drain-on-pause idle grace (seconds): a PAUSEd async consumer
+#: keeps eating its in-flight activation stream until every origin
+#: feeder's final epoch fence arrived, or the queue has been silent
+#: this long — the bounded-staleness tax a delayed stream may cost
+#: (frames beyond the grace are dropped, not waited for)
+ASYNC_DRAIN_IDLE_S = 0.5
+
+
 @dataclasses.dataclass
 class _AbortPause(Pause):
     """Local sentinel: the round was abandoned (STOP/fresh START arrived
@@ -243,13 +251,34 @@ class ShardRunner:
         self._counter = 0
         lrn = self.learning
         self.lora_rank, self.lora_alpha = lrn.lora_rank, lrn.lora_alpha
+        # async decoupled mode (learning.mode: async): every non-final
+        # stage trains against a local auxiliary head on its cut
+        # boundary (ops/auxiliary.py) instead of waiting for a wire
+        # cotangent.  The module is deterministic from the cache key
+        # (model_key fixes the label space, learning fixes the
+        # architecture), so sharing it through _OPS_CACHE is safe.
+        self.aux = None
+        if lrn.mode == "async":
+            from split_learning_tpu.ops.auxiliary import (
+                build_aux_head, num_classes_for,
+            )
+            self.aux = build_aux_head(lrn.aux_head,
+                                      num_classes_for(model_key),
+                                      hidden=lrn.aux_hidden)
 
         cache_key = _ops_cache_key(model_key, start_layer, end_layer,
                                    learning, model_kwargs)
         ops = bounded_setdefault(_OPS_CACHE, _OPS_CACHE_MAX, cache_key,
                                  self._build_ops)
         (self.fwd, self.bwd, self.last_step, self.whole_step,
-         self.apply_update, self._merged) = ops
+         self.aux_step, self.apply_update, self._merged) = ops
+
+    def init_aux_params(self, boundary_shapes) -> dict:
+        """Aux-head params for this shard's boundary shape pytree (the
+        ``jax.eval_shape`` of ``fwd``)."""
+        from split_learning_tpu.ops.auxiliary import init_aux_params
+        return init_aux_params(self.aux, self.next_rng(),
+                               boundary_shapes)
 
     def _build_ops(self) -> tuple:
         """The five jitted ops + merged-params helper.  Closes over the
@@ -337,8 +366,36 @@ class ShardRunner:
             updates, new_opt = self.optimizer.update(grads, opt_state, t)
             return optax.apply_updates(t, updates), new_opt
 
-        return (fwd, bwd, last_step, whole_step, apply_update,
-                jax.jit(merged))
+        aux_step = None
+        if self.aux is not None:
+            @jax.jit
+            def aux_step(frozen, t, aux_p, stats, x, labels, rng):
+                """Decoupled forward + local aux loss in ONE program:
+                the stage steps on its auxiliary gradient immediately
+                after the forward tick — no wire cotangent, no
+                gradient_queue park.  Returns the boundary output so
+                the activation still streams downstream.
+
+                Returns (loss, out, shard_grads, aux_grads,
+                new_stats)."""
+                def f(tt, ap):
+                    out, mut = self.model.apply(
+                        _variables(merged(frozen, tt), stats), x,
+                        train=True, mutable=["batch_stats"],
+                        rngs={"dropout": rng})
+                    logits = self.aux.apply({"params": ap}, out)
+                    loss = \
+                        optax.softmax_cross_entropy_with_integer_labels(
+                            logits.astype(jnp.float32), labels).mean()
+                    return loss, (out, mut)
+                (loss, (out, mut)), (gt, ga) = jax.value_and_grad(
+                    f, argnums=(0, 1), has_aux=True)(t, aux_p)
+                new_stats = dict(stats)
+                new_stats.update(mut.get("batch_stats", {}))
+                return loss, out, gt, ga, new_stats
+
+        return (fwd, bwd, last_step, whole_step, aux_step,
+                apply_update, jax.jit(merged))
 
     def partition_params(self, params, is_final_shard: bool):
         """(frozen, trainable) split of the shard's params.
@@ -481,6 +538,20 @@ class ProtocolClient:
         self._delta_base = None
         self._delta_advert = None
         self._agg_group = None   # L1 group index (aggregation.fan-in)
+        # async decoupled mode (learning.mode: async): client-local
+        # auxiliary-head state — params + their own optimizer stream,
+        # lazily shaped from the first batch's boundary eval_shape and
+        # reset whenever a re-plan moves the cut (the shape signature
+        # below is the reset trigger)
+        self.aux_params = None
+        self.aux_opt_state = None
+        self._aux_sig = None
+        # pipelined rounds: samples trained by overlap ticks between
+        # the round's UPDATE and the next START (counted into the NEXT
+        # round's Update), and control frames an overlap loop popped
+        # off the reply queue for run() to handle in order
+        self._overlap_samples = 0
+        self._pending_ctrl: list[bytes] = []
         if cfg.checkpoint.load:
             self._load_ef_state()
         # device-resident NaN sentinel: hot loops fold jnp.isfinite
@@ -671,7 +742,14 @@ class ProtocolClient:
         started = False
         while True:
             try:
-                raw = self.bus.get(q, timeout=None if started else 3.0)
+                # control frames an async overlap loop already popped
+                # from the reply queue come first — same order they
+                # arrived on the wire
+                if self._pending_ctrl:
+                    raw = self._pending_ctrl.pop(0)
+                else:
+                    raw = self.bus.get(q,
+                                       timeout=None if started else 3.0)
             except (QueueClosed, ConnectionError, OSError) as e:
                 # Transport gone while idle BETWEEN rounds: after at
                 # least one START this is almost always the STOP fan-out
@@ -779,6 +857,7 @@ class ProtocolClient:
                     + zlib.crc32(self.client_id.encode()) % 100000)
                 self.perf.wrap_runner(self.runner)
                 self.opt_state = self.runner.optimizer.init(self.trainable)
+                self._reset_aux()
                 self.log.info("hyperparams changed: rebuilt runner "
                               "(weights kept)")
             else:
@@ -811,6 +890,11 @@ class ProtocolClient:
         # compile/retrace accounting on the five jitted ops (instance
         # attributes only; the shared _OPS_CACHE bundle is untouched)
         self.perf.wrap_runner(self.runner)
+        # aux-head state deliberately NOT cleared here: it is
+        # client-local (like EF residuals) and survives same-shape
+        # re-seeds so the local probe keeps converging; _ensure_aux's
+        # boundary-shape signature resets it when a re-plan moved the
+        # cut (the old head would be probing another tensor)
         if self.codecs.get("rpc") is not None \
                 and self._delta_advert is not None:
             # base = the shard EXACTLY as received (the server's shadow
@@ -825,6 +909,17 @@ class ProtocolClient:
                     or msg.end_layer >= len(self.runner.model.specs))
         self.frozen, self.trainable = self.runner.partition_params(
             params, is_final)
+        if self._overlap_samples:
+            # pipelined overlap trained the PREVIOUS seed's shard;
+            # this START just re-seeded it, so that shard work never
+            # reaches the fold — crediting its samples would inflate
+            # this client's FedAvg weight with training the server
+            # cannot see.  (The aux head keeps its overlap progress:
+            # it is client-local and survives the re-seed.)  A hold
+            # START (no params) keeps local weights AND the credit.
+            self.log.info(f"overlap: {self._overlap_samples} old-seed "
+                          "samples uncounted (shard re-seeded)")
+            self._overlap_samples = 0
         if getattr(self.runner, "lora_noop", False):
             self.log.warning(
                 "lora_rank set but no target kernels in this shard; "
@@ -862,7 +957,12 @@ class ProtocolClient:
         self.round_ok = True
         self._ok_dev = jnp.asarray(True)
         self.round_idx = msg.round_idx
-        self.num_samples = 0
+        # pipelined async rounds: overlap-tick samples survive only a
+        # HOLD start (local shard kept — the work is in what the next
+        # Update uploads); a re-seeding START zeroed them in
+        # _apply_start because the fold never sees that training
+        self.num_samples = self._overlap_samples
+        self._overlap_samples = 0
         self.gauges.set("round", msg.round_idx)
         # perf plane round window: SYN -> UPDATE published.  The
         # attribution record's components (compute|compile|dispatch|
@@ -885,6 +985,12 @@ class ProtocolClient:
                               stage=self.stage):
             if self.stage == 1 and whole:
                 pause = self._train_whole()
+            elif self._async_mode and self.stage == 1:
+                pause = self._train_first_async()
+            elif self._async_mode and self.stage == self.n_stages:
+                pause = self._train_last_async()
+            elif self._async_mode:
+                pause = self._train_middle_async()
             elif self.stage == 1:
                 pause = self._train_first()
             elif self.stage == self.n_stages:
@@ -918,6 +1024,9 @@ class ProtocolClient:
         # a finished round's spans must be durable even if the process
         # dies while idle between rounds
         self.tracer.flush()
+        # pipelined rounds: keep ticking locally while the server
+        # aggregates/validates and the next START streams in
+        self._overlap_ticks()
 
     def _send_update(self, with_weights: bool = True):
         # the round's ONE host sync of the NaN sentinel the hot loops
@@ -953,6 +1062,10 @@ class ProtocolClient:
                                 stage=self.stage, cluster=cl, params=p,
                                 batch_stats=s, num_samples=n, ok=ok,
                                 round_idx=fence, delta_base=db,
+                                # async staleness tag: the generation
+                                # these params were seeded from — the
+                                # server's admission window reads it
+                                version=fence,
                                 telemetry=tel),
                                 self._chunk_bytes,
                                 ctx=ctx), kind="Update")
@@ -1003,9 +1116,20 @@ class ProtocolClient:
         barriers no longer count us, so no PAUSE is coming).  Requeue the
         START for the run() loop and unwind without uploading — the
         client then rejoins from the fresh START instead of being lost
-        until STOP."""
-        self.log.warning("START while mid-round: rejoining next round")
+        until STOP.
+
+        Async mode instead UPLOADS the round's work before rejoining:
+        the Update carries the old seed's version tag, and the server's
+        bounded-staleness admission window folds it with a
+        staleness-scaled weight — the straggler contributes late
+        instead of throwing its round away.  The requeued START is the
+        double-buffered next seed, swapped at this tick boundary."""
         self.bus.publish(reply_queue(self.client_id), encode(msg))
+        if self._async_mode:
+            self.log.info("START mid-round (async): uploading late "
+                          "update, swapping seed at tick boundary")
+            return Pause(send_weights=True)
+        self.log.warning("START while mid-round: rejoining next round")
         return _AbortPause(send_weights=False)
 
     def _wait_pause(self) -> Pause:
@@ -1042,6 +1166,299 @@ class ProtocolClient:
         if isinstance(msg, Start):
             return self._redeliver_start(msg)
         return None
+
+    # -- async decoupled mode (learning.mode: async) -------------------------
+
+    @property
+    def _async_mode(self) -> bool:
+        r = getattr(self, "runner", None)
+        return r is not None and r.learning.mode == "async"
+
+    def _reset_aux(self) -> None:
+        self.aux_params = None
+        self.aux_opt_state = None
+        self._aux_sig = None
+        self._aux_key = None
+
+    def _ensure_aux(self, x) -> None:
+        """Shape (or re-shape) the aux head for the current boundary.
+
+        The boundary shape is ``eval_shape`` of this shard's forward on
+        the live batch — recomputed only when the shard slice or the
+        batch shape moved.  A changed signature means a re-plan moved
+        the cut: params AND optimizer state reset (the old moments are
+        another tensor's momentum); an unchanged one keeps both, so the
+        local probe keeps converging across rounds."""
+        r = self.runner
+        key = (r.start_layer, r.model.resolved_end,
+               tuple(np.shape(leaf)
+                     for leaf in jax.tree_util.tree_leaves(x)))
+        if self.aux_params is not None \
+                and key == getattr(self, "_aux_key", None):
+            return
+        from split_learning_tpu.ops.auxiliary import aux_shapes_signature
+        shapes = jax.eval_shape(r.fwd, self.frozen, self.trainable,
+                                self.stats, x, jax.random.key(0))
+        sig = aux_shapes_signature(shapes)
+        if sig != self._aux_sig:
+            if self._aux_sig is not None:
+                self.log.info("aux head re-shaped (re-plan moved the "
+                              "cut): optimizer state reset")
+            self.aux_params = r.init_aux_params(shapes)
+            self.aux_opt_state = r.optimizer.init(self.aux_params)
+            self._aux_sig = sig
+        self._aux_key = key
+
+    def _aux_tick(self, xd, yd, n: int, publish_to: str | None = None):
+        """One decoupled training tick: forward + aux loss + immediate
+        shard AND head step.  When ``publish_to`` is set the boundary
+        output streams downstream as a normal Activation payload (the
+        caller wraps it); returns the wire-staged output or None."""
+        r = self.runner
+        self._ensure_aux(xd)
+        rng = r.next_rng()
+        sp = self.tracer.start("aux_step", always=False,
+                               round=self.round_idx)
+        t_sp = time.perf_counter()
+        loss, out, gt, ga, self.stats = r.aux_step(
+            self.frozen, self.trainable, self.aux_params, self.stats,
+            xd, yd, rng)
+        self._ok_dev = jnp.logical_and(self._ok_dev,
+                                       jnp.isfinite(loss))
+        self.trainable, self.opt_state = r.apply_update(
+            self.trainable, self.opt_state, gt)
+        self.aux_params, self.aux_opt_state = r.apply_update(
+            self.aux_params, self.aux_opt_state, ga)
+        wire_out = None
+        if publish_to is not None:
+            wire_out = self._wire_out(out, "intermediate", publish_to)
+        sp.end()
+        self.hists.observe("step", time.perf_counter() - t_sp)
+        self.perf.note_step(t_sp, (loss, self.trainable), n=n)
+        self.num_samples += n
+        return wire_out
+
+    def _overlap_ticks(self) -> None:
+        """Pipelined rounds: after the round's UPDATE leaves, a stage-1
+        async client keeps ticking on its CURRENT version (local aux
+        steps, nothing published) while the server aggregates/validates
+        and the next START streams in — server wall overlaps client
+        compute instead of alternating with it.  Bounded to one pass
+        over the loader; any control frame ends the overlap and is
+        handed back to run() in arrival order.  The extra samples are
+        banked for the NEXT round's Update but survive only a hold
+        START (shard kept): a re-seed discards the credit along with
+        the shard work (_apply_start), while the client-local aux head
+        keeps its progress either way."""
+        if (not self._async_mode or self.stage != 1
+                or self.loader is None or self.aux_params is None):
+            return
+        from split_learning_tpu.runtime.bus import QueueClosed
+        q = reply_queue(self.client_id)
+        ticked = 0
+        for x, labels in iter(self.loader):
+            try:
+                raw = self.bus.get(q, timeout=0.0005)
+            except (QueueClosed, ConnectionError, OSError):
+                # transport gone between rounds: stop ticking and let
+                # run()'s own get take the graceful-shutdown path
+                # (tracer flush + close), same as a sync client
+                return
+            if raw is not None:
+                self._pending_ctrl.append(raw)
+                break
+            with self.perf.host():
+                xd = jnp.asarray(x)
+                yd = jnp.asarray(labels.astype(np.int32))
+            self._aux_tick(xd, yd, len(labels))
+            # _aux_tick counts into num_samples (already reported in
+            # the sent UPDATE) — move the credit to the next round
+            self.num_samples -= len(labels)
+            self._overlap_samples += len(labels)
+            ticked += 1
+        if ticked:
+            self.log.info(f"async overlap: {ticked} local ticks "
+                          f"({self._overlap_samples} samples banked "
+                          "for the next round)")
+
+    def _train_first_async(self) -> Pause:
+        """Stage-1 decoupled loop: dispatch + local aux step per batch,
+        activations stream downstream, NO gradient wait — the
+        gradient queue (and its EF codec) stays dormant."""
+        out_qs = self._out_queues()
+        n_fwd = 0
+        for ep in range(self.epochs):
+            self.gauges.set("epoch", ep)
+            data_iter = iter(self.loader)
+            while True:
+                pause = self._check_pause()
+                if pause is not None:
+                    return pause
+                with self.perf.host():
+                    item = next(data_iter, None)
+                    if item is not None:
+                        x, labels = item
+                        xd = jnp.asarray(x)
+                        yd = jnp.asarray(labels.astype(np.int32))
+                if item is None:
+                    break
+                out_q = out_qs[n_fwd % len(out_qs)]
+                out = self._aux_tick(xd, yd, len(labels),
+                                     publish_to=out_q)
+                _start_host_copy(out)
+                labels_np = np.asarray(labels, np.int32)
+                data_id = uuid.uuid4().hex
+                self._publish_parts(
+                    out_q,
+                    lambda ctx, out=out, labels_np=labels_np, d=data_id,
+                    fence=self.fence, cl=self.cluster:
+                        encode_parts(Activation(
+                            data_id=d,
+                            data=self._wire_host(out, "intermediate"),
+                            labels=labels_np, trace=[self.client_id],
+                            cluster=cl, round_idx=fence),
+                            self._chunk_bytes, ctx=ctx),
+                    kind="Activation")
+                n_fwd += 1
+            # epoch fence, unconditionally in async (not just strict
+            # SDA): downstream PAUSE drains exit the moment every
+            # feeder's final fence arrives instead of idling out
+            # ASYNC_DRAIN_IDLE_S — per-queue FIFO orders it after
+            # every activation it covers
+            for q in out_qs:
+                self.bus.publish(q, encode(EpochEnd(
+                    client_id=self.client_id, round_idx=self.fence,
+                    epoch=ep)))
+        self.bus.publish(RPC_QUEUE, encode(Notify(
+            client_id=self.client_id, cluster=self.cluster,
+            round_idx=self.fence)))
+        self.log.info(f"[>>>] NOTIFY fwd={n_fwd} (async)")
+        return self._wait_pause()
+
+    def _drained(self, fenced: set, last_rx: float) -> bool:
+        """PAUSE-drain exit test for async consumers: every origin
+        feeder's final epoch fence arrived (per-queue FIFO: nothing
+        the fences cover is still upstream), or the in-queue idled
+        past the grace (a delayed stream's tail beyond it is dropped —
+        the bounded-staleness liveness contract)."""
+        feeders = set(self.sda_feeders or ())
+        if feeders and feeders <= fenced:
+            return True
+        return time.monotonic() - last_rx > ASYNC_DRAIN_IDLE_S
+
+    def _train_middle_async(self) -> Pause:
+        """Middle-stage decoupled loop: consume upstream activations,
+        local aux step, forward downstream.  EpochEnd markers relay
+        downstream AND fence this stage's PAUSE drain — a Pause does
+        not abandon the in-flight stream (the feeders NOTIFY the
+        moment they exhaust their data, well before a slow wire has
+        delivered everything they sent)."""
+        in_q = intermediate_queue(self.stage - 1, self.cluster,
+                                  self.pair)
+        out_qs = self._out_queues()
+        n_fwd = 0
+        fenced: set = set()
+        paused: Pause | None = None
+        last_rx = time.monotonic()
+        while True:
+            if paused is None:
+                pause = self._check_pause()
+                if isinstance(pause, _AbortPause):
+                    return pause      # round abandoned: nothing to drain
+                if pause is not None:
+                    paused = pause
+                    last_rx = time.monotonic()
+            elif self._drained(fenced, last_rx):
+                self.log.info("[<<<] PAUSE (stream drained)")
+                return paused
+            raw = self.bus.get(in_q, timeout=0.001)
+            if raw is None:
+                continue
+            act = self._decode(raw, in_q)
+            if act is None or act.round_idx != self.fence:
+                continue
+            last_rx = time.monotonic()
+            if isinstance(act, EpochEnd):
+                if act.epoch >= self.epochs - 1:
+                    fenced.add(act.client_id)
+                for q in out_qs:
+                    self.bus.publish(q, raw)  # slcheck: wire=EpochEnd
+                continue
+            xd = _from_wire_tree(act.data)
+            yd = jnp.asarray(act.labels, jnp.int32)
+            out_q = out_qs[n_fwd % len(out_qs)]
+            out = self._aux_tick(xd, yd, len(act.labels),
+                                 publish_to=out_q)
+            _start_host_copy(out)
+            self._publish_parts(
+                out_q,
+                lambda ctx, out=out, act=act, fence=self.fence,
+                cl=self.cluster:
+                    encode_parts(Activation(
+                        data_id=act.data_id,
+                        data=self._wire_host(out, "intermediate"),
+                        labels=act.labels,
+                        trace=list(act.trace) + [self.client_id],
+                        cluster=cl, round_idx=fence),
+                        self._chunk_bytes, ctx=ctx),
+                kind="Activation")
+            n_fwd += 1
+
+    def _train_last_async(self) -> Pause:
+        """Final-stage decoupled loop: true loss + local step per
+        received batch, NO input-gradient return (the whole point) —
+        reuses the whole-model step, which takes gradients wrt the
+        trainables only.  PAUSE starts a bounded drain (``_drained``):
+        the feeders NOTIFY the moment their data is dispatched, so the
+        head's input stream is still in flight when the round closes —
+        it eats until every feeder's final epoch fence lands or the
+        queue idles out."""
+        r = self.runner
+        in_q = intermediate_queue(self.stage - 1, self.cluster,
+                                  self.pair)
+        fenced: set = set()
+        paused: Pause | None = None
+        last_rx = time.monotonic()
+        while True:
+            if paused is None:
+                pause = self._check_pause()
+                if isinstance(pause, _AbortPause):
+                    return pause      # round abandoned: nothing to drain
+                if pause is not None:
+                    paused = pause
+                    last_rx = time.monotonic()
+            elif self._drained(fenced, last_rx):
+                self.log.info("[<<<] PAUSE (stream drained)")
+                return paused
+            raw = self.bus.get(in_q, timeout=0.001)
+            if raw is None:
+                continue
+            act = self._decode(raw, in_q)
+            if act is None or act.round_idx != self.fence:
+                continue
+            last_rx = time.monotonic()
+            if isinstance(act, EpochEnd):
+                if act.epoch >= self.epochs - 1:
+                    # the feeder's last fence: its stream is fully in
+                    fenced.add(act.client_id)
+                continue
+            x = _from_wire_tree(act.data)
+            labels = jnp.asarray(act.labels, jnp.int32)
+            sp = self.tracer.start("sda_step", always=False,
+                                   round=self.round_idx, window=1)
+            t_sp = time.perf_counter()
+            loss, gt, self.stats = r.whole_step(
+                self.frozen, self.trainable, self.stats, x, labels,
+                r.next_rng())
+            self._ok_dev = jnp.logical_and(self._ok_dev,
+                                           jnp.isfinite(loss))
+            self.trainable, self.opt_state = r.apply_update(
+                self.trainable, self.opt_state, gt)
+            sp.end()
+            self.hists.observe("step", time.perf_counter() - t_sp)
+            self.perf.note_step(t_sp, (loss, self.trainable),
+                                n=len(act.labels))
+            self.num_samples += len(act.labels)
 
     # -- hot loops -----------------------------------------------------------
 

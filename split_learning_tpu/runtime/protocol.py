@@ -128,6 +128,14 @@ class Update:
     # what it sent in START.  None = full frame (the resync fallback
     # whenever the version chain broke: client restart, shadow loss).
     delta_base: int | None = None
+    # async mode (learning.mode: async): the server generation this
+    # client's params were SEEDED from — rides the existing delta-base
+    # advertisement chain (START extra carries the gen, the client
+    # stamps it back).  The server's bounded-staleness admission window
+    # folds ``server_version - version <= learning.max-staleness`` with
+    # staleness-scaled weight and rejects-and-counts the rest.  None =
+    # sync client (round_idx carries the same fence).
+    version: int | None = None
     # piggybacked TelemetrySnapshot dict (runtime/telemetry.py): every
     # sync round delivers one fleet sample for free, heartbeat thread
     # or not.  A plain dict, NOT the dataclass — the restricted

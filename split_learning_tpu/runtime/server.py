@@ -189,6 +189,25 @@ class ProtocolContext(MeshContext):
         # from sub-call k must not satisfy sub-call k+1's barriers)
         self._gen = 0
         self._cur_gen = 0
+        # async decoupled mode (learning.mode: async): the generation IS
+        # the global model version.  Instead of the hard gen fence, the
+        # UPDATE pump admits contributions through a bounded-staleness
+        # window (_admit_update) and the UPDATE barrier cuts a new
+        # version at learning.async-quorum fresh contributions.
+        self._async = cfg.learning.mode == "async"
+        # (client_id, version) pairs already folded — the dedup that
+        # keeps an at-least-once redelivery of a post-fold Update from
+        # double-counting samples (and a stale resend from re-folding
+        # across invocations); pruned past the admission window
+        self._folded_versions: set = set()
+        # late-READY SYN: True between the SYN fan-out and the end of
+        # an async invocation, so a straggler's late READY still gets
+        # its SYN instead of idling out the whole round
+        self._syn_live = False
+        self._syn_round = 0
+        # per-client responsive-set fence overrides captured at the
+        # SYN fan-out, reused for late-READY joiners
+        self._syn_overrides: dict = {}
 
     # -- rpc pump ------------------------------------------------------------
 
@@ -283,7 +302,29 @@ class ProtocolContext(MeshContext):
                 self.log.warning(f"stale READY {msg.client_id} "
                                  f"gen={msg.round_idx} (dropped)")
             else:
+                late = (self._syn_live
+                        and msg.client_id not in self._ready)
                 self._ready.add(msg.client_id)
+                if late:
+                    # async pipelining: the SYN fan-out already went
+                    # out (the READY barrier collapsed to the
+                    # responsive set) — a straggler that finishes its
+                    # previous round's late upload and re-READYs still
+                    # joins THIS round instead of idling to the next.
+                    # It gets the same responsive-set fence overrides
+                    # the fan-out carried: the static START values may
+                    # name feeders dropped at the barrier, whose
+                    # fences would stall its strict drain / burn the
+                    # async drain grace every round.
+                    q, feeders = self._syn_overrides.get(
+                        msg.client_id, (None, None))
+                    self.bus.publish(
+                        reply_queue(msg.client_id),
+                        encode(Syn(self._syn_round,
+                                   sda_fence_quorum=q,
+                                   sda_feeders=feeders)))
+                    self.log.sent(f"SYN -> {msg.client_id} "
+                                  "(late READY)")
         elif isinstance(msg, Notify):
             if msg.round_idx != self._cur_gen:
                 self.log.warning(f"stale NOTIFY {msg.client_id} "
@@ -292,26 +333,10 @@ class ProtocolContext(MeshContext):
                 self._notified.add(msg.client_id)
                 self.log.received(f"NOTIFY {msg.client_id}")
         elif isinstance(msg, Update):
-            # a straggler dropped in invocation k that wakes during k+1
-            # must not have its stale weights aggregated as k+1's
-            # contribution
-            if msg.round_idx != self._cur_gen:
-                self.log.warning(f"stale UPDATE {msg.client_id} "
-                                 f"gen={msg.round_idx} (dropped)")
-            else:
-                self._fold_update(msg)
-                if self._fold is not None:
-                    # streaming fold: the weights fold into the running
-                    # sum NOW (a shallow copy keeps the tree alive in
-                    # the fold's reorder window) and the barrier list
-                    # holds a weight-stripped record — O(1) full trees
-                    # at the UPDATE barrier instead of O(clients)
-                    self._fold.add_update(copy.copy(msg))
-                    msg.params = None
-                    msg.batch_stats = None
-                self._updates.append(msg)
-                self.log.received(f"UPDATE {msg.client_id} "
-                                  f"samples={msg.num_samples} ok={msg.ok}")
+            # generation fence (sync) / bounded-staleness admission
+            # window (async) + (client_id, version) dedup — one door
+            # for every fold-bound Update
+            self._admit_update(msg)
         elif isinstance(msg, PartialAggregate):
             # one L1 aggregator's folded group landing at the root
             if msg.round_idx != self._cur_gen:
@@ -322,6 +347,77 @@ class ProtocolContext(MeshContext):
             else:
                 self._fold_partial(msg)
         return True
+
+    def _admit_update(self, msg: Update) -> None:
+        """The one admission door for client Updates.
+
+        * dedup first: a resent (at-least-once redelivered) Update for
+          a ``(client_id, version)`` already folded is dropped BEFORE
+          any sample accounting — the weight-less skip path in
+          ``aggregate_cluster`` must never see the same contribution
+          twice (PR 6 double-count fix);
+        * sync (``learning.mode: sync``): only the current generation
+          folds — the hard fence, unchanged semantics;
+        * async: an Update seeded from version ``v`` is admitted while
+          ``server_version - v <= learning.max-staleness`` and folded
+          with weight scaled by ``staleness-decay ** lag`` under a
+          ``client@vN`` extras key (a straggler contributes late
+          instead of stalling the fleet); anything older is
+          rejected-and-counted (``agg_stale_updates``).
+        """
+        lrn = self.cfg.learning
+        ver = msg.version if msg.version is not None else msg.round_idx
+        key = (msg.client_id, ver)
+        if key in self._folded_versions:
+            self.faults.inc("agg_dup_drops")
+            self.log.warning(f"duplicate UPDATE {msg.client_id} "
+                             f"v{ver} (already folded; dropped)")
+            return
+        lag = self._cur_gen - ver
+        if lag == 0 and msg.round_idx == self._cur_gen:
+            self._fold_update(msg)
+            if self._fold is not None:
+                # streaming fold: the weights fold into the running
+                # sum NOW (a shallow copy keeps the tree alive in
+                # the fold's reorder window) and the barrier list
+                # holds a weight-stripped record — O(1) full trees
+                # at the UPDATE barrier instead of O(clients)
+                self._fold.add_update(copy.copy(msg))
+                msg.params = None
+                msg.batch_stats = None
+            self._folded_versions.add(key)
+            self._updates.append(msg)
+            if self._async and self.fleet is not None:
+                # version lag is an async-mode signal: in sync mode the
+                # generation bumps per INVOCATION (sequential clusters
+                # would read as phantom lag and flap the straggler state)
+                self.fleet.note_client_version(msg.client_id, ver)
+            self.log.received(f"UPDATE {msg.client_id} "
+                              f"samples={msg.num_samples} ok={msg.ok}")
+            return
+        if (self._async and self._fold is not None
+                and 0 < lag <= lrn.max_staleness):
+            # bounded-staleness admission: fold with decayed weight,
+            # keyed off the canonical window so the same client's
+            # FRESH contribution this round still occupies its slot
+            self._fold_update(msg)
+            scale = lrn.staleness_decay ** lag
+            self._fold.add_update(copy.copy(msg), scale=scale,
+                                  key=f"{msg.client_id}@v{ver}")
+            msg.params = None
+            msg.batch_stats = None
+            self._folded_versions.add(key)
+            self._updates.append(msg)
+            self.faults.inc("agg_stale_admits")
+            if self.fleet is not None:   # stale admits only exist async
+                self.fleet.note_client_version(msg.client_id, ver)
+            self.log.received(
+                f"UPDATE {msg.client_id} v{ver} lag={lag} "
+                f"(stale-admitted, weight x{scale:g})")
+            return
+        self.faults.inc("agg_stale_updates")
+        self.log.warning(f"stale UPDATE {msg.client_id} v{ver} "
+                         f"lag={lag} (rejected)")
 
     def _fold_update(self, msg: Update) -> None:
         """Reconstruct a delta-encoded UPDATE in place (``base +
@@ -373,7 +469,9 @@ class ProtocolContext(MeshContext):
                 f"PARTIALAGGREGATE {msg.aggregator_id} outside a "
                 "streaming invocation (dropped)")
             return
-        self._fold.add_partial(
+        # gen-fenced upstream (the pump drops stale PartialAggregates
+        # before this); L1 members are never stale-admitted
+        self._fold.add_partial(  # slcheck: async-exempt
             msg.stage, agg_plane.group_key(msg.group), msg.sums,
             msg.weight, msg.dtypes, stat_sums=msg.stat_sums,
             stat_weight=msg.stat_weight, stat_dtypes=msg.stat_dtypes,
@@ -458,7 +556,8 @@ class ProtocolContext(MeshContext):
             fb["deadline"] = (time.monotonic()
                               + self.L1_FALLBACK_GRACE_S)
             self._fold_update(u)   # delta reconstruction, like the pump
-            fb["fold"].add_update(copy.copy(u))
+            # drain_group_queue already gen-fenced this frame
+            fb["fold"].add_update(copy.copy(u))  # slcheck: async-exempt
             u.params = None
             u.batch_stats = None
             if self.fleet is not None and u.telemetry:
@@ -476,7 +575,8 @@ class ProtocolContext(MeshContext):
         stages, n = fb["fold"].partial()
         ent = stages.get(g.stage)
         if ent:
-            self._fold.add_partial(
+            # members already gen-fenced at the drain
+            self._fold.add_partial(  # slcheck: async-exempt
                 g.stage, g.key, ent["sums"], ent["weight"],
                 ent["dtypes"], stat_sums=ent["stat_sums"],
                 stat_weight=ent["stat_weight"],
@@ -750,6 +850,18 @@ class ProtocolContext(MeshContext):
         self._updates = []
         self._gen += 1
         self._cur_gen = self._gen
+        self._syn_live = False
+        # async: the generation is the global model version — prune the
+        # (client, version) dedup ledger past the admission window and
+        # tell the fleet monitor where "now" is (version-lag scoring)
+        self._folded_versions = {
+            (c, v) for c, v in self._folded_versions
+            if self._cur_gen - v <= self.cfg.learning.max_staleness + 1}
+        if self._async and self.fleet is not None:
+            # async only: in sync mode the generation is an invocation
+            # counter, not a model version — feeding it to the monitor
+            # would fabricate version lag for sequential clusters
+            self.fleet.note_version(self._cur_gen)
 
         # streaming fold for this invocation: contributions fold in
         # canonical per-stage key order — sorted client ids, or L1
@@ -999,59 +1111,105 @@ class ProtocolContext(MeshContext):
                 f"fan-in {self._agg.fan_in}", "cyan")
         stage_of = dict(active)
         syn_span = self.tracer.start("syn_fanout", round=round_idx)
-        for cid in ids:
-            s = stage_of[cid]
-            # strict-SDA liveness under client loss (ADVICE r5): the
-            # fence quorum / feeder set sent in START counted the
-            # STATIC plan, but a previous-stage client dropped at the
-            # READY barrier will never send its fence copies — the
-            # static quorum could never be met and the strict drain
-            # would stall to round timeout.  Recompute both from the
-            # RESPONSIVE set and rebroadcast them with SYN.
+        # strict-SDA liveness under client loss (ADVICE r5): the
+        # fence quorum / feeder set sent in START counted the
+        # STATIC plan, but a previous-stage client dropped at the
+        # READY barrier will never send its fence copies — the
+        # static quorum could never be met and the strict drain
+        # would stall to round timeout.  Recompute both from the
+        # RESPONSIVE set and rebroadcast them with SYN.  Computed for
+        # EVERY active client (not just the responsive set): a late
+        # READY joiner's pump-sent SYN reuses its entry.
+        self._syn_overrides = {}
+        for cid, s in active:
             quorum = (1 if s <= 2 else max(1, sum(
                 1 for c in plan.clients[s - 2] if c in ids)))
-            feeders = [c for c in stage1 if c in ids
+            feeders = [c for c in stage1 if (c in ids or c == cid)
                        and (not pair_groups
                             or pair_groups.get(c) == pair_groups.get(cid))]
+            self._syn_overrides[cid] = (quorum, feeders)
+        for cid in ids:
+            quorum, feeders = self._syn_overrides[cid]
             self.bus.publish(reply_queue(cid), encode(Syn(
                 round_idx, sda_fence_quorum=quorum,
                 sda_feeders=feeders)))
         self.log.sent(f"SYN -> {sorted(ids)}")
         syn_span.end()
+        # async: keep the SYN window open — a straggler's late READY
+        # (it was still uploading its previous round) gets its SYN from
+        # the pump and joins this round late instead of idling it out
+        self._syn_live = self._async
+        self._syn_round = round_idx
 
         s1_ids = set(stage1) & ids
+        quorum_n = self.cfg.learning.async_quorum
         deadline = time.monotonic() + self.client_timeout
         with self.tracer.span("notify_wait", round=round_idx):
-            self._pump_until(lambda: s1_ids <= self._notified,
-                             "NOTIFY from stage-1 clients",
-                             deadline=deadline,
-                             waiting=lambda: s1_ids - self._notified)
+            if self._async and quorum_n:
+                # async quorum: the round moves on once enough feeders
+                # exhausted their data — a high-RTT feeder finishes its
+                # contribution late (stale-admitted next cut) instead
+                # of stalling the fleet
+                s1_need = min(len(s1_ids), max(1, quorum_n))
+                self._pump_until(
+                    lambda: len(self._notified & s1_ids) >= s1_need,
+                    f"NOTIFY quorum {s1_need}/{len(s1_ids)}",
+                    deadline=deadline,
+                    waiting=lambda: s1_ids - self._notified)
+            else:
+                self._pump_until(lambda: s1_ids <= self._notified,
+                                 "NOTIFY from stage-1 clients",
+                                 deadline=deadline,
+                                 waiting=lambda: s1_ids - self._notified)
         pause_span = self.tracer.start("pause_fanout", round=round_idx)
-        for cid in ids:
+        # late-READY joiners (async) get their PAUSE too — they are
+        # training and must upload like everyone else
+        pause_ids = set(ids) | (self._ready & {c for c, _ in active})
+        for cid in pause_ids:
             if isinstance(send_weights, dict):
                 flag = bool(send_weights.get(stage_of[cid], True))
             else:
                 flag = bool(send_weights)
             self.bus.publish(reply_queue(cid),
                              encode(Pause(send_weights=flag)))
-        self.log.sent(f"PAUSE -> {sorted(ids)}")
+        self.log.sent(f"PAUSE -> {sorted(pause_ids)}")
         pause_span.end()
 
         # _agg_gone: members a dead L1 consumed-then-lost — their
         # UPDATE can never arrive, so the barrier stops counting them
-        got = lambda: ({u.client_id for u in self._updates}  # noqa
-                       | self._agg_gone) >= ids
+        def fresh_ids() -> set:
+            return {u.client_id for u in self._updates
+                    if (u.version if u.version is not None
+                        else u.round_idx) == self._cur_gen}
+        if self._async and quorum_n:
+            # bounded-staleness version cut: a new global version cuts
+            # once async-quorum FRESH contributions folded; stragglers
+            # contribute late through the admission window instead of
+            # holding the barrier
+            need = min(max(1, quorum_n), len(ids))
+            got = lambda: len((fresh_ids() & ids)  # noqa: E731
+                              | (self._agg_gone & ids)) >= need
+            missing = lambda: ids - fresh_ids() - self._agg_gone  # noqa
+            what = lambda: (f"UPDATE quorum {need}/{len(ids)} "  # noqa
+                            f"(missing {sorted(missing())})")
+        else:
+            # fresh_ids, NOT the raw barrier list: in async mode a
+            # straggler's stale-admitted PREVIOUS-version Update also
+            # rides self._updates, and counting it would cut the round
+            # without the client's fresh contribution (in sync the two
+            # sets are identical — only current-gen Updates fold)
+            got = lambda: (fresh_ids()  # noqa: E731
+                           | self._agg_gone) >= ids
+            missing = lambda: (ids  # noqa: E731
+                               - fresh_ids() - self._agg_gone)
+            what = lambda: "UPDATE from " + str(missing())  # noqa
         with self.tracer.span("update_wait", round=round_idx):
             self._pump_until(
-                got,
-                lambda: ("UPDATE from " + str(
-                    ids - {u.client_id for u in self._updates}
-                    - self._agg_gone)),
+                got, what,
                 deadline=time.monotonic() + self.client_timeout,
-                waiting=lambda: (
-                    ids - {u.client_id for u in self._updates}
-                    - self._agg_gone),
+                waiting=missing,
                 poll=self._poll_l1 if self._l1 else None)
+        self._syn_live = False
         if self._l1:
             self._finish_l1()
         updates = list(self._updates)

@@ -85,11 +85,23 @@ def aggregate_cluster(updates: Sequence[Update]) -> tuple[Any, Any, int]:
     fold = getattr(updates, "fold", None)
     by_stage: dict[int, list[Update]] = {}
     n_weightless = 0
+    # dedup on (client_id, version) BEFORE any sample accounting: an
+    # at-least-once transport can redeliver a client's Update after the
+    # streaming fold already consumed (and weight-stripped) the first
+    # copy — without this guard the weight-less skip path would count
+    # the same client's samples twice (PR 6 regression)
+    seen: set = set()
     for u in updates:
         if getattr(u, "delta_base", None) is not None:
             raise ValueError(
                 f"delta-encoded Update from {u.client_id} (base "
                 f"v{u.delta_base}) reached aggregation un-reconstructed")
+        key = (u.client_id,
+               u.version if getattr(u, "version", None) is not None
+               else u.round_idx)
+        if key in seen:
+            continue
+        seen.add(key)
         if fold is not None or u.params is None:
             if u.stage == 1:
                 n_weightless += u.num_samples

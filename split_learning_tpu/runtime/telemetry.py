@@ -281,6 +281,7 @@ class _ClientHealth:
     rate: float | None = None       # EWMA samples/s (sender-reported)
     score: float | None = None      # rate / fleet median (lower=worse)
     round: int | None = None
+    version: int | None = None      # last Update's seed version (async)
     samples: int = 0
     counters: dict = dataclasses.field(default_factory=dict)
     wire: dict = dataclasses.field(default_factory=dict)
@@ -320,6 +321,7 @@ class FleetMonitor:
     STRAGGLER_MISSES = 2.0   # intervals of silence -> straggler
     STRAGGLER_SCORE = 0.5    # rate below this x median -> straggler
     RECOVER_SCORE = 0.75     # rate at/above this x median -> healthy
+    STALE_LAG = 2            # version lag at/above this -> straggler
     MAX_TRANSITIONS = 512    # bounded transition journal
 
     def __init__(self, interval: float, liveness_timeout: float,
@@ -333,6 +335,13 @@ class FleetMonitor:
         self._lock = threading.RLock()
         self._clients: dict[str, _ClientHealth] = {}
         self._last_pump: float | None = None
+        # async staleness as a first-class fleet signal: the server's
+        # current global version (note_version at each cut) vs the
+        # version each client's last Update was seeded from — the lag
+        # the admission window decays by, surfaced per client as
+        # sl_client_version_lag and annotated `stale` on straggler
+        # transitions it causes
+        self._version: int | None = None
         self.transitions: collections.deque = collections.deque(
             maxlen=self.MAX_TRANSITIONS)
         # optional hook fired (under the monitor lock) when a client
@@ -421,6 +430,27 @@ class FleetMonitor:
                 self._transition(cid, h, "degraded", "fresh heartbeat",
                                  now)
             return True
+
+    def note_version(self, version: int) -> None:
+        """The server cut a new global version (async mode; in sync
+        mode this is simply the invocation generation)."""
+        with self._lock:
+            self._version = int(version)
+
+    def note_client_version(self, cid: str, version: int,
+                            now: float | None = None) -> None:
+        """Record the seed version of a client's admitted Update —
+        the numerator of its version lag."""
+        now = time.time() if now is None else now
+        with self._lock:
+            h = self._ensure(cid, now)
+            if h.version is None or version > h.version:
+                h.version = int(version)
+
+    def _lag(self, h: _ClientHealth) -> int | None:
+        if self._version is None or h.version is None:
+            return None
+        return max(0, self._version - h.version)
 
     def forget(self, cid: str) -> None:
         """Elastic prune: a client removed from the plans stops being
@@ -511,6 +541,16 @@ class FleetMonitor:
                     if h.state == "healthy":
                         self._transition(cid, h, "degraded",
                                          "missed a heartbeat", now)
+                elif (self._lag(h) is not None
+                        and self._lag(h) >= self.STALE_LAG):
+                    # async staleness: the client is alive and may even
+                    # be fast, but its contributions fold STALE_LAG+
+                    # versions behind the fleet — a distinct straggler
+                    # cause from compute-slow / wire-slow
+                    self._transition(
+                        cid, h, "straggler",
+                        f"stale: version lag {self._lag(h)} behind "
+                        f"v{self._version}", now)
                 elif (h.score is not None
                         and h.score < self.STRAGGLER_SCORE
                         and len(rates) >= 2):
@@ -519,7 +559,8 @@ class FleetMonitor:
                         f"rate {h.rate:.1f}/s is {h.score:.2f}x the "
                         "fleet median" + self._rate_why(h, cmed), now)
                 elif h.state in ("degraded", "straggler"):
-                    if h.score is None or h.score >= self.RECOVER_SCORE:
+                    if (h.score is None
+                            or h.score >= self.RECOVER_SCORE):
                         self._transition(cid, h, "healthy",
                                          "heartbeats + rate recovered",
                                          now)
@@ -571,6 +612,10 @@ class FleetMonitor:
                     "samples": h.samples,
                     "samples_per_s": h.rate,
                     "straggler_score": h.score,
+                    # async staleness signal: versions behind the
+                    # server's current cut (None outside async / before
+                    # the first Update)
+                    "version_lag": self._lag(h),
                     "rtt_p95_ms": rtt.get("p95_ms"),
                     "wire_bytes_out": h.wire.get("bytes_out_total"),
                     # perf-plane gauges (runtime/perf.py), ridden in on
@@ -712,7 +757,7 @@ def render_prometheus(fleet: FleetMonitor | None = None, faults=None,
         family("sl_fleet_clients", "gauge",
                "Clients per health state.", by_state)
         up, code, rate, score, age = [], [], [], [], []
-        mfu, crate = [], []
+        mfu, crate, vlag = [], [], []
         for cid, c in sorted(snap["clients"].items()):
             lbl = {"client": cid}
             up.append(_sample("sl_client_up", lbl,
@@ -725,6 +770,9 @@ def render_prometheus(fleet: FleetMonitor | None = None, faults=None,
             if c["straggler_score"] is not None:
                 score.append(_sample("sl_client_straggler_score", lbl,
                                      c["straggler_score"]))
+            if c.get("version_lag") is not None:
+                vlag.append(_sample("sl_client_version_lag", lbl,
+                                    c["version_lag"]))
             if c.get("mfu") is not None:
                 mfu.append(_sample("sl_client_mfu", lbl, c["mfu"]))
             if c.get("compute_samples_per_s") is not None:
@@ -741,6 +789,9 @@ def render_prometheus(fleet: FleetMonitor | None = None, faults=None,
                "EWMA training throughput per client.", rate)
         family("sl_client_straggler_score", "gauge",
                "Client rate / fleet median (lower is slower).", score)
+        family("sl_client_version_lag", "gauge",
+               "Versions behind the server's current cut "
+               "(async bounded-staleness mode).", vlag)
         family("sl_client_mfu", "gauge",
                "Per-client model-FLOPs utilization (perf plane).", mfu)
         family("sl_client_compute_samples_per_second", "gauge",

@@ -77,6 +77,11 @@ FAULT_COUNTER_NAMES = frozenset({
     # UPDATE frames before dying (one inc per member)
     "agg_dup_drops", "agg_stale_drops", "agg_l1_fallbacks",
     "agg_fallback_abandons",
+    # async bounded-staleness admission window (runtime/server.py
+    # _admit_update): contributions folded late with a decayed weight
+    # (server_version - version <= learning.max-staleness), and
+    # contributions past the window rejected and dropped
+    "agg_stale_admits", "agg_stale_updates",
 })
 
 #: Declared registry of latency-histogram names (same contract as

@@ -703,6 +703,70 @@ def test_ag001_registered_and_repo_clean():
     assert agg_check.run(repo_root()) == []
 
 
+# --------------------------------------------------------------------------
+# async staleness-admission rule (AS001)
+# --------------------------------------------------------------------------
+
+def test_as001_unguarded_fold_flagged():
+    from split_learning_tpu.analysis import async_check
+    src = (
+        "def pump(self, msg):\n"
+        "    self._fold.add_update(msg)\n"                 # AS001
+        "\n"
+        "def drain(self, g, ent):\n"
+        "    self._fold.add_partial(g.stage, g.key, ent)\n"  # AS001
+        "\n"
+        "self._fold.add_update(late_msg)\n"                # AS001 (no fn)
+    )
+    findings = async_check.check_source(src, "x.py")
+    assert [f.code for f in findings] == ["AS001"] * 3
+    assert {f.line for f in findings} == {2, 5, 7}
+
+
+def test_as001_admission_window_suppresses():
+    from split_learning_tpu.analysis import async_check
+    src = (
+        "def door(self, msg):\n"
+        "    lag = self._cur_gen - msg.version\n"
+        "    if lag <= self.cfg.learning.max_staleness:\n"
+        "        self._fold.add_update(msg)\n"
+        "\n"
+        "def pump(self, msg):\n"
+        "    self._admit_update(msg)\n"
+        "    self._fold.add_update(msg)\n"     # enclosing fn holds the door
+    )
+    assert async_check.check_source(src, "x.py") == []
+
+
+def test_as001_exempt_annotation_suppresses():
+    from split_learning_tpu.analysis import async_check
+    src = (
+        "def l1_drain(self, fb, u):\n"
+        "    fb['fold'].add_update(u)  # slcheck: async-exempt\n"
+    )
+    assert async_check.check_source(src, "x.py") == []
+
+
+def test_as001_registered_and_repo_clean():
+    from split_learning_tpu.analysis import async_check
+    from split_learning_tpu.analysis.__main__ import ANALYZERS, repo_root
+    assert "async" in ANALYZERS
+    assert async_check.run(repo_root()) == []
+
+
+def test_as001_server_fold_sites_enumerated():
+    """The rule only bites if it watches the real file: every fold call
+    site in runtime/server.py is either inside the admission door or
+    carries the exemption."""
+    import pathlib
+
+    from split_learning_tpu.analysis import async_check
+    src = pathlib.Path(
+        async_check.FILES[0]).read_text()
+    calls = src.count(".add_update(") + src.count(".add_partial(")
+    assert calls >= 3   # _admit_update + L1 fallback + partial root
+
+
 def test_partial_aggregate_in_protocol_model():
     # the tree's frame kind is first-class: model vocabulary, send/recv
     # rules for all three roles, and legal transitions where the

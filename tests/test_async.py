@@ -1,0 +1,607 @@
+"""Asynchronous decoupled split learning (``learning.mode: async``).
+
+Fast tier-1 coverage: auxiliary-head construction against every plan
+cut shape (including the re-plan reset of client-local head/optimizer
+state), the bounded-staleness admission window (weight decay, exact
+reject/dup accounting, sync-mode fence unchanged), the streaming
+fold's staleness-scaled weights, the ``aggregate_cluster``
+(client_id, version) dedup regression, and config validation.
+
+Slow e2e: a 3-client async round with the gradient plane delay-injected
+must finish under the wall sync loses to the same injection (the
+backward wire dependence is GONE — gradient queues are dormant), and an
+async-quorum round must cut its version past a client that dies before
+its UPDATE instead of stalling to a timeout.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from split_learning_tpu.config import LearningConfig
+from split_learning_tpu.models import build_model, shard_params
+from split_learning_tpu.runtime.bus import InProcTransport
+from split_learning_tpu.runtime.client import ProtocolClient, ShardRunner
+from split_learning_tpu.runtime.protocol import Update
+from split_learning_tpu.runtime.trace import FaultCounters
+
+TINY_KWT = {"embed_dim": 16, "num_heads": 2, "mlp_dim": 32}
+TINY_BERT = dict(vocab_size=97, hidden_size=32, num_heads=2,
+                 intermediate_size=64, max_position_embeddings=64,
+                 n_block=2)
+ASYNC_LRN = {"mode": "async", "optimizer": "sgd", "learning_rate": 0.1,
+             "batch_size": 4}
+
+
+def _first_shard(model_key, cut, learning, kwargs, x):
+    """(runner, frozen, trainable) for the stage-1 shard [0, cut)."""
+    full = build_model(model_key, **kwargs)
+    params = full.init(jax.random.key(0), x, train=False)["params"]
+    r = ShardRunner(model_key, 0, cut, learning, model_kwargs=kwargs,
+                    seed=0)
+    f, t = r.partition_params(shard_params(params, full.specs, 0, cut),
+                              False)
+    return r, f, t
+
+
+# --------------------------------------------------------------------------
+# auxiliary heads (ops/auxiliary.py)
+# --------------------------------------------------------------------------
+
+class TestAuxHead:
+    def test_num_classes_for(self):
+        from split_learning_tpu.ops.auxiliary import num_classes_for
+        assert num_classes_for("KWT_SPEECHCOMMANDS") == 10
+        assert num_classes_for("BERT_AGNEWS") == 4
+        assert num_classes_for("VGG16_CIFAR100") == 100
+        # no silent default: a dataset without a classification label
+        # space (token models) must fail fast, not train toward noise
+        with pytest.raises(ValueError, match="label space"):
+            num_classes_for("TINYLLAMA_TINYSTORIES")
+
+    def test_build_kinds(self):
+        from split_learning_tpu.ops.auxiliary import build_aux_head
+        assert build_aux_head("pooled-linear", 10).hidden == 0
+        assert build_aux_head("projection-mlp", 10, hidden=32).hidden == 32
+        with pytest.raises(ValueError, match="unknown aux head"):
+            build_aux_head("conv-probe", 10)
+
+    def test_head_builds_at_every_kwt_cut(self):
+        """The head must shape itself from ANY plan cut boundary: every
+        cut point of the (tiny) KWT produces logits (B, classes)."""
+        from split_learning_tpu.ops.auxiliary import (
+            aux_shapes_signature, init_aux_params,
+        )
+        x = jnp.zeros((2, 40, 98), jnp.float32)
+        sigs = set()
+        n = len(build_model("KWT_SPEECHCOMMANDS", **TINY_KWT).specs)
+        for cut in range(1, n):
+            r, f, t = _first_shard("KWT_SPEECHCOMMANDS", cut,
+                                   ASYNC_LRN, TINY_KWT, x)
+            shapes = jax.eval_shape(r.fwd, f, t, {}, x,
+                                    jax.random.key(0))
+            p = init_aux_params(r.aux, jax.random.key(1), shapes)
+            zeros = jax.tree_util.tree_map(
+                lambda s: jnp.zeros(s.shape, s.dtype), shapes)
+            logits = r.aux.apply({"params": p}, zeros)
+            assert logits.shape == (2, 10), f"cut {cut}"
+            sigs.add(aux_shapes_signature(shapes))
+        # the signature is the re-plan reset trigger: distinct cut
+        # boundaries must not collide on one signature class-wide
+        assert len(sigs) > 1
+
+    def test_pytree_boundary_ignores_mask(self):
+        """BERT's (hidden, mask) boundary: the bool mask leaf carries no
+        gradient — the head must probe the float leaf only."""
+        from split_learning_tpu.ops.auxiliary import init_aux_params
+        ids = jnp.zeros((2, 8), jnp.int32)
+        r, f, t = _first_shard(
+            "BERT_AGNEWS", 1,
+            dict(ASYNC_LRN, aux_head="projection-mlp", aux_hidden=16),
+            TINY_BERT, ids)
+        shapes = jax.eval_shape(r.fwd, f, t, {}, ids, jax.random.key(0))
+        leaves = jax.tree_util.tree_leaves(shapes)
+        assert any(s.dtype == jnp.bool_ for s in leaves)  # mask present
+        p = init_aux_params(r.aux, jax.random.key(1), shapes)
+        assert "proj" in p and "probe" in p   # projection-mlp layers
+        zeros = jax.tree_util.tree_map(
+            lambda s: jnp.zeros(s.shape, s.dtype), shapes)
+        assert r.aux.apply({"params": p}, zeros).shape == (2, 4)
+
+    def test_all_nonfloat_boundary_rejected(self):
+        from split_learning_tpu.ops.auxiliary import AuxHead
+        head = AuxHead(num_classes=4)
+        with pytest.raises(ValueError, match="no float leaves"):
+            head.init(jax.random.key(0), jnp.zeros((2, 3), jnp.int32))
+
+    def test_aux_step_trains_decoupled(self):
+        """One aux tick = forward + LOCAL loss + immediate step: loss
+        finite, boundary output identical to the plain forward, and the
+        shard AND head params both move — no cotangent from anywhere."""
+        x = jnp.asarray(
+            np.random.RandomState(0).randn(4, 40, 98), jnp.float32)
+        y = jnp.asarray([0, 1, 2, 3], jnp.int32)
+        r, f, t = _first_shard("KWT_SPEECHCOMMANDS", 2, ASYNC_LRN,
+                               TINY_KWT, x)
+        assert r.aux_step is not None
+        shapes = jax.eval_shape(r.fwd, f, t, {}, x, jax.random.key(0))
+        ap = r.init_aux_params(shapes)
+        rng = jax.random.key(3)
+        loss, out, gt, ga, stats = r.aux_step(f, t, ap, {}, x, y, rng)
+        assert np.isfinite(float(loss))
+        np.testing.assert_allclose(np.asarray(out),
+                                   np.asarray(r.fwd(f, t, {}, x, rng)),
+                                   rtol=1e-5)
+        t2, _ = r.apply_update(t, r.optimizer.init(t), gt)
+        ap2, _ = r.apply_update(ap, r.optimizer.init(ap), ga)
+        moved = [not np.allclose(np.asarray(a), np.asarray(b))
+                 for (_, a), (_, b) in zip(
+                     jax.tree_util.tree_leaves_with_path(t),
+                     jax.tree_util.tree_leaves_with_path(t2))]
+        assert any(moved), "shard params did not move on the aux grad"
+        assert not np.allclose(
+            np.asarray(jax.tree_util.tree_leaves(ap)[0]),
+            np.asarray(jax.tree_util.tree_leaves(ap2)[0]))
+
+    def test_sync_mode_builds_no_aux(self):
+        r = ShardRunner("KWT_SPEECHCOMMANDS", 0, 2,
+                        {"optimizer": "sgd", "learning_rate": 0.1},
+                        model_kwargs=TINY_KWT, seed=0)
+        assert r.aux is None and r.aux_step is None
+
+
+class TestEnsureAuxReset:
+    def _client(self, tmp_path):
+        cfg = _cfg(tmp_path, tmp_path / "aux")
+        return ProtocolClient(cfg, "c1", 1, transport=InProcTransport())
+
+    def _arm(self, client, cut):
+        x = jnp.zeros((2, 40, 98), jnp.float32)
+        r, f, t = _first_shard("KWT_SPEECHCOMMANDS", cut, ASYNC_LRN,
+                               TINY_KWT, x)
+        client.runner, client.frozen, client.trainable = r, f, t
+        client.stats = {}
+        return x
+
+    def test_replan_resets_optimizer_state(self, tmp_path):
+        """A re-plan that moves the cut changes the boundary shape: the
+        head (another tensor's probe now) AND its optimizer moments must
+        reset.  Same-shape re-seeds keep both (the probe keeps
+        converging)."""
+        c = self._client(tmp_path)
+        x = self._arm(c, 2)
+        c._ensure_aux(x)
+        p0, o0, sig0 = c.aux_params, c.aux_opt_state, c._aux_sig
+        assert p0 is not None and o0 is not None
+        c._ensure_aux(x)               # same cut, same batch: no reset
+        assert c.aux_params is p0 and c.aux_opt_state is o0
+        # re-plan to a cut whose boundary SHAPE differs (KWT cut 16 is
+        # the pooled (B, D) pre-head boundary vs the (B, T, D) blocks)
+        self._arm(c, 16)
+        c._ensure_aux(x)
+        assert c._aux_sig != sig0
+        assert c.aux_params is not p0 and c.aux_opt_state is not o0
+
+    def test_overlap_credit_discarded_on_reseed(self, tmp_path):
+        """Overlap-tick samples trained the OLD seed's shard: a
+        weight-carrying START overwrites that work, so the banked
+        credit must go with it (FedAvg weight may only count training
+        the fold can see); a hold START keeps shard AND credit."""
+        from split_learning_tpu.runtime.protocol import Start
+        x = jnp.zeros((2, 40, 98), jnp.float32)
+        full = build_model("KWT_SPEECHCOMMANDS", **TINY_KWT)
+        params = full.init(jax.random.key(0), x,
+                           train=False)["params"]
+        shard = shard_params(params, full.specs, 0, 2)
+        shard = jax.tree_util.tree_map(np.asarray, shard)
+        lrn = dict(ASYNC_LRN)
+        c = self._client(tmp_path)
+        start = Start(start_layer=0, end_layer=2, cluster=0,
+                      params=shard, learning=lrn, round_idx=0,
+                      extra={"gen": 1})
+        c._on_start(start)
+        c._overlap_samples = 24
+        c._on_start(Start(start_layer=0, end_layer=2, cluster=0,
+                             params=shard, learning=lrn, round_idx=1,
+                             extra={"gen": 2}))
+        assert c._overlap_samples == 0     # re-seed discards credit
+        c._overlap_samples = 24
+        c._on_start(Start(start_layer=0, end_layer=2, cluster=0,
+                             params=None, learning=lrn, round_idx=2,
+                             extra={"gen": 3}))
+        assert c._overlap_samples == 24    # hold START keeps it
+
+    def test_reset_aux_clears_state(self, tmp_path):
+        c = self._client(tmp_path)
+        x = self._arm(c, 2)
+        c._ensure_aux(x)
+        c._reset_aux()
+        assert c.aux_params is None and c.aux_opt_state is None
+        assert c._aux_sig is None
+
+
+# --------------------------------------------------------------------------
+# bounded-staleness admission window (runtime/server.py _admit_update)
+# --------------------------------------------------------------------------
+
+def _cfg(tmp_path, log_dir, **over):
+    from test_chaos import _round_cfg
+    base = dict(
+        aggregation={"strategy": "fedavg", "sda_strict": False,
+                     "sda_size": 1},
+        learning={"mode": "async", "max_staleness": 2,
+                  "staleness_decay": 0.5, "async_quorum": 0,
+                  "batch_size": 4, "control_count": 1,
+                  "optimizer": "adamw", "learning_rate": 1e-3})
+    for k, v in over.items():
+        if isinstance(v, dict) and isinstance(base.get(k), dict):
+            base[k].update(v)
+        else:
+            base[k] = v
+    return _round_cfg(tmp_path, log_dir, **base)
+
+
+def _ctx(tmp_path, gen=5, **over):
+    from split_learning_tpu.runtime.aggregate import StreamingFold
+    from split_learning_tpu.runtime.server import ProtocolContext
+    cfg = _cfg(tmp_path, tmp_path / "admit", **over)
+    ctx = ProtocolContext(cfg, InProcTransport())
+    # per-test counters (the bus-less context would otherwise share the
+    # process-global default registry across tests)
+    ctx.faults = FaultCounters()
+    ctx._cur_gen = gen
+    ctx._fold = StreamingFold({1: ["fresh"]}, faults=ctx.faults)
+    return ctx
+
+
+def _upd(cid, ver, samples=8, value=1.0, round_idx=None):
+    return Update(client_id=cid, stage=1, cluster=0,
+                  params={"layer1": {
+                      "w": np.full(4, value, np.float32)}},
+                  num_samples=samples, ok=True,
+                  round_idx=ver if round_idx is None else round_idx,
+                  version=ver)
+
+
+class TestStalenessAdmission:
+    def test_weight_decay_math(self, tmp_path):
+        """Admitted weight = samples * decay ** lag, folded into the
+        weighted mean exactly: fresh 8xA@w8 + lag-1 4xB@w2 -> mean
+        (8*1 + 2*3) / 10."""
+        ctx = _ctx(tmp_path)
+        ctx._admit_update(_upd("fresh", 5, samples=8, value=1.0))
+        ctx._admit_update(_upd("late", 4, samples=4, value=3.0))
+        res = ctx._fold.finish()
+        st = ctx._fold._stages[1]
+        assert st.total_w == pytest.approx(8 + 4 * 0.5)
+        np.testing.assert_allclose(
+            np.asarray(res.params["layer1"]["w"]),
+            np.full(4, (8 * 1.0 + 2.0 * 3.0) / 10.0, np.float32),
+            rtol=1e-6)
+
+    def test_window_boundary_and_exact_counts(self, tmp_path):
+        """lag <= max_staleness admits, lag = max_staleness + 1 rejects;
+        a post-fold duplicate dedups — all exactly counted."""
+        ctx = _ctx(tmp_path, gen=5)   # max_staleness=2
+        for ver in (5, 4, 3, 2):      # lag 0, 1, 2 fold; lag 3 rejects
+            ctx._admit_update(_upd(f"c{5 - ver}", ver))
+        ctx._admit_update(_upd("c1", 4))   # redelivered post-fold
+        snap = ctx.faults.snapshot()
+        assert snap.get("agg_stale_admits", 0) == 2
+        assert snap.get("agg_stale_updates", 0) == 1
+        assert snap.get("agg_dup_drops", 0) == 1
+        assert len(ctx._updates) == 3
+        # stale-admitted entries are weight-stripped like fresh ones
+        assert all(u.params is None for u in ctx._updates)
+
+    def test_versionless_update_uses_round_fence(self, tmp_path):
+        """A mixed-fleet client without the version tag falls back to
+        ``round_idx`` (the generation it was seeded from): fresh folds,
+        in-window folds stale-weighted, past-window rejects."""
+        ctx = _ctx(tmp_path, gen=5)   # max_staleness=2
+        for ver, cid in ((5, "fresh"), (4, "late"), (2, "ancient")):
+            u = _upd(cid, ver)
+            u.version = None
+            ctx._admit_update(u)
+        assert {u.client_id for u in ctx._updates} == {"fresh", "late"}
+        snap = ctx.faults.snapshot()
+        assert snap.get("agg_stale_admits", 0) == 1
+        assert snap.get("agg_stale_updates", 0) == 1
+
+    def test_sync_mode_keeps_hard_fence(self, tmp_path):
+        """learning.mode: sync — a lag-1 Update is REJECTED even though
+        a streaming fold is live (no admission window in sync)."""
+        ctx = _ctx(tmp_path, gen=5, learning={"mode": "sync"})
+        ctx._admit_update(_upd("late", 4))
+        assert not ctx._updates
+        snap = ctx.faults.snapshot()
+        assert snap.get("agg_stale_updates", 0) == 1
+        assert snap.get("agg_stale_admits", 0) == 0
+
+    def test_sync_mode_reports_no_version_lag(self, tmp_path):
+        """Version lag is an async signal: in sync mode the generation
+        is an invocation counter (sequential clusters bump it several
+        times per round), so the fleet monitor must never see it —
+        phantom lag would flap healthy clients to 'stale' stragglers."""
+        from split_learning_tpu.runtime.telemetry import FleetMonitor
+        ctx = _ctx(tmp_path, gen=5, learning={"mode": "sync"})
+        ctx.fleet = FleetMonitor(interval=10.0, liveness_timeout=60.0)
+        ctx._admit_update(_upd("fresh", 5))     # folds fresh (sync)
+        assert len(ctx._updates) == 1
+        snap = ctx.fleet.snapshot()
+        client = snap["clients"].get("fresh")
+        assert client is None or client["version_lag"] is None
+
+    def test_late_ready_syn_carries_responsive_overrides(self, tmp_path):
+        """A late READY joiner's pump-sent SYN must carry the same
+        responsive-set fence overrides the fan-out computed — the
+        static START feeder list may name clients dropped at the
+        barrier, whose fences would burn the drain grace forever."""
+        from split_learning_tpu.runtime.protocol import (
+            RPC_QUEUE, Ready, Syn, decode, encode, reply_queue,
+        )
+        ctx = _ctx(tmp_path, gen=3)
+        ctx._syn_live = True
+        ctx._syn_round = 3
+        ctx._syn_overrides = {"c9": (2, ["f1"])}
+        ctx.bus.publish(RPC_QUEUE, encode(Ready(client_id="c9",
+                                                round_idx=3)))
+        assert ctx._pump_one(0.5)
+        syn = decode(ctx.bus.get(reply_queue("c9"), timeout=0.5))
+        assert isinstance(syn, Syn) and syn.round_idx == 3
+        assert syn.sda_fence_quorum == 2
+        assert syn.sda_feeders == ["f1"]
+
+    def test_fleet_version_lag_recorded(self, tmp_path):
+        """Admits report the client's seed version to the FleetMonitor
+        (the sl_client_version_lag signal)."""
+        from split_learning_tpu.runtime.telemetry import FleetMonitor
+        ctx = _ctx(tmp_path, gen=5)
+        ctx.fleet = FleetMonitor(interval=10.0, liveness_timeout=60.0)
+        ctx.fleet.note_version(5)
+        ctx._admit_update(_upd("fresh", 5))
+        ctx._admit_update(_upd("late", 4))
+        snap = ctx.fleet.snapshot()
+        assert snap["clients"]["fresh"]["version_lag"] == 0
+        assert snap["clients"]["late"]["version_lag"] == 1
+
+
+class TestStreamingFoldScale:
+    def test_scaled_extras_fold_deterministically(self):
+        """Stale admits ride extras keys (client@vN) so they can never
+        collide with the same client's fresh slot; scale multiplies the
+        FedAvg weight."""
+        from split_learning_tpu.runtime.aggregate import StreamingFold
+        faults = FaultCounters()
+        results = []
+        for order in (("a", "b"), ("b", "a")):   # arrival order races
+            fold = StreamingFold({1: ["c1"]}, faults=faults)
+            fold.add_update(_upd("c1", 5, samples=8, value=1.0))
+            stale = {
+                "a": _upd("c1", 4, samples=8, value=5.0),
+                "b": _upd("c1", 3, samples=8, value=9.0)}
+            for k in order:
+                fold.add_update(stale[k], scale=0.5 if k == "a" else .25,
+                                key=f"c1@v{4 if k == 'a' else 3}")
+            results.append(fold.finish())
+        w0 = np.asarray(results[0].params["layer1"]["w"])
+        np.testing.assert_array_equal(
+            w0, np.asarray(results[1].params["layer1"]["w"]))
+        np.testing.assert_allclose(
+            w0, (8 * 1.0 + 4 * 5.0 + 2 * 9.0) / 14.0, rtol=1e-6)
+
+    def test_revived_after_drop_folds_at_finish(self):
+        """A key the window gave up on (dropped at a barrier) whose
+        contribution arrives anyway — the async late-READY rejoin —
+        must fold as an extra at finish, not park in a pending slot
+        the canonical drain already passed."""
+        from split_learning_tpu.runtime.aggregate import StreamingFold
+        fold = StreamingFold({1: ["c1", "c2"]}, faults=FaultCounters())
+        fold.drop(1, "c2")                      # dropped at READY
+        fold.add_update(_upd("c1", 5, samples=8, value=1.0))
+        fold.add_update(_upd("c2", 5, samples=8, value=3.0))  # revived
+        res = fold.finish()
+        assert res.n_samples == 16
+        np.testing.assert_allclose(
+            np.asarray(res.params["layer1"]["w"]), 2.0, rtol=1e-6)
+
+    def test_unit_scale_keeps_exact_weight_path(self):
+        """scale=1.0 (sync) must not perturb the weight accumulation —
+        the bit-identity contract with the barrier oracle (integer
+        sample counts sum exactly; no decay factor is applied)."""
+        from split_learning_tpu.runtime.aggregate import StreamingFold
+        fold = StreamingFold({1: ["c1"]}, faults=FaultCounters())
+        fold.add_update(_upd("c1", 5, samples=7), scale=1.0)
+        st = fold._stages[1]
+        assert st.total_w == 7
+
+
+# --------------------------------------------------------------------------
+# aggregate_cluster (client_id, version) dedup — PR 6 double-count fix
+# --------------------------------------------------------------------------
+
+class TestAggregateClusterDedup:
+    def test_resent_weightless_update_counts_samples_once(self):
+        """Regression: in streaming mode the pump weight-strips the
+        first copy; an at-least-once redelivery arriving post-fold used
+        to take the weight-less skip path and count the same client's
+        samples AGAIN."""
+        from split_learning_tpu.runtime.strategies import (
+            aggregate_cluster,
+        )
+        first = _upd("c1", 3, samples=8)
+        resend = _upd("c1", 3, samples=8)
+        resend.params = None           # weight-stripped post-fold copy
+        params, _, n = aggregate_cluster([first, resend])
+        assert n == 8, f"samples double-counted: {n}"
+        np.testing.assert_allclose(
+            np.asarray(params["layer1"]["w"]), 1.0)
+
+    def test_distinct_versions_both_count(self):
+        """An async straggler's late v-1 contribution plus its fresh v
+        one are DIFFERENT contributions — dedup must not eat them."""
+        from split_learning_tpu.runtime.strategies import (
+            aggregate_cluster,
+        )
+        _, _, n = aggregate_cluster(
+            [_upd("c1", 3, samples=8), _upd("c1", 4, samples=8,
+                                            round_idx=4)])
+        assert n == 16
+
+
+# --------------------------------------------------------------------------
+# config surface
+# --------------------------------------------------------------------------
+
+class TestAsyncConfig:
+    def test_learning_validation(self):
+        LearningConfig(mode="async").validate()
+        with pytest.raises(ValueError, match="sync|async"):
+            LearningConfig(mode="eventually").validate()
+        with pytest.raises(ValueError, match="aux-head"):
+            LearningConfig(aux_head="conv-probe").validate()
+        with pytest.raises(ValueError, match="staleness-decay"):
+            LearningConfig(staleness_decay=1.5).validate()
+        with pytest.raises(ValueError, match="max-staleness"):
+            LearningConfig(max_staleness=-1).validate()
+        with pytest.raises(ValueError, match="async-quorum"):
+            LearningConfig(async_quorum=-2).validate()
+
+    def test_async_requires_streaming_strategy(self, tmp_path):
+        with pytest.raises(ValueError, match="streaming-capable"):
+            _cfg(tmp_path, tmp_path / "bad",
+                 aggregation={"strategy": "relay"})
+        _cfg(tmp_path, tmp_path / "ok")   # fedavg passes
+
+    def test_async_rejects_inert_admission_window(self, tmp_path):
+        """Configs where the staleness window could never fold — no
+        streaming plane, or an aggregator tree whose L1s gen-fence
+        Updates first — must fail validation instead of silently
+        rejecting every late contribution."""
+        with pytest.raises(ValueError, match="streaming"):
+            _cfg(tmp_path, tmp_path / "nostream",
+                 aggregation={"streaming": False})
+        with pytest.raises(ValueError, match="fan-in"):
+            _cfg(tmp_path, tmp_path / "tree",
+                 aggregation={"fan_in": 2})
+
+    def test_sync_default_untouched(self, tmp_path):
+        from test_chaos import _round_cfg
+        cfg = _round_cfg(tmp_path, tmp_path / "sync")
+        assert cfg.learning.mode == "sync"
+
+
+# --------------------------------------------------------------------------
+# slow e2e: the perf story
+# --------------------------------------------------------------------------
+
+def _delay_cfgs(tmp_path, tag, mode):
+    over = dict(learning={"mode": mode})
+    if mode == "async":
+        return _cfg(tmp_path, tmp_path / tag, **over)
+    return _cfg(tmp_path, tmp_path / tag,
+                learning={"mode": "sync", "max_staleness": 0})
+
+
+@pytest.mark.slow
+def test_async_round_immune_to_gradient_delay(tmp_path):
+    """The headline: delay EVERY gradient frame by 0.5 s.  Sync 1F1B
+    parks on each cotangent, so its wall absorbs the full injected
+    stall; async has NO gradient traffic (aux heads) and must finish
+    well under sync's stalled wall at the same sample budget."""
+    from test_chaos import _chaos, _run_cell
+    delay = _chaos(seed=3, delay=1.0, delay_s=0.5,
+                   queues=("gradient_queue*",))
+
+    walls = {}
+    for mode in ("async", "sync"):
+        # warm leg compiles this mode's jitted ops (ops cache is
+        # process-global); the measured leg then times the round alone
+        _run_cell(_delay_cfgs(tmp_path, f"{mode}_warm", mode))
+        t0 = time.monotonic()
+        res = _run_cell(_delay_cfgs(tmp_path, f"{mode}_delay", mode),
+                        chaos_cfg=delay)
+        walls[mode] = time.monotonic() - t0
+        assert res.history[0].ok
+        assert res.history[0].num_samples == 16   # both feeders folded
+    # 2 batches x 2 feeders x 0.5 s of serialized cotangent stalls land
+    # on sync; async never touches gradient_queue
+    assert walls["async"] < walls["sync"], walls
+
+
+class _UpdateCrashTransport:
+    """Per-client wrapper: die (like a process) right BEFORE publishing
+    this client's round Update — the quorum straggler."""
+
+    def __init__(self, inner):
+        self.inner = inner
+        self.died = threading.Event()
+
+    def __getattr__(self, name):
+        return getattr(self.inner, name)
+
+    def publish(self, queue, raw):
+        from split_learning_tpu.runtime.protocol import decode
+        if queue == "rpc_queue" and not self.died.is_set():
+            try:
+                msg = decode(raw)
+            except Exception:
+                msg = None
+            if isinstance(msg, Update):
+                self.died.set()
+                from split_learning_tpu.runtime.chaos import ChaosCrash
+                raise ChaosCrash("straggler died before its UPDATE")
+        return self.inner.publish(queue, raw)
+
+
+@pytest.mark.slow
+def test_async_quorum_cuts_past_dead_straggler(tmp_path):
+    """async-quorum=2: one feeder dies before its UPDATE ever leaves.
+    The version cut needs 2 fresh contributions (fast feeder + head) —
+    the round must complete promptly instead of pumping the UPDATE
+    barrier to the client timeout, and the fold must carry exactly the
+    fast feeder's samples."""
+    from split_learning_tpu.runtime.server import ProtocolServer
+
+    cfg = _cfg(tmp_path, tmp_path / "quorum",
+               learning={"async_quorum": 2},
+               observability={"heartbeat_interval": 0.0})
+    # warm the ops cache so the wall bound measures the barrier, not XLA
+    from test_chaos import _run_cell
+    _run_cell(_cfg(tmp_path, tmp_path / "quorum_warm",
+                   observability={"heartbeat_interval": 0.0}))
+
+    bus = InProcTransport()
+    server = ProtocolServer(cfg, transport=bus, client_timeout=60.0)
+    threads = []
+    for stage, count in enumerate(cfg.clients, start=1):
+        for i in range(count):
+            cid = f"client_{stage}_{i}"
+            stack = _UpdateCrashTransport(bus) \
+                if cid == "client_1_1" else bus
+            client = ProtocolClient(cfg, cid, stage, transport=stack)
+
+            def run(c=client):
+                from split_learning_tpu.runtime.chaos import ChaosCrash
+                try:
+                    c.run()
+                except ChaosCrash:
+                    pass
+            t = threading.Thread(target=run, daemon=True)
+            t.start()
+            threads.append(t)
+    t0 = time.monotonic()
+    res = server.serve()
+    wall = time.monotonic() - t0
+    for t in threads:
+        t.join(timeout=10)
+    assert res.history[0].ok
+    # only the fast feeder's stage-1 samples folded (the straggler's
+    # update never existed); the barrier did NOT wait out the timeout
+    assert res.history[0].num_samples == 8
+    assert wall < 45, f"quorum barrier stalled: {wall:.0f}s"

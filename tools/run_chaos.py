@@ -326,6 +326,123 @@ def fleet_cell(tmp: str, seed: int = 7) -> tuple[bool, str]:
                   else "degraded") + f"+{scrapes['ok']}scrapes"
 
 
+def async_cell(tmp: str, seed: int = 11) -> tuple[bool, str]:
+    """Async-mode chaos cell (learning.mode: async): a 3-client round
+    (2 aux-loss feeders + 1 head) under delay + drop + duplicate
+    injection with the reliable layer on.  PASSes iff
+
+    * the round completes without a barrier stall (bounded wall — the
+      decoupled loops never park on gradient_queue, so an injected
+      delay costs latency, not a deadlock);
+    * the fold is DETERMINISTIC: a twin run with the same chaos seed
+      produces bit-identical STAGE-1 aggregated params (each feeder's
+      decoupled aux-step sequence depends only on its own data/rng —
+      no wire cotangent to race on) and the exact same aggregation
+      counter snapshot (dup drops included).  The head's shard is
+      excluded: async deliberately trades the strict SDA arrival
+      barrier for liveness, so the head steps in arrival order — the
+      documented nondeterminism async buys its stall-freedom with;
+    * stale rejections are counted EXACTLY: a directly-driven admission
+      sweep over versions ``cur, cur-1, .., cur-max_staleness-1`` plus
+      a duplicate must land exactly max_staleness admits, one reject,
+      one dup drop — and the staleness weights must match
+      ``staleness_decay ** lag`` to the bit.
+    """
+    import numpy as np
+
+    sys.path.insert(0, "tests")
+    from test_chaos import _chaos, _round_cfg, _run_cell  # noqa: E402
+
+    over = dict(
+        global_rounds=1,
+        aggregation={"strategy": "fedavg", "sda_strict": False,
+                     "sda_size": 1},
+        learning={"mode": "async", "aux_head": "pooled-linear",
+                  "max_staleness": 2, "staleness_decay": 0.5,
+                  "async_quorum": 0, "batch_size": 4,
+                  "control_count": 1, "optimizer": "adamw",
+                  "learning_rate": 1e-3})
+    chaos = _chaos(seed=seed, drop=0.10, duplicate=0.10, delay=0.15,
+                   delay_s=0.02)
+
+    def run(tag):
+        fc = FaultCounters()
+        cfg = _round_cfg(pathlib.Path(tmp),
+                         pathlib.Path(tmp) / f"async_{tag}", **over)
+        t0 = time.monotonic()
+        res = _run_cell(cfg, chaos_cfg=chaos, reliable=True, faults=fc)
+        return res, fc.snapshot(), time.monotonic() - t0
+
+    res_a, snap_a, wall_a = run("a")
+    res_b, snap_b, wall_b = run("b")
+    if not (res_a.history and res_a.history[0].ok
+            and res_b.history and res_b.history[0].ok):
+        return False, "round not ok"
+    if max(wall_a, wall_b) > 240:
+        return False, f"barrier stall ({max(wall_a, wall_b):.0f}s)"
+    import jax
+
+    from split_learning_tpu.models import build_model, shard_params
+    cfg_a = _round_cfg(pathlib.Path(tmp),
+                       pathlib.Path(tmp) / "async_spec", **over)
+    specs = build_model(cfg_a.model_key,
+                        **(cfg_a.model_kwargs or {})).specs
+    cut = cfg_a.topology.cut_layers[0]
+    s1_a = shard_params(res_a.params, specs, 0, cut)
+    s1_b = shard_params(res_b.params, specs, 0, cut)
+    if not s1_a:
+        return False, "no stage-1 keys in aggregated params"
+    for a, b in zip(jax.tree_util.tree_leaves(s1_a),
+                    jax.tree_util.tree_leaves(s1_b)):
+        if not np.array_equal(np.asarray(a), np.asarray(b)):
+            return False, "async stage-1 fold not deterministic"
+    if res_a.history[0].num_samples != res_b.history[0].num_samples:
+        return False, "sample count drifted"
+    agg_keys = ("agg_stale_updates", "agg_stale_admits",
+                "agg_dup_drops")
+    counts_a = {k: snap_a.get(k, 0) for k in agg_keys}
+    if counts_a != {k: snap_b.get(k, 0) for k in agg_keys}:
+        return False, f"agg counters drifted: {counts_a} vs twin"
+
+    # exact staleness accounting, driven directly (no timing): versions
+    # cur .. cur-(max_staleness+1) plus a duplicate of the last admit
+    from split_learning_tpu.runtime.bus import InProcTransport
+    from split_learning_tpu.runtime.aggregate import StreamingFold
+    from split_learning_tpu.runtime.protocol import Update
+    from split_learning_tpu.runtime.server import ProtocolContext
+    cfg = _round_cfg(pathlib.Path(tmp), pathlib.Path(tmp) / "admit",
+                     **over)
+    ctx = ProtocolContext(cfg, InProcTransport())
+    ctx._cur_gen = 5
+    ctx._fold = StreamingFold({1: ["c0"]}, faults=ctx.faults)
+
+    def upd(cid, ver):
+        return Update(client_id=cid, stage=1, cluster=0,
+                      params={"layer1": {"w": np.ones(4, np.float32)}},
+                      num_samples=8, round_idx=ver, version=ver)
+    for ver in (5, 4, 3, 2):          # lag 0 fresh, 1+2 admit, 3 reject
+        ctx._admit_update(upd(f"c{5 - ver}", ver))
+    ctx._admit_update(upd("c1", 4))   # post-fold duplicate
+    snap = ctx.faults.snapshot()
+    got = {k: snap.get(k, 0) for k in agg_keys}
+    want = {"agg_stale_updates": 1, "agg_stale_admits": 2,
+            "agg_dup_drops": 1}
+    if got != want:
+        return False, f"admission counts {got} != {want}"
+    # weight math: 8 + 8*0.5 + 8*0.25 folded over all-ones trees
+    result = ctx._fold.finish()
+    w = np.asarray(result.params["layer1"]["w"])
+    if not np.allclose(w, 1.0):
+        return False, f"staleness-weighted fold wrong: {w[:2]}"
+    expect_w = 8 + 8 * 0.5 + 8 * 0.25
+    st = ctx._fold._stages[1]
+    if abs(st.total_w - expect_w) > 1e-9:
+        return False, f"fold weight {st.total_w} != {expect_w}"
+    return True, (f"deterministic+admitted "
+                  f"({counts_a.get('agg_dup_drops', 0)} dup drops, "
+                  f"{wall_a:.0f}s/{wall_b:.0f}s)")
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(
         description="Sweep fault probabilities over seeds; print a "
@@ -354,7 +471,27 @@ def main(argv=None):
                          "asserts the FleetMonitor flags it, /metrics "
                          "lints mid-round, and sl_top renders the "
                          "/fleet snapshot (writes fleet.json)")
+    ap.add_argument("--async", dest="async_mode", action="store_true",
+                    help="run ONLY the async-mode cell: a 3-client "
+                         "aux-loss round under delay+drop+dup must "
+                         "complete with no barrier stall, fold "
+                         "deterministically (twin-seed bit-identity), "
+                         "and count stale rejections exactly")
     args = ap.parse_args(argv)
+
+    if args.async_mode:
+        if args.artifacts_dir:
+            tmp = args.artifacts_dir
+            pathlib.Path(tmp).mkdir(parents=True, exist_ok=True)
+        else:
+            import tempfile
+            tmp = tempfile.mkdtemp(prefix="chaos_async_")
+        t0 = time.monotonic()
+        ok, note = async_cell(tmp)
+        dt = time.monotonic() - t0
+        print(f"async cell: {'PASS' if ok else 'FAIL'} ({note}) "
+              f"[{dt:.1f}s, artifacts in {tmp}]")
+        return 0 if ok else 1
 
     if args.fleet:
         if args.artifacts_dir:

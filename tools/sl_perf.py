@@ -61,6 +61,12 @@ STABLE_KEYS = {
     # full-tree copies at the UPDATE barrier (O(1) memory headline)
     "extra.agg_wall_per_client_ms": "down",
     "extra.agg_peak_tree_copies": "down",
+    # async decoupled mode (round-10): delayed-cell throughput, the
+    # delayed async/sync wall ratio (<1 = async wins under RTT), and
+    # the accuracy parity delta at equal sample budget
+    "extra.async_samples_per_sec": "up",
+    "extra.async_wall_ratio_vs_sync": "down",
+    "extra.async_accuracy_delta": "up",
 }
 
 #: attribution components of a kind=perf record, in report order
@@ -103,6 +109,12 @@ _SCAVENGE_RES = {
         re.compile(r'"agg_wall_per_client_ms":\s*' + _NUM),
     "extra.agg_peak_tree_copies":
         re.compile(r'"agg_peak_tree_copies":\s*' + _NUM),
+    "extra.async_samples_per_sec":
+        re.compile(r'"async_samples_per_sec":\s*' + _NUM),
+    "extra.async_wall_ratio_vs_sync":
+        re.compile(r'"async_wall_ratio_vs_sync":\s*' + _NUM),
+    "extra.async_accuracy_delta":
+        re.compile(r'"async_accuracy_delta":\s*' + _NUM),
 }
 
 

@@ -31,8 +31,9 @@ _STATE_COLOR = {"healthy": "\033[92m", "degraded": "\033[93m",
                 "straggler": "\033[95m", "lost": "\033[91m"}
 _RESET = "\033[0m"
 
-_COLUMNS = ("CLIENT", "STATE", "ROUND", "SAMPLES", "RATE/s", "SCORE",
-            "MFU", "STEP p95 ms", "RTT p95 ms", "WIRE MB", "AGE s")
+_COLUMNS = ("CLIENT", "STATE", "ROUND", "VLAG", "SAMPLES", "RATE/s",
+            "SCORE", "MFU", "STEP p95 ms", "RTT p95 ms", "WIRE MB",
+            "AGE s")
 
 
 def fetch_fleet(url: str, timeout: float = 3.0) -> dict:
@@ -82,6 +83,8 @@ def render_fleet(fleet: dict, color: bool = True,
         wire_mb = (c.get("wire_bytes_out") or 0) / 1e6
         rows.append((
             cid, c.get("state", "?"), _fmt(c.get("round")),
+            # async version lag (bounded-staleness mode); "-" outside it
+            _fmt(c.get("version_lag")),
             _fmt(c.get("samples")), _fmt(c.get("samples_per_s")),
             _fmt(c.get("straggler_score"), 2),
             # perf-plane gauges (runtime/perf.py); "-" for clients
