@@ -34,7 +34,18 @@ Two complementary passes over the compiled training path:
     the result in the same statement (the convention every call site
     follows — a later read of a donated buffer is undefined);
   - JX006 — ``jax.jit`` invoked inside a loop body (a fresh jit wrapper
-    per iteration defeats the compile cache).
+    per iteration defeats the compile cache);
+  - JX007 — non-donated round-boundary update buffers: in the
+    aggregation plane (``runtime/aggregate.py``) every ``jax.jit``
+    whose function takes a running-accumulator parameter (``acc`` /
+    ``stat_acc`` — the module's naming convention) must donate those
+    positions, or each fold/update allocates a fresh full-stage buffer
+    instead of updating in place.  The jaxpr pass additionally traces
+    the fused sharded stage update (``MeshFoldBackend.stage_update``)
+    and flags host round-trips compiled into it (JX003) and
+    fp32-upcast-on-bf16-wire outputs (a leaf declared bf16 must come
+    back bf16 — JX002) — the buffer-donation audit the sharded
+    weight-update plane is gated by.
 """
 
 from __future__ import annotations
@@ -220,6 +231,124 @@ def _audit_donation(root: pathlib.Path) -> list[Finding]:
     return findings
 
 
+# -- round-boundary update donation (JX007) ---------------------------------
+# Convention (runtime/aggregate.py): a jitted op whose function takes a
+# running-accumulator parameter — named `acc` / `stat_acc` — consumes
+# that buffer (the fold adds in place, the fused stage update finishes
+# it).  Not donating it doubles the aggregation plane's residency and
+# adds a full-stage copy per call.
+
+_UPDATE_BUF_PARAMS = {"acc", "stat_acc"}
+_UPDATE_REL = "split_learning_tpu/runtime/aggregate.py"
+
+
+def _scan_update_donation(source: str, rel: str) -> list[Finding]:
+    findings: list[Finding] = []
+    tree = ast.parse(source)
+    defs = {n.name: n for n in ast.walk(tree)
+            if isinstance(n, ast.FunctionDef)}
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "jit" and node.args):
+            continue
+        fn = node.args[0]
+        if isinstance(fn, ast.Lambda):
+            params = [a.arg for a in fn.args.args]
+        elif isinstance(fn, ast.Name) and fn.id in defs:
+            params = [a.arg for a in defs[fn.id].args.args]
+        else:
+            continue
+        positions = [i for i, p in enumerate(params)
+                     if p in _UPDATE_BUF_PARAMS]
+        if not positions:
+            continue
+        donated: tuple = ()
+        for kw in node.keywords:
+            if kw.arg == "donate_argnums":
+                try:
+                    val = ast.literal_eval(kw.value)
+                except ValueError:
+                    val = None
+                if isinstance(val, int):
+                    donated = (val,)
+                elif isinstance(val, (tuple, list)):
+                    donated = tuple(val)
+        missing = [params[i] for i in positions if i not in donated]
+        if missing:
+            findings.append(Finding(
+                "JX007", rel, node.lineno, "jit",
+                "round-boundary update buffer(s) "
+                f"{missing!r} not in donate_argnums: every fold/update "
+                "call allocates a fresh full-stage buffer instead of "
+                "updating in place"))
+    return findings
+
+
+def _audit_update_donation(root: pathlib.Path) -> list[Finding]:
+    return _scan_update_donation((root / _UPDATE_REL).read_text(),
+                                 _UPDATE_REL)
+
+
+def _audit_update_jaxpr(root: pathlib.Path) -> list[Finding]:
+    """Trace the fused sharded stage update (the round-boundary
+    program per stage) and audit it like the train ops: no host
+    round-trip primitives (JX003), and no fp32-upcast leaving the
+    program — a leaf the START will ship as bf16 must come back bf16
+    (JX002), or every round fetches (and pins in the shadow) double
+    the bytes the wire carries."""
+    import jax
+    import numpy as np
+
+    try:
+        import ml_dtypes
+        bf16 = np.dtype(ml_dtypes.bfloat16)
+    except ImportError:  # pragma: no cover - jax ships it
+        bf16 = np.dtype(np.float16)
+    from split_learning_tpu.runtime.aggregate import (
+        MeshFoldBackend, _StageFold,
+    )
+
+    findings: list[Finding] = []
+    be = MeshFoldBackend()
+    st = _StageFold(["c0"])
+    declared = {"layer0/k": bf16, "layer0/b": np.dtype(np.float32),
+                "layer0/step": np.dtype(np.int32)}
+    st.dtype = dict(declared)
+    st.total_w = 2.0
+    st.acc = {
+        "layer0/k": be.contrib(np.ones((8, 4), bf16), 2.0),
+        "layer0/b": be.contrib(np.ones((4,), np.float32), 2.0),
+        "layer0/step": be.contrib(np.asarray(3, np.int32), 2.0),
+    }
+    base_flat = {"layer0/k": np.ones((8, 4), np.float32),
+                 "layer0/b": np.ones((4,), np.float32)}
+    params, stats, _ = be.stage_fetch(
+        be.stage_update(st, base_flat, {}, 0.9))
+    for path, dt in declared.items():
+        got = np.asarray(params[path]).dtype
+        if got != dt:
+            findings.append(Finding(
+                "JX002", _UPDATE_REL, 0, "stage_update",
+                f"fused update returns {path} as {got} but the START "
+                f"wire dtype is {dt}: cast on device before the "
+                "fetch"))
+    # the program the call above compiled-and-cached, traced abstractly
+    for prog in be._fused_cache.values():
+        jaxpr = jax.make_jaxpr(
+            lambda acc, stat, base, vel: prog(
+                acc, stat, base, vel, np.float32(2.0),
+                np.float32(0.0), np.float32(0.9)))(
+            {p: np.ones((8, 4), np.float32) if p == "layer0/k"
+             else (np.ones((4,), np.float32) if p == "layer0/b"
+                   else np.float32(6.0))
+             for p in declared},
+            {}, dict(base_flat),
+            {p: np.zeros_like(v) for p, v in base_flat.items()})
+        _scan_jaxpr(jaxpr, _UPDATE_REL, "stage_update", findings)
+    return findings
+
+
 # -- jaxpr pass -------------------------------------------------------------
 
 _AUDIT_MODEL = "KWT_SPEECHCOMMANDS"
@@ -344,8 +473,10 @@ def _audit_jaxprs(root: pathlib.Path,
 def run(root: pathlib.Path, trace: bool = True) -> list[Finding]:
     findings = _audit_hot_loops(root)
     findings += _audit_donation(root)
+    findings += _audit_update_donation(root)
     if trace:
         from split_learning_tpu.config import TransportConfig
         wire = TransportConfig().wire_dtype_normalized
         findings += _audit_jaxprs(root, wire)
+        findings += _audit_update_jaxpr(root)
     return findings

@@ -109,6 +109,18 @@ class LearningConfig:
     # fresh (lag-0) contributions that cut a new global version; 0 =
     # every started client (the full barrier, maximally deterministic)
     async_quorum: int = 0
+    # Round-boundary compute overlap in SYNC mode (the sync twin of the
+    # async mode's pipelined rounds): after publishing its Update a
+    # stage-1 client keeps working while the server folds, optimizes
+    # and re-fans-out — it prefetches the next round's first batches
+    # (loader draw + host->device transfer) and, when the previous
+    # START held the local shard, runs the next round's first
+    # microbatch FORWARDS on the stale seed.  The new params splice in
+    # at the first tick boundary after START lands: speculative work
+    # that matches the round's actual seed/loader is consumed in
+    # place, anything else is discarded with the rng stream restored —
+    # so an overlapped round is BIT-IDENTICAL to a non-overlapped one.
+    sync_overlap: bool = False
 
     def validate(self):
         _check(self.remat in ("all", "wide", "none"),
@@ -270,6 +282,19 @@ class AggregationConfig:
     # oracle.  Velocity state lives in the fold backend's (sharded)
     # representation between rounds.
     server_momentum: float = 0.0
+    # Cross-replica-sharded weight update (arxiv 2004.13336): run the
+    # entire round-boundary update — FedAvg divide, FedAvgM momentum
+    # step, wire-dtype cast for START — as ONE fused program per
+    # stage instead of per-leaf ops.  On the mesh backend
+    # (aggregation.sharded) the fused program is jitted with donated
+    # buffers and every leaf sharded along axis 0 over the `agg` mesh
+    # axis, and the stage's result comes back in a single
+    # device->host fetch; per-stage results stream to the START
+    # fan-out in stage order while later stages are still updating.
+    # Bit-identical to the per-leaf path (same elementwise IEEE ops in
+    # the same order) — False keeps the legacy per-leaf path as the
+    # parity oracle.
+    update_sharded: bool = True
 
     def validate(self):
         _check(self.strategy in ("fedavg", "relay", "cluster_relay",

@@ -32,6 +32,18 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from split_learning_tpu.parallel.expert import moe_aux_loss
 
 
+def leaf_axis0_spec(shape, axis_size: int, axis: str) -> P:
+    """Leaf-axis-0 partition rule shared by the ZeRO-style layouts and
+    the server's cross-replica-sharded weight update
+    (:class:`split_learning_tpu.runtime.aggregate.MeshFoldBackend`):
+    shard dim 0 over ``axis`` when it divides evenly, replicate
+    otherwise — small or ragged leaves are not worth a padded layout.
+    """
+    if shape and shape[0] and shape[0] % axis_size == 0:
+        return P(axis)
+    return P()
+
+
 def stacked_shardings(tree, mesh: Mesh, spec_fn, axis: str,
                       client_axis: str = "client"):
     """NamedShardings for a CLIENT-STACKED param tree: ``spec_fn``

@@ -49,6 +49,21 @@ streaming, hierarchical, optionally mesh-sharded fold:
   the fold's finalize — with ``m = 0`` (default) this is plain FedAvg.
   Velocity lives in the backend's (sharded) representation between
   rounds.
+
+* **sharded weight-update plane** (``aggregation.update-sharded``,
+  "Automatic Cross-Replica Sharding of Weight Update in Data-Parallel
+  Training", arxiv 2004.13336): the whole round-boundary update —
+  FedAvg divide, FedAvgM step, wire-dtype cast for START — runs as ONE
+  fused program per stage (:meth:`MeshFoldBackend.stage_update`:
+  jitted, accumulator/velocity buffers donated, every leaf sharded
+  along axis 0 over the ``agg`` axis via the shared
+  :func:`~split_learning_tpu.parallel.axes.leaf_axis0_spec` rule),
+  with a single device->host fetch per stage.  ``finish(on_stage=...)``
+  dispatches every stage's program before fetching any, then streams
+  each stage's host trees to the callback in stage order — stage k's
+  fetch + START encode overlap stage k+1's device compute, the
+  per-shard pipelining that (with the clients' ``learning.sync-overlap``
+  ticks) hides the round-boundary update wall.
 """
 
 from __future__ import annotations
@@ -94,6 +109,12 @@ class FoldResult:
     window_hwm: int = 0            # most simultaneous held contributions
     folded: int = 0                # contributions folded
     partials: int = 0              # PartialAggregate contributions
+    update_s: float = 0.0          # round-boundary update wall (divide +
+    # momentum + cast + device->host fetch), the serial bubble the
+    # sharded update + sync overlap exist to shrink/hide
+    stage_update_ms: dict = dataclasses.field(default_factory=dict)
+    # per-stage update wall (ms), keyed by stage — the per-shard
+    # streaming granularity
 
 
 # --------------------------------------------------------------------------
@@ -106,6 +127,31 @@ class FoldResult:
 # so a streamed fold is bit-identical to the barrier fold, and the mesh
 # backend is bit-identical to the host one on CPU (elementwise IEEE ops).
 
+def _mom_path_set(st: "_StageFold", base_flat, momentum: float) -> set:
+    """Paths the FedAvgM step applies to: float leaves present in the
+    base tree (int leaves and paths outside the base keep plain
+    FedAvg) — the single definition both backends' fused stage update
+    and the legacy per-leaf path share."""
+    if not momentum or base_flat is None:
+        return set()
+    return {p for p in st.acc
+            if p in base_flat and not _is_int_dtype(st.dtype[p])}
+
+
+def _stage_velocity(st: "_StageFold", base_flat, velocity,
+                    mom_paths: set) -> dict:
+    """This stage's usable velocity entries (an elastic re-plan can
+    leave a path's velocity shaped for another tensor — restart those
+    from zero, exactly like the legacy per-leaf path did)."""
+    out = {}
+    for p in mom_paths:
+        vel = (velocity or {}).get(p)
+        if vel is not None and np.shape(vel) != np.shape(base_flat[p]):
+            vel = None
+        out[p] = vel
+    return out
+
+
 class HostFoldBackend:
     """Numpy accumulate/divide — the single-host default."""
 
@@ -115,8 +161,14 @@ class HostFoldBackend:
         return np.nan_to_num(np.asarray(leaf, dtype=np.float32)) * w
 
     def ingest(self, sums_leaf) -> np.ndarray:
-        """Adopt a PartialAggregate's precomputed f32 sum leaf."""
-        return np.asarray(sums_leaf, dtype=np.float32)
+        """Adopt a PartialAggregate's precomputed f32 sum leaf.
+
+        ``nan_to_num`` like :meth:`contrib`: a partial's sums arrive
+        over the wire (f32 overflow at an L1, a corrupt-but-crc-lucky
+        frame) and are the one fold input the contribution path's
+        sanitizer never saw — a no-op on every finite value, so clean
+        runs keep their bit-identity contracts."""
+        return np.nan_to_num(np.asarray(sums_leaf, dtype=np.float32))
 
     def add(self, acc, t):
         return acc + t
@@ -132,6 +184,36 @@ class HostFoldBackend:
         b = np.asarray(base, dtype=np.float32)
         v = m * vel + (b - avg32) if vel is not None else (b - avg32)
         return b - v, v
+
+    def stage_update(self, st: "_StageFold", base_flat, velocity,
+                     momentum: float):
+        """Fused per-stage round-boundary update, host twin: FedAvg
+        divide + FedAvgM step + cast back to the START wire dtype for
+        EVERY leaf of one stage, as one call.  Returns an opaque
+        pending handle for :meth:`stage_fetch` (eager here; the mesh
+        backend dispatches async so stage k+1's compute overlaps
+        stage k's fetch/encode)."""
+        mom_paths = _mom_path_set(st, base_flat, momentum)
+        vels = _stage_velocity(st, base_flat, velocity, mom_paths)
+        params: dict = {}
+        new_vel: dict = {}
+        for path, acc in st.acc.items():
+            dt = st.dtype[path]
+            if path in mom_paths:
+                avg32 = self.finalize(acc, st.total_w,
+                                      np.dtype(np.float32))
+                new32, nv = self.momentum_step(base_flat[path], avg32,
+                                               vels[path], momentum)
+                new_vel[path] = nv
+                params[path] = np.asarray(new32).astype(dt)
+            else:
+                params[path] = self.finalize(acc, st.total_w, dt)
+        stats = {p: self.finalize(a, st.stat_total_w, st.stat_dtype[p])
+                 for p, a in st.stat_acc.items()}
+        return params, stats, new_vel
+
+    def stage_fetch(self, pending):
+        return pending
 
     def to_host(self, x) -> np.ndarray:
         return np.asarray(x)
@@ -164,7 +246,10 @@ class MeshFoldBackend:
         self._NS, self._P = NamedSharding, PartitionSpec
         self._contrib = jax.jit(
             lambda x, w: jnp.nan_to_num(x.astype(jnp.float32)) * w)
-        self._add = jax.jit(lambda a, t: a + t, donate_argnums=(0,))
+        # `acc` naming is load-bearing: the JX007 audit
+        # (analysis/jaxpr_audit.py) statically requires every jitted
+        # op consuming a running-accumulator parameter to donate it
+        self._add = jax.jit(lambda acc, t: acc + t, donate_argnums=(0,))
         self._div = jax.jit(lambda a, tw: a / tw)
         self._div_round = jax.jit(lambda a, tw: jnp.round(a / tw))
         # FedAvgM inner step: v' = m v + (b - a); p' = b - v'
@@ -172,12 +257,117 @@ class MeshFoldBackend:
             nv = m * v + (b - a)
             return b - nv, nv
         self._mom = jax.jit(_mom)
+        # fused per-stage round-boundary update programs, keyed by the
+        # stage's static structure signature (paths/shapes/dtypes +
+        # which paths take the momentum step) — see _fused_update.
+        # Bounded like client._OPS_CACHE: elastic re-plans mint fresh
+        # signatures, and each entry pins a compiled XLA executable.
+        self._fused_cache: dict = {}
+        self._fused_cache_max = 32
 
     def _sharding(self, shape):
-        spec = (self._P("agg")
-                if shape and shape[0] and shape[0] % self.n_devices == 0
-                else self._P())
+        from split_learning_tpu.parallel.axes import leaf_axis0_spec
+        spec = leaf_axis0_spec(tuple(shape), self.n_devices, "agg")
         return self._NS(self.mesh, spec)
+
+    # -- fused sharded stage update (aggregation.update-sharded) ---------
+
+    def _fused_update(self, sig, dtypes, stat_dtypes, mom_paths):
+        """One jitted program for one stage's ENTIRE round-boundary
+        update: FedAvg divide, FedAvgM momentum step, and the cast
+        back to each leaf's START wire dtype — every leaf sharded
+        along axis 0 over the ``agg`` mesh axis (the ZeRO-style
+        leaf-axis-0 rule), accumulator and velocity buffers DONATED so
+        the update happens in place.  The elementwise op sequence
+        matches the host twin exactly, so mesh and host stay
+        bit-identical on CPU."""
+        prog = self._fused_cache.get(sig)
+        if prog is not None:
+            return prog
+        jax = self._jax
+        import jax.numpy as jnp
+
+        def fused(acc, stat_acc, base, vel, tw, stat_tw, m):
+            params, stats, nvel = {}, {}, {}
+            for path in sorted(acc):
+                dt = dtypes[path]
+                a32 = acc[path] / tw
+                if path in mom_paths:
+                    nv = m * vel[path] + (base[path] - a32)
+                    nvel[path] = nv
+                    params[path] = (base[path] - nv).astype(dt)
+                elif _is_int_dtype(dt):
+                    params[path] = jnp.round(a32).astype(dt)
+                else:
+                    params[path] = a32.astype(dt)
+            for path in sorted(stat_acc):
+                dt = stat_dtypes[path]
+                s32 = stat_acc[path] / stat_tw
+                stats[path] = (jnp.round(s32).astype(dt)
+                               if _is_int_dtype(dt)
+                               else s32.astype(dt))
+            return params, stats, nvel
+
+        # donate the consumed accumulators and the replaced velocity;
+        # base is read-only (it seeds the NEXT round's shadow compare)
+        from split_learning_tpu.runtime.memo import bounded_setdefault
+        return bounded_setdefault(
+            self._fused_cache, self._fused_cache_max, sig,
+            lambda: jax.jit(fused, donate_argnums=(0, 1, 3)))
+
+    def stage_update(self, st: "_StageFold", base_flat, velocity,
+                     momentum: float):
+        """Dispatch one stage's fused sharded update; returns a pending
+        handle whose :meth:`stage_fetch` does the stage's ONE
+        device->host fetch.  Dispatch is async — the caller can
+        dispatch every stage first and then fetch in stage order, so
+        stage k's fetch/encode overlaps stage k+1's device compute
+        (the per-shard streaming the START fan-out consumes)."""
+        mom_paths = frozenset(_mom_path_set(st, base_flat, momentum))
+        vels = _stage_velocity(st, base_flat, velocity, mom_paths)
+        dtypes = dict(st.dtype)
+        stat_dtypes = dict(st.stat_dtype)
+        sig = (tuple(sorted((p, tuple(np.shape(a)), str(dtypes[p]))
+                            for p, a in st.acc.items())),
+               tuple(sorted((p, tuple(np.shape(a)),
+                             str(stat_dtypes[p]))
+                            for p, a in st.stat_acc.items())),
+               tuple(sorted(mom_paths)))
+        prog = self._fused_update(sig, dtypes, stat_dtypes, mom_paths)
+        base_dev = {p: self._put(np.asarray(base_flat[p], np.float32))
+                    for p in mom_paths}
+        vel_dev = {}
+        for p in mom_paths:
+            v = vels[p]
+            if v is None:
+                vel_dev[p] = self._put(
+                    np.zeros(np.shape(base_flat[p]), np.float32))
+            elif isinstance(v, np.ndarray):
+                vel_dev[p] = self._put(v)
+            else:
+                vel_dev[p] = v   # already device-resident (sharded)
+        import warnings
+        with warnings.catch_warnings():
+            # int leaves accumulate in f32 and cast to int on output —
+            # their donated buffer can't alias the narrower result, and
+            # XLA says so once per compile; expected, not actionable
+            warnings.filterwarnings(
+                "ignore", message=".*donated buffers were not usable.*")
+            params, stats, nvel = prog(
+                dict(st.acc), dict(st.stat_acc), base_dev, vel_dev,
+                np.float32(st.total_w), np.float32(st.stat_total_w),
+                np.float32(momentum))
+        st.acc = {}          # donated — the buffers are gone
+        st.stat_acc = {}
+        return params, stats, nvel
+
+    def stage_fetch(self, pending):
+        """The stage's single device->host fetch (params + stats in one
+        transfer); the new velocity stays device-resident between
+        rounds (the backend's sharded representation)."""
+        params, stats, nvel = pending
+        host_p, host_s = self._jax.device_get((params, stats))
+        return host_p, host_s, nvel
 
     def _put(self, a: np.ndarray):
         return self._jax.device_put(a, self._sharding(a.shape))
@@ -187,7 +377,9 @@ class MeshFoldBackend:
         return self._contrib(self._put(a), np.float32(w))
 
     def ingest(self, sums_leaf):
-        return self._put(np.asarray(sums_leaf, dtype=np.float32))
+        # nan_to_num for wire-borne partial sums, like the host twin
+        return self._put(np.nan_to_num(
+            np.asarray(sums_leaf, dtype=np.float32)))
 
     def add(self, acc, t):
         return self._add(acc, t)
@@ -491,9 +683,27 @@ class StreamingFold:
             return out, self.n_samples
 
     def finish(self, base=None, momentum: float = 0.0,
-               velocity: dict | None = None) -> FoldResult:
-        """FedAvg divide (+ optional server momentum vs ``base``), in
-        canonical stage order; idempotent (returns the first result)."""
+               velocity: dict | None = None, *, fused: bool = True,
+               on_stage=None) -> FoldResult:
+        """The round-boundary update: FedAvg divide (+ optional server
+        momentum vs ``base``) + cast back to each leaf's START wire
+        dtype, in canonical stage order; idempotent (returns the first
+        result).
+
+        ``fused`` (``aggregation.update-sharded``, default) runs each
+        stage's whole update as ONE backend program — on the mesh
+        backend a jitted, donated, leaf-axis-0-sharded program whose
+        result comes back in a single device->host fetch; every
+        stage's program is dispatched before any stage is fetched, so
+        stage k's fetch (and whatever the caller's ``on_stage``
+        does with it — shadow refresh, START encode) overlaps stage
+        k+1's device compute.  ``fused=False`` keeps the legacy
+        per-leaf path as the bit-parity oracle.
+
+        ``on_stage(stage, stage_params, stage_stats)`` (when given) is
+        called per stage, in ascending stage order, the moment that
+        stage's host trees exist — the per-shard streaming hook the
+        server's START fan-out consumes."""
         with self._lock:
             if self._finished is not None:
                 return self._finished
@@ -502,41 +712,78 @@ class StreamingFold:
             t0 = time.perf_counter()
             params: dict = {}
             stats: dict = {}
+            stage_ms: dict = {}
             base_flat = (dict(_flat_items(base))
                          if (momentum and base is not None) else None)
-            for s in sorted(self._stages):
-                st = self._stages[s]
-                flat: dict = {}
-                for path, acc in st.acc.items():
-                    dt = st.dtype[path]
-                    if base_flat is not None and path in base_flat \
-                            and not _is_int_dtype(dt):
-                        # server momentum (FedAvgM): average in f32,
-                        # optimizer step in the backend (sharded on the
-                        # mesh backend), one dtype cast at the end
-                        avg32 = be.finalize(acc, st.total_w,
-                                            np.dtype(np.float32))
-                        vel = (velocity or {}).get(path)
-                        if vel is not None and np.shape(vel) != \
-                                np.shape(base_flat[path]):
-                            # an elastic re-plan moved this path's
-                            # layer range: the old velocity is another
-                            # tensor's momentum — restart from zero
-                            vel = None
-                        new32, nv = be.momentum_step(
-                            base_flat[path], avg32, vel, momentum)
-                        if velocity is not None:
-                            velocity[path] = nv
-                        flat[path] = be.to_host(new32).astype(dt)
-                    else:
-                        flat[path] = be.finalize(acc, st.total_w, dt)
-                params.update(_unflatten(flat))
-                if st.stat_acc:
-                    stats.update(_unflatten(
-                        {p: be.finalize(a, st.stat_total_w,
-                                        st.stat_dtype[p])
-                         for p, a in st.stat_acc.items()}))
-            self.fold_s += time.perf_counter() - t0
+            order = [s for s in sorted(self._stages)
+                     if self._stages[s].acc or self._stages[s].stat_acc]
+            if fused:
+                # all stages dispatch BEFORE any stage fetches; sound
+                # because stage param paths are disjoint (stage
+                # concatenation of absolute layer keys) — no stage's
+                # velocity read depends on another stage's write
+                pending = [(s, be.stage_update(self._stages[s],
+                                               base_flat, velocity,
+                                               momentum))
+                           for s in order]
+                for s, pend in pending:
+                    t_s = time.perf_counter()
+                    flat_p, flat_s, new_vel = be.stage_fetch(pend)
+                    if velocity is not None:
+                        velocity.update(new_vel)
+                    stage_p = _unflatten(flat_p)
+                    stage_s = _unflatten(flat_s)
+                    params.update(stage_p)
+                    stats.update(stage_s)
+                    stage_ms[s] = round(
+                        (time.perf_counter() - t_s) * 1e3, 3)
+                    if on_stage is not None:
+                        on_stage(s, stage_p, stage_s)
+            else:
+                for s in order:
+                    t_s = time.perf_counter()
+                    st = self._stages[s]
+                    flat: dict = {}
+                    for path, acc in st.acc.items():
+                        dt = st.dtype[path]
+                        if base_flat is not None and path in base_flat \
+                                and not _is_int_dtype(dt):
+                            # server momentum (FedAvgM): average in
+                            # f32, optimizer step in the backend, one
+                            # dtype cast at the end
+                            avg32 = be.finalize(acc, st.total_w,
+                                                np.dtype(np.float32))
+                            vel = (velocity or {}).get(path)
+                            if vel is not None and np.shape(vel) != \
+                                    np.shape(base_flat[path]):
+                                # an elastic re-plan moved this path's
+                                # layer range: the old velocity is
+                                # another tensor's momentum — restart
+                                # from zero
+                                vel = None
+                            new32, nv = be.momentum_step(
+                                base_flat[path], avg32, vel, momentum)
+                            if velocity is not None:
+                                velocity[path] = nv
+                            flat[path] = be.to_host(new32).astype(dt)
+                        else:
+                            flat[path] = be.finalize(acc, st.total_w,
+                                                     dt)
+                    stage_p = _unflatten(flat)
+                    stage_s = {}
+                    if st.stat_acc:
+                        stage_s = _unflatten(
+                            {p: be.finalize(a, st.stat_total_w,
+                                            st.stat_dtype[p])
+                             for p, a in st.stat_acc.items()})
+                    params.update(stage_p)
+                    stats.update(stage_s)
+                    stage_ms[s] = round(
+                        (time.perf_counter() - t_s) * 1e3, 3)
+                    if on_stage is not None:
+                        on_stage(s, stage_p, stage_s)
+            update_s = time.perf_counter() - t0
+            self.fold_s += update_s
             result_bytes = _tree_nbytes(params)
             peak = (1.0 + self._held_hwm_bytes / result_bytes
                     if result_bytes else float(bool(self.window_hwm)))
@@ -545,7 +792,8 @@ class StreamingFold:
                 fold_s=round(self.fold_s, 6),
                 peak_tree_copies=round(peak, 3),
                 window_hwm=self.window_hwm, folded=self.folded,
-                partials=self.partials)
+                partials=self.partials,
+                update_s=round(update_s, 6), stage_update_ms=stage_ms)
             return self._finished
 
 

@@ -215,8 +215,13 @@ def make_optimizer_from_dict(learning: dict | None) -> tuple[
 
 def _ops_cache_key(model_key, start_layer, end_layer, learning,
                    model_kwargs) -> tuple:
+    d = dict(learning or {})
+    # loop-behavior-only knobs: the jitted ops are identical with the
+    # flag on or off, so sharing the compiled bundle across the A/B is
+    # free (and keeps the sync-overlap bench/test legs compile-warm)
+    d.pop("sync_overlap", None)
     return (model_key, start_layer, end_layer,
-            repr(sorted((learning or {}).items())),
+            repr(sorted(d.items())),
             repr(sorted((model_kwargs or {}).items())))
 
 
@@ -552,6 +557,15 @@ class ProtocolClient:
         # off the reply queue for run() to handle in order
         self._overlap_samples = 0
         self._pending_ctrl: list[bytes] = []
+        # sync-mode round-boundary overlap (learning.sync-overlap):
+        # the speculative cache built between this round's UPDATE and
+        # the next START (_sync_overlap_ticks), the splice the next
+        # round's hot loop consumes when the speculation held, and the
+        # last START's shape (the hold/re-seed predictor)
+        self._sync_cache: dict | None = None
+        self._spliced: dict | None = None
+        self._last_start_held = False
+        self._update_pub_t = 0.0
         if cfg.checkpoint.load:
             self._load_ef_state()
         # device-resident NaN sentinel: hot loops fold jnp.isfinite
@@ -835,6 +849,29 @@ class ProtocolClient:
         self.sda_fence_quorum = int(extra.get("sda_fence_quorum", 1))
         self.sda_strict = bool(extra.get("sda_strict", False))
         self.sda_feeders = extra.get("sda_feeders")
+        # sync-overlap speculation (built between the last UPDATE and
+        # this START): consumed below iff it matches the round this
+        # START actually opens; every mismatch discards with state
+        # restored so the round stays bit-identical to non-overlapped
+        sc, self._sync_cache = self._sync_cache, None
+        if self._spliced is not None:
+            # a previous START spliced but no round ever consumed it
+            # (e.g. an elastic re-plan fanned out a second START before
+            # SYN): unwind the speculation's state — the rng counter on
+            # a kept runner, the kept loader's shuffle (hold mode), or
+            # the adopted clone's shuffle (reseed mode) — exactly as a
+            # discard would, or the bit-identity contract breaks
+            stale, self._spliced = self._spliced, None
+            if (stale["mode"] == "reseed"
+                    and self.loader is stale["loader"]
+                    and stale["loader_rng0"] is not None):
+                self.loader._rng.bit_generator.state = \
+                    stale["loader_rng0"]
+                self.faults.inc("overlap_discards")
+            else:
+                self._discard_sync_cache(stale, runner_kept=True,
+                                         loader_kept=True)
+        self._last_start_held = msg.params is None
         if msg.params is None:
             # FLEX non-reseed round (other/FLEX/src/Server.py:220-226):
             # START without weights — keep the locally persisted shard
@@ -844,6 +881,7 @@ class ProtocolClient:
                 raise RuntimeError(
                     "START without params but no matching local shard "
                     f"(layers [{msg.start_layer}, {msg.end_layer}])")
+            runner_kept = True
             if dict(msg.learning or {}) != self.runner.learning_dict:
                 # hyperparams changed mid-hold (e.g. lr decay): rebuild
                 # the jitted ops around the kept weights; optimizer
@@ -858,6 +896,7 @@ class ProtocolClient:
                 self.perf.wrap_runner(self.runner)
                 self.opt_state = self.runner.optimizer.init(self.trainable)
                 self._reset_aux()
+                runner_kept = False
                 self.log.info("hyperparams changed: rebuilt runner "
                               "(weights kept)")
             else:
@@ -868,11 +907,13 @@ class ProtocolClient:
             # or (b) an elastic re-plan moved this client's data
             # distribution without moving its layer range — otherwise
             # the server's plan and the trained subset silently diverge
+            loader_kept = True
             if (self.stage == 1 and msg.label_counts is not None
                     and ((msg.extra or {}).get("refresh")
                          or [int(c) for c in msg.label_counts]
                          != getattr(self, "_loader_counts", None))):
                 self._build_loader(msg)
+                loader_kept = False
             # hold START: the delta base survives only while it still
             # matches the server's shadow — a drifted advertisement
             # (shadow lost/moved) breaks the chain, so fall back to a
@@ -880,6 +921,22 @@ class ProtocolClient:
             if (self._delta_base is not None
                     and self._delta_base[0] != self._delta_advert):
                 self._delta_base = None
+            if sc is not None:
+                # the speculation holds iff the round it predicted is
+                # the round it got: a hold START with the SAME runner
+                # (same params AND rng stream) and the SAME loader —
+                # then the cached forwards are bit-exactly the round's
+                # first microbatches and the hot loop consumes them
+                if (sc["mode"] == "hold" and runner_kept
+                        and loader_kept):
+                    self._spliced = sc
+                    self.faults.inc("overlap_splices")
+                    self.log.info(
+                        f"sync overlap: splicing {len(sc['items'])} "
+                        "precomputed forward(s) into this round")
+                else:
+                    self._discard_sync_cache(sc, runner_kept,
+                                             loader_kept)
             return
         model_kwargs = dict(self.cfg.model_kwargs or {})
         self.runner = ShardRunner(
@@ -925,7 +982,35 @@ class ProtocolClient:
                 "lora_rank set but no target kernels in this shard; "
                 "training full shard parameters instead")
         self.opt_state = self.runner.optimizer.init(self.trainable)
-        self._build_loader(msg)
+        if (sc is not None and sc["mode"] == "reseed"
+                and self.stage == 1 and msg.label_counts is not None
+                and not (msg.extra or {}).get("refresh")
+                and [int(c) for c in msg.label_counts]
+                == getattr(self, "_loader_counts", None)
+                and sc["batch_size"]
+                == self.runner.learning.batch_size):
+            # re-seed predicted and got: the overlap's loader clone IS
+            # what _build_loader would now rebuild (same subset seed,
+            # same counts, same batch geometry) — adopt it, and let
+            # the round consume the already-transferred first batches.
+            # The speculative stale-seed forwards lose their bet (this
+            # START replaced the params): drop the outputs and their
+            # rng draws — the runner is fresh-built, so the round's
+            # recompute draws from the new stream exactly like a
+            # non-overlapped run.
+            for ent in sc["items"]:
+                ent["rng"] = ent["out"] = None
+            self.loader = sc["loader"]
+            self._spliced = sc
+            self.faults.inc("overlap_splices")
+            self.log.info(
+                f"sync overlap: {len(sc['items'])} prefetched "
+                "batch(es) spliced into this round (loader adopted)")
+        else:
+            if sc is not None:
+                self._discard_sync_cache(sc, runner_kept=False,
+                                         loader_kept=False)
+            self._build_loader(msg)
 
     def _build_loader(self, msg: Start):
         """(Re)build the stage-1 data loader from a START's label
@@ -1021,12 +1106,15 @@ class ProtocolClient:
             if rec:
                 self.log.metric(kind="perf", client=self.client_id,
                                 round_idx=msg.round_idx, **rec)
+        # pipelined rounds: keep ticking locally while the server
+        # aggregates/validates and the next START streams in — BEFORE
+        # the span flush below, so the overlap window opens while the
+        # server's update wall is still running (the flush's file I/O
+        # would otherwise eat the head start)
+        self._overlap_ticks()
         # a finished round's spans must be durable even if the process
         # dies while idle between rounds
         self.tracer.flush()
-        # pipelined rounds: keep ticking locally while the server
-        # aggregates/validates and the next START streams in
-        self._overlap_ticks()
 
     def _send_update(self, with_weights: bool = True):
         # the round's ONE host sync of the NaN sentinel the hot loops
@@ -1069,6 +1157,10 @@ class ProtocolClient:
                                 telemetry=tel),
                                 self._chunk_bytes,
                                 ctx=ctx), kind="Update")
+        # wall-clock anchor for the round-boundary overlap window: the
+        # bench intersects [publish, next ctrl] with the server's
+        # update/fan-out window on the same host clock
+        self._update_pub_t = time.time()
         # error-feedback residuals are part of the client's durable
         # state: checkpoint them with the round (atomic sidecar)
         if self.cfg.checkpoint.save and self.codecs:
@@ -1250,7 +1342,12 @@ class ProtocolClient:
         START (shard kept): a re-seed discards the credit along with
         the shard work (_apply_start), while the client-local aux head
         keeps its progress either way."""
-        if (not self._async_mode or self.stage != 1
+        if not self._async_mode:
+            # sync twin (learning.sync-overlap): speculative prefetch +
+            # stale-seed forward ticks instead of aux training
+            self._sync_overlap_ticks()
+            return
+        if (self.stage != 1
                 or self.loader is None or self.aux_params is None):
             return
         from split_learning_tpu.runtime.bus import QueueClosed
@@ -1280,6 +1377,161 @@ class ProtocolClient:
             self.log.info(f"async overlap: {ticked} local ticks "
                           f"({self._overlap_samples} samples banked "
                           "for the next round)")
+
+    # -- sync-mode round-boundary overlap (learning.sync-overlap) ------------
+
+    def _overlap_loader_clone(self):
+        """The loader a re-seeding next START would build — rebuilt
+        HERE, ahead of the START, so the subset draw, epoch shuffle and
+        host->device transfers of the next round's first batches all
+        run inside the server's update wall.  None when the next
+        round's loader is unknowable (refresh re-salts the subset per
+        round) or this client has no stage-1 loader."""
+        if (self.stage != 1
+                or getattr(self, "_loader_counts", None) is None
+                or self.cfg.distribution.refresh):
+            return None
+        from split_learning_tpu.runtime.validation import (
+            dataset_kwargs_for_model,
+        )
+        return make_data_loader(
+            dataset_for_model(self.cfg.model_key),
+            self.runner.learning.batch_size,
+            distribution=np.asarray(self._loader_counts), train=True,
+            seed=subset_seed(self.cfg.seed, self.client_id, 0, False),
+            synthetic_size=self.cfg.synthetic_size,
+            dataset_kwargs=dataset_kwargs_for_model(
+                self.cfg.model_key, self.cfg.model_kwargs))
+
+    def _sync_overlap_ticks(self) -> None:
+        """Sync-mode pipelined rounds: after the round's UPDATE leaves,
+        a stage-1 client keeps working while the server runs its
+        round-boundary update (fold finish, FedAvgM, re-shard, START
+        fan-out) — the serial bubble that otherwise idles every
+        accelerator.
+
+        The client runs the next round's first microbatches — data
+        draw, host->device transfer, AND the forward pass — on the
+        stale seed, in-flight-window's worth (``control-count``), then
+        keeps prefetching further batches.  Two speculative modes,
+        predicted from the LAST START's shape:
+
+        * **hold predicted** (FLEX/periodic wire economy): the local
+          shard IS the next round's seed and the kept loader IS its
+          batch stream — the cached ``(x, rng, out)`` forwards splice
+          into the round bit-exactly;
+        * **re-seed predicted** (the FedAvg common case): batches come
+          from a freshly rebuilt loader clone (the exact sequence a
+          re-seeding START's ``_build_loader`` would draw).  The
+          forwards are a losing-but-cheap bet (a re-seed replaces the
+          params, so their outputs are dropped at the splice and only
+          the transferred batches survive), but they are exactly "the
+          next round's first microbatches on the stale seed" — the
+          compute that fills the server's update wall either way.
+
+        The next ``_on_start`` splices a cache that matches the round
+        it actually got and discards anything else — with the rng
+        counter and the kept loader's shuffle state restored on
+        discard, so an overlapped round stays **bit-identical** to a
+        non-overlapped one (tests/test_async.py).  Any control frame
+        ends the overlap and is handed back to run() in arrival
+        order."""
+        r = getattr(self, "runner", None)
+        if (r is None or self.stage != 1 or self.loader is None
+                or not getattr(r.learning, "sync_overlap", False)):
+            return
+        whole = (r.start_layer == 0
+                 and r.model.resolved_end == len(r.model.specs))
+        if whole:
+            return   # _train_whole has no splice consumer
+        from split_learning_tpu.runtime.bus import QueueClosed
+        hold = bool(self._last_start_held)
+        cap_fwd = max(1, r.learning.control_count)
+        cap = cap_fwd * 4
+        counter0 = r._counter
+        # the activity window opens HERE: the loader clone build (the
+        # next round's subset draw + epoch shuffle) is overlap work too
+        t0 = time.time()
+        loader_rng0 = None
+        if hold:
+            src_loader = self.loader
+            loader_rng0 = self.loader._rng.bit_generator.state
+        else:
+            src_loader = self._overlap_loader_clone()
+            if src_loader is None:
+                return
+            # pristine clone state: if an adopted-then-never-trained
+            # splice is dropped by a second START, the clone's shuffle
+            # stream rewinds to what a fresh _build_loader would hold
+            loader_rng0 = src_loader._rng.bit_generator.state
+        it = iter(src_loader)
+        q = reply_queue(self.client_id)
+        items: list[dict] = []
+        while len(items) < cap:
+            try:
+                raw = self.bus.get(q, timeout=0.0005)
+            except (QueueClosed, ConnectionError, OSError):
+                return   # transport gone between rounds: run() exits
+            if raw is not None:
+                self._pending_ctrl.append(raw)
+                break
+            with self.perf.host():
+                item = next(it, None)
+                if item is not None:
+                    x, labels = item
+                    xd = jnp.asarray(x)
+                    yd = np.asarray(labels, np.int32)
+            if item is None:
+                break   # epoch exhausted: nothing left to speculate on
+            rng = out = None
+            if len(items) < cap_fwd:
+                # the next round's first microbatch forwards, on the
+                # stale seed (both modes — a re-seed drops the outputs
+                # at the splice, a hold consumes them bit-exactly)
+                rng = r.next_rng()
+                sp = self.tracer.start("overlap_fwd", always=False,
+                                       round=self.round_idx)
+                out = r.fwd(self.frozen, self.trainable, self.stats,
+                            xd, rng)
+                sp.end()
+            items.append({"x": xd, "labels": yd, "rng": rng,
+                          "out": out})
+        t1 = time.time()
+        if not items:
+            return
+        self._sync_cache = {
+            "mode": "hold" if hold else "reseed",
+            "loader": None if hold else src_loader,
+            "iter": it, "items": items, "counter0": counter0,
+            "loader_rng0": loader_rng0,
+            "batch_size": r.learning.batch_size,
+        }
+        # kind=overlap: the activity window the bench intersects with
+        # the server's kind=agg/kind=update wall on the shared clock
+        self.log.metric(kind="overlap", client=self.client_id,
+                        round_idx=self.round_idx,
+                        mode=self._sync_cache["mode"],
+                        ticks=len(items),
+                        t_pub=round(self._update_pub_t, 6),
+                        act_t0=round(t0, 6), act_t1=round(t1, 6))
+        self.log.info(
+            f"sync overlap: {len(items)} speculative "
+            f"{'forward' if hold else 'prefetch'} tick(s) while the "
+            "server updates")
+
+    def _discard_sync_cache(self, sc: dict, runner_kept: bool,
+                            loader_kept: bool) -> None:
+        """Unwind a speculation the actual START invalidated: restore
+        the rng counter (the kept runner's stream must match a
+        non-overlapped round) and the kept loader's shuffle state (the
+        overlap consumed an epoch permutation the round now re-draws)."""
+        self.faults.inc("overlap_discards")
+        if runner_kept and sc["items"] and sc["items"][0]["rng"] \
+                is not None:
+            self.runner._counter = sc["counter0"]
+        if loader_kept and sc["mode"] == "hold" \
+                and sc["loader_rng0"] is not None:
+            self.loader._rng.bit_generator.state = sc["loader_rng0"]
 
     def _train_first_async(self) -> Pause:
         """Stage-1 decoupled loop: dispatch + local aux step per batch,
@@ -1498,6 +1750,25 @@ class ProtocolClient:
             round_idx=self.fence)))
         return self._wait_pause()
 
+    def _epoch_items(self, ep: int):
+        """One epoch's ``(x, labels, cached)`` stream for the stage-1
+        hot loop.  Epoch 0 consumes the sync-overlap splice first —
+        ``cached`` carries the speculative ``{rng, out}`` when the
+        forward was precomputed on the held seed (or just the
+        device-resident batch on a re-seed round) — then continues the
+        overlap's own iterator, which IS the round's epoch-0 sequence
+        (same shuffle draw).  No splice: the plain loader epoch."""
+        sp = self._spliced if ep == 0 else None
+        if sp is not None:
+            self._spliced = None
+            for ent in sp["items"]:
+                yield ent["x"], ent["labels"], ent
+            for x, labels in sp["iter"]:
+                yield x, labels, None
+        else:
+            for x, labels in iter(self.loader):
+                yield x, labels, None
+
     def _train_first(self) -> Pause:
         """Bounded-in-flight 1F1B streaming (``src/train/VGG16.py:61-136``)."""
         r = self.runner
@@ -1522,7 +1793,7 @@ class ProtocolClient:
 
         for ep in range(self.epochs):
             self.gauges.set("epoch", ep)
-            data_iter = iter(self.loader)
+            data_iter = self._epoch_items(ep)
             # prefetch one batch: exhaustion must be known at the LAST
             # dispatch, not when the in-flight cap next frees — with a
             # strict head holding this feeder's batches, the cap never
@@ -1574,17 +1845,31 @@ class ProtocolClient:
                             f"PAUSE mid-loop with {len(inflight)} in flight")
                         return pause
                     continue
-                x, labels = next_item
+                x, labels, cached = next_item
                 with self.perf.host():
                     next_item = next(data_iter, None)
                     x = jnp.asarray(x)
-                rng = r.next_rng()
                 out_q = out_qs[n_fwd % len(out_qs)]
                 sp = self.tracer.start("fwd", always=False,
-                                       round=self.round_idx)
-                out = self._wire_out(
-                    r.fwd(self.frozen, self.trainable, self.stats, x,
-                          rng), "intermediate", out_q)
+                                       round=self.round_idx,
+                                       spliced=bool(
+                                           cached
+                                           and cached["out"]
+                                           is not None))
+                if cached is not None and cached["out"] is not None:
+                    # sync-overlap splice: this microbatch's forward
+                    # already ran on the held seed during the server's
+                    # update wall — consume it (the rng it drew is the
+                    # stream's next draw, so the sequence matches a
+                    # non-overlapped round bit-for-bit)
+                    rng = cached["rng"]
+                    out = self._wire_out(cached["out"], "intermediate",
+                                         out_q)
+                else:
+                    rng = r.next_rng()
+                    out = self._wire_out(
+                        r.fwd(self.frozen, self.trainable, self.stats,
+                              x, rng), "intermediate", out_q)
                 sp.end()
                 data_id = uuid.uuid4().hex
                 inflight[data_id] = _Inflight(x=x, rng=rng,

@@ -47,7 +47,8 @@ from split_learning_tpu.runtime.plan import (
 from split_learning_tpu.runtime import aggregate as agg_plane
 from split_learning_tpu.runtime.protocol import (
     FrameAssembler, Heartbeat, Notify, PartialAggregate, Pause, Ready,
-    Register, Start, Stop, Syn, Update, encode, reply_queue, RPC_QUEUE,
+    Register, Start, Stop, Syn, Update, encode, encode_parts,
+    reply_queue, RPC_QUEUE,
 )
 from split_learning_tpu.runtime.spans import unpack_ctx
 from split_learning_tpu.runtime.telemetry import FleetMonitor, GaugeSet
@@ -954,6 +955,13 @@ class ProtocolContext(MeshContext):
         fanout_span = self.tracer.start("start_fanout",
                                         round=round_idx,
                                         cluster=plan.cluster_id)
+        fanout_t0 = time.time()
+        shadow_refresh_s = 0.0
+        # stage-ascending order (``active`` is built stage 1 first):
+        # stage-1 clients' STARTs leave the socket before any later
+        # stage's are even encoded, so the pipeline's feeders start
+        # streaming while the rest of the fan-out is still encoding —
+        # the fan-out half of the per-shard streaming discipline
         for cid, s in active:
             a, b = ranges[s - 1]
             sp = (send_params.get(s, True)
@@ -985,8 +993,14 @@ class ProtocolContext(MeshContext):
                 if group is not None:
                     self._delta_shadow.clear(cid)
                 elif sp:
+                    # the shadow stores VIEWS of the same host arrays
+                    # the sharded update fetched (one device->host
+                    # fetch per stage, _np_tree/shard_params slice
+                    # without copying) — no fp32 re-materialization
+                    t_sh = time.perf_counter()
                     self._delta_shadow.note_sent(cid, self._cur_gen,
                                                  shard_p)
+                    shadow_refresh_s += time.perf_counter() - t_sh
                     delta_ver = self._cur_gen
                 else:
                     delta_ver = self._delta_shadow.version_for(cid)
@@ -995,7 +1009,12 @@ class ProtocolContext(MeshContext):
                 label_counts = np.asarray(
                     plan.label_counts[plan.stage1_clients.index(cid)])
             end_layer = -1 if s == plan.n_stages else b
-            self.bus.publish(reply_queue(cid), encode(Start(
+            # per-shard START streaming: a big shard frame splits into
+            # crc'd SLTC chunks published as they are cut, so the
+            # client's FrameAssembler starts receiving shard bytes
+            # while the tail of the frame is still encoding (and
+            # later-stage STARTs haven't been touched yet)
+            start_parts = encode_parts(Start(
                 start_layer=a, end_layer=end_layer,
                 cluster=plan.cluster_id, params=shard_p,
                 batch_stats=shard_s, learning=learning,
@@ -1046,10 +1065,31 @@ class ProtocolContext(MeshContext):
                        # this group's aggregate queue instead of rpc
                        "agg_group": (group.idx if group is not None
                                      else None),
-                       "gen": self._cur_gen})))
+                       "gen": self._cur_gen}),
+                self.cfg.transport.chunk_mb << 20)
+            for part in start_parts:
+                self.bus.publish(reply_queue(cid), part)  # slcheck: wire=Start
             self.log.sent(f"START -> {cid} layers=[{a}, {end_layer}]"
                           + ("" if sp else " (no weights)"))
         fanout_span.end()
+        # round-boundary fan-out wall: with the previous invocation's
+        # kind=agg update window this bounds the serial weight-update
+        # bubble (finish + re-shard + encode + publish) the clients'
+        # sync-overlap ticks hide
+        self.log.metric(kind="update", gen=self._cur_gen,
+                        round_idx=round_idx, cluster=plan.cluster_id,
+                        fanout_s=round(time.time() - fanout_t0, 6),
+                        fanout_t0=round(fanout_t0, 6),
+                        fanout_t1=round(time.time(), 6),
+                        shadow_refresh_s=round(shadow_refresh_s, 6),
+                        n_starts=len(active))
+        # also surfaced on this invocation's kind=agg record below —
+        # note the boundary: this is the cost of the fan-out that
+        # OPENED this invocation (delivering the previous fold's
+        # params), so the agg record shows the adjacent boundary's
+        # shadow-write cost; kind=update above is the exact per-round
+        # attribution
+        self._fanout_shadow_s = shadow_refresh_s
         if self._delta_shadow is not None:
             # shadow memory audit: bytes pinned by per-client base
             # copies, refreshed whenever the set can have changed
@@ -1221,14 +1261,27 @@ class ProtocolContext(MeshContext):
             # sl_trace/sl_perf attribute the phase honestly.
             fold, self._fold = self._fold, None
             m = float(self._agg.server_momentum)
+            self._update_t0 = time.time()
             with self.tracer.span(
                     "aggregate", round=round_idx,
                     cluster=plan.cluster_id,
                     overlapped_fold_s=round(fold.fold_s, 6)):
+                # fused sharded update (aggregation.update-sharded):
+                # each stage's divide+momentum+cast runs as one
+                # donated program, all stages dispatched before any
+                # fetch — stage k's single device->host fetch overlaps
+                # stage k+1's device compute.  The on_stage hook marks
+                # each stage's completion on the aggregate span so
+                # sl_trace shows the per-shard pipeline.
                 result = fold.finish(
                     base=params if m else None, momentum=m,
                     velocity=(self._agg_velocity.setdefault(
-                        plan.cluster_id, {}) if m else None))
+                        plan.cluster_id, {}) if m else None),
+                    fused=self._agg.update_sharded,
+                    on_stage=lambda s, p, st: self.tracer.record(
+                        "update_stage", time.time(), time.time(),
+                        round=round_idx, stage=s))
+            self._update_t1 = time.time()
             updates = agg_plane.UpdateBatch(updates)
             updates.fold = result
             self.log.metric(
@@ -1241,7 +1294,19 @@ class ProtocolContext(MeshContext):
                 partials=result.partials,
                 window_hwm=result.window_hwm,
                 peak_tree_copies=result.peak_tree_copies,
-                n_samples=result.n_samples)
+                n_samples=result.n_samples,
+                # round-boundary update wall (divide + FedAvgM + cast
+                # + per-stage fetch) — the serial bubble the sharded
+                # update shrinks and the clients' sync-overlap hides.
+                # Wall-clock t0/t1 let the bench intersect this window
+                # with client overlap activity on the same host clock.
+                update_sharded=bool(self._agg.update_sharded),
+                update_s=result.update_s,
+                update_t0=round(self._update_t0, 6),
+                update_t1=round(self._update_t1, 6),
+                stage_update_ms=result.stage_update_ms,
+                shadow_refresh_s=round(
+                    getattr(self, "_fanout_shadow_s", 0.0), 6))
             self.log.info(
                 f"streamed aggregate: folded={result.folded} "
                 f"(partials={result.partials}) fold={result.fold_s:.3f}s"

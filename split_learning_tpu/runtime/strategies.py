@@ -138,7 +138,14 @@ def aggregate_cluster(updates: Sequence[Update]) -> tuple[Any, Any, int]:
 
 
 def merge_clusters(cluster_trees: Sequence[Any]) -> Any:
-    """Unweighted cross-cluster average (``src/Server.py:410-434``)."""
+    """Unweighted cross-cluster average (``src/Server.py:410-434``).
+
+    Deliberately NOT short-circuited for one cluster: the degenerate
+    average still runs every leaf through ``nan_to_num`` — relay-style
+    strategies feed RAW client trees in here, and that sanitization is
+    load-bearing for them.  The FedAvg/SDA round path (whose single
+    tree comes out of the already-sanitized fold) skips this call at
+    the call site instead."""
     return fedavg_trees(list(cluster_trees))
 
 
@@ -238,9 +245,20 @@ class FedAvgStrategy(RoundStrategy):
         # span covers the final merge and carries the fold total
         with _span(ctx, "aggregate", round=round_idx,
                    fold_s=round(agg_s, 6)):
-            out = RoundOutcome(merge_clusters(cluster_params),
-                               merge_clusters(cluster_stats),
-                               num_samples=total)
+            if len(plans) == 1:
+                # one cluster (the common deployment): the tree IS the
+                # fold's output — already nan_to_num-sanitized by the
+                # fold's contribution path — so the degenerate
+                # self-average would only re-materialize every leaf on
+                # the round path, defeating the sharded update's
+                # one-fetch-per-stage discipline (the next START
+                # fan-out and delta shadow slice these arrays in place)
+                out = RoundOutcome(cluster_params[0], cluster_stats[0],
+                                   num_samples=total)
+            else:
+                out = RoundOutcome(merge_clusters(cluster_params),
+                                   merge_clusters(cluster_stats),
+                                   num_samples=total)
         return out
 
 

@@ -77,6 +77,10 @@ FAULT_COUNTER_NAMES = frozenset({
     # UPDATE frames before dying (one inc per member)
     "agg_dup_drops", "agg_stale_drops", "agg_l1_fallbacks",
     "agg_fallback_abandons",
+    # sync-mode round-boundary overlap (runtime/client.py
+    # _sync_overlap_ticks): speculative caches the next START consumed
+    # (spliced) vs invalidated-and-unwound (discarded)
+    "overlap_splices", "overlap_discards",
     # async bounded-staleness admission window (runtime/server.py
     # _admit_update): contributions folded late with a decayed weight
     # (server_version - version <= learning.max-staleness), and

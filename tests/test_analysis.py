@@ -343,6 +343,37 @@ def test_jaxpr_pass_clean_on_repo():
     assert JX._audit_jaxprs(ROOT, "bfloat16") == []
 
 
+def test_update_buffer_without_donation_detected():
+    """JX007: a jitted round-boundary op consuming an accumulator
+    parameter without donating it is flagged."""
+    src = ("import jax\n"
+           "f = jax.jit(lambda acc, t: acc + t)\n"
+           "def fused(acc, stat_acc, base):\n"
+           "    return acc\n"
+           "g = jax.jit(fused, donate_argnums=(0,))\n")
+    fs = JX._scan_update_donation(src, "x.py")
+    assert [f.code for f in fs] == ["JX007", "JX007"]
+    assert "'acc'" in fs[0].message
+    assert "stat_acc" in fs[1].message   # donated acc, forgot stat_acc
+
+
+def test_update_donation_donated_site_passes():
+    src = ("import jax\n"
+           "f = jax.jit(lambda acc, t: acc + t, donate_argnums=(0,))\n")
+    assert JX._scan_update_donation(src, "x.py") == []
+
+
+def test_update_donation_clean_on_repo():
+    assert JX._audit_update_donation(ROOT) == []
+
+
+def test_update_jaxpr_clean_on_repo():
+    """The fused sharded stage update: no host round-trips compiled in,
+    and every leaf comes back in its declared START wire dtype (a bf16
+    leaf must not fetch as fp32)."""
+    assert JX._audit_update_jaxpr(ROOT) == []
+
+
 # --------------------------------------------------------------------------
 # concurrency lint negatives
 # --------------------------------------------------------------------------

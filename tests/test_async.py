@@ -605,3 +605,137 @@ def test_async_quorum_cuts_past_dead_straggler(tmp_path):
     # update never existed); the barrier did NOT wait out the timeout
     assert res.history[0].num_samples == 8
     assert wall < 45, f"quorum barrier stalled: {wall:.0f}s"
+
+
+# --------------------------------------------------------------------------
+# sync-mode round-boundary overlap (learning.sync-overlap)
+# --------------------------------------------------------------------------
+
+def _overlap_metrics(log_dir, kind="overlap"):
+    import glob
+    import json
+    out = []
+    for p in glob.glob(str(log_dir / "**" / "metrics.jsonl"),
+                       recursive=True):
+        for line in open(p):
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if rec.get("kind") == kind:
+                out.append(rec)
+    return out
+
+
+def _bit_same_tree(a, b) -> bool:
+    la = jax.tree_util.tree_leaves(a)
+    lb = jax.tree_util.tree_leaves(b)
+    return len(la) == len(lb) and all(
+        np.asarray(x).tobytes() == np.asarray(y).tobytes()
+        for x, y in zip(la, lb))
+
+
+class TestSyncOverlap:
+    """learning.sync-overlap: the stale-seed speculation between UPDATE
+    and the next START must be invisible to the training semantics —
+    an overlapped deployment is BIT-IDENTICAL to a non-overlapped one,
+    splice or discard."""
+
+    def test_config_surface(self):
+        LearningConfig(sync_overlap=True).validate()
+        from split_learning_tpu.config import from_dict
+        cfg = from_dict({"learning": {"sync-overlap": True}})
+        assert cfg.learning.sync_overlap is True
+
+    def test_loader_clone_refuses_refresh(self, tmp_path):
+        """Under distribution.refresh the next round's subset seed is
+        unknowable — the speculative loader clone must refuse."""
+        from test_chaos import _round_cfg
+        cfg = _round_cfg(tmp_path, tmp_path / "r",
+                         distribution={"num_samples": 8,
+                                       "refresh": True})
+        c = ProtocolClient(cfg, "c0", 1, transport=InProcTransport())
+        c.runner = ShardRunner(cfg.model_key, 0, 2,
+                               {"batch_size": 4, "mode": "sync"},
+                               model_kwargs=dict(cfg.model_kwargs))
+        c._loader_counts = [1] * 10
+        assert c._overlap_loader_clone() is None
+
+    def test_loader_clone_matches_build_loader(self, tmp_path):
+        """The clone must draw the exact sequence a re-seeding START's
+        _build_loader would — same subset seed, same epoch shuffle."""
+        from test_chaos import _round_cfg
+        from split_learning_tpu.runtime.protocol import Start
+        cfg = _round_cfg(tmp_path, tmp_path / "r")
+        c = ProtocolClient(cfg, "c0", 1, transport=InProcTransport())
+        c.runner = ShardRunner(cfg.model_key, 0, 2,
+                               {"batch_size": 4, "mode": "sync"},
+                               model_kwargs=dict(cfg.model_kwargs))
+        counts = np.zeros(35, np.int64)
+        counts[:4] = 2
+        c._loader_counts = [int(x) for x in counts]
+        clone = c._overlap_loader_clone()
+        c._build_loader(Start(start_layer=0, end_layer=2, cluster=0,
+                              params=None, label_counts=counts,
+                              round_idx=3))
+        got = [(np.asarray(x), np.asarray(y)) for x, y in clone]
+        want = [(np.asarray(x), np.asarray(y)) for x, y in c.loader]
+        assert len(got) == len(want) and all(
+            np.array_equal(gx, wx) and np.array_equal(gy, wy)
+            for (gx, gy), (wx, wy) in zip(got, want))
+
+    def test_reseed_rounds_bit_identical(self, tmp_path):
+        """FedAvg re-seeds every round: overlap runs in prefetch mode
+        (loader clone adopted, data spliced, forwards never
+        speculated) and the whole run must match overlap-off
+        bit-for-bit."""
+        from test_chaos import _round_cfg, _run_cell
+        runs = {}
+        for tag, overlap in (("off", False), ("on", True)):
+            cfg = _round_cfg(tmp_path, tmp_path / f"rs_{tag}",
+                             global_rounds=3, clients=[1, 1],
+                             learning={"sync_overlap": overlap})
+            runs[tag] = _run_cell(cfg)
+        assert _bit_same_tree(runs["off"].params, runs["on"].params)
+        assert ([h.num_samples for h in runs["off"].history]
+                == [h.num_samples for h in runs["on"].history])
+        recs = _overlap_metrics(tmp_path / "rs_on")
+        assert recs and all(r["mode"] == "reseed" for r in recs)
+
+    def test_hold_rounds_splice_forwards_bit_identical(self, tmp_path):
+        """FLEX-style wire economy (periodic t-c=3/t-g=3): rounds 1-2
+        HOLD the shard, so the overlap speculates actual stale-seed
+        FORWARDS and round 2 splices them — still bit-identical to the
+        non-overlapped run, with at least one hold-mode overlap
+        record."""
+        from test_chaos import _round_cfg, _run_cell
+        runs = {}
+        for tag, overlap in (("off", False), ("on", True)):
+            cfg = _round_cfg(
+                tmp_path, tmp_path / f"hold_{tag}",
+                global_rounds=3, clients=[1, 1],
+                aggregation={"strategy": "periodic", "t_client": 3,
+                             "t_global": 3, "sda_size": 1,
+                             "sda_strict": False},
+                learning={"sync_overlap": overlap})
+            runs[tag] = _run_cell(cfg)
+        assert _bit_same_tree(runs["off"].params, runs["on"].params)
+        recs = _overlap_metrics(tmp_path / "hold_on")
+        assert any(r["mode"] == "hold" for r in recs), recs
+
+    def test_async_mode_keeps_aux_overlap(self, tmp_path):
+        """learning.mode: async keeps PR 10's aux-training overlap —
+        the sync speculation path must not hijack it."""
+        from test_chaos import _round_cfg
+        cfg = _round_cfg(tmp_path, tmp_path / "a",
+                         learning={"mode": "async",
+                                   "sync_overlap": True,
+                                   "optimizer": "adamw"},
+                         aggregation={"strategy": "fedavg",
+                                      "sda_strict": False,
+                                      "sda_size": 1})
+        c = ProtocolClient(cfg, "c0", 1, transport=InProcTransport())
+        c.runner = ShardRunner(cfg.model_key, 0, 2,
+                               dict(cfg.learning.__dict__),
+                               model_kwargs=dict(cfg.model_kwargs))
+        assert c._async_mode     # dispatch takes the async branch

@@ -443,6 +443,75 @@ def async_cell(tmp: str, seed: int = 11) -> tuple[bool, str]:
                   f"{wall_a:.0f}s/{wall_b:.0f}s)")
 
 
+def overlap_cell(tmp: str, seed: int = 13) -> tuple[bool, str]:
+    """Sync-overlap chaos cell (learning.sync-overlap): a 3-client
+    sync round with the round-boundary overlap ON, under drop +
+    duplicate + delay injection with the reliable layer masking.
+    PASSes iff
+
+    * the rounds complete without a barrier stall (bounded wall — the
+      overlap's speculative ticks hand any control frame back to the
+      lifecycle loop in arrival order, so nothing can park);
+    * the aggregated params are BIT-IDENTICAL to a fault-free,
+      overlap-OFF twin: the speculation (prefetch + stale-seed
+      forwards, spliced or discarded with rng/loader state restored)
+      must be invisible to training semantics even while chaos
+      reorders the wire around it;
+    * the overlap actually ran (kind=overlap records in the cell's
+      metrics).
+    """
+    import numpy as np
+
+    sys.path.insert(0, "tests")
+    from test_chaos import _chaos, _round_cfg, _run_cell  # noqa: E402
+
+    chaos = _chaos(seed=seed, drop=0.10, duplicate=0.10, delay=0.15,
+                   delay_s=0.02)
+    fc = FaultCounters()
+    cfg_c = _round_cfg(pathlib.Path(tmp),
+                       pathlib.Path(tmp) / "overlap_chaos",
+                       global_rounds=2,
+                       learning={"sync_overlap": True})
+    t0 = time.monotonic()
+    res_c = _run_cell(cfg_c, chaos_cfg=chaos, reliable=True, faults=fc)
+    wall = time.monotonic() - t0
+    cfg_b = _round_cfg(pathlib.Path(tmp),
+                       pathlib.Path(tmp) / "overlap_base",
+                       global_rounds=2)
+    res_b = _run_cell(cfg_b)
+    if not (res_c.history and all(h.ok for h in res_c.history)
+            and res_b.history and all(h.ok for h in res_b.history)):
+        return False, "round not ok"
+    if wall > 240:
+        return False, f"barrier stall ({wall:.0f}s)"
+    import jax
+    la = jax.tree_util.tree_leaves(res_c.params)
+    lb = jax.tree_util.tree_leaves(res_b.params)
+    if len(la) != len(lb) or any(
+            np.asarray(a).tobytes() != np.asarray(b).tobytes()
+            for a, b in zip(la, lb)):
+        return False, "overlap+chaos fold not bit-identical"
+    if [h.num_samples for h in res_c.history] \
+            != [h.num_samples for h in res_b.history]:
+        return False, "sample counts drifted"
+    import glob as _glob
+    import json as _json
+    n_ovl = 0
+    for p in _glob.glob(str(pathlib.Path(tmp) / "overlap_chaos"
+                            / "**" / "metrics.jsonl"), recursive=True):
+        for line in open(p):
+            try:
+                rec = _json.loads(line)
+            except _json.JSONDecodeError:
+                continue
+            if rec.get("kind") == "overlap":
+                n_ovl += 1
+    if not n_ovl:
+        return False, "no overlap activity recorded"
+    return True, (f"bit-identical through chaos "
+                  f"({n_ovl} overlap ticks records, {wall:.0f}s)")
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(
         description="Sweep fault probabilities over seeds; print a "
@@ -477,7 +546,28 @@ def main(argv=None):
                          "complete with no barrier stall, fold "
                          "deterministically (twin-seed bit-identity), "
                          "and count stale rejections exactly")
+    ap.add_argument("--overlap", dest="overlap_mode",
+                    action="store_true",
+                    help="run ONLY the sync-overlap cell: a 3-client "
+                         "sync round with learning.sync-overlap on "
+                         "under drop+dup+delay must stay bit-identical "
+                         "to a fault-free overlap-off twin with no "
+                         "barrier stall")
     args = ap.parse_args(argv)
+
+    if args.overlap_mode:
+        if args.artifacts_dir:
+            tmp = args.artifacts_dir
+            pathlib.Path(tmp).mkdir(parents=True, exist_ok=True)
+        else:
+            import tempfile
+            tmp = tempfile.mkdtemp(prefix="chaos_overlap_")
+        t0 = time.monotonic()
+        ok, note = overlap_cell(tmp)
+        dt = time.monotonic() - t0
+        print(f"overlap cell: {'PASS' if ok else 'FAIL'} ({note}) "
+              f"[{dt:.1f}s, artifacts in {tmp}]")
+        return 0 if ok else 1
 
     if args.async_mode:
         if args.artifacts_dir:

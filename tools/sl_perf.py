@@ -67,6 +67,21 @@ STABLE_KEYS = {
     "extra.async_samples_per_sec": "up",
     "extra.async_wall_ratio_vs_sync": "down",
     "extra.async_accuracy_delta": "up",
+    # sharded weight update + sync overlap (round-11): the serial
+    # round-boundary update wall per boundary, and the fraction of it
+    # hidden behind client compute
+    "extra.update_bubble_ms": "down",
+    "extra.update_overlap_ratio": "up",
+}
+
+#: absolute pins, enforced on the NEWEST record regardless of trend: a
+#: "down" key must stay <= its cap, an "up" key >= it.  The split
+#: ratio drifted 1.5 -> 2.1 across BENCH_r02-r05 while the
+#: trend-only gate read the torn driver tails as unparseable (the
+#: escaped-quote scavenge gap fixed below) — a pin cannot recalcify.
+STABLE_KEY_CAPS = {
+    "extra.split_ratio_vs_unsplit": 1.7,
+    "extra.update_overlap_ratio": 0.5,
 }
 
 #: attribution components of a kind=perf record, in report order
@@ -84,38 +99,45 @@ COMPONENTS = ("compute_s", "compile_s", "dispatch_s", "host_s",
 #: the gap the run-scoped bench.json artifact closes).  Only keys with
 #: globally unique spellings are scavenged; ambiguous ones (e.g. the
 #: many nested "samples_per_sec") are left to structured parses.
+#:
+#: The quotes match BOTH ``"key":`` and ``\"key\":`` — a driver tail
+#: embeds the payload as a JSON string, so every quote arrives
+#: backslash-escaped.  The round-11 split-ratio hunt found the old
+#: plain-quote patterns silently scavenged NOTHING from r02-r05,
+#: which is how a 1.5 -> 2.1 regression of a gated key calcified
+#: unseen.
 _NUM = r"(-?[0-9]+(?:\.[0-9]+)?(?:[eE][+-]?[0-9]+)?)"
+_Q = r'\\?"'
+
+
+def _kv_re(key: str, suffix: str = "") -> "re.Pattern":
+    return re.compile(_Q + key + _Q + r":\s*" + _NUM + suffix)
+
+
 _SCAVENGE_RES = {
-    "value": re.compile(r'"value":\s*' + _NUM
-                        + r',\s*"unit":\s*"samples/sec/chip"'),
-    "extra.protocol_samples_per_sec":
-        re.compile(r'"protocol_samples_per_sec":\s*' + _NUM),
-    "extra.split_ratio_vs_unsplit":
-        re.compile(r'"split_ratio_vs_unsplit":\s*' + _NUM),
-    "extra.cold_round_wall_s":
-        re.compile(r'"cold_round_wall_s":\s*' + _NUM),
-    "extra.wire_mb_per_round":
-        re.compile(r'"wire_mb_per_round":\s*' + _NUM),
-    "extra.wire_mb_per_round_compressed":
-        re.compile(r'"wire_mb_per_round_compressed":\s*' + _NUM),
+    "value": re.compile(_Q + "value" + _Q + r":\s*" + _NUM
+                        + r",\s*" + _Q + "unit" + _Q + r":\s*"
+                        + _Q + "samples/sec/chip"),
     "extra.per_device_hbm_gb.total_est":
-        re.compile(r'"per_device_hbm_gb":\s*\{[^{}]*"total_est":\s*'
-                   + _NUM),
-    "extra.mfu.mfu_vs_datasheet":
-        re.compile(r'"mfu_vs_datasheet":\s*' + _NUM),
-    "extra.mfu.measured_matmul_roofline_tflops":
-        re.compile(r'"measured_matmul_roofline_tflops":\s*' + _NUM),
-    "extra.agg_wall_per_client_ms":
-        re.compile(r'"agg_wall_per_client_ms":\s*' + _NUM),
-    "extra.agg_peak_tree_copies":
-        re.compile(r'"agg_peak_tree_copies":\s*' + _NUM),
-    "extra.async_samples_per_sec":
-        re.compile(r'"async_samples_per_sec":\s*' + _NUM),
-    "extra.async_wall_ratio_vs_sync":
-        re.compile(r'"async_wall_ratio_vs_sync":\s*' + _NUM),
-    "extra.async_accuracy_delta":
-        re.compile(r'"async_accuracy_delta":\s*' + _NUM),
+        re.compile(_Q + "per_device_hbm_gb" + _Q + r":\s*\{[^{}]*"
+                   + _Q + "total_est" + _Q + r":\s*" + _NUM),
+    # the split ratio has two spellings: the mirrored stable key and
+    # the in-section "ratio_vs_unsplit" older records carry
+    "extra.split_ratio_vs_unsplit":
+        re.compile(_Q + r"(?:split_)?ratio_vs_unsplit" + _Q
+                   + r":\s*" + _NUM),
 }
+for _k in ("protocol_samples_per_sec", "cold_round_wall_s",
+           "wire_mb_per_round", "wire_mb_per_round_compressed",
+           "mfu_vs_datasheet", "measured_matmul_roofline_tflops",
+           "agg_wall_per_client_ms", "agg_peak_tree_copies",
+           "async_samples_per_sec", "async_wall_ratio_vs_sync",
+           "async_accuracy_delta", "update_bubble_ms",
+           "update_overlap_ratio"):
+    _path = ("extra.mfu." + _k
+             if _k.startswith(("mfu_vs", "measured_matmul"))
+             else "extra." + _k)
+    _SCAVENGE_RES[_path] = _kv_re(_k)
 
 
 def _dig(d: dict, dotted: str):
@@ -182,18 +204,28 @@ def load_bench(path: str | pathlib.Path) -> dict | None:
     except (OSError, json.JSONDecodeError):
         return None
     payload = _extract_payload(rec)
-    if payload is not None:
-        return stable_values(payload)
     text = rec.get("tail") if isinstance(rec, dict) \
         and isinstance(rec.get("tail"), str) else raw
-    return scavenge_stable_values(text) or None
+    scavenged = scavenge_stable_values(text)
+    if payload is not None:
+        vals = stable_values(payload)
+        # scavenge fills keys the structured payload predates (e.g.
+        # r02's split ratio lived only inside its section before the
+        # mirrored stable key existed)
+        for k, v in scavenged.items():
+            vals.setdefault(k, v)
+        return vals or None
+    return scavenged or None
 
 
 def diff_bench(prev: dict, cur: dict,
                threshold: float = DEFAULT_THRESHOLD) -> dict:
     """Stable-key comparison of two flat maps: per-key old/new/
     relative change and a regression verdict.  ``regressions`` lists
-    the keys that worsened beyond the threshold."""
+    the keys that worsened beyond the threshold, plus any key whose
+    NEWEST value crosses its absolute pin (``STABLE_KEY_CAPS``) — a
+    pinned key fails even when the round-over-round trend is flat,
+    so a regression that slipped through once can never calcify."""
     keys = {}
     regressions = []
     for key, direction in STABLE_KEYS.items():
@@ -209,6 +241,21 @@ def diff_bench(prev: dict, cur: dict,
                      "regression": worse}
         if worse:
             regressions.append(key)
+    for key, cap in STABLE_KEY_CAPS.items():
+        new = cur.get(key)
+        if new is None:
+            continue
+        direction = STABLE_KEYS.get(key, "down")
+        pinned = new < cap if direction == "up" else new > cap
+        ent = keys.setdefault(key, {"old": prev.get(key), "new": new,
+                                    "change": None,
+                                    "direction": direction,
+                                    "regression": False})
+        ent["cap"] = cap
+        if pinned:
+            ent["regression"] = True
+            if key not in regressions:
+                regressions.append(key)
     return {"threshold": threshold, "keys": keys,
             "regressions": regressions}
 
@@ -297,6 +344,19 @@ def render_report(report: dict) -> str:
                                    for v, w in zip(row, widths)))
     else:
         lines.append("no kind=perf records found")
+    hist = report.get("bench_history")
+    if hist:
+        # stable-key trend across the given history (oldest..newest):
+        # the update-bubble / split-ratio trajectory at a glance
+        lines.append("")
+        lines.append("stable-key trend (oldest -> newest):")
+        seen_keys = sorted({k for b in hist for k in b})
+        for key in seen_keys:
+            vals = [(f"{b[key]:g}" if key in b else "-") for b in hist]
+            pin = (f"  [pin {'>=' if STABLE_KEYS.get(key) == 'up' else '<='}"
+                   f" {STABLE_KEY_CAPS[key]:g}]"
+                   if key in STABLE_KEY_CAPS else "")
+            lines.append(f"  {key}: " + " -> ".join(vals) + pin)
     diff = report.get("diff")
     if diff:
         lines.append("")
@@ -304,8 +364,14 @@ def render_report(report: dict) -> str:
                      f"{diff['threshold']:.0%}):")
         for key, d in sorted(diff["keys"].items()):
             mark = "REGRESSION" if d["regression"] else "ok"
+            change = ("" if d.get("change") is None
+                      else f"{d['change']:+.1%}, ")
+            cap = ""
+            if d.get("cap") is not None:
+                op = ">=" if d["direction"] == "up" else "<="
+                cap = f", pin {op} {d['cap']:g}"
             lines.append(f"  {key}: {d['old']} -> {d['new']} "
-                         f"({d['change']:+.1%}, want {d['direction']}) "
+                         f"({change}want {d['direction']}{cap}) "
                          f"[{mark}]")
         if not diff["keys"]:
             lines.append("  (no comparable stable keys)")
