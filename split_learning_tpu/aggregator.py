@@ -1,0 +1,7 @@
+"""``python -m split_learning_tpu.aggregator`` — standalone aggregator
+node entry (``aggregation.remote``, ``runtime/aggnode.py``)."""
+
+from split_learning_tpu.runtime.aggnode import main
+
+if __name__ == "__main__":
+    main()

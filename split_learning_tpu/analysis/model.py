@@ -48,7 +48,8 @@ from split_learning_tpu.analysis.findings import Finding
 
 CONTROL_KINDS = ("Register", "Ready", "Notify", "Update",
                  "Start", "Syn", "Pause", "Stop", "Heartbeat",
-                 "PartialAggregate")
+                 "PartialAggregate", "AggHello", "AggAssign",
+                 "AggFlush")
 DATA_KINDS = ("Activation", "Gradient", "EpochEnd")
 ALL_KINDS = CONTROL_KINDS + DATA_KINDS
 
@@ -82,6 +83,19 @@ SEND_RULES = frozenset({
     # folds the group and publishes one PartialAggregate to the root
     ("client", "aggregate", "Update"),
     ("aggregator", "rpc", "PartialAggregate"),
+    # multi-process aggregator tree (aggregation.remote / levels,
+    # runtime/aggnode.py): a standalone node announces itself for
+    # adoption and heartbeats like a client; the server assigns its
+    # groups and flushes it over its reply queue; interior levels
+    # relay partials through the parent group's aggregate queue — and
+    # the server's fallback publishes a SUBSTITUTE partial there when
+    # the child's aggregator died (runtime/server.py _flush_fallback)
+    ("aggregator", "rpc", "AggHello"),
+    ("aggregator", "rpc", "Heartbeat"),
+    ("server", "reply", "AggAssign"),
+    ("server", "reply", "AggFlush"),
+    ("aggregator", "aggregate", "PartialAggregate"),
+    ("server", "aggregate", "PartialAggregate"),
 })
 
 #: queue families each role may consume from.  The server's aggregate
@@ -93,6 +107,9 @@ RECV_RULES = frozenset({
     ("client", "reply"), ("client", "intermediate"),
     ("client", "gradient"),
     ("aggregator", "aggregate"),
+    # remote aggregator node: AggAssign/AggFlush/Stop on its reply
+    # queue (runtime/aggnode.py AggregatorNode.run)
+    ("aggregator", "reply"),
 })
 
 #: kinds legal on each DATA queue family (post-transport stream)
@@ -131,6 +148,10 @@ SERVER_FSM: dict[str, dict[tuple[str, str], str]] = {
         # and fold, staleness-weighted — at ANY point of the next
         # invocation, not just during the UPDATE barrier
         ("recv", "Update"): "starting",
+        # remote aggregator tree: group assignments fan out between
+        # the START fan-out and SYN (after the READY barrier narrowed
+        # the membership)
+        ("send", "AggAssign"): "starting",
         ("send", "Syn"): "running",
         ("send", "Stop"): "stopped",
     },
@@ -156,6 +177,11 @@ SERVER_FSM: dict[str, dict[tuple[str, str], str]] = {
         # stays open until the version cut)
         ("recv", "Ready"): "pausing",
         ("send", "Syn"): "pausing",
+        # remote aggregator tree: the server releases straggler-held
+        # nodes (AggFlush) and, when a child aggregator died, publishes
+        # the fallback's SUBSTITUTE partial into the parent's queue
+        ("send", "AggFlush"): "pausing",
+        ("send", "PartialAggregate"): "pausing",
         ("send", "Start"): "starting",   # next invocation / cluster
         ("send", "Stop"): "stopped",
     },
@@ -184,10 +210,26 @@ AGGREGATOR_FSM: dict[str, dict[tuple[str, str], str]] = {
     "idle": {
         ("recv", "Update"): "idle",
         ("send", "PartialAggregate"): "flushed",
+        # remote aggregator node (runtime/aggnode.py): adoption hello,
+        # per-round assignment, child partials at interior levels
+        ("send", "AggHello"): "idle",
+        ("recv", "AggAssign"): "idle",
+        ("recv", "AggFlush"): "idle",
+        ("recv", "PartialAggregate"): "idle",
+        ("recv", "Stop"): "stopped",
     },
     "flushed": {
         ("recv", "Update"): "flushed",
         ("send", "PartialAggregate"): "flushed",
+        ("send", "AggHello"): "flushed",
+        # the next invocation's assignment re-arms the node
+        ("recv", "AggAssign"): "idle",
+        ("recv", "AggFlush"): "flushed",
+        ("recv", "PartialAggregate"): "flushed",
+        ("recv", "Stop"): "stopped",
+    },
+    "stopped": {
+        ("recv", "Stop"): "stopped",
     },
 }
 
@@ -245,7 +287,13 @@ CLIENT_FSM: dict[str, dict[tuple[str, str], str]] = {
 # any transition (runtime/telemetry.py).
 for _state, _transitions in SERVER_FSM.items():
     _transitions[("recv", "Heartbeat")] = _state
+    # AggHello is lifecycle-orthogonal too: a node process may start
+    # (or reconnect-and-rehello) at any point of the server's round
+    _transitions[("recv", "AggHello")] = _state
 for _state, _transitions in CLIENT_FSM.items():
+    _transitions[("send", "Heartbeat")] = _state
+for _state, _transitions in AGGREGATOR_FSM.items():
+    # remote nodes heartbeat from a background thread, any state
     _transitions[("send", "Heartbeat")] = _state
 
 FSM_BY_ROLE = {"server": SERVER_FSM, "client": CLIENT_FSM,

@@ -299,7 +299,19 @@ def _sample_messages():
             stat_sums={"m": np.ones((2,), np.float32)},
             stat_weight=3.0, stat_dtypes={"m": "float32"},
             n_samples=12, members=[{"client_id": "c", "stage": 1,
-                                    "num_samples": 12, "ok": True}]),
+                                    "num_samples": 12, "ok": True}],
+            level=2, codec="int8:64", codec_base=3),
+        "AggHello": P.AggHello(node_id="aggregator_node_0",
+                               capacity=4),
+        "AggAssign": P.AggAssign(
+            node_id="aggregator_node_0", cluster=0, gen=3,
+            round_idx=1,
+            groups=[{"idx": 0, "stage": 1, "level": 1,
+                     "members": ["c"], "parent": 2}],
+            deadline_s=30.0, codec="delta:int8:64",
+            bases={1: {"w": np.ones((4,), np.float32)}},
+            chunk_bytes=1 << 20),
+        "AggFlush": P.AggFlush(node_id="aggregator_node_0", gen=3),
         "Activation": P.Activation(
             data_id="d0", data=np.ones((2, 3), np.float32),
             labels=np.zeros((2,), np.int64), trace=["c"], cluster=0),
@@ -456,7 +468,8 @@ def _check_handlers(root: pathlib.Path) -> list[Finding]:
     }
     must_handle = {"client": {"Start", "Syn", "Pause", "Stop"},
                    "server": {"Register", "Ready", "Notify", "Update",
-                              "Heartbeat", "PartialAggregate"}}
+                              "Heartbeat", "PartialAggregate",
+                              "AggHello"}}
     for role in ("client", "server"):
         rel = f"split_learning_tpu/runtime/{role}.py"
         tree = ast.parse((root / rel).read_text())

@@ -32,8 +32,15 @@ def main(argv=None):
     args = ap.parse_args(argv)
 
     if args.max_frame_gb is not None:
-        from split_learning_tpu.runtime import bus
+        from split_learning_tpu.runtime import bus, protocol
         bus.MAX_FRAME_BYTES = int(args.max_frame_gb * (1 << 30))
+        # the chunked twin lives at the ENDPOINTS: reassembly happens
+        # in each server/client/aggregator process's FrameAssembler,
+        # which this process cannot reach — set SLT_MAX_ASSEMBLED_GB
+        # in those processes' environments to lower it there (counted
+        # oversize_frames).  Lowered here too for a broker-hosted
+        # server (--broker in the server process).
+        protocol.MAX_ASSEMBLED_BYTES = bus.MAX_FRAME_BYTES
         if not args.python:
             print("--max-frame-gb: native broker does not enforce the "
                   "cap; using the Python broker")

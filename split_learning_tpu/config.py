@@ -272,6 +272,25 @@ class AggregationConfig:
     # direct-to-root.  An L1 that dies mid-round degrades to a counted
     # direct-to-root fallback drain.
     fan_in: int = 0
+    # Tree depth (runtime/aggregate.py plan_tree): 1 = the classic
+    # clients -> L1 -> root shape; >= 2 adds interior levels that
+    # re-fold their children's PartialAggregates (sums of sums with
+    # total weight — any depth divides exactly once at the root) so
+    # the ROOT's fan-in stays constant at 10k+ clients too.  Stages
+    # whose population already fits one group are not wrapped again.
+    levels: int = 1
+    # Run the aggregator tree in standalone AGGREGATOR PROCESSES
+    # (runtime/aggnode.py, tools/sl_aggregator.py) adopted over the
+    # broker instead of server threads: nodes announce with AggHello,
+    # receive per-round AggAssign group assignments, heartbeat like
+    # clients (FleetMonitor `lost` — or child-process exit — triggers
+    # the same counted direct-to-root fallback drain an in-proc L1
+    # death does).  False (default): thread-mode L1s, unchanged.
+    remote: bool = False
+    # With remote: the number of aggregator subprocesses the SERVER
+    # spawns at startup (tcp transport only).  0 = adopt externally
+    # started nodes (`python -m split_learning_tpu.aggregator`).
+    nodes: int = 0
     # Run the running sum + FedAvg divide + server optimizer step as
     # jitted ops on arrays sharded across the server's device mesh
     # (MeshFoldBackend) instead of replicated host numpy trees.
@@ -310,6 +329,17 @@ class AggregationConfig:
         _check(not self.fan_in or self.streaming,
                "aggregation.fan-in requires aggregation.streaming "
                "(the root folds PartialAggregates incrementally)")
+        _check(1 <= self.levels <= 4,
+               f"aggregation.levels must be in 1..4, got {self.levels!r}")
+        _check(self.levels == 1 or self.fan_in,
+               "aggregation.levels > 1 requires aggregation.fan-in "
+               "(the tree is built from fan-in groups)")
+        _check(not self.remote or self.fan_in,
+               "aggregation.remote requires aggregation.fan-in "
+               "(remote nodes serve fan-in groups)")
+        _check(self.nodes >= 0, "aggregation.nodes must be >= 0")
+        _check(not self.nodes or self.remote,
+               "aggregation.nodes requires aggregation.remote")
         _check(0.0 <= self.server_momentum < 1.0,
                f"aggregation.server-momentum must be in [0, 1), "
                f"got {self.server_momentum!r}")
@@ -649,6 +679,12 @@ class Config:
                    "aggregator tree yet (L1 groups generation-fence "
                    "Updates before the admission window) — set "
                    "aggregation.fan-in: 0")
+        if self.aggregation.nodes:
+            _check(self.transport.kind == "tcp",
+                   "aggregation.nodes (server-spawned aggregator "
+                   "subprocesses) requires transport.kind: tcp — "
+                   "in-process deployments adopt AggregatorNode "
+                   "threads instead")
         if self.topology.mode == "manual":
             cuts = self.topology.cluster_cut_layers or (
                 self.topology.cut_layers,)

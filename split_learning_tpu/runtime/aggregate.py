@@ -64,6 +64,21 @@ streaming, hierarchical, optionally mesh-sharded fold:
   fetch + START encode overlap stage k+1's device compute, the
   per-shard pipelining that (with the clients' ``learning.sync-overlap``
   ticks) hides the round-boundary update wall.
+
+* **multi-level, multi-process tree** (``aggregation.levels`` /
+  ``aggregation.remote``): :func:`plan_tree` generalizes the fan-in
+  grouping recursively — interior groups fold their children's
+  PartialAggregates (sums of sums with total weight, so any depth
+  divides exactly once at the root), and every group's input is
+  simply ``aggregate_queue(cluster, idx)`` (indices globally unique
+  across levels).  :class:`L1Aggregator` serves any level; with
+  ``aggregation.remote`` the same fold logic runs inside standalone
+  aggregator processes (``runtime/aggnode.py``,
+  ``tools/sl_aggregator.py``) adopted over the broker, with liveness
+  via the HEARTBEAT/FleetMonitor plane and the counted direct-to-root
+  fallback drain on node death.  The partial-sum wire optionally
+  compresses through the ``partial`` codec family
+  (``runtime/codec/partial.py``).
 """
 
 from __future__ import annotations
@@ -816,8 +831,58 @@ def plan_fanin_groups(active: list, fan_in: int) -> list:
     return groups
 
 
+def plan_tree(active: list, fan_in: int, levels: int = 1) -> list:
+    """:func:`plan_fanin_groups` generalized to a recursive tree
+    (``aggregation.levels``): level-1 groups fold ≤ ``fan_in`` client
+    Updates; each higher level folds ≤ ``fan_in`` child-group
+    PARTIALS (sums of sums, total weight carried, so any depth still
+    divides exactly once at the root).  Group indices are globally
+    unique across levels — a group's input queue is simply
+    ``aggregate_queue(cluster, idx)`` whatever its level.  A stage
+    whose level-k population is already a single group is NOT wrapped
+    again (a one-child interior node would add a hop for nothing), so
+    such a group stays parentless (``parent is None`` = publish to
+    the root's rpc queue).  Returns every group of every level,
+    canonical order within each level.
+    """
+    groups = plan_fanin_groups(active, fan_in)
+    gi = len(groups)
+    tier = groups
+    for _ in range(2, levels + 1):
+        by_stage: dict[int, list] = {}
+        for g in tier:
+            by_stage.setdefault(g.stage, []).append(g)
+        nxt: list[AggGroup] = []
+        for s in sorted(by_stage):
+            kids = sorted(by_stage[s], key=lambda g: g.idx)
+            if len(kids) <= 1:
+                continue   # nothing to reduce at this stage
+            for i in range(0, len(kids), fan_in):
+                chunk = kids[i:i + fan_in]
+                parent = AggGroup(
+                    idx=gi, stage=s,
+                    members=[c.key for c in chunk],
+                    level=chunk[0].level + 1)
+                gi += 1
+                for c in chunk:
+                    c.parent = parent.idx
+                nxt.append(parent)
+        if not nxt:
+            break
+        groups += nxt
+        tier = nxt
+    return groups
+
+
+def root_groups(groups: list) -> list:
+    """The parentless groups — whose PartialAggregates land at the
+    server root (canonical order: level then idx)."""
+    return sorted((g for g in groups if g.parent is None),
+                  key=lambda g: g.idx)
+
+
 def group_key(idx: int) -> str:
-    """Canonical root-fold key of L1 group ``idx`` (zero-padded so
+    """Canonical fold key of aggregator group ``idx`` (zero-padded so
     lexicographic order == numeric order)."""
     return f"g{idx:05d}"
 
@@ -826,11 +891,28 @@ def group_key(idx: int) -> str:
 class AggGroup:
     idx: int
     stage: int
-    members: list
+    members: list               # client ids (level 1) or child keys
+    level: int = 1
+    parent: int | None = None   # parent group idx; None = root child
 
     @property
     def key(self) -> str:
         return group_key(self.idx)
+
+    def as_dict(self) -> dict:
+        """Wire form for :class:`~split_learning_tpu.runtime.protocol
+        .AggAssign` (plain builtins — the restricted unpickler's
+        vocabulary stays closed)."""
+        return {"idx": self.idx, "stage": self.stage,
+                "members": list(self.members), "level": self.level,
+                "parent": self.parent}
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "AggGroup":
+        return cls(idx=int(d["idx"]), stage=int(d["stage"]),
+                   members=list(d.get("members") or []),
+                   level=int(d.get("level", 1)),
+                   parent=d.get("parent"))
 
 
 # --------------------------------------------------------------------------
@@ -839,8 +921,17 @@ class AggGroup:
 
 class L1Aggregator(threading.Thread):
     """One aggregator-tree interior node: drains its group's
-    ``aggregate_queue``, folds member Updates in canonical member order,
-    and publishes one PartialAggregate to the server's rpc queue.
+    ``aggregate_queue``, folds its members in canonical member order,
+    and publishes one PartialAggregate to ``out_queue`` — the server's
+    rpc queue for a parentless group, the parent group's aggregate
+    queue below an L2 (``aggregation.levels``).  A level-1 node folds
+    client Updates; a level ≥ 2 node folds its children's
+    PartialAggregates (sums of sums, total weight carried).
+
+    ``codec`` (a ``transport.codec: partial`` spec) compresses the
+    published sums (``runtime/codec/partial.py``); ``base``/
+    ``base_gen`` are the stage's START shard for the delta mode — an
+    interior node uses the same base to DECODE codec'd child partials.
 
     Flushes when every expected member has folded, on
     :meth:`request_flush` (the server gave up on stragglers), or at
@@ -854,7 +945,8 @@ class L1Aggregator(threading.Thread):
     def __init__(self, bus, *, cluster: int, group: AggGroup,
                  members: list, gen: int, deadline: float,
                  log=None, faults=None, chunk_bytes: int | None = None,
-                 owns_bus: bool = False):
+                 owns_bus: bool = False, out_queue: str = RPC_QUEUE,
+                 codec=None, base=None, base_gen: int | None = None):
         self.agg_id = f"aggregator_{cluster}_{group.idx}"
         super().__init__(daemon=True, name=self.agg_id)
         self.bus = bus
@@ -872,9 +964,24 @@ class L1Aggregator(threading.Thread):
         self.faults = faults
         self.chunk_bytes = chunk_bytes
         self.owns_bus = owns_bus
+        self.out_queue = out_queue
+        self.codec = codec
+        self.base = base
+        self.base_gen = base_gen
         self.flushed = False
         self._flush = threading.Event()
         self._kill = threading.Event()
+        # per-group fold state lives on the INSTANCE so a standalone
+        # aggregator node (runtime/aggnode.py) can drive the same
+        # object directly — feed_raw()/publish() without start()ing
+        # the thread — and the thread run loop is just a driver
+        self.fold = StreamingFold({self.group.stage: self.members},
+                                  faults=self.faults)
+        self.asm = FrameAssembler(faults=self.faults)
+        self.meta: list[dict] = []
+        self.seen: set = set()
+        self.ingress_bytes = 0
+        self.egress_bytes = 0
 
     def request_flush(self) -> None:
         self._flush.set()
@@ -883,26 +990,27 @@ class L1Aggregator(threading.Thread):
         """Die without flushing (tests: the L1-failure path)."""
         self._kill.set()
 
+    @property
+    def complete(self) -> bool:
+        return self.seen >= set(self.members)
+
+    @property
+    def queue(self) -> str:
+        return aggregate_queue(self.cluster, self.group.idx)
+
     def run(self) -> None:
-        fold = StreamingFold({self.group.stage: self.members},
-                             faults=self.faults)
-        asm = FrameAssembler()
-        meta: list[dict] = []
-        seen: set = set()
         try:
             while True:
                 if self._kill.is_set() \
                         or self.agg_id in L1Aggregator.TEST_KILL:
                     return   # died mid-round: the server's fallback
                     # drains the queue direct-to-root
-                q = aggregate_queue(self.cluster, self.group.idx)
-                raw = self.bus.get(q, timeout=0.2)
+                raw = self.bus.get(self.queue, timeout=0.2)
                 if raw is not None:
-                    self._feed(raw, asm, fold, seen, meta)
-                done = seen >= set(self.members)
-                if done or self._flush.is_set() \
+                    self.feed_raw(raw)
+                if self.complete or self._flush.is_set() \
                         or time.monotonic() >= self.deadline:
-                    self._publish(fold, meta)
+                    self.publish()
                     return
         finally:
             if self.owns_bus:
@@ -911,10 +1019,10 @@ class L1Aggregator(threading.Thread):
                 except Exception:  # noqa: BLE001 — teardown best-effort
                     pass
 
-    def _feed(self, raw: bytes, asm: FrameAssembler, fold: StreamingFold,
-              seen: set, meta: list) -> None:
+    def feed_raw(self, raw: bytes) -> None:
+        self.ingress_bytes += len(raw)
         try:
-            msg = asm.feed(raw)
+            msg = self.asm.feed(raw)
         except Exception as e:  # noqa: BLE001 — one corrupt frame must
             # cost one message, not the aggregator
             self.faults.inc("corrupt_rejected")
@@ -922,25 +1030,91 @@ class L1Aggregator(threading.Thread):
                 self.log.warning(f"{self.agg_id}: dropping undecodable "
                                  f"frame: {e}")
             return
-        if msg is None or not isinstance(msg, Update):
+        if msg is None:
             return
+        if isinstance(msg, Update) and self.group.level == 1:
+            self._feed_update(msg)
+        elif isinstance(msg, PartialAggregate) and self.group.level > 1:
+            self._feed_partial(msg)
+
+    def _feed_update(self, msg: Update) -> None:
         if msg.round_idx != self.gen:
             self.faults.inc("agg_stale_drops")
             return
-        if msg.client_id in seen:
+        if msg.client_id in self.seen:
             self.faults.inc("agg_dup_drops")
             return
-        seen.add(msg.client_id)
-        fold.add_update(msg)
-        meta.append({"client_id": msg.client_id, "stage": msg.stage,
-                     "num_samples": msg.num_samples, "ok": msg.ok,
-                     "telemetry": msg.telemetry})
+        self.seen.add(msg.client_id)
+        self.fold.add_update(msg)
+        self.meta.append(
+            {"client_id": msg.client_id, "stage": msg.stage,
+             "num_samples": msg.num_samples, "ok": msg.ok,
+             "telemetry": msg.telemetry})
         if self.log is not None:
             self.log.received(f"UPDATE {msg.client_id} (L1 fold)")
 
-    def _publish(self, fold: StreamingFold, meta: list) -> None:
-        stages, n_samples = fold.partial()
+    def _feed_partial(self, msg: PartialAggregate) -> None:
+        """Interior-level ingest: one child group's partial, dedup'd on
+        its key like a level-1 member Update — the at-least-once wire
+        must not double-weight a whole group either."""
+        if msg.round_idx != self.gen:
+            self.faults.inc("agg_stale_drops")
+            return
+        key = group_key(msg.group)
+        if key in self.seen:
+            self.faults.inc("agg_dup_drops")
+            return
+        if msg.codec or msg.members_z:
+            from split_learning_tpu.runtime.codec.partial import (
+                PartialCodecError, decode_partial_msg,
+            )
+            try:
+                decode_partial_msg(
+                    msg, bases={msg.stage: self.base},
+                    base_gen=self.base_gen)
+            except PartialCodecError as e:
+                self.faults.inc("partial_codec_errors")
+                if self.log is not None:
+                    self.log.warning(f"{self.agg_id}: dropping "
+                                     f"undecodable partial: {e}")
+                return
+        self.seen.add(key)
+        self.fold.add_partial(msg.stage, key, msg.sums, msg.weight,
+                              msg.dtypes, stat_sums=msg.stat_sums,
+                              stat_weight=msg.stat_weight,
+                              stat_dtypes=msg.stat_dtypes,
+                              n_samples=msg.n_samples)
+        self.meta.extend(msg.members or [])
+        if self.log is not None:
+            self.log.received(f"PARTIALAGGREGATE {msg.aggregator_id} "
+                              f"(L{self.group.level} fold)")
+
+    def publish(self) -> int:
+        """Flush: one PartialAggregate (codec'd when configured) to
+        ``out_queue``; returns the published wire bytes.  Idempotent —
+        a second call is a no-op (0 bytes)."""
+        if self.flushed:
+            return 0
+        stages, n_samples = self.fold.partial()
         ent = stages.get(self.group.stage, {})
+        codec_s = codec_base = members_z = None
+        members = self.meta
+        if self.codec is not None:
+            if ent.get("sums"):
+                from split_learning_tpu.runtime.codec.partial import (
+                    encode_partial_entry,
+                )
+                ent, codec_s, codec_base = encode_partial_entry(
+                    ent, self.codec, base=self.base,
+                    base_gen=self.base_gen, faults=self.faults)
+            # the member metadata is the OTHER O(clients) term of a
+            # root partial's bytes — pack it with the sums
+            from split_learning_tpu.runtime.protocol import (
+                pack_members,
+            )
+            members_z = pack_members(members)
+            if members_z is not None:
+                members = None
         msg = PartialAggregate(
             aggregator_id=self.agg_id, cluster=self.cluster,
             group=self.group.idx, stage=self.group.stage,
@@ -949,22 +1123,29 @@ class L1Aggregator(threading.Thread):
             dtypes=ent.get("dtypes"), stat_sums=ent.get("stat_sums"),
             stat_weight=float(ent.get("stat_weight") or 0.0),
             stat_dtypes=ent.get("stat_dtypes"), n_samples=n_samples,
-            members=meta)
+            members=members, level=self.group.level, codec=codec_s,
+            codec_base=codec_base, members_z=members_z)
+        nbytes = 0
         for part in encode_parts(msg, self.chunk_bytes):
-            self.bus.publish(RPC_QUEUE, part)  # slcheck: wire=PartialAggregate
+            self.bus.publish(self.out_queue, part)  # slcheck: wire=PartialAggregate
+            nbytes += len(part)
+        self.egress_bytes += nbytes
         self.flushed = True
         if self.log is not None:
-            self.log.sent(f"PARTIALAGGREGATE members={len(meta)}/"
+            self.log.sent(f"PARTIALAGGREGATE members={len(self.meta)}/"
                           f"{len(self.members)}")
+        return nbytes
 
 
 def drain_group_queue(bus, cluster: int, group_idx: int, gen: int,
                       assembler: FrameAssembler, faults,
-                      log=None) -> list[Update]:
-    """Direct-to-root fallback: drain whatever a dead (or flushed) L1's
-    queue currently holds and return the fresh-generation Updates, so
-    the root can fold the members itself."""
-    out: list[Update] = []
+                      log=None) -> list:
+    """Direct-to-root fallback: drain whatever a dead (or flushed)
+    aggregator's queue currently holds and return the fresh-generation
+    messages — member Updates for a level-1 group, child
+    PartialAggregates for an interior one — so the root can fold the
+    members itself."""
+    out: list = []
     while True:
         q = aggregate_queue(cluster, group_idx)
         raw = bus.get(q, timeout=0.0)
@@ -977,7 +1158,7 @@ def drain_group_queue(bus, cluster: int, group_idx: int, gen: int,
             if log is not None:
                 log.warning(f"fallback drain: undecodable frame: {e}")
             continue
-        if msg is None or not isinstance(msg, Update):
+        if msg is None or not isinstance(msg, (Update, PartialAggregate)):
             continue
         if msg.round_idx != gen:
             faults.inc("agg_stale_drops")

@@ -1,0 +1,205 @@
+"""Codec'd partial sums — the aggregator tree's wire codec
+(``transport.codec: {partial: "int8:64"}`` / ``"delta:int8:64"``).
+
+A :class:`~split_learning_tpu.runtime.protocol.PartialAggregate`
+carries one group's per-stage weighted f32 SUMS — at fleet scale the
+root's ingress is ``top_groups x stage_tree`` of raw fp32, the same
+bandwidth problem PR 6 attacked on the activation plane.  This codec
+compresses that leg the same way, host-side (aggregators never touch a
+device, so the :mod:`~split_learning_tpu.runtime.codec.quant` numpy
+twins apply):
+
+* the sender ships the group **mean** (``sums / weight``) instead of
+  the sums — bounded, parameter-scaled magnitudes that tile-quantize
+  well, where raw sums grow with the fold weight;
+* ``delta`` mode first subtracts the generation's START shard (the
+  base the server distributed in :class:`~split_learning_tpu.runtime
+  .protocol.AggAssign` and itself holds) — after one round of SGD the
+  group mean sits a small step from the base, so the int8 tiles spend
+  their range on the *training delta*;
+* the mean (or delta) is tiled-absmax quantized
+  (:func:`~split_learning_tpu.runtime.codec.quant.quantize_np`), and
+  the receiver reconstructs ``sums = (base? + dequant) * weight`` in
+  f32 before folding.
+
+Semantics preserved at every level:
+
+* **NaN propagation** — a non-finite tile ships a NaN scale
+  (counted ``quant_nonfinite``), dequantizes to NaN, and hits the fold
+  backend's ingest exactly like a NaN in a raw f32 partial would;
+* **dedup** — the codec is payload-only: group keys, member metadata
+  and the fold-level dup drops are untouched;
+* **self-description** — the frame's ``codec``/``codec_base`` fields
+  say how to decode, so a raw-f32 partial (codec off, the bit-parity
+  leg) and a codec'd one can share every consumer.  A delta partial
+  whose base the receiver does not hold is dropped and counted
+  (``partial_codec_errors``) — never mis-reconstructed.
+
+Batch-stat sums quantize WITHOUT the delta (running statistics drift
+away from the START base too fast for the delta to help, and plumbing
+a second base tree is not worth the bytes — they are a tiny fraction
+of the frame).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import numpy as np
+
+from split_learning_tpu.runtime.codec.quant import (
+    dequantize_leaf_np, quantize_np,
+)
+from split_learning_tpu.runtime.codec.specs import CodecSpec, parse_spec
+from split_learning_tpu.runtime.protocol import QuantLeaf
+
+
+class PartialCodecError(ValueError):
+    """A codec'd partial could not be reconstructed (unknown spec,
+    missing/mismatched delta base)."""
+
+
+def _flat_items(tree):
+    from split_learning_tpu.ops.fedavg import walk_items
+    return walk_items(tree)
+
+
+def _unflatten(flat):
+    from split_learning_tpu.ops.fedavg import unflatten_items
+    return unflatten_items(flat)
+
+
+def _resolve_spec(spec: CodecSpec | str) -> CodecSpec:
+    return parse_spec(spec) if isinstance(spec, str) else spec
+
+
+def spec_string(spec: CodecSpec) -> str:
+    """The self-describing wire form of a partial codec spec."""
+    if spec.kind == "delta":
+        return f"delta:{'int8' if spec.delta_dtype == 'int8' else 'bf16'}" \
+            + (f":{spec.tile}" if spec.delta_dtype == "int8" else "")
+    return f"{spec.kind}:{spec.tile}"
+
+
+def _quant_bits(spec: CodecSpec) -> int:
+    if spec.kind == "delta":
+        # delta:bf16 has no integer quantizer; callers guard on it
+        return 8
+    return spec.bits
+
+
+def encode_partial_entry(ent: dict, spec: CodecSpec | str,
+                         base: Any = None, base_gen: int | None = None,
+                         faults=None) -> tuple[dict, str, int | None]:
+    """Compress one ``StreamingFold.partial()`` stage entry in place
+    (a copy — the caller's entry is untouched).
+
+    Returns ``(entry, codec_string, codec_base)`` for the
+    PartialAggregate fields.  ``base`` is the stage's START shard tree
+    (delta mode); paths absent from it quantize plain."""
+    spec = _resolve_spec(spec)
+    delta = spec.kind == "delta"
+    if delta and spec.delta_dtype != "int8":
+        raise PartialCodecError(
+            "partial delta codec supports int8 payloads only "
+            f"(got {spec.delta_dtype!r})")
+    out = dict(ent)
+    base_flat = dict(_flat_items(base)) if (delta and base is not None) \
+        else {}
+    used_base = False
+    for sums_key, w_key in (("sums", "weight"),
+                            ("stat_sums", "stat_weight")):
+        sums = ent.get(sums_key)
+        w = float(ent.get(w_key) or 0.0)
+        if not sums or w == 0.0:
+            continue
+        flat: dict = {}
+        for path, leaf in _flat_items(sums):
+            a = np.asarray(leaf, np.float32)
+            mean = a / np.float32(w)
+            b = base_flat.get(path) if sums_key == "sums" else None
+            if b is not None and np.shape(b) == mean.shape:
+                mean = mean - np.asarray(b, np.float32)
+                used_base = True
+            q = quantize_np(mean, spec.tile, bits=_quant_bits(spec))
+            if not np.isfinite(np.asarray(q.scale)).all():
+                if faults is not None:
+                    faults.inc("quant_nonfinite")
+            flat[path] = q
+        out[sums_key] = _unflatten(flat)
+    return (out, spec_string(spec),
+            base_gen if (delta and used_base) else None)
+
+
+def decode_partial_entry(ent: dict, codec: str,
+                         codec_base: int | None = None,
+                         base: Any = None,
+                         base_gen: int | None = None) -> dict:
+    """Reconstruct f32 sums from a codec'd stage entry; raises
+    :class:`PartialCodecError` when the delta base is required but
+    missing or from a different generation — the caller counts
+    ``partial_codec_errors`` and drops the frame (a mis-reconstructed
+    fold would be silently wrong, the one outcome worse than a lost
+    partial)."""
+    spec = _resolve_spec(codec)
+    if codec_base is not None:
+        if base is None or base_gen != codec_base:
+            raise PartialCodecError(
+                f"delta partial against base gen {codec_base} but the "
+                f"receiver holds "
+                f"{'none' if base is None else f'gen {base_gen}'}")
+    base_flat = dict(_flat_items(base)) if (codec_base is not None
+                                            and base is not None) else {}
+    out = dict(ent)
+    for sums_key, w_key in (("sums", "weight"),
+                            ("stat_sums", "stat_weight")):
+        sums = ent.get(sums_key)
+        w = float(ent.get(w_key) or 0.0)
+        if not sums:
+            continue
+        flat: dict = {}
+        for path, leaf in _flat_items(sums):
+            if isinstance(leaf, QuantLeaf):
+                mean = dequantize_leaf_np(leaf)
+                b = base_flat.get(path) if sums_key == "sums" else None
+                if b is not None:
+                    if np.shape(b) != mean.shape:
+                        raise PartialCodecError(
+                            f"delta base shape {np.shape(b)} != "
+                            f"partial {mean.shape} at {path!r}")
+                    mean = mean + np.asarray(b, np.float32)
+                flat[path] = (mean * np.float32(w)).astype(np.float32)
+            else:
+                flat[path] = np.asarray(leaf, np.float32)
+        out[sums_key] = _unflatten(flat)
+    return out
+
+
+def msg_entry(msg) -> dict:
+    """The stage-entry view of a PartialAggregate's payload fields —
+    the shape both codec halves operate on."""
+    return {"sums": msg.sums, "weight": msg.weight,
+            "stat_sums": msg.stat_sums, "stat_weight": msg.stat_weight}
+
+
+def decode_partial_msg(msg, bases: dict | None = None,
+                       base_gen: int | None = None) -> None:
+    """Decode a PartialAggregate IN PLACE when it carries a codec
+    (no-op on raw f32 frames).  ``bases`` maps stage -> START shard
+    tree for the delta mode.  Packed member metadata
+    (``members_z``) is restored to the plain list first — it is the
+    other O(clients) term the codec compresses."""
+    if getattr(msg, "members_z", None):
+        from split_learning_tpu.runtime.protocol import unpack_members
+        msg.members = unpack_members(msg.members_z)
+        msg.members_z = None
+    if not msg.codec:
+        return
+    base = (bases or {}).get(msg.stage)
+    ent = decode_partial_entry(
+        msg_entry(msg), msg.codec, codec_base=msg.codec_base,
+        base=base, base_gen=base_gen)
+    msg.sums = ent["sums"]
+    msg.stat_sums = ent["stat_sums"]
+    msg.codec = None
+    msg.codec_base = None

@@ -35,27 +35,36 @@ from __future__ import annotations
 
 import dataclasses
 
-#: queue families a codec policy can target (the protocol's three
-#: tensor-framed planes: Activation, Gradient, Update)
-CODEC_FAMILIES = ("intermediate", "gradient", "rpc")
+#: queue families a codec policy can target (the protocol's four
+#: tensor-framed planes: Activation, Gradient, Update, and the
+#: aggregator tree's PartialAggregate sums)
+CODEC_FAMILIES = ("intermediate", "gradient", "rpc", "partial")
 
 #: codec kind -> FaultCounters names its runtime half may increment.
 #: The ``codec`` slcheck analyzer (CD001) holds every entry to the
 #: declared registries in ``runtime/trace.py`` — a codec minting an
 #: unregistered counter is a typo no dashboard would ever surface.
 CODEC_COUNTERS: dict[str, tuple] = {
-    "int8": ("quant_nonfinite",),
-    "int4": ("quant_nonfinite",),
+    # the partial family reuses the int8/int4/delta kinds
+    # (runtime/codec/partial.py): undecodable codec'd partials count
+    # partial_codec_errors at the receiving aggregator/root
+    "int8": ("quant_nonfinite", "partial_codec_errors"),
+    "int4": ("quant_nonfinite", "partial_codec_errors"),
     "topk": ("topk_dense_fallbacks",),
     "delta": ("delta_folds", "delta_full_frames", "delta_resyncs",
-              "quant_nonfinite"),
+              "quant_nonfinite", "partial_codec_errors"),
 }
 
-#: specs legal per family
+#: specs legal per family.  ``partial`` (the aggregator tree's
+#: PartialAggregate sums, ``runtime/codec/partial.py``) takes the
+#: tiled quantizers — the group MEAN ships as int8/int4 codes — or
+#: ``delta[:int8[:tile]]``: mean minus the generation's START shard
+#: (the base both endpoints hold), quantized.
 _FAMILY_KINDS = {
     "intermediate": ("int8", "int4"),
     "gradient": ("int8", "int4", "topk"),
     "rpc": ("delta",),
+    "partial": ("int8", "int4", "delta"),
 }
 
 DEFAULT_TILE = 256
@@ -164,5 +173,14 @@ def parse_codec_map(codec) -> dict[str, CodecSpec]:
                 f"codec {parsed.kind!r} is not valid for the "
                 f"{family!r} family (allowed: "
                 f"{'/'.join(_FAMILY_KINDS[family])})")
+        if family == "partial" and parsed.kind == "delta" \
+                and parsed.delta_dtype != "int8":
+            # the partial delta path is int8-only (the bf16 delta has
+            # no tiled quantizer); rejecting here keeps the runtime
+            # failure mode — an aggregator dying at flush AFTER
+            # consuming its members — out of reach of a legal config
+            raise CodecSpecError(
+                f"codec spec {spec!r}: the partial family's delta "
+                "form must be delta:int8[:tile]")
         out[family] = parsed
     return out

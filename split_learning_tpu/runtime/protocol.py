@@ -28,6 +28,7 @@ import collections
 import dataclasses
 import io
 import math
+import os
 import pickle
 import struct
 import uuid
@@ -189,20 +190,30 @@ class Stop:
 
 @dataclasses.dataclass
 class PartialAggregate:
-    """L1 aggregator → server (rpc queue): one aggregator-tree group's
-    folded contribution (``aggregation.fan-in``,
-    ``runtime/aggregate.py``).  Carries the group's per-path weighted
-    **sums** (f32, NOT averaged — the root continues the running fold
-    and divides once) plus the total weight, so tree depth never
-    changes how many divides touch the data.  ``members`` is the
-    per-client metadata the root needs for barrier bookkeeping and
-    fleet telemetry (client_id, stage, num_samples, ok, telemetry) —
-    the clients behind an L1 still count individually everywhere
-    except the fold itself.  ``round_idx`` carries the server's
-    invocation generation, same fence as Update."""
+    """Aggregator → its parent (rpc queue at the root, the parent
+    group's aggregate queue below it): one aggregator-tree group's
+    folded contribution (``aggregation.fan-in`` /
+    ``aggregation.levels``, ``runtime/aggregate.py``).  Carries the
+    group's per-path weighted **sums** (f32, NOT averaged — every
+    interior level continues the running fold and the root divides
+    once, so tree depth never changes how many divides touch the
+    data).  ``members`` is the per-client metadata the root needs for
+    barrier bookkeeping and fleet telemetry (client_id, stage,
+    num_samples, ok, telemetry) — the clients behind an aggregator
+    still count individually everywhere except the fold itself; an L2
+    node concatenates its children's member lists.  ``round_idx``
+    carries the server's invocation generation, same fence as Update.
+
+    ``codec``/``codec_base`` describe a compressed payload
+    (``transport.codec: {partial: ...}``, ``runtime/codec/partial.py``):
+    ``sums`` then holds tiled-int8 :class:`QuantLeaf` codes of the
+    group **mean** (optionally delta'd against the generation
+    ``codec_base`` START shard both endpoints hold), and the receiver
+    reconstructs f32 sums before folding.  None = raw f32 sums — the
+    bit-parity leg."""
     aggregator_id: str
     cluster: int
-    group: int                      # L1 group index (canonical position)
+    group: int                      # group index (canonical position)
     stage: int                      # the one stage this group covers
     round_idx: int = 0
     sums: Any = None                # pytree of f32 weighted sums
@@ -213,6 +224,85 @@ class PartialAggregate:
     stat_dtypes: Any = None
     n_samples: int = 0              # stage-1 samples folded (0 otherwise)
     members: list | None = None     # per-client {client_id, stage, ...}
+    level: int = 1                  # tree level that produced this
+    codec: str | None = None        # partial codec spec, None = raw f32
+    codec_base: int | None = None   # delta base generation, None = plain
+    # packed members (codec path only): at 10k clients the per-client
+    # member dicts dominate a root partial's bytes — zlib'd pickle
+    # (pack_members/unpack_members, ~10x on the repetitive id/key
+    # text) keeps the root ingress flat.  Exclusive with ``members``;
+    # decode_partial_msg restores the plain list.
+    members_z: bytes | None = None
+
+
+def pack_members(members: list | None) -> bytes | None:
+    """crc32-prefixed zlib'd pickle of a PartialAggregate member list
+    (the codec'd wire form — see ``PartialAggregate.members_z``)."""
+    if not members:
+        return None
+    body = zlib.compress(
+        pickle.dumps(members, protocol=pickle.HIGHEST_PROTOCOL), 6)
+    return struct.pack(">I", zlib.crc32(body)) + body
+
+
+def unpack_members(blob: bytes) -> list:
+    """Inverse of :func:`pack_members`: own crc checked BEFORE any
+    decompression/unpickling (the outer frame crc already covered
+    these bytes, but the blob also crosses aggregator levels — same
+    integrity-first discipline as every frame family), then the
+    restricted unpickler (member dicts are plain builtins; anything
+    else in the blob is rejected like any hostile frame payload)."""
+    if len(blob) < 4:
+        raise CorruptFrame("packed member list truncated")
+    (want,) = struct.unpack_from(">I", blob, 0)
+    body = blob[4:]
+    if zlib.crc32(body) != want:
+        raise CorruptFrame("packed member list checksum mismatch")
+    out = _SafeUnpickler(io.BytesIO(zlib.decompress(body))).load()
+    if not isinstance(out, list):
+        raise CorruptFrame(
+            f"packed member list decoded to {type(out).__name__}")
+    return out
+
+
+@dataclasses.dataclass
+class AggHello:
+    """aggregator node → server (rpc queue): a standalone aggregator
+    process announcing itself for adoption (``aggregation.remote``).
+    Re-sent on reconnect; liveness afterwards rides the node's
+    HEARTBEAT frames like any client's."""
+    node_id: str
+    capacity: int = 0               # informational (groups it can take)
+
+
+@dataclasses.dataclass
+class AggAssign:
+    """server → one aggregator node (its reply queue): the node's
+    group assignment for one train_cluster invocation.  ``groups`` is
+    a list of plain dicts ``{idx, stage, level, members, parent}``
+    (members are client ids at level 1, child group keys above;
+    ``parent`` is the parent group's index, None = publish to the
+    root's rpc queue).  ``bases`` carries the per-stage START shard
+    trees when the partial codec is delta-encoded — both endpoints
+    must hold the same base."""
+    node_id: str
+    cluster: int
+    gen: int                        # invocation generation fence
+    round_idx: int = 0
+    groups: list | None = None
+    deadline_s: float = 600.0       # forced-flush deadline from receipt
+    codec: str | None = None        # partial codec spec for publishes
+    bases: Any = None               # {stage: tree} delta bases
+    chunk_bytes: int | None = None  # partial chunking cap
+
+
+@dataclasses.dataclass
+class AggFlush:
+    """server → one aggregator node: flush every still-incomplete
+    group of generation ``gen`` now (the server gave up waiting on the
+    group's stragglers)."""
+    node_id: str = ""
+    gen: int = 0
 
 
 @dataclasses.dataclass
@@ -333,7 +423,8 @@ class _TensorRef:
 
 
 CONTROL_TYPES = (Register, Ready, Notify, Update, Start, Syn, Pause,
-                 Stop, Heartbeat, PartialAggregate)
+                 Stop, Heartbeat, PartialAggregate, AggHello, AggAssign,
+                 AggFlush)
 DATA_TYPES = (Activation, Gradient, EpochEnd)
 #: messages whose ndarray payloads ride the zero-copy TENSOR framing
 #: (the high-volume data plane + the round's weight uploads — Update
@@ -697,6 +788,23 @@ DEFAULT_CHUNK_BYTES = 512 << 20
 _CHUNK_HDR = 16 + 8 + 2        # uuid | u32 idx | u32 total | u16 ctx-len
 _MAX_CHUNKS = 1 << 16
 
+#: assembled-frame sanity cap, the chunked twin of the broker's
+#: per-frame cap (``runtime/bus.py MAX_FRAME_BYTES``): the broker
+#: checks each frame's length prefix, but an SLTC-chunked message is
+#: many legal frames whose ASSEMBLED size the broker never sees — a
+#: corrupt/hostile chunk stream could drive an arbitrarily large
+#: reassembly allocation.  Reassembly happens at the ENDPOINTS
+#: (server/client/aggregator processes), so the operable knob is the
+#: ``SLT_MAX_ASSEMBLED_GB`` env var set on each endpoint process —
+#: the broker's ``--max-frame-gb`` cannot reach their
+#: FrameAssemblers.  Exceeding the cap is a counted corrupt frame
+#: (``oversize_frames``), not a process death.
+try:
+    MAX_ASSEMBLED_BYTES = int(
+        float(os.environ.get("SLT_MAX_ASSEMBLED_GB", "8")) * (1 << 30))
+except ValueError:
+    MAX_ASSEMBLED_BYTES = 1 << 33
+
 
 def encode_parts(msg, max_bytes: int | None = None,
                  ctx: bytes = b"") -> list[bytes]:
@@ -740,18 +848,39 @@ class FrameAssembler:
     held — on an at-most-once transport a dropped chunk strands its
     message, and the stalest partial is evicted rather than leaking.
     Not thread-safe: give each consumer thread its own assembler (same
-    ownership rule as a transport connection)."""
+    ownership rule as a transport connection).
 
-    def __init__(self, max_pending: int = 64):
+    ``last_bytes`` holds the wire byte count of the most recently
+    COMPLETED message (all its chunks for an SLTC stream) — how a
+    consumer attributes ingress bytes to a decoded message without
+    re-measuring the chunk stream."""
+
+    def __init__(self, max_pending: int = 64, faults=None):
         self._max_pending = max_pending
+        self._faults = faults
+        self.last_bytes = 0
         self._pending: collections.OrderedDict = collections.OrderedDict()
         # mids whose partial was evicted: their LATE chunks must be
         # dropped, not allowed to recreate a can-never-complete partial
         # that would occupy a slot and evict further live messages
         self._evicted: collections.OrderedDict = collections.OrderedDict()
 
+    def _count_oversize(self) -> None:
+        if self._faults is None:
+            from split_learning_tpu.runtime.trace import (
+                default_fault_counters,
+            )
+            self._faults = default_fault_counters
+        self._faults.inc("oversize_frames")
+
     def feed(self, raw: bytes):
         if raw[:4] != CHUNK_MAGIC:
+            if len(raw) > MAX_ASSEMBLED_BYTES:
+                self._count_oversize()
+                raise CorruptFrame(
+                    f"frame of {len(raw)} bytes exceeds the "
+                    f"{MAX_ASSEMBLED_BYTES}-byte assembled cap")
+            self.last_bytes = len(raw)
             return decode(raw)
         if len(raw) < _HDR_LEN + _CHUNK_HDR:
             raise CorruptFrame(f"chunk frame truncated ({len(raw)} bytes)")
@@ -773,7 +902,7 @@ class FrameAssembler:
         ent = self._pending.get(mid)
         if ent is None:
             ent = self._pending[mid] = {"total": total, "parts": {},
-                                        "ctx": ctx}
+                                        "ctx": ctx, "bytes": 0}
             while len(self._pending) > self._max_pending:
                 dead, _ = self._pending.popitem(last=False)
                 self._evicted[dead] = True
@@ -781,10 +910,25 @@ class FrameAssembler:
                     self._evicted.popitem(last=False)
         if ent["total"] != total:
             raise CorruptFrame("chunk total mismatch within message")
-        ent["parts"].setdefault(idx, bytes(body[_CHUNK_HDR + ctx_len:]))
+        if idx not in ent["parts"]:
+            ent["parts"][idx] = bytes(body[_CHUNK_HDR + ctx_len:])
+            ent["bytes"] += len(raw)
+            # the broker's frame cap is per FRAME; a chunked message's
+            # ASSEMBLED size must honor the same bound or a legal chunk
+            # stream smuggles an arbitrarily large allocation past it
+            if ent["bytes"] > MAX_ASSEMBLED_BYTES:
+                del self._pending[mid]
+                self._evicted[mid] = True
+                self._count_oversize()
+                raise CorruptFrame(
+                    f"chunked message exceeds the "
+                    f"{MAX_ASSEMBLED_BYTES}-byte assembled cap "
+                    f"({ent['bytes']} bytes across "
+                    f"{len(ent['parts'])}/{total} chunks)")
         if len(ent["parts"]) < total:
             return None
         del self._pending[mid]
+        self.last_bytes = ent["bytes"]
         msg = decode(b"".join(ent["parts"][i] for i in range(total)))
         if ent["ctx"] and getattr(msg, "_ctx", None) is None:
             # chunked legacy frame: the chunk headers carried the only

@@ -46,9 +46,9 @@ from split_learning_tpu.runtime.plan import (
 )
 from split_learning_tpu.runtime import aggregate as agg_plane
 from split_learning_tpu.runtime.protocol import (
-    FrameAssembler, Heartbeat, Notify, PartialAggregate, Pause, Ready,
-    Register, Start, Stop, Syn, Update, encode, encode_parts,
-    reply_queue, RPC_QUEUE,
+    AggAssign, AggFlush, AggHello, FrameAssembler, Heartbeat, Notify,
+    PartialAggregate, Pause, Ready, Register, Start, Stop, Syn, Update,
+    encode, encode_parts, reply_queue, RPC_QUEUE,
 )
 from split_learning_tpu.runtime.spans import unpack_ctx
 from split_learning_tpu.runtime.telemetry import FleetMonitor, GaugeSet
@@ -158,6 +158,27 @@ class ProtocolContext(MeshContext):
         self._group_of: dict = {}      # client_id -> AggGroup (tree on)
         self._l1: list = []            # this invocation's L1Aggregators
         self._l1_fallback: dict = {}   # group idx -> fallback drain state
+        # multi-process aggregator tree (aggregation.remote,
+        # runtime/aggnode.py): adopted node registry (AggHello /
+        # spawned Popen handles), the current invocation's node ->
+        # groups assignment, nodes already declared dead this
+        # invocation, and the full tree plan by group idx
+        self._agg_nodes: dict = {}     # node_id -> {t, proc?}
+        self._l1_remote: dict = {}     # node_id -> [AggGroup]
+        self._dead_nodes: set = set()
+        self._tree_groups: dict = {}   # group idx -> AggGroup
+        self._tree_roots: list = []    # parentless groups (root children)
+        self._tree_narrowed: dict = {}   # group idx -> responsive members
+        self._cur_cluster = 0
+        self._agg_topology: dict | None = None   # /fleet view
+        # partial-sum codec (transport.codec: partial): the spec, and
+        # the per-stage START-base trees the delta mode reconstructs
+        # against (both endpoints hold the generation's base)
+        from split_learning_tpu.runtime.codec import parse_codec_map
+        self._partial_codec = parse_codec_map(
+            getattr(cfg.transport, "codec", None)).get("partial")
+        self._partial_bases: dict = {}
+        self._partial_base_gen: int | None = None
         # members of a dead L1's group whose UPDATE frames the L1
         # consumed before dying — unrecoverable, so the UPDATE barrier
         # stops waiting for them (counted agg_fallback_abandons)
@@ -339,14 +360,24 @@ class ProtocolContext(MeshContext):
             # for every fold-bound Update
             self._admit_update(msg)
         elif isinstance(msg, PartialAggregate):
-            # one L1 aggregator's folded group landing at the root
+            # one aggregator's folded group landing at the root
             if msg.round_idx != self._cur_gen:
                 self.faults.inc("agg_stale_drops")
                 self.log.warning(
                     f"stale PARTIALAGGREGATE {msg.aggregator_id} "
                     f"gen={msg.round_idx} (dropped)")
             else:
-                self._fold_partial(msg)
+                self._fold_partial(msg, nbytes=self._assembler.last_bytes)
+        elif isinstance(msg, AggHello):
+            # a standalone aggregator process offering itself for
+            # adoption (aggregation.remote); liveness afterwards rides
+            # its heartbeats through the FleetMonitor like a client's
+            ent = self._agg_nodes.setdefault(msg.node_id, {})
+            if "t" not in ent:
+                self.log.received(f"AGGHELLO {msg.node_id}")
+            ent["t"] = time.time()
+            if self.fleet is not None:
+                self.fleet.note_frame(msg.node_id)
         return True
 
     def _admit_update(self, msg: Update) -> None:
@@ -459,19 +490,39 @@ class ProtocolContext(MeshContext):
             self.gauges.set("agg_shadow_bytes",
                             self._delta_shadow.nbytes())
 
-    def _fold_partial(self, msg: PartialAggregate) -> None:
+    def _fold_partial(self, msg: PartialAggregate,
+                      nbytes: int = 0) -> None:
         """Fold one PartialAggregate at its group's canonical position
         and book its members: each one gets a weight-less Update record
         (barrier membership, ok flag, elastic liveness) and its
         piggybacked telemetry feeds the fleet monitor — clients behind
-        an L1 stay individually visible everywhere but the fold."""
+        an aggregator stay individually visible everywhere but the
+        fold.  A codec'd payload (transport.codec: partial) is
+        reconstructed to f32 sums first; one that cannot be (missing
+        delta base) is dropped and counted — the fallback machinery,
+        not a silently wrong fold, owns that group's fate."""
         if self._fold is None:
             self.log.warning(
                 f"PARTIALAGGREGATE {msg.aggregator_id} outside a "
                 "streaming invocation (dropped)")
             return
+        self._agg_ingress_bytes = (
+            getattr(self, "_agg_ingress_bytes", 0) + int(nbytes))
+        if msg.codec or msg.members_z:
+            from split_learning_tpu.runtime.codec.partial import (
+                PartialCodecError, decode_partial_msg,
+            )
+            try:
+                decode_partial_msg(msg, bases=self._partial_bases,
+                                   base_gen=self._partial_base_gen)
+            except PartialCodecError as e:
+                self.faults.inc("partial_codec_errors")
+                self.log.warning(
+                    f"PARTIALAGGREGATE {msg.aggregator_id}: "
+                    f"undecodable codec'd payload ({e}); dropped")
+                return
         # gen-fenced upstream (the pump drops stale PartialAggregates
-        # before this); L1 members are never stale-admitted
+        # before this); tree members are never stale-admitted
         self._fold.add_partial(  # slcheck: async-exempt
             msg.stage, agg_plane.group_key(msg.group), msg.sums,
             msg.weight, msg.dtypes, stat_sums=msg.stat_sums,
@@ -494,6 +545,94 @@ class ProtocolContext(MeshContext):
             f"PARTIALAGGREGATE {msg.aggregator_id} "
             f"members={len(msg.members or [])} weight={msg.weight:g}")
 
+    def _node_dead(self, node_id: str) -> bool:
+        """A remote aggregator node is dead when its spawned process
+        exited or the FleetMonitor marked it ``lost`` (no heartbeat
+        within observability.liveness-timeout) — the satellite fix for
+        the thread-liveness assumption: ``_poll_l1`` used to detect a
+        dead L1 via ``Thread.is_alive``, which a remote process has no
+        equivalent of."""
+        ent = self._agg_nodes.get(node_id) or {}
+        proc = ent.get("proc")
+        if proc is not None and proc.poll() is not None:
+            return True
+        return (self.fleet is not None
+                and self.fleet.state(node_id) == "lost")
+
+    def _spawn_l1_threads(self, plan, groups, narrowed: dict) -> None:
+        """Thread-mode aggregators (the default): one L1Aggregator
+        thread per group, any level.  Over TCP each gets its own
+        transport stack (a blocked get serializes a TcpTransport's
+        socket); in-proc they share the bus."""
+        l1_deadline = time.monotonic() + self.client_timeout
+        for g in groups:
+            agg_id = f"aggregator_{plan.cluster_id}_{g.idx}"
+            l1_bus, owns = self.bus, False
+            if self.cfg.transport.kind == "tcp":
+                from split_learning_tpu.runtime.chaos import (
+                    make_runtime_transport,
+                )
+                l1_bus = make_runtime_transport(
+                    self.cfg, agg_id, faults=self.faults)
+                owns = True
+            l1_log = self._l1_logs.get(agg_id)
+            if l1_log is None:
+                l1_log = self._l1_logs[agg_id] = Logger.for_run(
+                    self.cfg, agg_id, console=False)
+            out_q = (RPC_QUEUE if g.parent is None
+                     else agg_plane.aggregate_queue(plan.cluster_id,
+                                                    g.parent))
+            t = agg_plane.L1Aggregator(
+                l1_bus, cluster=plan.cluster_id, group=g,
+                members=narrowed[g.idx], gen=self._cur_gen,
+                deadline=l1_deadline, log=l1_log,
+                faults=self.faults,
+                chunk_bytes=self.cfg.transport.chunk_mb << 20,
+                owns_bus=owns, out_queue=out_q,
+                codec=self._partial_codec,
+                base=self._partial_bases.get(g.stage),
+                base_gen=self._partial_base_gen)
+            t.start()
+            self._l1.append(t)
+
+    def _dispatch_remote(self, plan, groups, narrowed: dict,
+                         node_ids: list, round_idx: int) -> None:
+        """Assign the tree's groups round-robin across the adopted
+        aggregator processes and send each node ONE AggAssign naming
+        its groups (and the delta-codec base trees, when configured).
+        The node folds exactly what a thread-mode L1 would — same
+        L1Aggregator objects, same queues — so the choreography and
+        determinism contracts carry over unchanged."""
+        codec_s = None
+        if self._partial_codec is not None:
+            from split_learning_tpu.runtime.codec.partial import (
+                spec_string,
+            )
+            codec_s = spec_string(self._partial_codec)
+        self._l1_remote = {nid: [] for nid in node_ids}
+        ordered = sorted(groups, key=lambda g: (g.level, g.idx))
+        for i, g in enumerate(ordered):
+            self._l1_remote[node_ids[i % len(node_ids)]].append(g)
+        for nid, glist in self._l1_remote.items():
+            wire_groups = []
+            for g in glist:
+                d = g.as_dict()
+                d["members"] = list(narrowed[g.idx])
+                wire_groups.append(d)
+            assign = AggAssign(
+                node_id=nid, cluster=plan.cluster_id,
+                gen=self._cur_gen, round_idx=round_idx,
+                groups=wire_groups, deadline_s=self.client_timeout,
+                codec=codec_s,
+                bases=(dict(self._partial_bases)
+                       if self._partial_bases else None),
+                chunk_bytes=self.cfg.transport.chunk_mb << 20)
+            for part in encode_parts(
+                    assign, self.cfg.transport.chunk_mb << 20):
+                self.bus.publish(reply_queue(nid), part)  # slcheck: wire=AggAssign
+            self.log.sent(f"AGGASSIGN -> {nid} "
+                          f"groups={len(wire_groups)}")
+
     #: liveness grace on a fallback drain: a dead L1 may have consumed
     #: a member's UPDATE frames before dying — those are unrecoverable,
     #: and the member (already in its post-round wait) will never
@@ -505,10 +644,13 @@ class ProtocolContext(MeshContext):
 
     def _poll_l1(self) -> None:
         """Aggregator-tree health check, run every UPDATE-barrier pump
-        iteration: an L1 that died without flushing degrades its group
-        to direct-to-root — the server drains the orphaned queue
-        itself and folds the members at the group's canonical
-        position, so tree rounds stay deterministic through L1 loss."""
+        iteration: a dead aggregator — a thread that is no longer
+        alive, or a REMOTE node whose spawned process exited or whose
+        heartbeats went FleetMonitor-``lost`` — degrades its groups to
+        direct-to-root: the server drains the orphaned queues itself
+        and folds each group at its canonical position, so tree rounds
+        stay deterministic through aggregator loss instead of stalling
+        a barrier."""
         for t in self._l1:
             if t.flushed:
                 continue
@@ -520,84 +662,256 @@ class ProtocolContext(MeshContext):
                 self.log.warning(
                     f"aggregator {t.agg_id} died mid-round; draining "
                     f"group {t.group.idx} direct-to-root")
-                fb = self._l1_fallback[t.group.idx] = {
-                    "group": t.group, "cluster": t.cluster,
-                    "members": set(t.members),
-                    "fold": agg_plane.StreamingFold(
-                        {t.group.stage: sorted(t.members)},
-                        faults=self.faults),
-                    "asm": FrameAssembler(), "seen": set(),
-                    "deadline": (time.monotonic()
-                                 + self.L1_FALLBACK_GRACE_S),
-                    "flushed": False}
-            if not fb["flushed"]:
-                self._drain_fallback(fb)
-            if (not fb["flushed"]
-                    and time.monotonic() >= fb["deadline"]):
-                gone = fb["members"] - fb["seen"]
-                for cid in sorted(gone):
-                    self.faults.inc("agg_fallback_abandons")
+                fb = self._start_fallback(t.group, t.cluster,
+                                          set(t.members))
+            self._step_fallback(fb)
+        for nid, glist in self._l1_remote.items():
+            if nid in self._dead_nodes:
+                for g in glist:
+                    fb = self._l1_fallback.get(g.idx)
+                    if fb is not None:
+                        self._step_fallback(fb)
+                continue
+            if not self._node_dead(nid):
+                continue
+            self._dead_nodes.add(nid)
+            self.faults.inc("agg_node_deaths")
+            self.log.warning(
+                f"aggregator node {nid} is dead (process exit or "
+                f"fleet-lost); draining its {len(glist)} group(s) "
+                "direct-to-root")
+            for g in glist:
+                if g.parent is None and self._fold is not None \
+                        and self._fold.has_key(g.stage, g.key):
+                    continue   # its partial already landed at the root
+                self.faults.inc("agg_l1_fallbacks")
+                members = set(self._tree_narrowed.get(g.idx,
+                                                      g.members))
+                fb = self._start_fallback(g, self._cur_cluster,
+                                          members)
+                self._step_fallback(fb)
+
+    def _start_fallback(self, group, cluster: int,
+                        members: set) -> dict:
+        fb = self._l1_fallback[group.idx] = {
+            "group": group, "cluster": cluster,
+            "members": set(members),
+            "fold": agg_plane.StreamingFold(
+                {group.stage: sorted(members)}, faults=self.faults),
+            "asm": FrameAssembler(faults=self.faults),
+            "seen": set(), "meta": [],
+            # parentless groups book members/sums straight into the
+            # root fold; groups under an interior parent publish a
+            # substitute PartialAggregate into the parent's queue
+            # instead (the parent's dedup absorbs the race where the
+            # aggregator had actually flushed before being declared
+            # dead) — booking BOTH ways would double-count members
+            "book_direct": group.parent is None,
+            "deadline": (time.monotonic()
+                         + self.L1_FALLBACK_GRACE_S),
+            "flushed": False}
+        return fb
+
+    def _children_draining(self, group) -> bool:
+        """True while any CHILD group of an interior ``group`` has an
+        unflushed fallback of its own: the child's drain will publish
+        a substitute partial into THIS group's queue, so flushing (or
+        abandoning) the parent now would strand members the child is
+        actively recovering.  Bounded — every child fallback's own
+        grace deadline abandons it eventually."""
+        if group.level == 1:
+            return False
+        return any(f["group"].parent == group.idx and not f["flushed"]
+                   for f in self._l1_fallback.values())
+
+    def _step_fallback(self, fb: dict) -> None:
+        if not fb["flushed"]:
+            self._drain_fallback(fb)
+        if not fb["flushed"] and time.monotonic() >= fb["deadline"]:
+            if self._children_draining(fb["group"]):
+                fb["deadline"] = (time.monotonic()
+                                  + self.L1_FALLBACK_GRACE_S)
+                return
+            gone_keys = fb["members"] - fb["seen"]
+            gone = self._member_clients(fb["group"], gone_keys)
+            for _ in sorted(gone):
+                self.faults.inc("agg_fallback_abandons")
+            if gone_keys:
                 self.log.warning(
                     f"fallback group {fb['group'].idx}: abandoning "
-                    f"UPDATE from {sorted(gone)} (dead aggregator "
-                    f"consumed their frames; folding "
+                    f"{sorted(gone_keys)} (dead aggregator consumed "
+                    f"their frames; folding "
                     f"{len(fb['seen'])}/{len(fb['members'])} members)")
-                self._agg_gone |= gone
-                self._flush_fallback(fb)
+            self._agg_gone |= gone
+            self._flush_fallback(fb)
+
+    def _member_clients(self, group, keys) -> set:
+        """The CLIENT ids behind a set of member keys — the ids
+        themselves at level 1, the flattened (narrowed) client
+        membership of the named child groups above it.  What the
+        UPDATE barrier stops waiting for when a fallback abandons."""
+        if group.level == 1:
+            return set(keys)
+        out: set = set()
+        by_key = {g.key: g for g in self._tree_groups.values()}
+        for key in keys:
+            child = by_key.get(key)
+            if child is not None:
+                out |= self._member_clients(
+                    child, self._tree_narrowed.get(child.idx,
+                                                   child.members))
+        return out
 
     def _drain_fallback(self, fb: dict) -> None:
         g = fb["group"]
-        for u in agg_plane.drain_group_queue(
+        for m in agg_plane.drain_group_queue(
                 self.bus, fb["cluster"], g.idx, self._cur_gen,
                 fb["asm"], self.faults, log=self.log):
-            if u.client_id in fb["seen"]:
-                self.faults.inc("agg_dup_drops")
-                continue
-            fb["seen"].add(u.client_id)
-            fb["deadline"] = (time.monotonic()
-                              + self.L1_FALLBACK_GRACE_S)
-            self._fold_update(u)   # delta reconstruction, like the pump
-            # drain_group_queue already gen-fenced this frame
-            fb["fold"].add_update(copy.copy(u))  # slcheck: async-exempt
-            u.params = None
-            u.batch_stats = None
-            if self.fleet is not None and u.telemetry:
-                self.fleet.note_heartbeat(u.client_id, u.telemetry)
-            self._updates.append(u)
-            self.log.received(f"UPDATE {u.client_id} (fallback drain)")
+            if isinstance(m, Update):
+                self._drain_fallback_update(fb, g, m)
+            else:
+                self._drain_fallback_partial(fb, g, m)
         if not fb["flushed"] and fb["seen"] >= fb["members"]:
             self._flush_fallback(fb)
 
+    def _drain_fallback_update(self, fb: dict, g, u: Update) -> None:
+        if g.level != 1 or u.client_id in fb["seen"]:
+            self.faults.inc("agg_dup_drops")
+            return
+        fb["seen"].add(u.client_id)
+        fb["deadline"] = time.monotonic() + self.L1_FALLBACK_GRACE_S
+        self._fold_update(u)   # delta reconstruction, like the pump
+        # drain_group_queue already gen-fenced this frame
+        fb["fold"].add_update(copy.copy(u))  # slcheck: async-exempt
+        fb["meta"].append({"client_id": u.client_id, "stage": u.stage,
+                           "num_samples": u.num_samples, "ok": u.ok,
+                           "telemetry": u.telemetry})
+        u.params = None
+        u.batch_stats = None
+        if self.fleet is not None and u.telemetry:
+            self.fleet.note_heartbeat(u.client_id, u.telemetry)
+        if fb["book_direct"]:
+            self._updates.append(u)
+        self.log.received(f"UPDATE {u.client_id} (fallback drain)")
+
+    def _drain_fallback_partial(self, fb: dict, g,
+                                m: PartialAggregate) -> None:
+        """A dead INTERIOR group's queue holds its children's
+        partials: recover them into the fallback sub-fold, keyed and
+        dedup'd exactly as the dead aggregator would have."""
+        key = agg_plane.group_key(m.group)
+        if g.level == 1 or key in fb["seen"]:
+            self.faults.inc("agg_dup_drops")
+            return
+        if m.codec or m.members_z:
+            from split_learning_tpu.runtime.codec.partial import (
+                PartialCodecError, decode_partial_msg,
+            )
+            try:
+                decode_partial_msg(m, bases=self._partial_bases,
+                                   base_gen=self._partial_base_gen)
+            except PartialCodecError as e:
+                self.faults.inc("partial_codec_errors")
+                self.log.warning(f"fallback drain: undecodable "
+                                 f"partial ({e}); dropped")
+                return
+        fb["seen"].add(key)
+        fb["deadline"] = time.monotonic() + self.L1_FALLBACK_GRACE_S
+        fb["fold"].add_partial(  # slcheck: async-exempt
+            m.stage, key, m.sums, m.weight, m.dtypes,
+            stat_sums=m.stat_sums, stat_weight=m.stat_weight,
+            stat_dtypes=m.stat_dtypes, n_samples=m.n_samples)
+        fb["meta"].extend(m.members or [])
+        for mm in m.members or []:
+            cid = mm.get("client_id")
+            if cid is None:
+                continue
+            if self.fleet is not None and mm.get("telemetry"):
+                self.fleet.note_heartbeat(cid, mm["telemetry"])
+            if fb["book_direct"]:
+                self._updates.append(Update(
+                    client_id=cid, stage=int(mm.get("stage", m.stage)),
+                    cluster=m.cluster, params=None, num_samples=0,
+                    ok=bool(mm.get("ok", True)),
+                    round_idx=m.round_idx))
+        self.log.received(
+            f"PARTIALAGGREGATE {m.aggregator_id} (fallback drain)")
+
     def _flush_fallback(self, fb: dict) -> None:
-        """Close a fallback group: its sub-fold's partial sums land at
-        the group's canonical root position — the same summation shape
-        the L1 would have produced."""
+        """Close a fallback group: its sub-fold's partial sums land
+        where the dead aggregator's would have — folded at the
+        group's canonical position in the root fold when parentless,
+        published as a substitute PartialAggregate into the parent's
+        queue otherwise (same summation shape either way)."""
         g = fb["group"]
         stages, n = fb["fold"].partial()
         ent = stages.get(g.stage)
-        if ent:
-            # members already gen-fenced at the drain
-            self._fold.add_partial(  # slcheck: async-exempt
-                g.stage, g.key, ent["sums"], ent["weight"],
-                ent["dtypes"], stat_sums=ent["stat_sums"],
-                stat_weight=ent["stat_weight"],
-                stat_dtypes=ent["stat_dtypes"], n_samples=n)
+        if fb["book_direct"]:
+            if ent:
+                # members already gen-fenced at the drain
+                self._fold.add_partial(  # slcheck: async-exempt
+                    g.stage, g.key, ent["sums"], ent["weight"],
+                    ent["dtypes"], stat_sums=ent["stat_sums"],
+                    stat_weight=ent["stat_weight"],
+                    stat_dtypes=ent["stat_dtypes"], n_samples=n)
+            else:
+                self._fold.drop(g.stage, g.key)
         else:
-            self._fold.drop(g.stage, g.key)
+            ent = ent or {}
+            msg = PartialAggregate(
+                aggregator_id=f"aggregator_{fb['cluster']}_{g.idx}",
+                cluster=fb["cluster"], group=g.idx, stage=g.stage,
+                round_idx=self._cur_gen, sums=ent.get("sums"),
+                weight=float(ent.get("weight") or 0.0),
+                dtypes=ent.get("dtypes"),
+                stat_sums=ent.get("stat_sums"),
+                stat_weight=float(ent.get("stat_weight") or 0.0),
+                stat_dtypes=ent.get("stat_dtypes"), n_samples=n,
+                members=fb["meta"], level=g.level)
+            q = agg_plane.aggregate_queue(fb["cluster"], g.parent)
+            chunk = self.cfg.transport.chunk_mb << 20
+            for part in encode_parts(msg, chunk):
+                self.bus.publish(q, part)  # slcheck: wire=PartialAggregate
+            self.log.sent(
+                f"PARTIALAGGREGATE (fallback substitute for group "
+                f"{g.idx} -> group {g.parent})")
         fb["flushed"] = True
 
     def _finish_l1(self) -> None:
-        """Post-barrier aggregator-tree resolution: live unflushed L1s
-        are told to flush (the server gave up on their stragglers) and
-        their PartialAggregates pumped in; dead ones fall back to the
+        """Post-barrier aggregator-tree resolution, LEVEL-ASCENDING:
+        live unflushed aggregators are told to flush (the server gave
+        up on their stragglers) level by level, so an interior group
+        still folds the partials the level below it just produced;
+        remote nodes get one AggFlush each and cascade internally
+        (runtime/aggnode.py); dead aggregators fall back to the
         direct-to-root drain; every fallback closes into the root
-        fold.  Bounded — an L1 that can neither flush nor die within
-        the grace window is abandoned (its group key is dropped at
-        finish)."""
-        for t in self._l1:
-            if t.is_alive() and not t.flushed:
-                t.request_flush()
-        want = [(t.group.stage, t.group.key) for t in self._l1]
+        fold.  Bounded — an aggregator that can neither flush nor die
+        within the grace window is abandoned (its group key is
+        dropped at finish)."""
+        for lv in sorted({t.group.level for t in self._l1}):
+            level_ts = [t for t in self._l1 if t.group.level == lv]
+            for t in level_ts:
+                if t.is_alive() and not t.flushed:
+                    t.request_flush()
+
+            def lv_done(ts=level_ts) -> bool:
+                self._poll_l1()
+                return all(
+                    t.flushed or self._l1_fallback.get(
+                        t.group.idx, {}).get("flushed")
+                    for t in ts)
+            deadline = time.monotonic() + 15.0
+            while not lv_done() and time.monotonic() < deadline:
+                self._pump_one(timeout=0.05)
+        for nid in self._l1_remote:
+            if nid not in self._dead_nodes:
+                self.bus.publish(
+                    reply_queue(nid),
+                    encode(AggFlush(node_id=nid, gen=self._cur_gen)))
+        if self._l1_remote:
+            self.log.sent(f"AGGFLUSH -> {sorted(self._l1_remote)}")
+        want = [(g.stage, g.key) for g in self._tree_roots] \
+            or [(t.group.stage, t.group.key) for t in self._l1]
 
         def landed() -> bool:
             self._poll_l1()
@@ -607,9 +921,20 @@ class ProtocolContext(MeshContext):
             self._pump_until(
                 landed, "aggregator flushes",
                 deadline=time.monotonic() + 30.0)
-        for fb in self._l1_fallback.values():
+        # forced close, LEVEL-ASCENDING: a child's flush publishes its
+        # substitute into the parent's queue, so the parent (stepped
+        # right after, flushed later in the same ordering) still folds
+        # it instead of closing empty a microsecond earlier
+        for fb in sorted(self._l1_fallback.values(),
+                         key=lambda f: (f["group"].level,
+                                        f["group"].idx)):
             if not fb["flushed"]:
                 self._flush_fallback(fb)
+            parent_idx = fb["group"].parent
+            if parent_idx is not None:
+                pfb = self._l1_fallback.get(parent_idx)
+                if pfb is not None and not pfb["flushed"]:
+                    self._drain_fallback(pfb)
         for t in self._l1:
             t.join(timeout=5.0)
 
@@ -873,15 +1198,23 @@ class ProtocolContext(MeshContext):
         self._group_of = {}
         self._l1 = []
         self._l1_fallback = {}
+        self._l1_remote = {}
+        self._dead_nodes = set()
+        self._tree_groups = {}
+        self._tree_roots = []
         self._agg_gone = set()
+        self._agg_ingress_bytes = 0
         if self._streaming:
             fan_in = self._agg.fan_in
             expected: dict[int, list] = {}
             if fan_in and len(active) > fan_in:
-                groups = agg_plane.plan_fanin_groups(active, fan_in)
+                groups = agg_plane.plan_tree(active, fan_in,
+                                             self._agg.levels)
+                self._tree_groups = {g.idx: g for g in groups}
+                self._tree_roots = agg_plane.root_groups(groups)
                 self._group_of = {cid: g for g in groups
-                                  for cid in g.members}
-                for g in groups:
+                                  if g.level == 1 for cid in g.members}
+                for g in self._tree_roots:
                     expected.setdefault(g.stage, []).append(g.key)
             else:
                 for cid, s in sorted(active):
@@ -889,6 +1222,18 @@ class ProtocolContext(MeshContext):
             self._fold = agg_plane.StreamingFold(
                 expected, backend=self._fold_backend,
                 faults=self.faults, hists=self.hists)
+            # partial-sum delta codec: pin this generation's per-stage
+            # START base — the tree encodes (group mean - base) and
+            # every receiver (interior node or this root) adds it back
+            self._partial_bases = {}
+            self._partial_base_gen = None
+            if groups is not None and self._partial_codec is not None \
+                    and self._partial_codec.kind == "delta":
+                for s in range(1, plan.n_stages + 1):
+                    a, b = ranges[s - 1]
+                    self._partial_bases[s] = _np_tree(
+                        shard_params(params, self.specs, a, b))
+                self._partial_base_gen = self._cur_gen
 
         # 2LS fixed 1:1 edge<->head pairing: when in_clusters in-groups
         # each have their own head, the forward data plane runs over
@@ -1112,43 +1457,49 @@ class ProtocolContext(MeshContext):
                 if cid not in ids:
                     self._fold.drop(s, cid)
         if groups is not None:
-            # aggregator tree: spawn the L1 participants now, with
-            # membership narrowed to the responsive set (a client
-            # dropped at READY will never publish; its L1 must not
-            # hold the group's flush for it).  Over TCP each L1 gets
-            # its own transport stack (a blocked get serializes a
-            # TcpTransport's socket); in-proc they share the bus.
-            l1_deadline = time.monotonic() + self.client_timeout
-            for g in groups:
-                members = [m for m in g.members if m in ids]
-                if not members:
-                    self._fold.drop(g.stage, g.key)
-                    continue
-                agg_id = f"aggregator_{plan.cluster_id}_{g.idx}"
-                l1_bus, owns = self.bus, False
-                if self.cfg.transport.kind == "tcp":
-                    from split_learning_tpu.runtime.chaos import (
-                        make_runtime_transport,
-                    )
-                    l1_bus = make_runtime_transport(
-                        self.cfg, agg_id, faults=self.faults)
-                    owns = True
-                l1_log = self._l1_logs.get(agg_id)
-                if l1_log is None:
-                    l1_log = self._l1_logs[agg_id] = Logger.for_run(
-                        self.cfg, agg_id, console=False)
-                t = agg_plane.L1Aggregator(
-                    l1_bus, cluster=plan.cluster_id, group=g,
-                    members=members, gen=self._cur_gen,
-                    deadline=l1_deadline, log=l1_log,
-                    faults=self.faults,
-                    chunk_bytes=self.cfg.transport.chunk_mb << 20,
-                    owns_bus=owns)
-                t.start()
-                self._l1.append(t)
+            # aggregator tree: dispatch the tree's interior nodes now,
+            # with LEVEL-1 membership narrowed to the responsive set
+            # (a client dropped at READY will never publish; its
+            # aggregator must not hold the group's flush for it).
+            # Interior groups keep every child key — child workers
+            # always publish, an empty group immediately.
+            narrowed = {
+                g.idx: ([m for m in g.members if m in ids]
+                        if g.level == 1 else list(g.members))
+                for g in groups}
+            self._tree_narrowed = narrowed
+            self._cur_cluster = plan.cluster_id
+            node_ids = [n for n in sorted(self._agg_nodes)
+                        if not self._node_dead(n)]
+            if self._agg.remote and not node_ids:
+                self.log.warning(
+                    "aggregation.remote: no live aggregator nodes "
+                    "adopted — falling back to thread-mode L1s")
+            if self._agg.remote and node_ids:
+                self._dispatch_remote(plan, groups, narrowed, node_ids,
+                                      round_idx)
+            else:
+                self._spawn_l1_threads(plan, groups, narrowed)
+            self._agg_topology = {
+                "fan_in": self._agg.fan_in,
+                "levels": self._agg.levels,
+                "remote": bool(self._l1_remote),
+                "gen": self._cur_gen,
+                "groups": [{
+                    "idx": g.idx, "stage": g.stage, "level": g.level,
+                    "parent": g.parent,
+                    "members": len(narrowed[g.idx]),
+                    "node": next((n for n, gl in
+                                  self._l1_remote.items()
+                                  if any(x.idx == g.idx for x in gl)),
+                                 None)}
+                    for g in groups],
+            }
             self.log.info(
-                f"aggregator tree: {len(self._l1)} L1 group(s), "
-                f"fan-in {self._agg.fan_in}", "cyan")
+                f"aggregator tree: {len(groups)} group(s), fan-in "
+                f"{self._agg.fan_in}, levels {self._agg.levels}"
+                + (f", remote across {len(self._l1_remote)} node(s)"
+                   if self._l1_remote else " (threads)"), "cyan")
         stage_of = dict(active)
         syn_span = self.tracer.start("syn_fanout", round=round_idx)
         # strict-SDA liveness under client loss (ADVICE r5): the
@@ -1248,9 +1599,10 @@ class ProtocolContext(MeshContext):
                 got, what,
                 deadline=time.monotonic() + self.client_timeout,
                 waiting=missing,
-                poll=self._poll_l1 if self._l1 else None)
+                poll=(self._poll_l1 if self._l1 or self._l1_remote
+                      else None))
         self._syn_live = False
-        if self._l1:
+        if self._l1 or self._l1_remote:
             self._finish_l1()
         updates = list(self._updates)
         self._updates = []
@@ -1290,6 +1642,15 @@ class ProtocolContext(MeshContext):
                 backend=(self._fold_backend.name
                          if self._fold_backend is not None else "host"),
                 fan_in=(self._agg.fan_in if groups is not None else 0),
+                levels=(self._agg.levels if groups is not None else 0),
+                remote_nodes=len(self._l1_remote),
+                node_deaths=len(self._dead_nodes),
+                # rpc-wire bytes of the PartialAggregate frames that
+                # landed at this root (chunked streams fully counted)
+                # — the ingress the partial codec exists to shrink
+                root_ingress_bytes=self._agg_ingress_bytes,
+                partial_codec=(None if self._partial_codec is None
+                               else self._partial_codec.kind),
                 fold_s=result.fold_s, folded=result.folded,
                 partials=result.partials,
                 window_hwm=result.window_hwm,
@@ -1314,6 +1675,7 @@ class ProtocolContext(MeshContext):
                 "cyan")
             self._l1 = []
             self._l1_fallback = {}
+            self._l1_remote = {}
         # elastic liveness bookkeeping, folded per ROUND at the next
         # refresh_plans: any UPDATE during the round marks a client
         # alive even if it sat out other invocations of a sequential
@@ -1418,6 +1780,9 @@ class ProtocolContext(MeshContext):
         for reg in self.registrations:
             self.bus.publish(reply_queue(reg.client_id),
                              encode(Stop(reason=reason)))
+        for nid in self._agg_nodes:
+            self.bus.publish(reply_queue(nid),
+                             encode(Stop(reason=reason)))
         # the STOP fan-out must actually leave this process before the
         # caller tears the broker down
         flush = getattr(self.bus, "flush", None)
@@ -1455,6 +1820,29 @@ class ProtocolServer:
         self.ctx = ProtocolContext(cfg, bus, logger=self.log,
                                    client_timeout=client_timeout,
                                    ready_timeout=ready_timeout)
+        # aggregation.nodes: spawn the aggregator subprocesses this
+        # deployment wants (tcp only — validated at config load); the
+        # nodes connect to the broker, AggHello into the rpc pump, and
+        # are adopted before planning (serve() waits for them)
+        self._spawned_nodes: list = []
+        if cfg.aggregation.remote and cfg.aggregation.nodes:
+            import pathlib
+
+            from split_learning_tpu.runtime.aggnode import (
+                spawn_node, write_node_config,
+            )
+            cfg_path = pathlib.Path(
+                getattr(self.log, "output_dir", None)
+                or cfg.log_path) / "aggregator_config.json"
+            write_node_config(cfg, cfg_path)
+            for i in range(cfg.aggregation.nodes):
+                nid = f"aggregator_node_{i}"
+                proc = spawn_node(cfg_path, nid)
+                self.ctx._agg_nodes.setdefault(nid, {})["proc"] = proc
+                self._spawned_nodes.append(proc)
+            self.log.info(
+                f"spawned {cfg.aggregation.nodes} aggregator "
+                "node(s)", "cyan")
         # real-time export (observability.http-port): /metrics serves
         # Prometheus text, /fleet the JSON health snapshot — what
         # tools/sl_top.py polls for the live terminal view.  Render
@@ -1492,10 +1880,18 @@ class ProtocolServer:
 
             def _fleet() -> dict:
                 if ctx.fleet is None:
-                    return {"clients": {}, "counts": {},
+                    snap = {"clients": {}, "counts": {},
                             "transitions": []}
-                ctx.fleet.advance()
-                return ctx.fleet.snapshot()
+                else:
+                    ctx.fleet.advance()
+                    snap = ctx.fleet.snapshot()
+                # aggregator-tree topology (aggregation.fan-in /
+                # levels / remote): which node serves which group, so
+                # straggler attribution can NAME a slow L1 instead of
+                # pointing at "the aggregate phase"
+                if ctx._agg_topology is not None:
+                    snap["agg_tree"] = ctx._agg_topology
+                return snap
 
             self.exporter = TelemetryExporter(
                 _metrics, _fleet, port=int(obs.http_port),
@@ -1510,6 +1906,25 @@ class ProtocolServer:
         )
         ensure_initialized()
         regs = self.ctx.wait_for_registrations()
+        if self.cfg.aggregation.remote:
+            # adopt aggregator nodes before the first round: spawned
+            # subprocesses are still importing; externally-started
+            # ones may hello any time.  A miss is a warning, not a
+            # failure — the tree falls back to thread-mode L1s.
+            ctx = self.ctx
+            want = max(int(self.cfg.aggregation.nodes), 1)
+
+            def adopted() -> int:
+                return sum(1 for e in ctx._agg_nodes.values()
+                           if "t" in e)
+            ctx._pump_until(
+                lambda: adopted() >= want,
+                lambda: (f"aggregator node adoption "
+                         f"({adopted()}/{want} helloed)"),
+                deadline=time.monotonic() + 60.0)
+            self.log.info(
+                f"aggregator nodes adopted: {adopted()}/{want}",
+                "cyan")
         # elastic deployments may have spares beyond the configured
         # counts at startup; plan whoever is there
         with self.ctx.tracer.span("plan"):
@@ -1529,6 +1944,17 @@ class ProtocolServer:
                 register_process_capture(None)
             if self.exporter is not None:
                 self.exporter.close()
+            for proc in self._spawned_nodes:
+                # STOP already fanned out (stop_all); give each child
+                # a moment to exit cleanly, then make sure
+                try:
+                    proc.wait(timeout=5.0)
+                except Exception:  # noqa: BLE001 — still running
+                    proc.terminate()
+                    try:
+                        proc.wait(timeout=5.0)
+                    except Exception:  # noqa: BLE001 — last resort
+                        proc.kill()
         return result
 
 

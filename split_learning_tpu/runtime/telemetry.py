@@ -97,6 +97,7 @@ class TelemetrySnapshot:
     part: str                       # participant id
     t: float                        # sender clock (epoch seconds)
     seq: int                        # per-emitter monotonic sequence
+    kind: str = "client"            # participant role: client | agg_node
     round: int | None = None        # current round index (gauge)
     samples: int = 0                # cumulative samples this round
     samples_per_s: float = 0.0      # EWMA training throughput
@@ -143,8 +144,13 @@ class TelemetryEmitter:
     def __init__(self, participant: str, send: Callable[[dict], None],
                  interval: float, faults=None, wire=None, hists=None,
                  gauges: GaugeSet | None = None,
-                 samples_fn: Callable[[], int] | None = None):
+                 samples_fn: Callable[[], int] | None = None,
+                 kind: str = "client"):
         self.participant = participant
+        # participant role stamped on every snapshot: the FleetMonitor
+        # rate-scores only kind="client" reporters (an idle aggregator
+        # node's 0 samples/s is its normal state, not a straggler)
+        self.kind = kind
         self.interval = float(interval)
         self._send = send
         self._faults = faults
@@ -207,7 +213,7 @@ class TelemetryEmitter:
             seq = self._seq
         rnd = self.gauges.get("round")
         return TelemetrySnapshot(
-            part=self.participant, t=now, seq=seq,
+            part=self.participant, t=now, seq=seq, kind=self.kind,
             round=None if rnd is None else int(rnd),
             samples=self._total_samples(),
             samples_per_s=round(rate, 3),
@@ -274,6 +280,7 @@ _STATE_CODE = {s: i for i, s in enumerate(HEALTH_STATES)}
 @dataclasses.dataclass
 class _ClientHealth:
     state: str = "healthy"
+    kind: str = "client"            # client | agg_node (snapshot.kind)
     first_seen: float = 0.0
     last_seen: float = 0.0          # receiver clock, any FRESH frame
     last_t_send: float = 0.0        # sender clock of last fresh beat
@@ -410,6 +417,7 @@ class FleetMonitor:
             h.last_seq = snap.seq
             h.last_t_send = snap.t
             h.last_seen = max(h.last_seen, now)
+            h.kind = snap.kind or "client"
             h.rate = float(snap.samples_per_s)
             h.round = snap.round
             h.samples = int(snap.samples)
@@ -505,8 +513,13 @@ class FleetMonitor:
             pumping = (self._last_pump is None
                        or now - self._last_pump
                        <= max(2 * self.interval, 1.0))
+            # rate scoring covers TRAINING clients only: an aggregator
+            # node's samples/s is structurally 0 — including it would
+            # both drag the fleet median and flag the node straggler
+            # for doing its job (liveness transitions still apply)
             rates = [h.rate for h in self._clients.values()
-                     if h.rate and h.state != "lost"]
+                     if h.rate and h.state != "lost"
+                     and h.kind == "client"]
             med = statistics.median(rates) if rates else None
             # compute-rate median (perf-plane gauge riding heartbeats):
             # the second axis that tells a compute-slow straggler from
@@ -520,7 +533,8 @@ class FleetMonitor:
             for cid, h in self._clients.items():
                 age = now - h.last_seen
                 h.score = (round(h.rate / med, 4)
-                           if med and h.rate is not None else None)
+                           if med and h.rate is not None
+                           and h.kind == "client" else None)
                 if not pumping:
                     pass
                 elif age > self.liveness_timeout:
@@ -607,6 +621,7 @@ class FleetMonitor:
                         or h.latency.get("step") or {})
                 clients[cid] = {
                     "state": h.state,
+                    "kind": h.kind,
                     "age_s": round(max(0.0, now - h.last_seen), 3),
                     "round": h.round,
                     "samples": h.samples,

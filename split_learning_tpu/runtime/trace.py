@@ -77,6 +77,13 @@ FAULT_COUNTER_NAMES = frozenset({
     # UPDATE frames before dying (one inc per member)
     "agg_dup_drops", "agg_stale_drops", "agg_l1_fallbacks",
     "agg_fallback_abandons",
+    # multi-process aggregator tree (runtime/aggnode.py): frames whose
+    # ASSEMBLED chunked size broke the broker-cap twin in
+    # FrameAssembler, partial frames a node/root could not decode
+    # through the partial codec (missing/mismatched delta base), and
+    # remote aggregator nodes declared dead (child exit or
+    # FleetMonitor lost) whose groups fell back to the root drain
+    "oversize_frames", "partial_codec_errors", "agg_node_deaths",
     # sync-mode round-boundary overlap (runtime/client.py
     # _sync_overlap_ticks): speculative caches the next START consumed
     # (spliced) vs invalidated-and-unwound (discarded)
@@ -130,6 +137,12 @@ GAUGE_NAMES = frozenset({
     # pinned by the delta codec's per-client shadow trees — the memory
     # the `lost`-client prune and elastic prune reclaim
     "agg_shadow_bytes",
+    # standalone aggregator nodes (runtime/aggnode.py), set per round
+    # and ridden on the node's heartbeats so /fleet and sl_top can
+    # attribute a slow L1: contributions folded, wire bytes in/out of
+    # the node's fold worker, and the round's fold wall
+    "agg_node_folded", "agg_node_ingress_bytes",
+    "agg_node_egress_bytes", "agg_node_fold_s", "agg_node_groups",
 })
 
 

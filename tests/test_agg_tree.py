@@ -1,0 +1,1109 @@
+"""Multi-process aggregator tree (aggregation.levels / remote):
+plan_tree shapes, L2 bit-identity vs the flat per-client oracle under
+chaos at both levels, codec'd-partial parity vs fp32, remote-node
+choreography, FleetMonitor-driven fallback, and the FrameAssembler
+assembled-size cap."""
+
+from __future__ import annotations
+
+import signal
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from split_learning_tpu.config import ChaosConfig, from_dict
+from split_learning_tpu.runtime import aggregate as A
+from split_learning_tpu.runtime import protocol as P
+from split_learning_tpu.runtime.aggnode import AggregatorNode
+from split_learning_tpu.runtime.bus import (
+    InProcTransport, ReliableTransport,
+)
+from split_learning_tpu.runtime.chaos import ChaosTransport
+from split_learning_tpu.runtime.codec.partial import (
+    PartialCodecError, decode_partial_entry, encode_partial_entry,
+)
+from split_learning_tpu.runtime.codec.specs import parse_spec
+from split_learning_tpu.runtime.trace import FaultCounters
+
+
+def _trees(active, seed=0):
+    rng = np.random.default_rng(seed)
+    return {cid: {f"layer{s}": {
+        "kernel": rng.standard_normal((8, 4)).astype(np.float32),
+        "bias": rng.standard_normal((4,)).astype(np.float32)}}
+        for cid, s in active}
+
+
+def _publish_updates(bus, groups, active, trees, gen=1, samples=7):
+    for cid, s in active:
+        g = next(g for g in groups if g.level == 1 and cid in g.members)
+        for part in P.encode_parts(P.Update(
+                client_id=cid, stage=s, cluster=0, params=trees[cid],
+                num_samples=samples, round_idx=gen)):
+            bus.publish(A.aggregate_queue(0, g.idx), part)
+
+
+def _drive_workers(bus, groups, gen=1, faults=None, codec=None,
+                   bases=None, timeout=10.0):
+    """Run the whole tree inline (no threads): one L1Aggregator object
+    per group, driven level-ascending — the remote node's fold loop
+    without the process."""
+    faults = faults or FaultCounters()
+    workers = []
+    for g in groups:
+        out_q = (P.RPC_QUEUE if g.parent is None
+                 else A.aggregate_queue(0, g.parent))
+        workers.append(A.L1Aggregator(
+            bus, cluster=0, group=g, members=g.members, gen=gen,
+            deadline=time.monotonic() + timeout, faults=faults,
+            out_queue=out_q, codec=codec,
+            base=(bases or {}).get(g.stage),
+            base_gen=gen if codec is not None
+            and codec.kind == "delta" else None))
+    for lv in sorted({g.level for g in groups}):
+        for w in workers:
+            if w.group.level != lv:
+                continue
+            deadline = time.monotonic() + timeout
+            while not w.complete and time.monotonic() < deadline:
+                raw = bus.get(w.queue, timeout=0.05)
+                if raw is not None:
+                    w.feed_raw(raw)
+            assert w.complete, f"group {w.group.idx} starved"
+            w.publish()
+    return workers
+
+
+def _root_fold(bus, groups, gen=1, faults=None, bases=None,
+               timeout=10.0):
+    """Drain the root partials off rpc_queue and fold them the way the
+    server's pump does (codec decode included)."""
+    from split_learning_tpu.runtime.codec.partial import (
+        decode_partial_msg,
+    )
+    faults = faults or FaultCounters()
+    roots = A.root_groups(groups)
+    expected: dict = {}
+    for g in roots:
+        expected.setdefault(g.stage, []).append(g.key)
+    fold = A.StreamingFold(expected, faults=faults)
+    asm = P.FrameAssembler(faults=faults)
+    seen: set = set()
+    members: list = []
+    deadline = time.monotonic() + timeout
+    while len(seen) < len(roots) and time.monotonic() < deadline:
+        raw = bus.get(P.RPC_QUEUE, timeout=0.05)
+        if raw is None:
+            continue
+        try:
+            msg = asm.feed(raw)
+        except P.CorruptFrame:
+            continue
+        if not isinstance(msg, P.PartialAggregate) \
+                or msg.round_idx != gen:
+            continue
+        key = A.group_key(msg.group)
+        if key in seen:
+            faults.inc("agg_dup_drops")
+            continue
+        if msg.codec or msg.members_z:
+            decode_partial_msg(msg, bases=bases or {}, base_gen=gen)
+        seen.add(key)
+        members.extend(msg.members or [])
+        fold.add_partial(msg.stage, key, msg.sums, msg.weight,
+                         msg.dtypes, stat_sums=msg.stat_sums,
+                         stat_weight=msg.stat_weight,
+                         stat_dtypes=msg.stat_dtypes,
+                         n_samples=msg.n_samples)
+    assert len(seen) == len(roots), f"only {seen} of {len(roots)}"
+    return fold.finish(), members
+
+
+def _oracle(groups, active, trees, samples=7):
+    """The flat per-client oracle: a single-process numpy fold over
+    the canonical (stage, group, client) order — contribution
+    ``nan_to_num(f32(leaf)) * w``, left-to-right accumulation within
+    each group, group sums ingested left-to-right up the tree, ONE
+    divide at the root.  Whatever processes, threads, chaos faults or
+    fallbacks the distributed tree ran through, its result must be a
+    bit-identical function of the same inputs."""
+    roots = A.root_groups(groups)
+    by_key = {g.key: g for g in groups}
+
+    def group_sums(g):
+        acc: dict = {}
+        total = 0.0
+        for m in g.members:
+            if g.level == 1:
+                w = samples
+                items = [(p, np.nan_to_num(
+                    np.asarray(leaf, np.float32)) * np.float32(w))
+                    for p, leaf in _walk(trees[m])]
+                total += w
+            else:
+                sums, w = group_sums(by_key[m])
+                items = [(p, np.nan_to_num(
+                    np.asarray(v, np.float32)))
+                    for p, v in sums.items()]
+                total += w
+            for p, c in items:
+                acc[p] = acc[p] + c if p in acc else c
+        return acc, total
+
+    out: dict = {}
+    by_stage: dict = {}
+    for g in roots:
+        by_stage.setdefault(g.stage, []).append(g)
+    for s, gs in sorted(by_stage.items()):
+        acc: dict = {}
+        total = 0.0
+        for g in sorted(gs, key=lambda g: g.key):
+            sums, w = group_sums(g)
+            for p, v in sums.items():
+                v = np.nan_to_num(np.asarray(v, np.float32))
+                acc[p] = acc[p] + v if p in acc else v
+            total += w
+        for p, a in acc.items():
+            out[p] = (a / np.float32(total)).astype(np.float32)
+    return out
+
+
+def _walk(tree, prefix=()):
+    if isinstance(tree, dict):
+        for k in tree:
+            yield from _walk(tree[k], prefix + (k,))
+    else:
+        yield prefix, tree
+
+
+def _bit_equal(params, oracle):
+    for p, want in oracle.items():
+        got = params
+        for k in p:
+            got = got[k]
+        assert np.asarray(got).dtype == want.dtype
+        assert np.array_equal(np.asarray(got), want), f"mismatch at {p}"
+
+
+# --------------------------------------------------------------------------
+# plan_tree
+# --------------------------------------------------------------------------
+
+class TestPlanTree:
+
+    def test_levels_parents_and_unique_indices(self):
+        active = [(f"c{i:02d}", 1) for i in range(13)] \
+            + [(f"h{i}", 2) for i in range(3)]
+        groups = A.plan_tree(active, 3, levels=2)
+        assert len({g.idx for g in groups}) == len(groups)
+        l1 = [g for g in groups if g.level == 1]
+        l2 = [g for g in groups if g.level == 2]
+        assert all(len(g.members) <= 3 for g in groups)
+        # stage 2 fits one level-1 group: NOT wrapped again
+        s2 = [g for g in l1 if g.stage == 2]
+        assert len(s2) == 1 and s2[0].parent is None
+        # every stage-1 level-1 group has a level-2 parent
+        assert all(g.parent is not None for g in l1 if g.stage == 1)
+        for g in l2:
+            assert all(by.parent == g.idx for by in l1
+                       if by.key in g.members)
+        # roots = parentless; their input queues are globally unique
+        roots = A.root_groups(groups)
+        assert all(g.parent is None for g in roots)
+
+    def test_level_one_matches_plan_fanin_groups(self):
+        active = [(f"c{i}", 1) for i in range(9)]
+        flat = A.plan_fanin_groups(active, 4)
+        tree = [g for g in A.plan_tree(active, 4, levels=1)]
+        assert [(g.idx, g.stage, g.members) for g in flat] \
+            == [(g.idx, g.stage, g.members) for g in tree]
+
+    def test_as_dict_roundtrip(self):
+        g = A.AggGroup(idx=7, stage=2, members=["a", "b"], level=2,
+                       parent=9)
+        back = A.AggGroup.from_dict(g.as_dict())
+        assert (back.idx, back.stage, back.members, back.level,
+                back.parent) == (7, 2, ["a", "b"], 2, 9)
+
+
+# --------------------------------------------------------------------------
+# L2 bit-identity vs the flat per-client oracle
+# --------------------------------------------------------------------------
+
+class TestL2BitIdentity:
+
+    def test_two_level_fold_matches_oracle(self):
+        active = [(f"c{i:02d}", 1) for i in range(13)] \
+            + [(f"h{i}", 2) for i in range(5)]
+        trees = _trees(active)
+        groups = A.plan_tree(active, 3, levels=2)
+        bus = InProcTransport()
+        fc = FaultCounters()
+        _publish_updates(bus, groups, active, trees)
+        _drive_workers(bus, groups, faults=fc)
+        result, members = _root_fold(bus, groups, faults=fc)
+        assert result.n_samples == 13 * 7
+        assert {m["client_id"] for m in members} \
+            == {cid for cid, _ in active}
+        _bit_equal(result.params, _oracle(groups, active, trees))
+
+    def test_three_level_fold_matches_oracle(self):
+        active = [(f"c{i:02d}", 1) for i in range(17)]
+        trees = _trees(active, seed=3)
+        groups = A.plan_tree(active, 2, levels=3)
+        assert {g.level for g in groups} == {1, 2, 3}
+        bus = InProcTransport()
+        _publish_updates(bus, groups, active, trees)
+        _drive_workers(bus, groups)
+        result, _ = _root_fold(bus, groups)
+        _bit_equal(result.params, _oracle(groups, active, trees))
+
+    @pytest.mark.parametrize("seed", [5, 11])
+    def test_chaos_on_both_levels_stays_bit_identical(self, seed):
+        """drop/dup/reorder injected on EVERY aggregate queue — the
+        client->L1 leg AND the L1->L2 partial leg — with the reliable
+        layer masking drops: the tree's canonical-order folds + key
+        dedup at every level keep the result bit-identical to the
+        oracle."""
+        active = [(f"c{i:02d}", 1) for i in range(10)]
+        trees = _trees(active, seed=seed)
+        groups = A.plan_tree(active, 3, levels=2)
+        chaos = ChaosConfig(
+            enabled=True, seed=seed, drop=0.15, duplicate=0.15,
+            reorder=0.2, queues=("aggregate_queue*",))
+        fc = FaultCounters()
+        inner = InProcTransport()
+        # one shared stack: worker publishes (L1 partials included)
+        # roll chaos faults; worker/root gets resequence + dedup
+        bus = ReliableTransport(
+            ChaosTransport(inner, chaos, name="tree", faults=fc),
+            sender="tree", patterns=("aggregate_queue*",),
+            redeliver_s=0.05, max_redeliver=40, faults=fc)
+        try:
+            _publish_updates(bus, groups, active, trees)
+            _drive_workers(bus, groups, faults=fc)
+            result, _ = _root_fold(bus, groups, faults=fc)
+        finally:
+            bus.stop(close_inner=False)
+        assert result.n_samples == 10 * 7
+        _bit_equal(result.params, _oracle(groups, active, trees))
+        snap = fc.snapshot()
+        assert snap.get("drops", 0) + snap.get("duplicates", 0) \
+            + snap.get("reorders", 0) > 0, "chaos never fired"
+
+    def test_duplicate_partial_dedup_at_l2_and_root(self):
+        active = [(f"c{i}", 1) for i in range(4)]
+        trees = _trees(active)
+        groups = A.plan_tree(active, 2, levels=2)
+        bus = InProcTransport()
+        fc = FaultCounters()
+        _publish_updates(bus, groups, active, trees)
+        workers = _drive_workers(bus, groups, faults=fc)
+        # replay one L1's partial into its parent queue (at-least-once
+        # redelivery) and one root partial onto rpc: both must be
+        # dup-dropped, not double-weighted
+        l1 = next(w for w in workers if w.group.level == 1)
+        l2 = next(w for w in workers if w.group.level == 2)
+        l1.flushed = False
+        l1.publish()
+        before = fc.snapshot().get("agg_dup_drops", 0)
+        raw = bus.get(l1.out_queue, timeout=1.0)
+        l2.feed_raw(raw)
+        assert fc.snapshot().get("agg_dup_drops", 0) == before + 1
+        result, _ = _root_fold(bus, groups, faults=fc)
+        _bit_equal(result.params, _oracle(groups, active, trees))
+
+
+# --------------------------------------------------------------------------
+# codec'd partials
+# --------------------------------------------------------------------------
+
+class TestPartialCodec:
+
+    def _run(self, codec_spec, active, trees, groups, bases=None):
+        bus = InProcTransport()
+        fc = FaultCounters()
+        spec = parse_spec(codec_spec) if codec_spec else None
+        _publish_updates(bus, groups, active, trees)
+        _drive_workers(bus, groups, faults=fc, codec=spec, bases=bases)
+        result, _ = _root_fold(bus, groups, faults=fc, bases=bases)
+        return result, fc
+
+    def test_codec_fold_parity_vs_fp32(self):
+        """int8 and delta:int8 partials reconstruct the fp32 fold
+        within quantization tolerance; the fp32 leg itself is the
+        bit-parity oracle."""
+        active = [(f"c{i:02d}", 1) for i in range(10)]
+        trees = _trees(active, seed=2)
+        groups = A.plan_tree(active, 3, levels=2)
+        base = {s: {f"layer{s}": {
+            "kernel": np.zeros((8, 4), np.float32),
+            "bias": np.zeros((4,), np.float32)}} for s in (1,)}
+        ref, _ = self._run(None, active, trees, groups)
+        _bit_equal(ref.params, _oracle(groups, active, trees))
+        for spec in ("int8:64", "delta:int8:64"):
+            got, _ = self._run(spec, active, trees, groups,
+                               bases=base)
+            for p, want in _oracle(groups, active, trees).items():
+                v = got.params
+                for k in p:
+                    v = v[k]
+                err = np.max(np.abs(np.asarray(v) - want))
+                scale = np.max(np.abs(want)) or 1.0
+                assert err / scale < 0.05, (spec, p, err)
+
+    def test_delta_base_tightens_quantization(self):
+        """The delta-vs-START form spends the int8 range on the
+        training delta: with a base close to the data, its error must
+        be far below plain int8's."""
+        rng = np.random.default_rng(4)
+        base_tree = {"l": rng.standard_normal((256,)).astype(np.float32)}
+        mean = {"l": base_tree["l"]
+                + 0.01 * rng.standard_normal((256,)).astype(np.float32)}
+        ent = {"sums": {"l": mean["l"] * np.float32(9.0)},
+               "weight": 9.0, "stat_sums": None, "stat_weight": 0.0}
+        errs = {}
+        for spec in ("int8:64", "delta:int8:64"):
+            enc, cs, cb = encode_partial_entry(
+                ent, parse_spec(spec), base=base_tree, base_gen=3)
+            dec = decode_partial_entry(enc, cs, codec_base=cb,
+                                       base=base_tree, base_gen=3)
+            errs[spec] = np.max(np.abs(dec["sums"]["l"]
+                                       - ent["sums"]["l"]))
+        assert errs["delta:int8:64"] < errs["int8:64"] / 10
+
+    def test_delta_base_gap_is_rejected_and_counted(self):
+        ent = {"sums": {"l": np.ones((8,), np.float32)}, "weight": 2.0,
+               "stat_sums": None, "stat_weight": 0.0}
+        base = {"l": np.zeros((8,), np.float32)}
+        enc, cs, cb = encode_partial_entry(
+            ent, parse_spec("delta:int8:4"), base=base, base_gen=5)
+        assert cb == 5
+        with pytest.raises(PartialCodecError):
+            decode_partial_entry(enc, cs, codec_base=cb, base=base,
+                                 base_gen=6)   # wrong generation
+        with pytest.raises(PartialCodecError):
+            decode_partial_entry(enc, cs, codec_base=cb, base=None,
+                                 base_gen=None)
+
+    def test_nan_propagates_and_counts(self):
+        fc = FaultCounters()
+        ent = {"sums": {"l": np.array([np.nan, 1, 2, 3], np.float32)},
+               "weight": 2.0, "stat_sums": None, "stat_weight": 0.0}
+        enc, cs, _ = encode_partial_entry(ent, parse_spec("int8:4"),
+                                          faults=fc)
+        assert fc.snapshot().get("quant_nonfinite") == 1
+        dec = decode_partial_entry(enc, cs)
+        assert np.isnan(dec["sums"]["l"]).any()
+
+    def test_partial_family_config_surface(self):
+        cfg = from_dict({"transport": {
+            "codec": {"partial": "int8:64"}}})
+        from split_learning_tpu.runtime.codec import parse_codec_map
+        assert parse_codec_map(cfg.transport.codec)["partial"].kind \
+            == "int8"
+        assert from_dict({"transport": {
+            "codec": {"partial": "delta:int8:64"}}})
+        with pytest.raises(Exception):
+            from_dict({"transport": {"codec": {"partial": "topk:0.1"}}})
+        # a bf16 delta partial has no runtime encoder — accepting it at
+        # config time would kill every aggregator at flush, AFTER it
+        # consumed its members' updates (review fix)
+        for spec in ("delta", "delta:bf16"):
+            with pytest.raises(Exception):
+                from_dict({"transport": {"codec": {"partial": spec}}})
+
+
+# --------------------------------------------------------------------------
+# remote node choreography
+# --------------------------------------------------------------------------
+
+def _node_cfg(tmp_path, **over):
+    d = {"log_path": str(tmp_path),
+         "observability": {"heartbeat_interval": 0.2,
+                           "liveness_timeout": 3.0},
+         "aggregation": {"fan_in": 3, "levels": 2, "remote": True,
+                         "streaming": True}}
+    for k, v in over.items():
+        d.setdefault(k, {}).update(v) if isinstance(v, dict) \
+            else d.update({k: v})
+    return from_dict(d)
+
+
+class TestRemoteNode:
+
+    def test_hello_assign_fold_flush_stop(self, tmp_path):
+        cfg = _node_cfg(tmp_path)
+        bus = InProcTransport()
+        node = AggregatorNode(cfg, "aggregator_node_0", transport=bus,
+                              fold_transport=bus)
+        th = threading.Thread(target=node.run, daemon=True)
+        th.start()
+        try:
+            active = [(f"c{i}", 1) for i in range(7)]
+            trees = _trees(active)
+            groups = A.plan_tree(active, 3, levels=2)
+            assign = P.AggAssign(
+                node_id="aggregator_node_0", cluster=0, gen=1,
+                round_idx=0, groups=[g.as_dict() for g in groups],
+                deadline_s=20.0, chunk_bytes=1 << 20)
+            bus.publish(P.reply_queue("aggregator_node_0"),
+                        P.encode(assign))
+            _publish_updates(bus, groups, active, trees)
+            asm = P.FrameAssembler()
+            hello = heartbeats = 0
+            result = None
+            deadline = time.monotonic() + 10
+            fold_members = None
+            while result is None and time.monotonic() < deadline:
+                raw = bus.get(P.RPC_QUEUE, timeout=0.1)
+                if raw is None:
+                    continue
+                msg = asm.feed(raw)
+                if isinstance(msg, P.AggHello):
+                    hello += 1
+                elif isinstance(msg, P.Heartbeat):
+                    heartbeats += 1
+                    assert (msg.telemetry or {}).get("kind") \
+                        == "agg_node"
+                elif isinstance(msg, P.PartialAggregate):
+                    # 7 clients / fan 3 -> one root L2 group
+                    fold_members = msg.members
+                    fold = A.StreamingFold(
+                        {1: [A.group_key(msg.group)]})
+                    fold.add_partial(
+                        msg.stage, A.group_key(msg.group), msg.sums,
+                        msg.weight, msg.dtypes,
+                        n_samples=msg.n_samples)
+                    result = fold.finish()
+            assert hello == 1 and result is not None
+            assert {m["client_id"] for m in fold_members} \
+                == {cid for cid, _ in active}
+            _bit_equal(result.params, _oracle(groups, active, trees))
+        finally:
+            bus.publish(P.reply_queue("aggregator_node_0"),
+                        P.encode(P.Stop(reason="test done")))
+            th.join(timeout=10)
+            assert not th.is_alive()
+
+    def test_aggflush_releases_incomplete_groups(self, tmp_path):
+        cfg = _node_cfg(tmp_path)
+        bus = InProcTransport()
+        node = AggregatorNode(cfg, "aggregator_node_0", transport=bus,
+                              fold_transport=bus)
+        th = threading.Thread(target=node.run, daemon=True)
+        th.start()
+        try:
+            active = [(f"c{i}", 1) for i in range(4)]
+            trees = _trees(active)
+            groups = A.plan_tree(active, 2, levels=1)
+            assign = P.AggAssign(
+                node_id="aggregator_node_0", cluster=0, gen=1,
+                round_idx=0, groups=[g.as_dict() for g in groups],
+                deadline_s=300.0)
+            bus.publish(P.reply_queue("aggregator_node_0"),
+                        P.encode(assign))
+            # only HALF the members upload: without a flush the node
+            # would hold its groups to the (5-minute) deadline
+            for cid, s in active[:2]:
+                g = next(g for g in groups if cid in g.members)
+                bus.publish(A.aggregate_queue(0, g.idx),
+                            P.encode(P.Update(
+                                client_id=cid, stage=s, cluster=0,
+                                params=trees[cid], num_samples=7,
+                                round_idx=1)))
+            time.sleep(0.3)
+            bus.publish(P.reply_queue("aggregator_node_0"),
+                        P.encode(P.AggFlush(
+                            node_id="aggregator_node_0", gen=1)))
+            asm = P.FrameAssembler()
+            got = {}
+            deadline = time.monotonic() + 10
+            while len(got) < len(groups) \
+                    and time.monotonic() < deadline:
+                raw = bus.get(P.RPC_QUEUE, timeout=0.1)
+                if raw is None:
+                    continue
+                msg = asm.feed(raw)
+                if isinstance(msg, P.PartialAggregate):
+                    got[msg.group] = msg
+            assert len(got) == len(groups)
+        finally:
+            bus.publish(P.reply_queue("aggregator_node_0"),
+                        P.encode(P.Stop(reason="test done")))
+            th.join(timeout=10)
+
+
+# --------------------------------------------------------------------------
+# FleetMonitor-driven remote fallback (the satellite fix: dead-node
+# detection must not rely on thread liveness)
+# --------------------------------------------------------------------------
+
+class _NullLog:
+    def __getattr__(self, _name):
+        return lambda *a, **k: None
+
+
+def _fallback_stub(bus, groups, narrowed, cluster=0, gen=2):
+    from split_learning_tpu.runtime.server import ProtocolContext
+    s = type("_Stub", (), {})()
+    s.bus = bus
+    s.faults = FaultCounters()
+    s.log = _NullLog()
+    s.fleet = None
+    s.cfg = from_dict({})
+    s._l1 = []
+    s._l1_fallback = {}
+    s._dead_nodes = set()
+    s._tree_groups = {g.idx: g for g in groups}
+    s._tree_narrowed = dict(narrowed)
+    s._agg_gone = set()
+    s._cur_gen = gen
+    s._cur_cluster = cluster
+    s._updates = []
+    s._partial_bases = {}
+    s._partial_base_gen = None
+    s._partial_codec = None
+    s._agg_nodes = {}
+    s._fold_update = lambda u: None
+    s.L1_FALLBACK_GRACE_S = 0.05
+    for name in ("_poll_l1", "_node_dead", "_start_fallback",
+                 "_step_fallback", "_children_draining",
+                 "_member_clients", "_drain_fallback",
+                 "_drain_fallback_update", "_drain_fallback_partial",
+                 "_flush_fallback"):
+        setattr(s, name, getattr(ProtocolContext, name).__get__(s))
+    return s
+
+
+class TestRemoteFallback:
+
+    def test_fleet_lost_node_triggers_counted_fallback(self):
+        """A remote node with queued-but-unconsumed member frames goes
+        FleetMonitor-lost: its groups drain direct-to-root — counted
+        agg_l1_fallbacks + agg_node_deaths — instead of stalling the
+        barrier on a thread-liveness check that cannot see a remote
+        process."""
+        active = [(f"c{i}", 1) for i in range(4)]
+        trees = _trees(active)
+        groups = A.plan_tree(active, 2, levels=1)
+        bus = InProcTransport()
+        s = _fallback_stub(bus, groups,
+                           {g.idx: list(g.members) for g in groups})
+        s._fold = A.StreamingFold(
+            {1: [g.key for g in A.root_groups(groups)]},
+            faults=s.faults)
+        s._l1_remote = {"aggregator_node_0": list(groups)}
+
+        class _Fleet:
+            def state(self, nid):
+                return "lost"
+        s.fleet = _Fleet()
+        _publish_updates(bus, groups, active, trees, gen=2)
+        s._poll_l1()
+        snap = s.faults.snapshot()
+        assert snap.get("agg_node_deaths") == 1
+        assert snap.get("agg_l1_fallbacks") == len(groups)
+        assert {u.client_id for u in s._updates} \
+            == {cid for cid, _ in active}
+        result = s._fold.finish()
+        _bit_equal(result.params, _oracle(groups, active, trees))
+
+    def test_spawned_proc_exit_counts_as_death(self):
+        groups = A.plan_tree([("c0", 1), ("c1", 1), ("c2", 1)], 2)
+        bus = InProcTransport()
+        s = _fallback_stub(bus, groups,
+                           {g.idx: list(g.members) for g in groups})
+        s._fold = A.StreamingFold(
+            {1: [g.key for g in A.root_groups(groups)]},
+            faults=s.faults)
+        s._l1_remote = {"aggregator_node_0": list(groups)}
+
+        class _DeadProc:
+            def poll(self):
+                return -9
+        s._agg_nodes = {"aggregator_node_0": {"proc": _DeadProc()}}
+        s._poll_l1()
+        assert s.faults.snapshot().get("agg_node_deaths") == 1
+
+    def test_fallback_drain_books_members_z_only_partial(self):
+        """A codec'd group whose members all sent weight-less Updates
+        publishes a partial with members_z set but codec None — the
+        fallback drain must still unpack and book those members
+        (review fix: the drain used to gate decode on `codec` only)."""
+        groups = A.plan_tree([(f"c{i}", 1) for i in range(4)], 2,
+                             levels=2)
+        l2 = next(g for g in groups if g.level == 2)
+        child = next(g for g in groups if g.parent == l2.idx)
+        bus = InProcTransport()
+        meta = [{"client_id": cid, "stage": 1, "num_samples": 0,
+                 "ok": False, "telemetry": None}
+                for cid in child.members]
+        bus.publish(A.aggregate_queue(0, l2.idx), P.encode(
+            P.PartialAggregate(
+                aggregator_id="aggregator_0_x", cluster=0,
+                group=child.idx, stage=1, round_idx=2,
+                members=None, members_z=P.pack_members(meta))))
+        s = _fallback_stub(bus, groups,
+                           {g.idx: list(g.members) for g in groups})
+        s._fold = A.StreamingFold({1: [l2.key]}, faults=s.faults)
+        fb = s._start_fallback(l2, 0, set(l2.members))
+        s._drain_fallback(fb)
+        assert {u.client_id for u in s._updates} \
+            == set(child.members)
+        assert all(not u.ok for u in s._updates)
+
+    def test_dead_node_owning_child_and_parent_defers_parent(self):
+        """One dead node served BOTH a level-1 child and its level-2
+        parent: the parent's fallback must not close (and abandon)
+        while the child's fallback is still recovering queued member
+        updates — the child's substitute partial must land (review
+        fix: both fallbacks used to share one grace clock)."""
+        active = [(f"c{i}", 1) for i in range(4)]
+        trees = _trees(active)
+        groups = A.plan_tree(active, 2, levels=2)
+        l2 = [g for g in groups if g.level == 2]
+        assert len(l2) == 1
+        bus = InProcTransport()
+        _publish_updates(bus, groups, active, trees, gen=2)
+        s = _fallback_stub(bus, groups,
+                           {g.idx: list(g.members) for g in groups})
+        s._fold = A.StreamingFold({1: [l2[0].key]}, faults=s.faults)
+        s._l1_remote = {"aggregator_node_0": list(groups)}
+
+        class _Fleet:
+            def state(self, nid):
+                return "lost"
+        s.fleet = _Fleet()
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            s._poll_l1()
+            if s._fold.has_key(1, l2[0].key):
+                break
+            time.sleep(0.01)
+        assert s._fold.has_key(1, l2[0].key)
+        assert s.faults.snapshot().get("agg_fallback_abandons", 0) == 0
+        assert {u.client_id for u in s._updates} \
+            == {cid for cid, _ in active}
+        _bit_equal(s._fold.finish().params,
+                   _oracle(groups, active, trees))
+
+    def test_l2_fallback_recovers_child_partials(self):
+        """A dead INTERIOR aggregator's queue holds its children's
+        partials: the fallback folds them (sums of sums) at the L2
+        group's canonical root position, and members whose child
+        partial the dead node consumed are abandoned as CLIENT ids."""
+        active = [(f"c{i}", 1) for i in range(12)]
+        trees = _trees(active)
+        groups = A.plan_tree(active, 4, levels=2)
+        l1 = [g for g in groups if g.level == 1]
+        l2 = [g for g in groups if g.level == 2]
+        assert len(l1) == 3 and len(l2) == 1
+        bus = InProcTransport()
+        fc = FaultCounters()
+        _publish_updates(bus, groups, active, trees, gen=2)
+        # run the level-1 workers only; their partials pile up on the
+        # dead L2's queue — except the LAST child's, which the dead L2
+        # "consumed" (we drop it before the drain)
+        for g in l1:
+            w = A.L1Aggregator(
+                bus, cluster=0, group=g, members=g.members, gen=2,
+                deadline=time.monotonic() + 5, faults=fc,
+                out_queue=A.aggregate_queue(0, g.parent))
+            while not w.complete:
+                w.feed_raw(bus.get(w.queue, timeout=1.0))
+            w.publish()
+        eaten = l1[-1]
+        q = A.aggregate_queue(0, l2[0].idx)
+        held = []
+        while True:
+            raw = bus.get(q, timeout=0.1)
+            if raw is None:
+                break
+            msg = P.decode(raw)
+            if msg.group != eaten.idx:
+                held.append(raw)
+        for raw in held:
+            bus.publish(q, raw)
+        s = _fallback_stub(bus, groups,
+                           {g.idx: list(g.members) for g in groups})
+        s._fold = A.StreamingFold({1: [l2[0].key]}, faults=s.faults)
+        s._l1_remote = {"aggregator_node_0": list(l2)}
+
+        class _Fleet:
+            def state(self, nid):
+                return "lost"
+        s.fleet = _Fleet()
+        s._poll_l1()
+        assert not s._l1_fallback[l2[0].idx]["flushed"]
+        time.sleep(0.07)
+        s._poll_l1()           # grace expired -> abandon + flush
+        fb = s._l1_fallback[l2[0].idx]
+        assert fb["flushed"]
+        # the eaten child's CLIENTS are abandoned, by id
+        assert s._agg_gone == set(eaten.members)
+        assert s.faults.snapshot()["agg_fallback_abandons"] \
+            == len(eaten.members)
+        # recovered members booked individually at the root
+        assert {u.client_id for u in s._updates} \
+            == {cid for cid, _ in active} - set(eaten.members)
+        # and the fold closed over exactly the recovered children
+        survivors = [cid for cid, _ in active
+                     if cid not in eaten.members]
+        sub = [(cid, 1) for cid in survivors]
+        sub_groups = [g for g in l1 if g is not eaten] + l2
+        pruned_l2 = A.AggGroup(
+            idx=l2[0].idx, stage=1, level=2, parent=None,
+            members=[g.key for g in l1 if g is not eaten])
+        result = s._fold.finish()
+        _bit_equal(result.params,
+                   _oracle([g for g in l1 if g is not eaten]
+                           + [pruned_l2], sub, trees))
+        assert sub_groups  # silence linters
+
+
+# --------------------------------------------------------------------------
+# FrameAssembler assembled-size cap
+# --------------------------------------------------------------------------
+
+class TestAssembledCap:
+
+    def test_chunked_message_over_cap_rejected_and_counted(self,
+                                                           monkeypatch):
+        from split_learning_tpu.runtime import protocol as proto
+        msg = P.Update(client_id="c", stage=1, cluster=0,
+                       params={"w": np.ones((4096,), np.float32)},
+                       num_samples=1)
+        parts = P.encode_parts(msg, max_bytes=1024)
+        assert len(parts) > 4
+        monkeypatch.setattr(proto, "MAX_ASSEMBLED_BYTES", 4096)
+        fc = FaultCounters()
+        asm = P.FrameAssembler(faults=fc)
+        with pytest.raises(P.CorruptFrame, match="assembled cap"):
+            for part in parts:
+                asm.feed(part)
+        assert fc.snapshot().get("oversize_frames") == 1
+        # late chunks of the evicted message are dropped, not revived
+        assert asm.feed(parts[-1]) is None
+        assert fc.snapshot().get("oversize_frames") == 1
+
+    def test_single_frame_over_cap_rejected(self, monkeypatch):
+        from split_learning_tpu.runtime import protocol as proto
+        frame = P.encode(P.Update(
+            client_id="c", stage=1, cluster=0,
+            params={"w": np.ones((4096,), np.float32)},
+            num_samples=1))
+        monkeypatch.setattr(proto, "MAX_ASSEMBLED_BYTES",
+                            len(frame) - 1)
+        fc = FaultCounters()
+        asm = P.FrameAssembler(faults=fc)
+        with pytest.raises(P.CorruptFrame, match="assembled cap"):
+            asm.feed(frame)
+        assert fc.snapshot().get("oversize_frames") == 1
+
+    def test_under_cap_reassembles_and_tracks_bytes(self):
+        msg = P.Update(client_id="c", stage=1, cluster=0,
+                       params={"w": np.ones((512,), np.float32)},
+                       num_samples=1)
+        parts = P.encode_parts(msg, max_bytes=256)
+        asm = P.FrameAssembler()
+        out = None
+        for part in parts:
+            out = asm.feed(part)
+        assert isinstance(out, P.Update)
+        assert asm.last_bytes == sum(len(p) for p in parts)
+        plain = P.encode(P.Syn())
+        asm.feed(plain)
+        assert asm.last_bytes == len(plain)
+
+
+# --------------------------------------------------------------------------
+# protocol-model conformance of the new choreography
+# --------------------------------------------------------------------------
+
+def test_remote_choreography_replays_clean_through_fsms():
+    from split_learning_tpu.analysis.model import (
+        Event, validate_events,
+    )
+    seq = [
+        ("aggregator", "send", "AggHello", "aggregator_node_0"),
+        ("aggregator", "send", "Heartbeat", "aggregator_node_0"),
+        ("server", "recv", "AggHello", "server"),
+        ("server", "send", "Start", "server"),
+        ("server", "recv", "Ready", "server"),
+        ("server", "send", "AggAssign", "server"),
+        ("server", "send", "Syn", "server"),
+        ("aggregator", "recv", "AggAssign", "aggregator_node_0"),
+        ("aggregator", "recv", "Update", "aggregator_node_0"),
+        ("aggregator", "recv", "PartialAggregate", "aggregator_node_0"),
+        ("server", "send", "Pause", "server"),
+        ("server", "send", "AggFlush", "server"),
+        ("aggregator", "recv", "AggFlush", "aggregator_node_0"),
+        ("aggregator", "send", "PartialAggregate", "aggregator_node_0"),
+        ("aggregator", "send", "PartialAggregate", "aggregator_node_0"),
+        ("server", "recv", "PartialAggregate", "server"),
+        ("server", "send", "PartialAggregate", "server"),
+        ("aggregator", "recv", "AggAssign", "aggregator_node_0"),
+        ("server", "send", "Stop", "server"),
+        ("aggregator", "recv", "Stop", "aggregator_node_0"),
+    ]
+    events = [Event(role=r, direction=d, kind=k, participant=p)
+              for r, d, k, p in seq]
+    assert validate_events(events) == []
+
+
+def test_node_log_markers_map_to_aggregator_role():
+    from split_learning_tpu.analysis.model import events_from_log
+    log = ("2026-08-04 - aggregator_node_0.1a2b - INFO - [>>>] "
+           "AGGHELLO\n"
+           "2026-08-04 - aggregator_node_0.1a2b - INFO - [<<<] "
+           "AGGASSIGN gen=1 groups=3\n"
+           "2026-08-04 - aggregator_node_0.1a2b - INFO - [>>>] "
+           "PARTIALAGGREGATE members=3/3\n")
+    events = events_from_log(log)
+    assert [e.kind for e in events] \
+        == ["AggHello", "AggAssign", "PartialAggregate"]
+    assert all(e.role == "aggregator" for e in events)
+
+
+# --------------------------------------------------------------------------
+# observability: node kind in the fleet plane + sl_top rows
+# --------------------------------------------------------------------------
+
+class TestNodeObservability:
+
+    @staticmethod
+    def _beat(part, kind, seq, t, rate=0.0, gauges=None):
+        from split_learning_tpu.runtime.telemetry import (
+            TelemetrySnapshot,
+        )
+        return TelemetrySnapshot(part=part, t=t, seq=seq, kind=kind,
+                                 samples_per_s=rate,
+                                 gauges=gauges or {}).as_dict()
+
+    def test_idle_agg_node_is_not_rate_scored_straggler(self):
+        from split_learning_tpu.runtime.telemetry import FleetMonitor
+        fm = FleetMonitor(interval=1.0, liveness_timeout=10.0)
+        fm.note_heartbeat("c1", self._beat("c1", "client", 2, 100.0,
+                                           rate=12.0), now=100.0)
+        fm.note_heartbeat("c2", self._beat("c2", "client", 2, 100.0,
+                                           rate=11.0), now=100.0)
+        fm.note_heartbeat(
+            "aggregator_node_0",
+            self._beat("aggregator_node_0", "agg_node", 2, 100.0,
+                       rate=0.0,
+                       gauges={"agg_node_folded": 64}), now=100.0)
+        fm.advance(now=100.2)
+        assert fm.state("aggregator_node_0") == "healthy"
+        snap = fm.snapshot(now=100.2)
+        ent = snap["clients"]["aggregator_node_0"]
+        assert ent["kind"] == "agg_node"
+        assert ent["straggler_score"] is None
+        # the node still goes lost on silence like anyone else
+        lost = fm.advance(now=120.0)
+        assert "aggregator_node_0" in lost
+
+    def test_sl_top_renders_aggregator_rows(self):
+        import importlib.util
+        import pathlib
+        spec = importlib.util.spec_from_file_location(
+            "sl_top", pathlib.Path(__file__).parent.parent
+            / "tools" / "sl_top.py")
+        sl_top = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(sl_top)
+        from split_learning_tpu.runtime.telemetry import FleetMonitor
+        fm = FleetMonitor(interval=1.0, liveness_timeout=10.0)
+        fm.note_heartbeat("c1", self._beat("c1", "client", 2, 100.0,
+                                           rate=5.0), now=100.0)
+        fm.note_heartbeat(
+            "aggregator_node_0",
+            self._beat("aggregator_node_0", "agg_node", 2, 100.0),
+            now=100.0)
+        fm.advance(now=100.2)
+        out = sl_top.render_fleet(fm.snapshot(now=100.2), color=False)
+        lines = out.splitlines()
+        agg_row = next(ln for ln in lines
+                       if ln.startswith("aggregator_node_0"))
+        assert " agg " in agg_row
+        client_row = next(ln for ln in lines if ln.startswith("c1"))
+        assert " client " in client_row
+
+
+# --------------------------------------------------------------------------
+# full protocol round with adopted remote nodes — slow
+# --------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_remote_round_bit_identical_to_thread_mode(tmp_path):
+    """A REAL 3-client protocol round (the chaos suite's deterministic
+    cell) with the aggregator tree served by two ADOPTED AggregatorNode
+    participants sharing the in-proc bus: the round completes, the
+    kind=agg record names the remote nodes, and the aggregated params
+    are bit-identical to the thread-mode twin."""
+    import json
+
+    from tests.test_chaos import _round_cfg
+    from split_learning_tpu.runtime.client import ProtocolClient
+    from split_learning_tpu.runtime.server import ProtocolServer
+
+    def run(tag, remote):
+        cfg = _round_cfg(tmp_path, tmp_path / tag, aggregation={
+            "strategy": "sda", "sda_size": 2, "sda_strict": True,
+            "fan_in": 2, "levels": 2, "remote": remote})
+        bus = InProcTransport()
+        server = ProtocolServer(cfg, transport=bus,
+                                client_timeout=300.0)
+        nodes, node_threads = [], []
+        if remote:
+            for i in range(2):
+                node = AggregatorNode(cfg, f"aggregator_node_{i}",
+                                      transport=bus,
+                                      fold_transport=bus)
+                th = threading.Thread(target=node.run, daemon=True)
+                th.start()
+                nodes.append(node)
+                node_threads.append(th)
+        threads = []
+        for stage, count in enumerate(cfg.clients, start=1):
+            for i in range(count):
+                cid = f"client_{stage}_{i}"
+                client = ProtocolClient(cfg, cid, stage, transport=bus)
+                th = threading.Thread(target=client.run, daemon=True)
+                th.start()
+                threads.append(th)
+        result = server.serve()
+        for th in threads + node_threads:
+            th.join(timeout=30)
+            assert not th.is_alive()
+        return result, cfg
+
+    remote_res, cfg = run("remote", True)
+    thread_res, _ = run("threads", False)
+    assert remote_res.history[0].ok and thread_res.history[0].ok
+    assert (remote_res.history[0].num_samples
+            == thread_res.history[0].num_samples)
+    import jax
+    for a, b in zip(jax.tree_util.tree_leaves(remote_res.params),
+                    jax.tree_util.tree_leaves(thread_res.params)):
+        assert np.array_equal(np.asarray(a), np.asarray(b))
+    recs = [json.loads(line) for line in
+            (tmp_path / "remote" / "metrics.jsonl")
+            .read_text().splitlines()]
+    agg_recs = [r for r in recs if r.get("kind") == "agg"]
+    assert agg_recs and agg_recs[-1]["remote_nodes"] == 2
+    assert agg_recs[-1]["node_deaths"] == 0
+    assert agg_recs[-1]["root_ingress_bytes"] > 0
+    node_recs = [r for r in recs if r.get("kind") == "agg_node"]
+    assert node_recs and sum(r["folded"] for r in node_recs) == 3
+
+
+# --------------------------------------------------------------------------
+# kill -9 of a REAL aggregator process (tcp) — slow
+# --------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_kill9_aggregator_process_completes_via_fallback(tmp_path):
+    """Two real aggregator subprocesses over a real TCP broker; one is
+    SIGKILLed before consuming its group's frames.  The root completes
+    via the counted fallback drain with the exact member set — no
+    barrier stall, bit-identical to the oracle over the recovered
+    members (all of them: the kill lands before any consumption)."""
+    import json
+
+    from split_learning_tpu.config import to_dict
+    from split_learning_tpu.runtime.aggnode import spawn_node
+    from split_learning_tpu.runtime.bus import Broker, TcpTransport
+
+    broker = Broker("127.0.0.1", 0)
+    cfg = from_dict({
+        "log_path": str(tmp_path),
+        "transport": {"kind": "tcp", "host": "127.0.0.1",
+                      "port": broker.port, "async_send": False},
+        "observability": {"heartbeat_interval": 0.25,
+                          "liveness_timeout": 6.0},
+        "aggregation": {"fan_in": 2, "remote": True}})
+    cfg_path = tmp_path / "agg.json"
+    cfg_path.write_text(json.dumps(to_dict(cfg), default=list))
+    bus = TcpTransport("127.0.0.1", broker.port)
+    procs = {}
+    try:
+        for i in range(2):
+            nid = f"aggregator_node_{i}"
+            procs[nid] = spawn_node(cfg_path, nid)
+        # adopt both
+        asm = P.FrameAssembler()
+        helloed = set()
+        deadline = time.monotonic() + 60
+        while len(helloed) < 2 and time.monotonic() < deadline:
+            raw = bus.get(P.RPC_QUEUE, timeout=0.5)
+            if raw is None:
+                continue
+            msg = asm.feed(raw)
+            if isinstance(msg, P.AggHello):
+                helloed.add(msg.node_id)
+        assert helloed == {"aggregator_node_0", "aggregator_node_1"}
+
+        active = [(f"c{i}", 1) for i in range(4)]
+        trees = _trees(active)
+        groups = A.plan_tree(active, 2, levels=1)
+        assert len(groups) == 2
+        # node 0 gets group 0, node 1 gets group 1; kill node 1
+        # BEFORE publishing, so every frame stays recoverable
+        victim = "aggregator_node_1"
+        procs[victim].send_signal(signal.SIGKILL)
+        procs[victim].wait(timeout=30)
+        for nid, g in (("aggregator_node_0", groups[0]),
+                       (victim, groups[1])):
+            bus.publish(P.reply_queue(nid), P.encode(P.AggAssign(
+                node_id=nid, cluster=0, gen=2, round_idx=0,
+                groups=[g.as_dict()], deadline_s=60.0)))
+        _publish_updates(bus, groups, active, trees, gen=2)
+
+        s = _fallback_stub(bus, groups,
+                           {g.idx: list(g.members) for g in groups})
+        s._fold = A.StreamingFold(
+            {1: [g.key for g in A.root_groups(groups)]},
+            faults=s.faults)
+        s._l1_remote = {victim: [groups[1]]}
+        s._agg_nodes = {victim: {"proc": procs[victim]}}
+        # pump the live node's partial + run the fallback for the dead
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            s._poll_l1()
+            raw = bus.get(P.RPC_QUEUE, timeout=0.2)
+            if raw is not None:
+                try:
+                    msg = asm.feed(raw)
+                except P.CorruptFrame:
+                    continue
+                if isinstance(msg, P.PartialAggregate) \
+                        and msg.round_idx == 2:
+                    s._fold.add_partial(
+                        msg.stage, A.group_key(msg.group), msg.sums,
+                        msg.weight, msg.dtypes,
+                        n_samples=msg.n_samples)
+            done = all(s._fold.has_key(1, g.key)
+                       for g in A.root_groups(groups))
+            if done:
+                break
+        assert done, "root never completed"
+        snap = s.faults.snapshot()
+        assert snap.get("agg_node_deaths") == 1
+        assert snap.get("agg_l1_fallbacks") == 1
+        assert snap.get("agg_fallback_abandons", 0) == 0
+        assert {u.client_id for u in s._updates} \
+            == set(groups[1].members)
+        _bit_equal(s._fold.finish().params,
+                   _oracle(groups, active, trees))
+    finally:
+        for proc in procs.values():
+            if proc.poll() is None:
+                proc.terminate()
+                try:
+                    proc.wait(timeout=10)
+                except Exception:
+                    proc.kill()
+        bus.close()
+        broker.close()

@@ -661,13 +661,21 @@ class TestAggregatorTree:
         s.fleet = None
         s._l1 = [_DeadL1()]
         s._l1_fallback = {}
+        s._l1_remote = {}
+        s._dead_nodes = set()
+        s._tree_groups = {0: _DeadL1.group}
+        s._tree_narrowed = {0: ["a", "b"]}
         s._agg_gone = set()
         s._cur_gen = 2
+        s._cur_cluster = 0
         s._updates = []
         s._fold = StreamingFold({1: [group_key(0)]}, faults=s.faults)
         s._fold_update = lambda u: None
         s.L1_FALLBACK_GRACE_S = 0.05
-        for name in ("_poll_l1", "_drain_fallback", "_flush_fallback"):
+        for name in ("_poll_l1", "_start_fallback", "_step_fallback",
+                     "_children_draining", "_member_clients",
+                     "_drain_fallback", "_drain_fallback_update",
+                     "_drain_fallback_partial", "_flush_fallback"):
             setattr(s, name, getattr(ProtocolContext, name).__get__(s))
 
         # "a"'s frames are still queued (recoverable); "b"'s were

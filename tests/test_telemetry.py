@@ -526,7 +526,7 @@ class TestExporterAndTop:
         fm.note_heartbeat("c2", _beat(2, 100.0, 1.0), now=100.0)
         fm.advance(now=100.2)
         out = sl_top.render_fleet(fm.snapshot(now=100.2), color=False)
-        assert "CLIENT" in out and "STATE" in out
+        assert "PARTICIPANT" in out and "STATE" in out
         assert "c1" in out and "c2" in out
         assert "straggler" in out          # c2's rate-scored state
         assert "->" in out                 # transitions tail rendered

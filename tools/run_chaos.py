@@ -443,6 +443,132 @@ def async_cell(tmp: str, seed: int = 11) -> tuple[bool, str]:
                   f"{wall_a:.0f}s/{wall_b:.0f}s)")
 
 
+def tree_remote_cell(tmp: str) -> tuple[bool, str]:
+    """Multi-process aggregator-tree cell (aggregation.remote): a real
+    TCP broker, THREE aggregator subprocesses spawned by the server
+    (``aggregation.nodes: 3``), a 3-client deterministic round — and
+    one aggregator process SIGKILLed mid-round, right after its group
+    assignment lands.  PASSes iff
+
+    * the round completes without a barrier stall (the killed node's
+      groups degrade to the server's counted direct-to-root fallback
+      drain — detected via the spawned process's exit, the same path
+      FleetMonitor ``lost`` drives for adopted nodes);
+    * the kind=agg record counts the node death and the fault record
+      counts ``agg_l1_fallbacks`` ≥ 1 with every member still folded
+      or explicitly abandoned;
+    * the surviving nodes' ``kind=agg_node`` records and the tree
+      topology land as artifacts (``agg_tree.json``).
+    """
+    import json
+    import threading as _threading
+
+    sys.path.insert(0, "tests")
+    from test_chaos import _round_cfg  # noqa: E402
+
+    from split_learning_tpu.runtime.bus import Broker
+    from split_learning_tpu.runtime.chaos import make_runtime_transport
+    from split_learning_tpu.runtime.client import ProtocolClient
+    from split_learning_tpu.runtime.server import ProtocolServer
+
+    cell_dir = pathlib.Path(tmp) / "tree_remote"
+    cell_dir.mkdir(parents=True, exist_ok=True)
+    broker = Broker("127.0.0.1", 0)
+    killed = {}
+    try:
+        cfg = _round_cfg(
+            pathlib.Path(tmp), cell_dir,
+            transport={"kind": "tcp", "host": "127.0.0.1",
+                       "port": broker.port},
+            aggregation={"strategy": "sda", "sda_size": 2,
+                         "sda_strict": True, "fan_in": 2, "levels": 2,
+                         "remote": True, "nodes": 3},
+            observability={"heartbeat_interval": 0.5,
+                           "liveness_timeout": 15.0})
+        server = ProtocolServer(cfg, client_timeout=300.0)
+        threads = []
+        for stage, count in enumerate(cfg.clients, start=1):
+            for i in range(count):
+                cid = f"client_{stage}_{i}"
+                client = ProtocolClient(
+                    cfg, cid, stage,
+                    transport=make_runtime_transport(cfg, cid))
+                th = _threading.Thread(target=client.run, daemon=True)
+                th.start()
+                threads.append(th)
+
+        def killer():
+            deadline = time.monotonic() + 240
+            while time.monotonic() < deadline:
+                for nid, glist in sorted(
+                        server.ctx._l1_remote.items()):
+                    if not glist:
+                        continue
+                    proc = (server.ctx._agg_nodes.get(nid)
+                            or {}).get("proc")
+                    if proc is not None and proc.poll() is None:
+                        proc.kill()     # SIGKILL: no cleanup, no flush
+                        killed["nid"] = nid
+                        killed["groups"] = [g.idx for g in glist]
+                        return
+                time.sleep(0.05)
+
+        kt = _threading.Thread(target=killer, daemon=True)
+        kt.start()
+        t0 = time.monotonic()
+        res = server.serve()
+        wall = time.monotonic() - t0
+        kt.join(timeout=5)
+        for th in threads:
+            th.join(timeout=30)
+        topo = {"agg_tree": server.ctx._agg_topology,
+                "killed": killed,
+                "fleet": (server.ctx.fleet.snapshot()
+                          if server.ctx.fleet is not None else {})}
+        (cell_dir / "agg_tree.json").write_text(
+            json.dumps(topo, indent=2, default=str))
+    finally:
+        broker.close()
+    if not res.history or not res.history[0].ok:
+        return False, "round not ok"
+    if wall > 240:
+        return False, f"round stalled ({wall:.0f}s)"
+    if not killed:
+        return False, "no aggregator process killed (assignment never " \
+                      "observed)"
+    recs = []
+    for p in cell_dir.rglob("metrics.jsonl"):
+        if p.is_symlink():
+            continue
+        for line in p.read_text().splitlines():
+            try:
+                recs.append(json.loads(line))
+            except json.JSONDecodeError:
+                continue
+    agg = [r for r in recs if r.get("kind") == "agg"]
+    if not agg:
+        return False, "no kind=agg record"
+    if agg[-1].get("node_deaths", 0) < 1:
+        return False, "node death not counted on the agg record"
+    if agg[-1].get("remote_nodes", 0) < 3:
+        return False, f"expected 3 remote nodes, saw " \
+                      f"{agg[-1].get('remote_nodes')}"
+    faults = [r for r in recs if r.get("kind") == "faults"]
+    snap = faults[-1] if faults else {}
+    fallbacks = snap.get("agg_l1_fallbacks", 0)
+    if not fallbacks:
+        return False, "agg_l1_fallbacks never counted"
+    node_recs = [r for r in recs if r.get("kind") == "agg_node"]
+    if not node_recs:
+        return False, "no kind=agg_node records from surviving nodes"
+    abandoned = snap.get("agg_fallback_abandons", 0)
+    return True, (f"killed {killed['nid']} "
+                  f"(groups {killed['groups']}), "
+                  f"fallbacks={fallbacks} abandoned={abandoned} "
+                  f"survivor_folds={sum(r.get('folded', 0) for r in node_recs)} "
+                  f"[{wall:.0f}s]")
+
+
 def overlap_cell(tmp: str, seed: int = 13) -> tuple[bool, str]:
     """Sync-overlap chaos cell (learning.sync-overlap): a 3-client
     sync round with the round-boundary overlap ON, under drop +
@@ -546,6 +672,14 @@ def main(argv=None):
                          "complete with no barrier stall, fold "
                          "deterministically (twin-seed bit-identity), "
                          "and count stale rejections exactly")
+    ap.add_argument("--tree-remote", dest="tree_remote",
+                    action="store_true",
+                    help="run ONLY the multi-process aggregator-tree "
+                         "cell: 3 aggregator subprocesses over a real "
+                         "TCP broker serve a 3-client round's tree; "
+                         "one is SIGKILLed mid-round and the round "
+                         "must complete via the counted direct-to-"
+                         "root fallback drain")
     ap.add_argument("--overlap", dest="overlap_mode",
                     action="store_true",
                     help="run ONLY the sync-overlap cell: a 3-client "
@@ -554,6 +688,20 @@ def main(argv=None):
                          "to a fault-free overlap-off twin with no "
                          "barrier stall")
     args = ap.parse_args(argv)
+
+    if args.tree_remote:
+        if args.artifacts_dir:
+            tmp = args.artifacts_dir
+            pathlib.Path(tmp).mkdir(parents=True, exist_ok=True)
+        else:
+            import tempfile
+            tmp = tempfile.mkdtemp(prefix="chaos_tree_remote_")
+        t0 = time.monotonic()
+        ok, note = tree_remote_cell(tmp)
+        dt = time.monotonic() - t0
+        print(f"tree-remote cell: {'PASS' if ok else 'FAIL'} ({note}) "
+              f"[{dt:.1f}s, artifacts in {tmp}]")
+        return 0 if ok else 1
 
     if args.overlap_mode:
         if args.artifacts_dir:

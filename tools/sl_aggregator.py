@@ -1,0 +1,28 @@
+#!/usr/bin/env python
+"""``sl_aggregator`` — standalone aggregator-node process
+(``aggregation.remote``).
+
+One interior node of the multi-process aggregator tree: connects to
+the TCP broker with the full runtime transport stack
+(Reliable/Chaos/Async compose unchanged), announces itself with
+AGGHELLO, heartbeats into the server's FleetMonitor, and folds the
+groups each round's AGGASSIGN hands it — publishing one
+PartialAggregate per group (codec'd when ``transport.codec: partial``
+is set) to its parent.  See ``runtime/aggnode.py``.
+
+    python tools/sl_aggregator.py --config config.yaml \
+        --node-id aggregator_node_0
+
+The server spawns these itself when ``aggregation.nodes`` is set;
+start them by hand (or under a process manager, one per host) for a
+real multi-host deployment.
+"""
+
+import sys
+
+sys.path.insert(0, ".")  # run from the repo root
+
+from split_learning_tpu.runtime.aggnode import main  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(main())

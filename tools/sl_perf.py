@@ -61,6 +61,12 @@ STABLE_KEYS = {
     # full-tree copies at the UPDATE barrier (O(1) memory headline)
     "extra.agg_wall_per_client_ms": "down",
     "extra.agg_peak_tree_copies": "down",
+    # multi-process aggregator tree (round-12): end-to-end aggregate
+    # wall per client at 10k synthetic clients through 3 real
+    # aggregator processes over TCP, and the root's PartialAggregate
+    # ingress bytes with the partial codec on vs raw fp32
+    "extra.agg_wall_per_client_ms_10k": "down",
+    "extra.agg_root_ingress_mb_ratio": "down",
     # async decoupled mode (round-10): delayed-cell throughput, the
     # delayed async/sync wall ratio (<1 = async wins under RTT), and
     # the accuracy parity delta at equal sample budget
@@ -82,6 +88,14 @@ STABLE_KEYS = {
 STABLE_KEY_CAPS = {
     "extra.split_ratio_vs_unsplit": 1.7,
     "extra.update_overlap_ratio": 0.5,
+    # multi-process tree acceptance pins (round-12): codec'd root
+    # ingress must stay <= 0.35x of raw fp32, and the 10k-client
+    # aggregate wall per client must stay flat (the 100-client point
+    # of the same leg measured ~1.4 ms and 10k ~0.94 ms on the r07
+    # host; the absolute pin is ~1.5x the measurement so a
+    # superlinear-aggregation regression cannot calcify)
+    "extra.agg_root_ingress_mb_ratio": 0.35,
+    "extra.agg_wall_per_client_ms_10k": 1.5,
 }
 
 #: attribution components of a kind=perf record, in report order
@@ -131,6 +145,7 @@ for _k in ("protocol_samples_per_sec", "cold_round_wall_s",
            "wire_mb_per_round", "wire_mb_per_round_compressed",
            "mfu_vs_datasheet", "measured_matmul_roofline_tflops",
            "agg_wall_per_client_ms", "agg_peak_tree_copies",
+           "agg_wall_per_client_ms_10k", "agg_root_ingress_mb_ratio",
            "async_samples_per_sec", "async_wall_ratio_vs_sync",
            "async_accuracy_delta", "update_bubble_ms",
            "update_overlap_ratio"):

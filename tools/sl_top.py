@@ -31,9 +31,14 @@ _STATE_COLOR = {"healthy": "\033[92m", "degraded": "\033[93m",
                 "straggler": "\033[95m", "lost": "\033[91m"}
 _RESET = "\033[0m"
 
-_COLUMNS = ("CLIENT", "STATE", "ROUND", "VLAG", "SAMPLES", "RATE/s",
-            "SCORE", "MFU", "STEP p95 ms", "RTT p95 ms", "WIRE MB",
-            "AGE s")
+_COLUMNS = ("PARTICIPANT", "ROLE", "STATE", "ROUND", "VLAG", "SAMPLES",
+            "RATE/s", "SCORE", "MFU", "STEP p95 ms", "RTT p95 ms",
+            "WIRE MB", "AGE s")
+
+#: telemetry snapshot `kind` -> table role label; aggregator nodes
+#: (aggregation.remote) rate-columns read "-": their samples/s is
+#: structurally 0, the AGG gauges carry their load instead
+_ROLE = {"client": "client", "agg_node": "agg"}
 
 
 def fetch_fleet(url: str, timeout: float = 3.0) -> dict:
@@ -81,11 +86,15 @@ def render_fleet(fleet: dict, color: bool = True,
     rows = [_COLUMNS]
     for cid, c in sorted(clients.items()):
         wire_mb = (c.get("wire_bytes_out") or 0) / 1e6
+        agg = c.get("kind") == "agg_node"
         rows.append((
-            cid, c.get("state", "?"), _fmt(c.get("round")),
+            cid, _ROLE.get(c.get("kind", "client"), c.get("kind")),
+            c.get("state", "?"), _fmt(c.get("round")),
             # async version lag (bounded-staleness mode); "-" outside it
             _fmt(c.get("version_lag")),
-            _fmt(c.get("samples")), _fmt(c.get("samples_per_s")),
+            # aggregator rows: training columns are structurally empty
+            "-" if agg else _fmt(c.get("samples")),
+            "-" if agg else _fmt(c.get("samples_per_s")),
             _fmt(c.get("straggler_score"), 2),
             # perf-plane gauges (runtime/perf.py); "-" for clients
             # predating the plane
@@ -100,7 +109,7 @@ def render_fleet(fleet: dict, color: bool = True,
         cells = [f"{str(v):<{w}}" for v, w in zip(row, widths)]
         line = "  ".join(cells)
         if color and ri > 0:
-            c = _STATE_COLOR.get(row[1])
+            c = _STATE_COLOR.get(row[2])
             if c:
                 line = f"{c}{line}{_RESET}"
         lines.append(line)
