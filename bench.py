@@ -244,6 +244,18 @@ class Artifact:
             for k in ("update_bubble_ms", "update_overlap_ratio"):
                 if k in uov:
                     self.extra[k] = uov[k]
+        # stable keys (round-13 scheduler PR): steady-state scheduler-
+        # on/off round-wall ratio on the heterogeneous simulated
+        # fleet, the 10k-client decision-pass wall, and the paired
+        # real-cell accuracy delta — mirrored at fixed paths for the
+        # sl_perf --diff gate
+        schf = self.results.get("sched_fleet")
+        if isinstance(schf, dict):
+            for k in ("sched_wall_ratio_vs_static",
+                      "sched_decision_ms_10k",
+                      "sched_accuracy_delta"):
+                if k in schf and schf[k] is not None:
+                    self.extra[k] = schf[k]
         plan = (self.cfgs.get("tinyllama_tinystories_4stage") or {})
         if isinstance(plan, dict):
             per_dev = (plan.get("memory_plan") or {}).get("per_device_gb")
@@ -1874,6 +1886,256 @@ def _sec_update_overlap(ctx: dict) -> dict:
     return out
 
 
+def _sim_fleet_leg(tag: str, n1: int, rounds: int, sched: bool, *,
+                   compute_slow: int = 0, wire_slow: int = 0,
+                   time_scale: float = 1.0,
+                   heartbeat: float = 0.25, grace: float = 0.3,
+                   evict_after: int = 2,
+                   client_timeout: float = 300.0) -> dict:
+    """One synthetic-fleet deployment (runtime/simfleet.py) against
+    the real server/telemetry/aggregation planes; returns round walls
+    + scheduler decision stats."""
+    import shutil
+
+    from split_learning_tpu.config import from_dict
+    from split_learning_tpu.runtime.bus import InProcTransport
+    from split_learning_tpu.runtime.log import Logger
+    from split_learning_tpu.runtime.server import ProtocolServer
+    from split_learning_tpu.runtime.simfleet import (
+        SyntheticFleet, hetero_fleet,
+    )
+
+    logdir = f"/tmp/slt_bench_sched_{tag}"
+    shutil.rmtree(logdir, ignore_errors=True)
+    cfg = from_dict({
+        "model": "KWT", "dataset": "SPEECHCOMMANDS",
+        "clients": [n1, 1], "global-rounds": rounds,
+        "synthetic-size": 48, "val-max-batches": 1,
+        "val-batch-size": 16,
+        "model-kwargs": {"embed_dim": 16, "num_heads": 2,
+                         "mlp_dim": 32},
+        "log-path": logdir,
+        "learning": {"batch-size": 4},
+        "topology": {"cut-layers": [2]},
+        "checkpoint": {"save": False, "validate": False,
+                       "directory": f"{logdir}/ckpt"},
+        "observability": {"heartbeat-interval": heartbeat,
+                          "liveness-timeout":
+                              max(30.0, 8 * heartbeat)},
+        "scheduler": {"enabled": sched, "warmup-rounds": 1,
+                      "evict-after": evict_after,
+                      "barrier-grace-s": grace},
+    })
+    specs = hetero_fleet(n1, 1, compute_speed=100.0,
+                         compute_slow=compute_slow,
+                         compute_slow_factor=8.0,
+                         wire_slow=wire_slow, samples=32, seed=0)
+    bus = InProcTransport()
+    server = ProtocolServer(cfg, transport=bus,
+                            logger=Logger.for_run(cfg, "server",
+                                                  console=False),
+                            client_timeout=client_timeout)
+    fleet = SyntheticFleet(bus, specs, heartbeat_interval=heartbeat,
+                           time_scale=time_scale).start()
+    t0 = time.perf_counter()
+    try:
+        res = server.serve()
+    finally:
+        fleet.stop()
+    out = {
+        "wall_s": round(time.perf_counter() - t0, 3),
+        "round_walls_s": [round(r.wall_s, 3) for r in res.history],
+        "rounds_ok": all(r.ok for r in res.history),
+        "samples": [r.num_samples for r in res.history],
+    }
+    ctx_s = server.ctx
+    if ctx_s.scheduler is not None:
+        out["decisions"] = sum(
+            1 for d in ctx_s.scheduler.decisions
+            if d["action"] != "decide")
+        out["decision_ms"] = ctx_s.gauges.get("sched_decision_ms")
+    return out
+
+
+def _sec_sched_fleet(ctx: dict) -> dict:
+    """Closed-loop resource-aware scheduler (ROADMAP item 1): three
+    legs, all against the REAL server/telemetry/aggregation planes.
+
+    1. **Paired heterogeneity cell** — a 40-client simulated fleet
+       (3 compute-stragglers at 1/8 device speed, 3 wire-stragglers
+       at ~6x wire time) runs the same rounds with the scheduler OFF
+       (static hand-written plan: every barrier waits for the slowest
+       client) and ON (stragglers demoted with retuned knobs,
+       barrier-dropped past the grace, evicted after 2 boundaries).
+       Stable key ``sched_wall_ratio_vs_static`` = steady-state
+       (final-round) wall ON / OFF — the headline, pinned <= 0.7.
+
+    2. **10k-client control-plane cell** — a 10k-client registration
+       storm + full protocol rounds; stable key
+       ``sched_decision_ms_10k`` is the scheduler's own boundary
+       decision-pass wall at 10k clients (pinned so the control loop
+       can never become the bottleneck), with the 1k point next to it
+       to show the per-client cost flat.
+
+    3. **Accuracy-parity cell** — a REAL paired KWT deployment (2
+       feeders + 1 head, one feeder's data plane delay-injected both
+       directions) with the scheduler off vs on (demotion only:
+       eviction + mid-round drops disabled so the sample budgets
+       match exactly); the demoted feeder consumes its codec knob
+       through the real client path.  ``sched_accuracy_delta`` is
+       best-of-run val accuracy (on - off) at the equal budget.
+    """
+    out: dict = {}
+
+    # -- leg 1: paired heterogeneous fleet -----------------------------------
+    n1, rounds = 40, 4
+    off = _sim_fleet_leg("off", n1, rounds, sched=False,
+                         compute_slow=3, wire_slow=3)
+    on = _sim_fleet_leg("on", n1, rounds, sched=True,
+                        compute_slow=3, wire_slow=3)
+    steady_off = off["round_walls_s"][-1]
+    steady_on = on["round_walls_s"][-1]
+    out["paired"] = {"off": off, "on": on}
+    out["sched_wall_ratio_vs_static"] = round(
+        steady_on / steady_off, 4) if steady_off else None
+    out["ratio_within_budget"] = (steady_off > 0
+                                  and steady_on / steady_off <= 0.6)
+
+    # -- leg 2: 10k control-plane scaling ------------------------------------
+    try:
+        k10 = _sim_fleet_leg("10k", 10000, 2, sched=True,
+                             time_scale=0.004, heartbeat=10.0,
+                             grace=5.0, client_timeout=500.0)
+        k1 = _sim_fleet_leg("1k", 1000, 2, sched=True,
+                            time_scale=0.004, heartbeat=10.0,
+                            grace=5.0)
+        out["scale"] = {"10k": k10, "1k": k1}
+        if k10.get("decision_ms") is not None:
+            out["sched_decision_ms_10k"] = round(k10["decision_ms"],
+                                                 3)
+            out["sched_decision_ms_1k"] = (
+                round(k1["decision_ms"], 3)
+                if k1.get("decision_ms") is not None else None)
+            # flat per-client decision cost: 10x the clients must not
+            # cost anywhere near 10x per client (<= 3x headroom)
+            if out["sched_decision_ms_1k"]:
+                out["decision_flat_ratio"] = round(
+                    (k10["decision_ms"] / 10000)
+                    / (k1["decision_ms"] / 1000), 3)
+                out["decision_flat_within_budget"] = \
+                    out["decision_flat_ratio"] <= 3.0
+        out["scale_rounds_ok"] = bool(k10.get("rounds_ok"))
+    except Exception as e:  # noqa: BLE001 — the paired leg above is
+        # still a valid record on a host too small for the 10k storm
+        out["scale"] = {"error": f"{type(e).__name__}: {e}"}
+
+    # -- leg 3: accuracy parity (real clients) -------------------------------
+    out["accuracy"] = _sched_accuracy_leg()
+    if "sched_accuracy_delta" in out["accuracy"]:
+        out["sched_accuracy_delta"] = out["accuracy"][
+            "sched_accuracy_delta"]
+    log(f"[bench] sched_fleet: ratio="
+        f"{out.get('sched_wall_ratio_vs_static')} "
+        f"decide10k={out.get('sched_decision_ms_10k')}ms "
+        f"acc_delta={out.get('sched_accuracy_delta')}")
+    return out
+
+
+def _sched_accuracy_leg() -> dict:
+    """Paired real-client KWT cell, scheduler off vs on (demotion
+    only), one feeder's data plane delay-injected both ways."""
+    import shutil
+    import threading
+
+    from split_learning_tpu.config import ChaosConfig, from_dict
+    from split_learning_tpu.runtime.bus import InProcTransport
+    from split_learning_tpu.runtime.chaos import ChaosTransport
+    from split_learning_tpu.runtime.client import ProtocolClient
+    from split_learning_tpu.runtime.server import ProtocolServer
+    from split_learning_tpu.runtime.trace import FaultCounters
+
+    rounds = int(os.environ.get("SLT_BENCH_SCHED_ROUNDS", 6))
+    feeder_chaos = ChaosConfig(
+        enabled=True, seed=21, delay=0.5, delay_s=0.4,
+        queues=("intermediate_queue*",))
+    head_chaos = ChaosConfig(
+        enabled=True, seed=22, delay=0.5, delay_s=0.4,
+        queues=("gradient_queue_*_sa_1_1",))
+
+    def cell(tag: str, sched: bool,
+             cell_rounds: int) -> tuple[float, float, int, int]:
+        logdir = f"/tmp/slt_bench_schedacc_{tag}"
+        shutil.rmtree(logdir, ignore_errors=True)
+        cfg = from_dict({
+            "model": "KWT", "dataset": "SPEECHCOMMANDS",
+            "clients": [2, 1], "global-rounds": cell_rounds,
+            "synthetic-size": 512, "val-max-batches": 3,
+            "val-batch-size": 32, "compute-dtype": "float32",
+            "model-kwargs": {"embed_dim": 16, "num_heads": 2,
+                             "mlp_dim": 32},
+            "log-path": logdir,
+            "learning": {"batch-size": 8, "control-count": 2,
+                         "optimizer": "adamw", "learning-rate": 1e-3},
+            "distribution": {"num-samples": 192},
+            "topology": {"cut-layers": [2]},
+            "observability": {"heartbeat-interval": 0.5},
+            "checkpoint": {"directory": f"{logdir}/ckpt",
+                           "save": False},
+            # demotion only: eviction + mid-round drops off, so both
+            # legs fold exactly the same sample budget and the delta
+            # reads accuracy, not membership
+            "scheduler": {"enabled": sched, "warmup-rounds": 1,
+                          "evict": False, "barrier-grace-s": 0.0},
+        })
+        bus = InProcTransport()
+        server = ProtocolServer(cfg, transport=bus,
+                                client_timeout=300.0)
+        threads = []
+        for stage, count in enumerate(cfg.clients, start=1):
+            for i in range(count):
+                cid = f"sa_{stage}_{i}"
+                stack = bus
+                if (stage, i) == (1, 1):
+                    stack = ChaosTransport(bus, feeder_chaos,
+                                           name=cid,
+                                           faults=FaultCounters())
+                elif stage == 2:
+                    stack = ChaosTransport(bus, head_chaos, name=cid,
+                                           faults=FaultCounters())
+                c = ProtocolClient(cfg, cid, stage, transport=stack)
+                t = threading.Thread(target=c.run, daemon=True)
+                t.start()
+                threads.append(t)
+        t0 = time.perf_counter()
+        res = server.serve()
+        wall = time.perf_counter() - t0
+        for t in threads:
+            t.join(timeout=30)
+        accs = [r.val_accuracy for r in res.history
+                if r.val_accuracy is not None]
+        samples = sum(r.num_samples for r in res.history)
+        demotes = 0
+        if server.ctx.scheduler is not None:
+            demotes = sum(1 for d in server.ctx.scheduler.decisions
+                          if d["action"] == "demote")
+        return wall, (max(accs) if accs else 0.0), samples, demotes
+
+    cell("warm", False, 1)   # compile warm-up
+    wall_off, acc_off, n_off, _ = cell("off", False, rounds)
+    wall_on, acc_on, n_on, demotes = cell("on", True, rounds)
+    return {
+        "rounds": rounds,
+        "walls_s": {"off": round(wall_off, 2),
+                    "on": round(wall_on, 2)},
+        "acc": {"off": round(acc_off, 4), "on": round(acc_on, 4)},
+        "samples": {"off": n_off, "on": n_on},
+        "sched_demotes": demotes,
+        "sched_accuracy_delta": round(acc_on - acc_off, 4),
+        "equal_budget": n_on == n_off,
+        "accuracy_within_budget": abs(acc_on - acc_off) <= 0.02,
+    }
+
+
 def _sec_test_ok(ctx: dict) -> dict:
     """Hidden test section: trivially succeeds (watchdog CI coverage)."""
     return {"ok": True}
@@ -1894,6 +2156,7 @@ SECTIONS = {
     "agg_scaling": _sec_agg_scaling,
     "async_vs_sync": _sec_async_vs_sync,
     "update_overlap": _sec_update_overlap,
+    "sched_fleet": _sec_sched_fleet,
     "resnet50_cifar100_3way_cut_3_6": _sec_resnet,
     "vit_s16_cifar10_cut_block6": _sec_vit,
     "tinyllama_tinystories_4stage": _sec_llama,
@@ -1916,6 +2179,7 @@ SECTION_PLAN = [
     ("agg_scaling", 900),
     ("async_vs_sync", 900),
     ("update_overlap", 900),
+    ("sched_fleet", 1200),
     ("resnet50_cifar100_3way_cut_3_6", 900),
     ("vit_s16_cifar10_cut_block6", 1500),
     ("tinyllama_tinystories_4stage", 3000),

@@ -24,7 +24,7 @@ from split_learning_tpu.analysis.findings import (
 )
 
 ANALYZERS = ("protocol", "jaxpr", "concurrency", "counters", "codec",
-             "perf", "agg", "async")
+             "perf", "agg", "async", "sched")
 
 
 def repo_root() -> pathlib.Path:
@@ -58,6 +58,9 @@ def run_analyzers(root: pathlib.Path, names=ANALYZERS,
     if "async" in names:
         from split_learning_tpu.analysis import async_check
         findings += async_check.run(root)
+    if "sched" in names:
+        from split_learning_tpu.analysis import sched_check
+        findings += sched_check.run(root)
     return findings
 
 

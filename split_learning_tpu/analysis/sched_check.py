@@ -1,0 +1,77 @@
+"""``sched`` analyzer — no silent scheduler control actions.
+
+**SC001**: every scheduler decision site must emit a ``kind=sched``
+journal record.  The closed-loop scheduler
+(``runtime/scheduler.py``) concentrates its control actions in
+``_act_*`` methods — evict, demote, re-plan, mid-round barrier drop,
+cluster move — and each one must call ``self.journal(...)`` (the one
+funnel that writes the ``kind=sched`` metrics record and the bounded
+in-memory journal ``/fleet`` serves).  A decision site that skips the
+journal is a control action an operator can never attribute: a client
+disappears from the round and nothing on disk says why.  That is
+exactly the debuggability regression this rule exists to prevent —
+the scheduler is allowed to act only on the record.
+
+Rule: in ``runtime/scheduler.py``, every function whose name starts
+with ``_act_`` must contain a call whose attribute name is
+``journal``.  The prefix is the extension point: new control actions
+are added as ``_act_*`` methods and inherit the obligation
+automatically (a reviewer adding a decision path outside an ``_act_*``
+method will meet the convention in the module docstring and this
+analyzer's tests).
+"""
+
+from __future__ import annotations
+
+import ast
+import pathlib
+
+from split_learning_tpu.analysis.findings import Finding
+
+#: files holding scheduler decision sites
+FILES = ("split_learning_tpu/runtime/scheduler.py",)
+
+#: decision-site naming convention
+ACT_PREFIX = "_act_"
+
+
+def _calls_journal(fn: ast.AST) -> bool:
+    for n in ast.walk(fn):
+        if isinstance(n, ast.Call):
+            f = n.func
+            if isinstance(f, ast.Attribute) and f.attr == "journal":
+                return True
+            if isinstance(f, ast.Name) and f.id == "journal":
+                return True
+    return False
+
+
+def check_source(source: str, rel: str) -> list[Finding]:
+    findings: list[Finding] = []
+    tree = ast.parse(source)
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.FunctionDef,
+                                 ast.AsyncFunctionDef)):
+            continue
+        if not node.name.startswith(ACT_PREFIX):
+            continue
+        if not _calls_journal(node):
+            findings.append(Finding(
+                code="SC001", path=rel, line=node.lineno,
+                where=node.name,
+                message=(f"scheduler decision site {node.name} does "
+                         "not journal: every control action must "
+                         "emit a kind=sched record "
+                         "(self.journal(...)) — no silent "
+                         "evictions/demotions/re-plans")))
+    return findings
+
+
+def run(root: pathlib.Path) -> list[Finding]:
+    findings: list[Finding] = []
+    for rel in FILES:
+        path = root / rel
+        if not path.exists():
+            continue
+        findings += check_source(path.read_text(), rel)
+    return findings

@@ -605,6 +605,93 @@ class PerfConfig:
 
 
 @dataclasses.dataclass(frozen=True)
+class SchedulerConfig:
+    """Closed-loop resource-aware scheduler (``runtime/scheduler.py``).
+
+    Runs at round boundaries on the protocol server, consuming the
+    fleet-telemetry plane (per-client EWMA rate, compute rate, step
+    p95, version lag — PRs 7/8/10) and closing the loop back into the
+    plan: online clustering of clients, straggler demotion/eviction
+    with per-client knob retunes, and measured-throughput cut
+    re-planning.  Every decision is journaled as a ``kind=sched``
+    metrics record (slcheck SC001 enforces that no control action is
+    silent).  Default off — a static hand-written plan behaves exactly
+    as before."""
+    enabled: bool = False
+    # decide every N rounds (1 = every round boundary)
+    interval: int = 1
+    # observe-only boundaries before the first action: the policies
+    # need at least one round of telemetry to score against
+    warmup_rounds: int = 1
+    # online-clustering centroid count; 0 = one cluster per plan
+    clusters: int = 0
+    # mini-batch KMeans partial-fit cap per boundary: bounds the
+    # decision cost so clustering stays O(minibatch) per round however
+    # large the fleet grows (assignment stays O(n), vectorized)
+    minibatch: int = 1024
+    # sticky re-assignment margin: a client moves cluster only when the
+    # new centroid is at least this fraction CLOSER than its current
+    # one — the hysteresis that keeps assignments stable under churn
+    hysteresis: float = 0.25
+    # straggler eviction (through the elastic-drop path) on/off, and
+    # how many consecutive scheduler boundaries a client must score
+    # straggler before it is evicted rather than demoted
+    evict: bool = True
+    evict_after: int = 2
+    # per-client knob demotion on/off
+    demote: bool = True
+    # codec retune shipped to WIRE-slow stragglers (START extra.sched):
+    # any intermediate-family spec (runtime/codec/specs.py)
+    wire_slow_codec: str = "int8:64"
+    # extra bounded-staleness window granted to COMPUTE-slow stragglers
+    # (async mode: their late Updates keep folding), and whether they
+    # are exempted from quorum denominators
+    staleness_bonus: int = 2
+    # measured-throughput cut re-planning on/off, the damping threshold
+    # (a new cut is adopted only when its predicted round wall improves
+    # on the incumbent by at least this fraction — the anti-flap
+    # contract), and the cooldown in rounds between adopted re-plans
+    replan: bool = True
+    replan_damping: float = 0.15
+    replan_cooldown: int = 2
+    # mid-round barrier policy: a NOTIFY/UPDATE barrier may drop a
+    # health-state-straggler client after waiting this many seconds
+    # (0 disables mid-round drops; lost clients are always droppable
+    # via the fleet-liveness path regardless)
+    barrier_grace_s: float = 20.0
+    seed: int = 0
+
+    def validate(self):
+        _check(self.interval >= 1, "scheduler.interval must be >= 1")
+        _check(self.warmup_rounds >= 0,
+               "scheduler.warmup-rounds must be >= 0")
+        _check(self.clusters >= 0, "scheduler.clusters must be >= 0")
+        _check(self.minibatch >= 1, "scheduler.minibatch must be >= 1")
+        _check(0.0 <= self.hysteresis < 1.0,
+               f"scheduler.hysteresis must be in [0, 1), "
+               f"got {self.hysteresis!r}")
+        _check(self.evict_after >= 1,
+               "scheduler.evict-after must be >= 1")
+        _check(self.staleness_bonus >= 0,
+               "scheduler.staleness-bonus must be >= 0")
+        _check(0.0 <= self.replan_damping < 1.0,
+               f"scheduler.replan-damping must be in [0, 1), "
+               f"got {self.replan_damping!r}")
+        _check(self.replan_cooldown >= 0,
+               "scheduler.replan-cooldown must be >= 0")
+        _check(self.barrier_grace_s >= 0,
+               "scheduler.barrier-grace-s must be >= 0")
+        from split_learning_tpu.runtime.codec.specs import (
+            CodecSpecError, parse_codec_map,
+        )
+        try:
+            parse_codec_map({"intermediate": self.wire_slow_codec})
+        except CodecSpecError as e:
+            raise ConfigError(
+                f"scheduler.wire-slow-codec: {e}") from None
+
+
+@dataclasses.dataclass(frozen=True)
 class Config:
     model: str = "VGG16"
     dataset: str = "CIFAR10"
@@ -633,6 +720,7 @@ class Config:
     chaos: ChaosConfig = ChaosConfig()
     observability: ObservabilityConfig = ObservabilityConfig()
     perf: PerfConfig = PerfConfig()
+    scheduler: SchedulerConfig = SchedulerConfig()
 
     @property
     def model_key(self) -> str:
@@ -652,8 +740,17 @@ class Config:
                f"got {self.compute_dtype!r}")
         for sub in (self.learning, self.distribution, self.topology,
                     self.aggregation, self.transport, self.chaos,
-                    self.observability, self.perf):
+                    self.observability, self.perf, self.scheduler):
             sub.validate()
+        if self.scheduler.enabled:
+            # the scheduler's only senses are the fleet-telemetry
+            # plane's; with heartbeats disabled there is no
+            # FleetMonitor and every policy would be blind
+            _check(self.observability.heartbeat_interval > 0,
+                   "scheduler.enabled requires "
+                   "observability.heartbeat-interval > 0 (the "
+                   "scheduler's inputs are the fleet-telemetry "
+                   "plane's per-client series)")
         if self.learning.mode == "async":
             # the bounded-staleness admission window lives in the
             # streaming fold; strategies that consume individual
@@ -706,6 +803,7 @@ _SECTION_TYPES = {
     "chaos": ChaosConfig,
     "observability": ObservabilityConfig,
     "perf": PerfConfig,
+    "scheduler": SchedulerConfig,
 }
 
 

@@ -12,6 +12,9 @@ from split_learning_tpu.planner.partition import partition, partition_multiway
 from split_learning_tpu.planner.selection import auto_threshold, select_devices
 from split_learning_tpu.planner.cluster import kmeans_cluster, clustering_algorithm
 from split_learning_tpu.planner.distribution import synthesize_label_counts
+from split_learning_tpu.planner.throughput import (
+    implied_bandwidth, predict_round_wall, replan_cuts, scaled_exe_time,
+)
 
 __all__ = [
     "partition",
@@ -21,4 +24,8 @@ __all__ = [
     "kmeans_cluster",
     "clustering_algorithm",
     "synthesize_label_counts",
+    "scaled_exe_time",
+    "implied_bandwidth",
+    "predict_round_wall",
+    "replan_cuts",
 ]

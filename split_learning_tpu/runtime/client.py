@@ -537,6 +537,11 @@ class ProtocolClient:
         # activations, EF-sparsified gradients, delta-encoded Updates.
         # Families without a policy fall back to the wire-dtype path.
         self.codecs = make_codecs(cfg, faults=self.faults)
+        # scheduler-granted knob retune currently applied (START
+        # extra.sched, runtime/scheduler.py): the codec-override map
+        # in force, so a repeated grant doesn't rebuild codecs (and
+        # reset their EF state) every round
+        self._sched_codec_over: dict | None = None
         # delta codec state: (version, base tree) of the last START
         # params, and the shadow version the server advertised — a
         # delta is sent ONLY when these agree (else: full frame)
@@ -683,6 +688,41 @@ class ProtocolClient:
         self.wire.count_raw(
             RPC_QUEUE, wire_raw_nbytes(params_h, np.float32))
         return rpc.encode_update(params_h, base_tree), ver
+
+    def _apply_sched_knobs(self, knobs: dict | None) -> None:
+        """Apply a scheduler-granted per-client retune (START
+        ``extra.sched``): a codec-override map is merged over the
+        config's ``transport.codec`` block and the wire codecs are
+        rebuilt.  Idempotent — the same grant repeated every round
+        rebuilds nothing (EF-stateful codecs keep their residuals);
+        a revoked grant (None) reverts to the config codecs.  A bad
+        spec is rejected-and-counted, never fatal: a scheduler bug
+        must cost one knob frame, not the client."""
+        over = (knobs or {}).get("codec") or None
+        if over == self._sched_codec_over:
+            return
+        import types
+
+        from split_learning_tpu.runtime.codec.specs import (
+            CodecSpecError,
+        )
+        base = dict(getattr(self.cfg.transport, "codec", None) or {})
+        merged = {**base, **(over or {})}
+        shim = types.SimpleNamespace(transport=types.SimpleNamespace(
+            codec=merged or None))
+        try:
+            codecs = make_codecs(shim, faults=self.faults)
+        except CodecSpecError as e:
+            self.faults.inc("sched_knob_rejects")
+            self.log.warning(
+                f"rejecting scheduler codec knob {over!r}: {e}")
+            return
+        self.codecs = codecs
+        self._sched_codec_over = over
+        self.log.info(
+            "scheduler retune: codec "
+            + (f"override {over}" if over else "reverted to config"),
+            "cyan")
 
     def _ef_stateful_codecs(self):
         for family in ("gradient", "rpc"):
@@ -833,6 +873,9 @@ class ProtocolClient:
         # re-shape per round).  Tree rounds never advertise a delta
         # base, so the full-frame path follows automatically.
         self._agg_group = extra.get("agg_group")
+        # scheduler-granted per-client knob retune (heavier wire codec
+        # for a wire-slow straggler; runtime/scheduler.py)
+        self._apply_sched_knobs(extra.get("sched"))
         # server-issued per-invocation generation: stamps every message
         # this client sends so the server/peers can drop strays from an
         # invocation that was already abandoned (round_idx alone can't —

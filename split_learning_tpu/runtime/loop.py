@@ -118,6 +118,17 @@ def run_training(cfg: Config, ctx: TrainContext,
                             f"cuts={plan.cuts} clients="
                             f"{[len(ids) for ids in plan.clients]}",
                             "cyan")
+                # closed-loop scheduler (scheduler.enabled, protocol
+                # backend): the round-boundary decision pass — online
+                # clustering, straggler eviction/demotion, measured-
+                # throughput cut re-planning — runs AFTER the elastic
+                # refresh so it scores the membership that will
+                # actually train
+                schedule = getattr(ctx, "schedule_plans", None)
+                if schedule is not None:
+                    sched_plans = schedule(plans, r)
+                    if sched_plans is not None:
+                        plans = sched_plans
             if capture is not None:
                 # armed via POST /profile: the window opens at this
                 # round boundary and closes at the round's end (in the

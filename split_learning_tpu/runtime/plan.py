@@ -70,6 +70,27 @@ def _num_classes(cfg: Config) -> int:
     return DATASET_CLASSES.get(cfg.dataset, 10)
 
 
+def prune_plan_members(plans: list, pruned: set) -> list | None:
+    """Remove ``pruned`` clients from plans without re-planning; None
+    when any cluster would lose a whole stage (an empty pipeline stage
+    cannot run).  Shared by the server's elastic prune and the
+    scheduler's eviction — one copy of the feasibility invariant."""
+    if not pruned:
+        return None
+    new_plans = []
+    for p in plans:
+        keep = [i for i, c in enumerate(p.stage1_clients)
+                if c not in pruned]
+        clients = [[c for c in ids if c not in pruned]
+                   for ids in p.clients]
+        if any(not ids for ids in clients):
+            return None
+        new_plans.append(dataclasses.replace(
+            p, clients=clients,
+            label_counts=np.asarray(p.label_counts)[keep]))
+    return new_plans
+
+
 def plan_clusters(cfg: Config,
                   registrations: list[Registration],
                   exact_counts: bool = True) -> list[ClusterPlan]:

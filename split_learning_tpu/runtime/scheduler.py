@@ -1,0 +1,708 @@
+"""Closed-loop resource-aware scheduler: telemetry in, plan out.
+
+The reference's third pillar (``src/Cluster.py`` KMeans client
+clustering, ``src/Selection.py`` GMM straggler rejection,
+``src/Partition.py`` throughput-optimal cut selection) ran ONCE, at the
+registration barrier, on self-reported profiles.  Everything it decided
+was frozen for the life of the run — a client that slowed down after
+round 3 set the round wall forever.  This module is the live
+counterpart: a decision loop running at round boundaries on the
+protocol server, consuming the planes the last five PRs built
+(per-client EWMA rate and compute rate, step p95, version lag,
+compute-slow vs wire-slow attribution — ``runtime/telemetry.py`` +
+``runtime/perf.py``) and closing the loop back into the plan:
+
+* **online clustering** (:class:`OnlineClusterer`) — mini-batch KMeans
+  over each client's label-distribution sketch + measured compute/wire
+  ratio, with sticky re-assignment hysteresis so membership churn
+  cannot flap assignments;
+* **straggler policy** — per-boundary scoring with attribution; a
+  straggler is first DEMOTED with per-client knob retunes (heavier
+  wire codec for wire-slow, wider staleness window + quorum exemption
+  for compute-slow — the PR 6/10 knobs, driven per client instead of
+  one global config) and EVICTED through the elastic-drop path after
+  ``scheduler.evict-after`` consecutive straggler boundaries;
+* **cut re-planning** — the measured-throughput model in
+  :mod:`split_learning_tpu.planner.throughput` re-runs the max-min
+  pipeline-balance search on live rates each boundary; a new cut ships
+  through the existing re-plan/START machinery only when it beats the
+  incumbent's predicted round wall by ``scheduler.replan-damping``
+  (anti-flap) and the cooldown has elapsed;
+* **mid-round barrier drops** — a NOTIFY/UPDATE barrier may stop
+  waiting for a health-state-straggler client after
+  ``scheduler.barrier-grace-s`` seconds (the same early-release shape
+  as the fleet-liveness drop, but policy-driven).
+
+Every decision flows through :meth:`Scheduler.journal` and lands as a
+``kind=sched`` metrics record — the slcheck ``sched`` analyzer (SC001)
+statically enforces that every ``_act_*`` decision site journals, so
+no control action is ever silent.  Decisions are DETERMINISTIC given
+the same telemetry snapshots and seed: all iteration is over sorted
+client ids, all randomness is drawn from ``(scheduler.seed, round)``.
+
+No jax, no protocol imports: plan surgery happens on
+:class:`~split_learning_tpu.runtime.plan.ClusterPlan` dataclasses, the
+server owns every wire side effect (STOP fan-out, shadow reclaim,
+``_needs_params`` marking).
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import statistics
+import threading
+import time
+from typing import Any, Sequence
+
+import numpy as np
+
+from split_learning_tpu.runtime.plan import (
+    ClusterPlan, prune_plan_members,
+)
+
+#: journal actions the validator admits (``validate_journal``)
+ACTIONS = ("decide", "evict", "evict-skip", "demote", "promote",
+           "replan", "drop", "cluster")
+
+#: score threshold mirroring FleetMonitor.STRAGGLER_SCORE: a rate (or
+#: compute rate) below this fraction of the fleet median is slow
+SLOW_SCORE = 0.5
+
+
+@dataclasses.dataclass
+class SchedOutcome:
+    """One boundary's decisions, for the server to apply."""
+    round_idx: int
+    evict: set                       # client ids to evict (elastic path)
+    plans: list | None               # replacement plans, or None
+    decision_ms: float = 0.0
+
+
+class OnlineClusterer:
+    """Mini-batch KMeans with sticky re-assignment hysteresis.
+
+    ``update`` takes the current feature map (sorted-client iteration,
+    deterministic), partial-fits at most ``minibatch`` points into the
+    running centroids (so the per-boundary cost is bounded however
+    large the fleet grows), then re-assigns: a client keeps its
+    current cluster unless another centroid is at least ``hysteresis``
+    fractionally closer — the damping that keeps assignments stable
+    while clients join, leave and drift."""
+
+    def __init__(self, k: int, hysteresis: float = 0.25,
+                 minibatch: int = 1024, seed: int = 0):
+        self.k = max(1, int(k))
+        self.hysteresis = float(hysteresis)
+        self.minibatch = int(minibatch)
+        self.seed = int(seed)
+        self.centers: np.ndarray | None = None
+        self._counts: np.ndarray | None = None
+        self.assignment: dict[str, int] = {}
+
+    def _init_centers(self, x: np.ndarray,
+                      rng: np.random.Generator) -> None:
+        k = min(self.k, x.shape[0])
+        centers = np.empty((k, x.shape[1]))
+        centers[0] = x[rng.integers(x.shape[0])]
+        d2 = ((x - centers[0]) ** 2).sum(axis=1)
+        for i in range(1, k):
+            total = d2.sum()
+            if total <= 0:
+                centers[i] = x[rng.integers(x.shape[0])]
+            else:
+                centers[i] = x[rng.choice(x.shape[0], p=d2 / total)]
+            d2 = np.minimum(d2, ((x - centers[i]) ** 2).sum(axis=1))
+        self.centers = centers
+        self._counts = np.ones(k)
+
+    def update(self, features: dict[str, Sequence[float]],
+               round_idx: int) -> tuple[dict[str, int], list[str]]:
+        """Fit + assign.  Returns ``(assignment, moved_client_ids)``."""
+        cids = sorted(features)
+        if not cids:
+            return dict(self.assignment), []
+        x = np.asarray([features[c] for c in cids], dtype=float)
+        rng = np.random.default_rng((self.seed, round_idx))
+        if self.centers is None or self.centers.shape[1] != x.shape[1]:
+            self._init_centers(x, rng)
+        assert self.centers is not None and self._counts is not None
+        # mini-batch partial fit (Sculley 2010): each sampled point
+        # pulls its nearest centroid with a 1/count learning rate
+        batch = (np.arange(len(cids))
+                 if len(cids) <= self.minibatch
+                 else rng.choice(len(cids), size=self.minibatch,
+                                 replace=False))
+        for i in np.sort(batch):
+            d2 = ((self.centers - x[i]) ** 2).sum(axis=1)
+            j = int(d2.argmin())
+            self._counts[j] += 1
+            lr = 1.0 / self._counts[j]
+            self.centers[j] = (1 - lr) * self.centers[j] + lr * x[i]
+        # vectorized assignment, sticky: keep the current cluster
+        # unless a rival centroid is a full hysteresis margin closer
+        d2 = ((x[:, None, :] - self.centers[None, :, :]) ** 2).sum(2)
+        nearest = d2.argmin(axis=1)
+        moved: list[str] = []
+        out: dict[str, int] = {}
+        for i, cid in enumerate(cids):
+            cur = self.assignment.get(cid)
+            if cur is None or cur >= self.centers.shape[0]:
+                out[cid] = int(nearest[i])
+                if cur is not None:
+                    moved.append(cid)
+                continue
+            if (nearest[i] != cur
+                    and d2[i, nearest[i]]
+                    < (1.0 - self.hysteresis) * d2[i, cur]):
+                out[cid] = int(nearest[i])
+                moved.append(cid)
+            else:
+                out[cid] = cur
+        # forget departed clients so churn cannot grow the map forever
+        self.assignment = out
+        return dict(out), moved
+
+
+def validate_journal(records: Sequence[dict]) -> list[str]:
+    """Schema check over a run's ``kind=sched`` records: every control
+    action must be fully attributable — action name from the known
+    vocabulary, the round it was taken at, a human-readable why, and a
+    client/cluster subject where the action has one.  Returns a list
+    of violations (empty = valid); used by the chaos ``--sched`` cell
+    and the determinism tests."""
+    errs: list[str] = []
+    for i, rec in enumerate(records):
+        act = rec.get("action")
+        if act not in ACTIONS:
+            errs.append(f"record {i}: unknown action {act!r}")
+            continue
+        if not isinstance(rec.get("round"), int):
+            errs.append(f"record {i} ({act}): missing integer round")
+        if act != "decide" and not rec.get("why"):
+            errs.append(f"record {i} ({act}): missing why")
+        if act in ("evict", "demote", "promote", "drop", "cluster") \
+                and not rec.get("client"):
+            errs.append(f"record {i} ({act}): missing client")
+        if act == "replan":
+            det = rec.get("detail") or {}
+            if "cuts_to" not in det or "cuts_from" not in det:
+                errs.append(f"record {i} (replan): missing cuts detail")
+    return errs
+
+
+class Scheduler:
+    """The round-boundary decision loop (one per ProtocolContext).
+
+    The server calls :meth:`plan_round` between rounds with the
+    current plans, the FleetMonitor's ``/fleet`` snapshot and the
+    registration profiles; the returned :class:`SchedOutcome` names
+    evictions and (possibly) replacement plans.  During a round the
+    barriers consult :meth:`barrier_drop`, the START fan-out ships
+    :meth:`knobs_for` per client, and the async admission window reads
+    :meth:`staleness_bonus_for` / :meth:`quorum_exempt`."""
+
+    #: bounded decision journal (the /fleet + topology() view)
+    MAX_JOURNAL = 1024
+    #: members fed to the cut-search cost model per cluster (evenly
+    #: strided over the sorted membership; see _replan_plan)
+    REPLAN_MEMBER_SAMPLE = 64
+
+    def __init__(self, cfg, log=None, faults=None, gauges=None):
+        self.cfg = cfg
+        self.sch = cfg.scheduler
+        # guards the journal/last-action/replan views: topology() is
+        # served from the telemetry exporter's HTTP threads while the
+        # protocol thread journals decisions
+        self._lock = threading.Lock()
+        self.log = log
+        self.faults = faults
+        self.gauges = gauges
+        self.clusterer = OnlineClusterer(
+            k=self.sch.clusters or 1,
+            hysteresis=self.sch.hysteresis,
+            minibatch=self.sch.minibatch, seed=self.sch.seed)
+        self.decisions: collections.deque = collections.deque(
+            maxlen=self.MAX_JOURNAL)
+        self.last_action: dict[str, str] = {}
+        self.last_replan: dict | None = None
+        self._ledger: dict[str, int] = {}   # consecutive straggler
+        self._healthy: dict[str, int] = {}  # consecutive healthy
+        # boundaries while demoted — the promote-side hysteresis
+        self._knobs: dict[str, dict] = {}   # cid -> START extra.sched
+        self._stale_bonus: dict[str, int] = {}
+        self._exempt: set = set()
+        self._evicted: set = set()
+        self._last_replan_round: int | None = None
+        self._last_decide_round: int | None = None
+        # first boundary pass that was past warmup: until it has
+        # happened, the mid-round barrier policy stays inert — round 0
+        # must never drop a client on seconds-old telemetry
+        self._last_acting_round: int | None = None
+
+    # -- journal (the ONE exit for decisions; SC001) -------------------------
+
+    def journal(self, action: str, round_idx: int, client=None,
+                cluster=None, why: str = "", detail=None) -> None:
+        """Record one decision: a ``kind=sched`` metrics record plus
+        the bounded in-memory journal the ``/fleet`` view serves.
+        Deterministic content only — wall-clock cost rides the
+        ``decide`` summary's detail, never an action record."""
+        rec = {"action": action, "round": int(round_idx),
+               "client": client, "cluster": cluster, "why": why,
+               "detail": detail or {}}
+        with self._lock:
+            self.decisions.append(rec)
+            if client is not None:
+                self.last_action[client] = f"{action}@r{round_idx}"
+        if self.log is not None:
+            self.log.metric(kind="sched", **rec)
+            if action not in ("decide",):
+                who = client if client is not None \
+                    else f"cluster {cluster}"
+                self.log.info(f"sched: {action} {who} r{round_idx}"
+                              + (f" ({why})" if why else ""), "cyan")
+
+    # -- per-round inputs ----------------------------------------------------
+
+    @staticmethod
+    def _views(fleet: dict) -> dict[str, dict]:
+        """Per-client telemetry views (training clients only — an
+        aggregator node is never schedulable)."""
+        out = {}
+        for cid, c in (fleet.get("clients") or {}).items():
+            if c.get("kind", "client") != "client":
+                continue
+            out[cid] = c
+        return out
+
+    def _features(self, plans: list, views: dict) -> dict:
+        """Clustering features: L1-normalized label distribution (the
+        reference Cluster.py input) + the measured compute/wire ratio
+        (end-to-end rate over device rate; 1.0 = wire-free) as one
+        extra dimension."""
+        label_of: dict[str, np.ndarray] = {}
+        n_classes = 1
+        for p in plans:
+            lc = np.asarray(p.label_counts, dtype=float)
+            if lc.ndim == 2 and lc.shape[0] == len(p.stage1_clients):
+                n_classes = max(n_classes, lc.shape[1])
+                for i, cid in enumerate(p.stage1_clients):
+                    row = lc[i]
+                    norm = np.abs(row).sum() or 1.0
+                    label_of[cid] = row / norm
+        feats = {}
+        for cid in sorted(label_of):
+            v = views.get(cid, {})
+            rate = v.get("samples_per_s") or 0.0
+            crate = v.get("compute_samples_per_s") or 0.0
+            ratio = (min(1.0, rate / crate)
+                     if rate > 0 and crate > 0 else 1.0)
+            row = label_of[cid]
+            if row.shape[0] < n_classes:
+                row = np.pad(row, (0, n_classes - row.shape[0]))
+            feats[cid] = np.concatenate([row, [ratio]])
+        return feats
+
+    @staticmethod
+    def _medians(views: dict) -> tuple[float | None, float | None]:
+        rates = [v.get("samples_per_s") for v in views.values()
+                 if v.get("samples_per_s") and v.get("state") != "lost"]
+        crates = [v.get("compute_samples_per_s")
+                  for v in views.values()
+                  if v.get("compute_samples_per_s")
+                  and v.get("state") != "lost"]
+        return (statistics.median(rates) if rates else None,
+                statistics.median(crates) if crates else None)
+
+    def _attribute(self, v: dict, med, cmed) -> str:
+        """Why is this client slow: ``stale`` (version lag), `
+        ``compute`` (device rate trails the fleet), ``wire`` (device
+        rate healthy, end-to-end rate is not), else ``unknown``."""
+        lag = v.get("version_lag")
+        if lag is not None and lag >= 2:
+            return "stale"
+        crate = v.get("compute_samples_per_s")
+        if crate and cmed:
+            if crate < SLOW_SCORE * cmed:
+                return "compute"
+            rate = v.get("samples_per_s")
+            if rate is not None and med and rate < SLOW_SCORE * med:
+                return "wire"
+        return "unknown"
+
+    # -- decision sites (every _act_* MUST journal — slcheck SC001) ----------
+
+    def _act_demote(self, cid: str, attribution: str,
+                    round_idx: int) -> None:
+        """Grant per-client knob retunes instead of one global config:
+        wire-slow gets a heavier activation codec (its round is wire
+        bytes); compute/stale-slow gets a wider bounded-staleness
+        window and a quorum exemption (its contribution folds late
+        instead of holding the fleet)."""
+        if attribution == "wire":
+            knobs: dict[str, Any] = {
+                "codec": {"intermediate": self.sch.wire_slow_codec}}
+            why = (f"wire-slow: retuned intermediate codec to "
+                   f"{self.sch.wire_slow_codec}")
+        elif attribution in ("compute", "stale"):
+            knobs = {"staleness_bonus": self.sch.staleness_bonus,
+                     "quorum_exempt": True}
+            self._stale_bonus[cid] = self.sch.staleness_bonus
+            self._exempt.add(cid)
+            why = (f"{attribution}-slow: staleness window "
+                   f"+{self.sch.staleness_bonus}, quorum-exempt")
+        else:
+            knobs = {"quorum_exempt": True}
+            self._exempt.add(cid)
+            why = "slow (unattributed): quorum-exempt"
+        self._knobs[cid] = knobs
+        if self.faults is not None:
+            self.faults.inc("sched_demotions")
+        self.journal("demote", round_idx, client=cid, why=why,
+                     detail={"attribution": attribution,
+                             "knobs": knobs})
+
+    def _act_promote(self, cid: str, round_idx: int,
+                     boundaries: int) -> None:
+        """Revoke a demotion after a sustained recovery: the client
+        has scored healthy for as many consecutive boundaries as the
+        evict ladder requires (symmetric hysteresis — one good
+        boundary must not flap the knobs off, a transient blip must
+        not degrade wire fidelity forever).  The next START ships
+        ``sched: None`` and the client reverts to its config codecs."""
+        self._knobs.pop(cid, None)
+        self._stale_bonus.pop(cid, None)
+        self._exempt.discard(cid)
+        self._healthy.pop(cid, None)
+        self.journal(
+            "promote", round_idx, client=cid,
+            why=f"healthy for {boundaries} consecutive boundaries: "
+                "demotion knobs revoked",
+            detail={"boundaries": boundaries})
+
+    def _act_evict(self, cid: str, round_idx: int,
+                   boundaries: int) -> None:
+        """Evict a persistent straggler through the elastic-drop path
+        (the server publishes STOP, reclaims its shadow and forgets
+        its telemetry; a later re-REGISTER rejoins it)."""
+        self._evicted.add(cid)
+        self._forget(cid)
+        if self.faults is not None:
+            self.faults.inc("sched_evictions")
+        self.journal(
+            "evict", round_idx, client=cid,
+            why=f"straggler for {boundaries} consecutive boundaries "
+                f"(>= evict-after {self.sch.evict_after})",
+            detail={"boundaries": boundaries})
+
+    def _act_replan(self, plan: ClusterPlan, result: dict,
+                    round_idx: int) -> None:
+        """Adopt a measured-throughput cut re-plan for one cluster
+        (ships through the existing re-plan/START machinery: the
+        server marks every member whose layer range moved for a full
+        re-seed)."""
+        self._last_replan_round = round_idx
+        self.last_replan = {
+            "round": round_idx, "cluster": plan.cluster_id,
+            "cuts_from": list(plan.cuts), "cuts_to": result["cuts"],
+            "improvement": result["improvement"]}
+        if self.faults is not None:
+            self.faults.inc("sched_replans")
+        self.journal(
+            "replan", round_idx, cluster=plan.cluster_id,
+            why=(f"predicted round wall improves "
+                 f"{result['improvement']:.0%} (>= damping "
+                 f"{self.sch.replan_damping:.0%})"),
+            detail={"cuts_from": list(plan.cuts),
+                    "cuts_to": list(result["cuts"]),
+                    "predicted_wall_s": result["predicted_wall_s"],
+                    "incumbent_wall_s": result["incumbent_wall_s"],
+                    "improvement": result["improvement"]})
+
+    def _act_drop(self, cid: str, round_idx: int, state: str,
+                  waited_s: float) -> None:
+        """Mid-round barrier drop: the round stops waiting for a
+        health-state-straggler past the grace window (its late Update
+        still folds through the staleness window in async mode)."""
+        if self.faults is not None:
+            self.faults.inc("sched_barrier_drops")
+        self.journal(
+            "drop", round_idx, client=cid,
+            why=(f"barrier waited {waited_s:.1f}s > grace "
+                 f"{self.sch.barrier_grace_s:g}s for a {state} "
+                 "client"),
+            detail={"state": state, "waited_s": round(waited_s, 3)})
+
+    def _act_cluster_move(self, cid: str, src, dst,
+                          round_idx: int) -> None:
+        """One client crossed the hysteresis margin into another
+        online cluster."""
+        if self.faults is not None:
+            self.faults.inc("sched_cluster_moves")
+        self.journal(
+            "cluster", round_idx, client=cid, cluster=dst,
+            why=f"feature drift past hysteresis "
+                f"{self.sch.hysteresis:g} (from cluster {src})",
+            detail={"from": src, "to": dst})
+
+    # -- the boundary pass ---------------------------------------------------
+
+    def plan_round(self, plans: list, round_idx: int, fleet: dict,
+                   profiles: dict | None = None) -> SchedOutcome:
+        """One closed-loop pass: observe → cluster → score → act.
+        Deterministic given (plans, fleet, profiles, seed)."""
+        t0 = time.perf_counter()
+        out = SchedOutcome(round_idx=round_idx, evict=set(),
+                           plans=None)
+        views = self._views(fleet)
+        acting = (round_idx >= self.sch.warmup_rounds
+                  and (round_idx % self.sch.interval) == 0)
+
+        # (a) online clustering — always observes (the map must track
+        # the fleet through warmup), moves journal once acting
+        prev = dict(self.clusterer.assignment)
+        feats = self._features(plans, views)
+        assignment, moved = self.clusterer.update(feats, round_idx)
+        if self.gauges is not None:
+            self.gauges.set("sched_clusters",
+                            len(set(assignment.values())))
+        if acting:
+            for cid in moved:
+                self._act_cluster_move(cid, prev.get(cid),
+                                       assignment[cid], round_idx)
+
+        # (b) straggler policy
+        med, cmed = self._medians(views)
+        evict: set = set()
+        evict_n: dict[str, int] = {}
+        if acting:
+            for cid in sorted(views):
+                v = views[cid]
+                straggling = v.get("state") in ("straggler", "lost")
+                if not straggling:
+                    score = v.get("straggler_score")
+                    straggling = (score is not None
+                                  and score < SLOW_SCORE)
+                if not straggling:
+                    if self._ledger.pop(cid, None) is not None \
+                            and self.log is not None:
+                        self.log.info(
+                            f"sched: {cid} recovered (ledger reset)",
+                            "green")
+                    if cid in self._knobs or cid in self._exempt:
+                        # promote-side hysteresis, symmetric with the
+                        # evict ladder: the demotion is revoked only
+                        # after evict-after consecutive HEALTHY
+                        # boundaries — one good boundary must not
+                        # flap the knobs off
+                        streak = self._healthy[cid] = \
+                            self._healthy.get(cid, 0) + 1
+                        if streak >= self.sch.evict_after:
+                            self._act_promote(cid, round_idx, streak)
+                    continue
+                self._healthy.pop(cid, None)
+                n = self._ledger[cid] = self._ledger.get(cid, 0) + 1
+                if self.sch.evict and n >= self.sch.evict_after:
+                    evict.add(cid)
+                    evict_n[cid] = n
+                elif self.sch.demote and cid not in self._knobs:
+                    self._act_demote(cid, self._attribute(v, med,
+                                                          cmed),
+                                     round_idx)
+        new_plans = plans
+        changed = False
+        if evict:
+            # feasibility BEFORE the journal: an eviction that cannot
+            # be applied must never be recorded (or counted) as one
+            pruned = prune_plan_members(plans, evict)
+            if pruned is None:
+                # dropping these members would empty a pipeline
+                # stage, and an empty stage cannot run
+                self.journal(
+                    "evict-skip", round_idx,
+                    why="eviction would empty a pipeline stage; "
+                        "demoting instead",
+                    detail={"clients": sorted(evict)})
+                for cid in sorted(evict):
+                    self._ledger[cid] = self.sch.evict_after - 1
+                    if self.sch.demote and cid not in self._knobs:
+                        self._act_demote(
+                            cid, self._attribute(views[cid], med,
+                                                 cmed), round_idx)
+                evict = set()
+            else:
+                new_plans, changed = pruned, True
+                for cid in sorted(evict):
+                    self._act_evict(cid, round_idx, evict_n[cid])
+        out.evict = evict
+
+        # (c) measured-throughput cut re-planning, damped + cooled
+        if acting and self.sch.replan:
+            cooled = (self._last_replan_round is None
+                      or round_idx - self._last_replan_round
+                      > self.sch.replan_cooldown)
+            if cooled:
+                replanned = []
+                for p in new_plans:
+                    res = self._replan_plan(p, views, profiles or {})
+                    if res is not None and res["adopted"]:
+                        self._act_replan(p, res, round_idx)
+                        p = dataclasses.replace(
+                            p, cuts=list(res["cuts"]))
+                        changed = True
+                    replanned.append(p)
+                new_plans = replanned
+
+        out.plans = new_plans if changed else None
+        out.decision_ms = round((time.perf_counter() - t0) * 1e3, 3)
+        if self.gauges is not None:
+            self.gauges.set("sched_decision_ms", out.decision_ms)
+        self._last_decide_round = round_idx
+        if acting:
+            self._last_acting_round = round_idx
+        self.journal(
+            "decide", round_idx,
+            why="boundary pass",
+            detail={"clients": len(views), "acting": acting,
+                    "evicted": sorted(evict),
+                    # THIS boundary's demotions, not the cumulative
+                    # demoted population — the decide stream must say
+                    # when control actions actually happened
+                    "demoted": sum(
+                        1 for d in list(self.decisions)
+                        if d["action"] == "demote"
+                        and d["round"] == round_idx),
+                    "moves": len(moved) if acting else 0,
+                    "decision_ms": out.decision_ms})
+        return out
+
+    def _replan_plan(self, plan: ClusterPlan, views: dict,
+                     profiles: dict) -> dict | None:
+        """Measured inputs for one cluster's cut search: the profile's
+        per-layer shape + boundary bytes, rescaled to each member's
+        measured device rate, with the wire bandwidth implied by its
+        measured end-to-end/device rate gap at the CURRENT cut."""
+        if plan.n_stages < 2 or not plan.cuts:
+            return None
+        from split_learning_tpu.planner.throughput import (
+            implied_bandwidth, replan_cuts, scaled_exe_time,
+        )
+        members = list(plan.stage1_clients)
+        # bound the per-boundary model cost: rates add harmonically
+        # across members, so an evenly-strided subsample scales BOTH the
+        # incumbent's and every candidate's predicted rate by the same
+        # factor — the argmin and the improvement ratio the damping
+        # gate reads are unchanged, while a 1k-member cluster costs
+        # the same as a 64-member one
+        if len(members) > self.REPLAN_MEMBER_SAMPLE:
+            stride = len(members) / self.REPLAN_MEMBER_SAMPLE
+            members = [members[int(i * stride)]
+                       for i in range(self.REPLAN_MEMBER_SAMPLE)]
+        profs = [(profiles.get(c) or {}) for c in members]
+        size_data = next((p["size_data"] for p in profs
+                          if p.get("size_data")), None)
+        base_exe = next((p["exe_time"] for p in profs
+                         if p.get("exe_time")), None)
+        if size_data is None or base_exe is None:
+            return None   # nothing to model transfer bytes against
+        wire_factor = {"float32": 1.0, "float16": 0.5,
+                       "bfloat16": 0.5, "int8": 0.25}[
+                           self.cfg.transport.wire_dtype_normalized]
+        size_data = [float(s) * wire_factor for s in size_data]
+        cur_cut_bytes = size_data[int(plan.cuts[0]) - 1]
+        exe, nets = [], []
+        for c, p in zip(members, profs):
+            v = views.get(c, {})
+            exe.append(scaled_exe_time(
+                p.get("exe_time") or base_exe,
+                v.get("compute_samples_per_s")))
+            bw = implied_bandwidth(cur_cut_bytes,
+                                   v.get("samples_per_s"),
+                                   v.get("compute_samples_per_s"))
+            if not bw:
+                bw = float(p.get("network") or 0.0)
+            nets.append(bw)
+        n_groups = plan.n_stages
+        # later stages are unprofiled at the server (the reference
+        # keeps only stage-1 size_data); mirror group 1, like the
+        # static planner does
+        return replan_cuts([exe] * n_groups, [nets] * n_groups,
+                           size_data, plan.cuts,
+                           damping=self.sch.replan_damping)
+
+    # -- in-round queries ----------------------------------------------------
+
+    def knobs_for(self, cid: str) -> dict | None:
+        """The per-client knob frame riding START ``extra.sched``."""
+        return self._knobs.get(cid)
+
+    def staleness_bonus_for(self, cid: str) -> int:
+        return self._stale_bonus.get(cid, 0)
+
+    @property
+    def max_staleness_bonus(self) -> int:
+        """Upper bound of any granted bonus — sizes the server's
+        (client, version) dedup-ledger retention."""
+        return max(self._stale_bonus.values(), default=0)
+
+    def quorum_exempt(self, cid: str) -> bool:
+        return cid in self._exempt
+
+    def barrier_drop(self, missing: set, states: dict,
+                     waited_s: float, round_idx: int) -> set:
+        """Mid-round policy: which of the clients a barrier is still
+        waiting on should it stop waiting for NOW.  Only health-state
+        stragglers, only past the grace window — a healthy-but-
+        briefly-quiet client is never dropped here."""
+        # barrier-grace-s is the ONE control for mid-round drops
+        # (0 = never), independent of the evict switch: an operator
+        # forbidding evictions must still be able to keep barriers
+        # from stalling on a health-state straggler
+        if (self.sch.barrier_grace_s <= 0
+                or waited_s < self.sch.barrier_grace_s
+                or self._last_acting_round is None):
+            return set()
+        drop = {cid for cid in missing
+                if states.get(cid) == "straggler"}
+        for cid in sorted(drop):
+            self._act_drop(cid, round_idx, states.get(cid, "?"),
+                           waited_s)
+        return drop
+
+    def _forget(self, cid: str) -> None:
+        self._ledger.pop(cid, None)
+        self._knobs.pop(cid, None)
+        self._stale_bonus.pop(cid, None)
+        self._exempt.discard(cid)
+        self.clusterer.assignment.pop(cid, None)
+
+    # -- views ---------------------------------------------------------------
+
+    def annotate_fleet(self, snap: dict) -> dict:
+        """Stamp a FleetMonitor snapshot with the scheduler view: the
+        ``scheduler`` block plus per-client ``cluster``/``sched``
+        fields.  The ONE place the view shape lives — shared by the
+        ``/fleet`` endpoint, the journaled ``kind=fleet`` record and
+        the chaos cell's artifact."""
+        topo = self.topology()
+        snap["scheduler"] = topo
+        for cid, c in (snap.get("clients") or {}).items():
+            c["cluster"] = topo["clusters"].get(cid)
+            c["sched"] = topo["actions"].get(cid)
+        return snap
+
+    def topology(self) -> dict:
+        """The ``/fleet`` scheduler view: current cluster map, last
+        per-client action, the last adopted re-plan, and the recent
+        decision journal tail.  Lock-guarded — the exporter's HTTP
+        threads call this while the protocol thread journals."""
+        with self._lock:
+            return {
+                "clusters": dict(self.clusterer.assignment),
+                "actions": dict(self.last_action),
+                "last_replan": self.last_replan,
+                "decisions": list(self.decisions)[-64:],
+            }
+
+

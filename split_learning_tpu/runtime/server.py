@@ -230,6 +230,24 @@ class ProtocolContext(MeshContext):
         # per-client responsive-set fence overrides captured at the
         # SYN fan-out, reused for late-READY joiners
         self._syn_overrides: dict = {}
+        # closed-loop resource-aware scheduler (runtime/scheduler.py,
+        # scheduler.enabled): round-boundary decision loop consuming
+        # the fleet-telemetry plane — online clustering, straggler
+        # demotion/eviction with per-client knob retunes, measured-
+        # throughput cut re-planning.  _sched_gone mirrors _agg_gone:
+        # clients a barrier stopped waiting for by scheduler policy
+        # (mid-round drop), reset per invocation; _stage_of maps the
+        # invocation's active clients to stages so a mid-round drop
+        # can release the streaming fold's reorder window.
+        self.scheduler = None
+        self._sched_gone: set = set()
+        self._stage_of: dict = {}
+        sch = getattr(cfg, "scheduler", None)
+        if sch is not None and sch.enabled:
+            from split_learning_tpu.runtime.scheduler import Scheduler
+            self.scheduler = Scheduler(cfg, log=self.log,
+                                       faults=self.faults,
+                                       gauges=self.gauges)
 
     # -- rpc pump ------------------------------------------------------------
 
@@ -427,8 +445,14 @@ class ProtocolContext(MeshContext):
             self.log.received(f"UPDATE {msg.client_id} "
                               f"samples={msg.num_samples} ok={msg.ok}")
             return
+        # per-client staleness window: a scheduler-demoted compute-slow
+        # client folds through a WIDER admission window than the global
+        # config grants (runtime/scheduler.py _act_demote)
+        max_st = lrn.max_staleness
+        if self.scheduler is not None:
+            max_st += self.scheduler.staleness_bonus_for(msg.client_id)
         if (self._async and self._fold is not None
-                and 0 < lag <= lrn.max_staleness):
+                and 0 < lag <= max_st):
             # bounded-staleness admission: fold with decayed weight,
             # keyed off the canonical window so the same client's
             # FRESH contribution this round still occupies its slot
@@ -942,7 +966,8 @@ class ProtocolContext(MeshContext):
                     what: str | Callable[[], str],
                     deadline: float | None = None,
                     waiting: Callable[[], set] | None = None,
-                    poll: Callable[[], None] | None = None) -> bool:
+                    poll: Callable[[], None] | None = None,
+                    sched_drop: bool = False) -> bool:
         """Drain rpc_queue until ``pred()``; False if the deadline passes.
 
         ``what`` may be a callable so the timeout warning names who is
@@ -954,23 +979,38 @@ class ProtocolContext(MeshContext):
         heartbeat for ``observability.liveness-timeout``), the wait
         gives up early — a dead client costs the round the liveness
         timeout, not the full barrier deadline.  A slow-but-alive
-        straggler is never dropped here; it keeps heartbeating and the
-        barrier keeps waiting (eviction policy belongs to the
-        scheduler, not the monitor)."""
+        straggler is never dropped by the monitor itself; with
+        ``sched_drop`` (the NOTIFY/UPDATE barriers, when the
+        scheduler is enabled) the scheduler's mid-round policy MAY
+        stop waiting for a health-state-straggler past
+        ``scheduler.barrier-grace-s`` — each such drop is journaled
+        (``kind=sched``) and counted, and the caller's predicate
+        consults ``_sched_gone`` so the barrier actually releases."""
         deadline = (time.monotonic() + self.client_timeout
                     if deadline is None else deadline)
+        t_begin = time.monotonic()
+        t_checked = 0.0
         while not pred():
             if poll is not None:
                 poll()   # e.g. L1 aggregator health -> fallback drain
                 if pred():
                     return True
-            remain = deadline - time.monotonic()
+            now = time.monotonic()
+            remain = deadline - now
             if remain <= 0:
                 w = what() if callable(what) else what
                 self.faults.inc("timeouts")
                 self.log.warning(f"timeout waiting for {w}")
                 return False
-            if waiting is not None and self.fleet is not None:
+            # the liveness/scheduler checks walk the whole fleet
+            # (advance + waiting-set rebuild are O(clients)); at 10k
+            # clients running them per FRAME is an O(n^2) round wall,
+            # so they are throttled to a coarse wall-clock cadence —
+            # more than fine-grained enough for 45 s liveness
+            # timeouts and multi-second scheduler graces
+            if (waiting is not None and self.fleet is not None
+                    and now - t_checked >= self._WAIT_CHECK_S):
+                t_checked = now
                 lost = self.fleet.advance()
                 missing = set(waiting())
                 if missing and missing <= lost:
@@ -981,8 +1021,46 @@ class ProtocolContext(MeshContext):
                         f"{self.fleet.liveness_timeout:g}s — barrier "
                         "released early")
                     return False
-            self._pump_one(timeout=min(remain, 0.25))
+                if (sched_drop and missing
+                        and self.scheduler is not None):
+                    drop = self.scheduler.barrier_drop(
+                        missing, self.fleet.states(),
+                        waited_s=now - t_begin,
+                        round_idx=getattr(self, "_cur_round",
+                                          self._cur_gen))
+                    if drop:
+                        self._sched_release(drop)
+                        continue   # re-check pred: barrier shrank
+            if self._pump_one(timeout=min(remain, 0.25)):
+                # drain what is already queued before re-evaluating
+                # the barrier predicate: pred/waiting are O(clients),
+                # and one evaluation per BATCH instead of per frame
+                # is what keeps a 10k-client registration storm or
+                # UPDATE wave linear in fleet size
+                for _ in range(self._PUMP_BATCH - 1):
+                    if not self._pump_one(timeout=0.0):
+                        break
         return True
+
+    #: wall-clock cadence of the O(clients) liveness/scheduler barrier
+    #: checks inside _pump_until
+    _WAIT_CHECK_S = 0.1
+    #: frames drained per barrier-predicate evaluation
+    _PUMP_BATCH = 256
+
+    def _sched_release(self, drop: set) -> None:
+        """Apply a scheduler mid-round drop: the barrier predicates
+        stop counting these clients (``_sched_gone``) and the
+        streaming fold's reorder window stops holding their slots —
+        the same release discipline as a READY-barrier drop, so the
+        fold order (and hence the aggregate) stays canonical over the
+        clients that actually contributed."""
+        self._sched_gone |= drop
+        if self._fold is not None:
+            for cid in sorted(drop):
+                s = self._stage_of.get(cid)
+                if s is not None and not self._fold.has_key(s, cid):
+                    self._fold.drop(s, cid)
 
     # -- registration barrier ------------------------------------------------
 
@@ -1124,20 +1202,65 @@ class ProtocolContext(MeshContext):
     def _prune_plans(plans, pruned: set):
         """Remove ``pruned`` clients from existing plans without
         re-planning; None when any cluster would lose a whole stage
-        (an empty pipeline stage cannot run)."""
-        if not pruned:
+        (shared feasibility invariant: runtime/plan.py, also the
+        scheduler's eviction path)."""
+        from split_learning_tpu.runtime.plan import prune_plan_members
+        return prune_plan_members(plans, pruned)
+
+    def schedule_plans(self, plans, round_idx: int):
+        """Closed-loop scheduler pass at a round boundary
+        (``scheduler.enabled``; called by the round loop right after
+        the elastic refresh).  Drains between-round mail so the fleet
+        snapshot is current, runs the decision pass, then applies the
+        transport side effects the scheduler itself must not own:
+        STOP fan-out + shadow/telemetry reclaim for evictions (the
+        same steps as the elastic prune), and ``_needs_params``
+        marking for every client whose layer range a re-plan moved.
+        Returns the replacement plans, or None when nothing changed."""
+        if self.scheduler is None:
             return None
-        new_plans = []
-        for p in plans:
-            keep = [i for i, c in enumerate(p.stage1_clients)
-                    if c not in pruned]
-            clients = [[c for c in ids if c not in pruned]
-                       for ids in p.clients]
-            if any(not ids for ids in clients):
-                return None
-            new_plans.append(dataclasses.replace(
-                p, clients=clients, label_counts=p.label_counts[keep]))
-        return new_plans
+        fleet = {"clients": {}}
+        if self.fleet is not None:
+            while self._pump_one(timeout=0.0):
+                pass
+            self.fleet.advance()
+            fleet = self.fleet.snapshot()
+        profiles = {cid: (r.profile or {})
+                    for cid, r in self._registrations.items()}
+        out = self.scheduler.plan_round(plans, round_idx, fleet,
+                                        profiles)
+        for cid in sorted(out.evict):
+            # the elastic-drop path's teardown: STOP, drop the
+            # registration (or the next elastic refresh would re-plan
+            # the evicted client straight back in), reclaim the delta
+            # shadow, stop fleet-scoring, forget the barrier ledger.
+            # A recovered client rejoins by re-REGISTERing through
+            # the elastic planner.
+            self.bus.publish(reply_queue(cid), encode(Stop(
+                reason="scheduler: evicted (persistent straggler)")))
+            self._registrations.pop(cid, None)
+            self._missed.pop(cid, None)
+            if self._delta_shadow is not None:
+                self._delta_shadow.clear(cid)
+            if self.fleet is not None:
+                self.fleet.forget(cid)
+            self._planned_ids.discard(cid)
+        if out.plans is None:
+            return None
+        old_rng = self._client_ranges(plans)
+        new_rng = self._client_ranges(out.plans)
+        # a re-plan that moved the cuts invalidates held shards: every
+        # client whose layer range changed gets params on its next
+        # START whatever the strategy's wire economy says
+        self._needs_params |= {cid for cid, rng in new_rng.items()
+                               if old_rng.get(cid) != rng}
+        for plan in out.plans:
+            self.log.info(
+                f"Cluster {plan.cluster_id} (scheduler): "
+                f"cuts={plan.cuts} "
+                f"clients={[len(ids) for ids in plan.clients]}",
+                "cyan")
+        return out.plans
 
     # -- the remote round ----------------------------------------------------
 
@@ -1176,13 +1299,17 @@ class ProtocolContext(MeshContext):
         self._updates = []
         self._gen += 1
         self._cur_gen = self._gen
+        self._cur_round = round_idx
         self._syn_live = False
         # async: the generation is the global model version — prune the
         # (client, version) dedup ledger past the admission window and
         # tell the fleet monitor where "now" is (version-lag scoring)
         self._folded_versions = {
             (c, v) for c, v in self._folded_versions
-            if self._cur_gen - v <= self.cfg.learning.max_staleness + 1}
+            if self._cur_gen - v
+            <= self.cfg.learning.max_staleness + 1
+            + (self.scheduler.max_staleness_bonus
+               if self.scheduler is not None else 0)}
         if self._async and self.fleet is not None:
             # async only: in sync mode the generation is an invocation
             # counter, not a model version — feeding it to the monitor
@@ -1203,6 +1330,8 @@ class ProtocolContext(MeshContext):
         self._tree_groups = {}
         self._tree_roots = []
         self._agg_gone = set()
+        self._sched_gone = set()
+        self._stage_of = dict(active)
         self._agg_ingress_bytes = 0
         if self._streaming:
             fan_in = self._agg.fan_in
@@ -1306,7 +1435,13 @@ class ProtocolContext(MeshContext):
         # stage-1 clients' STARTs leave the socket before any later
         # stage's are even encoded, so the pipeline's feeders start
         # streaming while the rest of the fan-out is still encoding —
-        # the fan-out half of the per-shard streaming discipline
+        # the fan-out half of the per-shard streaming discipline.
+        # Per-stage shard trees are cached across clients: 10k stage-1
+        # clients share one layer range, and re-slicing the same base
+        # per client was a multi-ms/START tax at fleet scale (the
+        # trees are read-only views of the same host arrays — exactly
+        # the sharing the delta shadow already relies on).
+        shard_cache: dict = {}
         for cid, s in active:
             a, b = ranges[s - 1]
             sp = (send_params.get(s, True)
@@ -1319,9 +1454,18 @@ class ProtocolContext(MeshContext):
                 self._needs_params.discard(cid)
             if sp:
                 base = (per_client_params or {}).get(cid, params)
-                shard_p = _np_tree(shard_params(base, self.specs, a, b))
-                shard_s = _np_tree(shard_params(stats or {},
-                                                self.specs, a, b))
+                key = (a, b) if base is params else None
+                cached = shard_cache.get(key) \
+                    if key is not None else None
+                if cached is None:
+                    shard_p = _np_tree(shard_params(base, self.specs,
+                                                    a, b))
+                    shard_s = _np_tree(shard_params(stats or {},
+                                                    self.specs, a, b))
+                    if key is not None:
+                        shard_cache[key] = (shard_p, shard_s)
+                else:
+                    shard_p, shard_s = cached
             else:
                 shard_p = shard_s = None
             # delta codec: keep a versioned shadow of EXACTLY what this
@@ -1390,11 +1534,17 @@ class ProtocolContext(MeshContext):
                        # feeder that could still extend a window has
                        # fenced its epoch — "everyone currently
                        # buffered is done" is not enough (a quiet
-                       # feeder may still be mid-batch)
+                       # feeder may still be mid-batch).  CONSUMERS
+                       # only (stages >= 2): feeders are producers,
+                       # never drain against the set — and shipping a
+                       # 10k-id list inside every stage-1 START was
+                       # the O(n^2) half of a fleet-scale fan-out
                        "sda_feeders": (
-                           [c for c in stage1
-                            if pair_groups.get(c) == pair_groups.get(cid)]
-                           if pair_groups else list(stage1)),
+                           None if s == 1 else
+                           ([c for c in stage1
+                             if pair_groups.get(c)
+                             == pair_groups.get(cid)]
+                            if pair_groups else list(stage1))),
                        "n_stages": plan.n_stages,
                        "pair": pair_of.get(cid),
                        "sda_peers": (list(plan.clients[s])
@@ -1410,6 +1560,14 @@ class ProtocolContext(MeshContext):
                        # this group's aggregate queue instead of rpc
                        "agg_group": (group.idx if group is not None
                                      else None),
+                       # scheduler-granted per-client knob retunes
+                       # (runtime/scheduler.py): e.g. a heavier
+                       # activation codec for a wire-slow straggler.
+                       # None for undemoted clients and with the
+                       # scheduler off — the client's config applies.
+                       "sched": (self.scheduler.knobs_for(cid)
+                                 if self.scheduler is not None
+                                 else None),
                        "gen": self._cur_gen}),
                 self.cfg.transport.chunk_mb << 20)
             for part in start_parts:
@@ -1512,12 +1670,20 @@ class ProtocolContext(MeshContext):
         # EVERY active client (not just the responsive set): a late
         # READY joiner's pump-sent SYN reuses its entry.
         self._syn_overrides = {}
+        # stage-1 clients never consume a feeder set (they produce);
+        # building a per-client O(stage1) list for each of them was
+        # the other O(n^2) term of a fleet-scale round open — they
+        # get (quorum=1, no override) in O(1)
+        responsive_s1 = [c for c in stage1 if c in ids]
         for cid, s in active:
+            if s == 1:
+                self._syn_overrides[cid] = (1, None)
+                continue
             quorum = (1 if s <= 2 else max(1, sum(
                 1 for c in plan.clients[s - 2] if c in ids)))
-            feeders = [c for c in stage1 if (c in ids or c == cid)
-                       and (not pair_groups
-                            or pair_groups.get(c) == pair_groups.get(cid))]
+            feeders = [c for c in responsive_s1
+                       if not pair_groups
+                       or pair_groups.get(c) == pair_groups.get(cid)]
             self._syn_overrides[cid] = (quorum, feeders)
         for cid in ids:
             quorum, feeders = self._syn_overrides[cid]
@@ -1534,6 +1700,12 @@ class ProtocolContext(MeshContext):
 
         s1_ids = set(stage1) & ids
         quorum_n = self.cfg.learning.async_quorum
+        # scheduler demotions lower a compute-slow straggler's quorum
+        # share: exempt clients don't count toward quorum denominators
+        # (their contribution folds late through the widened staleness
+        # window instead of holding the round)
+        exempt = ({c for c in ids if self.scheduler.quorum_exempt(c)}
+                  if self.scheduler is not None else set())
         deadline = time.monotonic() + self.client_timeout
         with self.tracer.span("notify_wait", round=round_idx):
             if self._async and quorum_n:
@@ -1541,17 +1713,29 @@ class ProtocolContext(MeshContext):
                 # exhausted their data — a high-RTT feeder finishes its
                 # contribution late (stale-admitted next cut) instead
                 # of stalling the fleet
-                s1_need = min(len(s1_ids), max(1, quorum_n))
+                # exempt clients shrink the denominator, floored at 1
+                # so a FULLY-exempt stage still owes one NOTIFY — but
+                # a genuinely EMPTY stage keeps the old instant-pass
+                # (need 0): flooring that case would hang the barrier
+                # for the full client_timeout on a set that can never
+                # respond
+                s1_need = min(max(1, len(s1_ids - exempt))
+                              if s1_ids else 0,
+                              max(1, quorum_n))
                 self._pump_until(
                     lambda: len(self._notified & s1_ids) >= s1_need,
                     f"NOTIFY quorum {s1_need}/{len(s1_ids)}",
                     deadline=deadline,
                     waiting=lambda: s1_ids - self._notified)
             else:
-                self._pump_until(lambda: s1_ids <= self._notified,
-                                 "NOTIFY from stage-1 clients",
-                                 deadline=deadline,
-                                 waiting=lambda: s1_ids - self._notified)
+                self._pump_until(
+                    lambda: s1_ids - self._sched_gone
+                    <= self._notified,
+                    "NOTIFY from stage-1 clients",
+                    deadline=deadline,
+                    waiting=lambda: (s1_ids - self._notified
+                                     - self._sched_gone),
+                    sched_drop=True)
         pause_span = self.tracer.start("pause_fanout", round=round_idx)
         # late-READY joiners (async) get their PAUSE too — they are
         # training and must upload like everyone else
@@ -1567,20 +1751,37 @@ class ProtocolContext(MeshContext):
         pause_span.end()
 
         # _agg_gone: members a dead L1 consumed-then-lost — their
-        # UPDATE can never arrive, so the barrier stops counting them
+        # UPDATE can never arrive, so the barrier stops counting them.
+        # fresh_ids folds INCREMENTALLY: re-scanning the whole updates
+        # list per predicate evaluation is an O(n^2) barrier over a
+        # 10k-client UPDATE wave.
+        fresh_seen: set = set()
+        fresh_idx = [0]
+
         def fresh_ids() -> set:
-            return {u.client_id for u in self._updates
-                    if (u.version if u.version is not None
-                        else u.round_idx) == self._cur_gen}
+            ups = self._updates
+            for u in ups[fresh_idx[0]:]:
+                if (u.version if u.version is not None
+                        else u.round_idx) == self._cur_gen:
+                    fresh_seen.add(u.client_id)
+            fresh_idx[0] = len(ups)
+            return fresh_seen
         if self._async and quorum_n:
             # bounded-staleness version cut: a new global version cuts
             # once async-quorum FRESH contributions folded; stragglers
             # contribute late through the admission window instead of
-            # holding the barrier
-            need = min(max(1, quorum_n), len(ids))
+            # holding the barrier.  Scheduler-exempt clients shrink
+            # the denominator — a demoted compute-slow client's share
+            # of the quorum is zero.
+            # same floor discipline as the NOTIFY quorum: fully-exempt
+            # still owes one fresh fold, genuinely-empty passes
+            need = min(max(1, quorum_n),
+                       max(1, len(ids - exempt)) if ids else 0)
             got = lambda: len((fresh_ids() & ids)  # noqa: E731
-                              | (self._agg_gone & ids)) >= need
-            missing = lambda: ids - fresh_ids() - self._agg_gone  # noqa
+                              | ((self._agg_gone | self._sched_gone)
+                                 & ids)) >= need
+            missing = lambda: (ids - fresh_ids()  # noqa: E731
+                               - self._agg_gone - self._sched_gone)
             what = lambda: (f"UPDATE quorum {need}/{len(ids)} "  # noqa
                             f"(missing {sorted(missing())})")
         else:
@@ -1589,10 +1790,10 @@ class ProtocolContext(MeshContext):
             # rides self._updates, and counting it would cut the round
             # without the client's fresh contribution (in sync the two
             # sets are identical — only current-gen Updates fold)
-            got = lambda: (fresh_ids()  # noqa: E731
-                           | self._agg_gone) >= ids
-            missing = lambda: (ids  # noqa: E731
-                               - fresh_ids() - self._agg_gone)
+            got = lambda: (fresh_ids() | self._agg_gone  # noqa: E731
+                           | self._sched_gone) >= ids
+            missing = lambda: (ids - fresh_ids()  # noqa: E731
+                               - self._agg_gone - self._sched_gone)
             what = lambda: "UPDATE from " + str(missing())  # noqa
         with self.tracer.span("update_wait", round=round_idx):
             self._pump_until(
@@ -1600,7 +1801,8 @@ class ProtocolContext(MeshContext):
                 deadline=time.monotonic() + self.client_timeout,
                 waiting=missing,
                 poll=(self._poll_l1 if self._l1 or self._l1_remote
-                      else None))
+                      else None),
+                sched_drop=True)
         self._syn_live = False
         if self._l1 or self._l1_remote:
             self._finish_l1()
@@ -1757,6 +1959,11 @@ class ProtocolContext(MeshContext):
                 pass
             self.fleet.advance()
             fsnap = self.fleet.snapshot()
+            if self.scheduler is not None:
+                # mirror the /fleet scheduler view into the journaled
+                # record so sl_top --journal renders the same
+                # CLUSTER/SCHED columns as the live endpoint
+                self.scheduler.annotate_fleet(fsnap)
             self.log.metric(kind="fleet", gen=self._cur_gen,
                             round_idx=round_idx,
                             cluster=plan.cluster_id, fleet=fsnap)
@@ -1891,6 +2098,13 @@ class ProtocolServer:
                 # pointing at "the aggregate phase"
                 if ctx._agg_topology is not None:
                     snap["agg_tree"] = ctx._agg_topology
+                # closed-loop scheduler view (runtime/scheduler.py):
+                # the current online-cluster map and last re-plan
+                # decision, plus per-client CLUSTER/SCHED fields so
+                # straggler attribution can name WHY a client was
+                # evicted/demoted (sl_top renders both columns)
+                if ctx.scheduler is not None:
+                    ctx.scheduler.annotate_fleet(snap)
                 return snap
 
             self.exporter = TelemetryExporter(

@@ -93,6 +93,14 @@ FAULT_COUNTER_NAMES = frozenset({
     # (server_version - version <= learning.max-staleness), and
     # contributions past the window rejected and dropped
     "agg_stale_admits", "agg_stale_updates",
+    # closed-loop scheduler (runtime/scheduler.py): clients evicted
+    # through the elastic path, demoted with retuned knobs, adopted
+    # cut re-plans, straggler clients a NOTIFY/UPDATE barrier dropped
+    # mid-round after the scheduler grace, clients moved between
+    # online clusters, and knob frames a client rejected (bad spec)
+    "sched_evictions", "sched_demotions", "sched_replans",
+    "sched_barrier_drops", "sched_cluster_moves",
+    "sched_knob_rejects",
 })
 
 #: Declared registry of latency-histogram names (same contract as
@@ -143,6 +151,11 @@ GAUGE_NAMES = frozenset({
     # the node's fold worker, and the round's fold wall
     "agg_node_folded", "agg_node_ingress_bytes",
     "agg_node_egress_bytes", "agg_node_fold_s", "agg_node_groups",
+    # closed-loop scheduler (runtime/scheduler.py): wall milliseconds
+    # of the last round-boundary decision pass (the control-plane cost
+    # the 10k-client bench key pins flat), and the live online-cluster
+    # count
+    "sched_decision_ms", "sched_clusters",
 })
 
 

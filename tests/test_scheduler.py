@@ -1,0 +1,667 @@
+"""Closed-loop resource-aware scheduler (runtime/scheduler.py +
+planner/throughput.py + runtime/simfleet.py).
+
+Covers: decision determinism (twin-run journal bit-compare), straggler
+attribution + knob demotion + eviction ladder, online-clustering
+hysteresis under churn, measured-throughput cut re-planning with
+damping/cooldown, mid-round barrier-drop policy, journal validation,
+client-side knob consumption, config gating — and the e2e synthetic-
+fleet cells: a heterogeneous round through the real server planes, and
+the chaos-soak proving a mid-round eviction round still aggregates
+bit-identical to its oracle over the members that folded.
+"""
+
+from __future__ import annotations
+
+import copy
+import json
+import pathlib
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from split_learning_tpu.config import ConfigError, from_dict
+from split_learning_tpu.runtime.plan import ClusterPlan
+from split_learning_tpu.runtime.scheduler import (
+    OnlineClusterer, Scheduler, validate_journal,
+)
+
+TINY_KWT = {"embed_dim": 16, "num_heads": 2, "mlp_dim": 32}
+
+
+def _cfg(**sched):
+    base = {"enabled": True, "warmup_rounds": 1, "evict_after": 2}
+    base.update(sched)
+    return from_dict({"scheduler": base,
+                      "observability": {"heartbeat_interval": 1.0}})
+
+
+def _plan(n=4, heads=1, cuts=(2,), n_classes=10):
+    clients = [[f"c{i}" for i in range(n)],
+               [f"h{i}" for i in range(heads)]]
+    lc = np.eye(n, n_classes)
+    return ClusterPlan(cluster_id=0, cuts=list(cuts), clients=clients,
+                       label_counts=lc, rejected=[])
+
+
+def _view(rate, crate, state="healthy", lag=None, score=None):
+    return {"state": state, "kind": "client", "samples_per_s": rate,
+            "compute_samples_per_s": crate,
+            "straggler_score": score, "version_lag": lag}
+
+
+def _fleet(views):
+    return {"clients": views}
+
+
+class TestStragglerPolicy:
+    def test_warmup_observes_only(self):
+        sch = Scheduler(_cfg(warmup_rounds=2))
+        out = sch.plan_round([_plan()], 1, _fleet({
+            "c0": _view(2, 2, "straggler"),
+            "c1": _view(10, 10), "c2": _view(10, 10),
+            "c3": _view(10, 10)}), {})
+        assert not out.evict and out.plans is None
+        assert all(d["action"] == "decide" for d in sch.decisions)
+
+    def test_demote_wire_slow_gets_codec(self):
+        sch = Scheduler(_cfg())
+        sch.plan_round([_plan()], 1, _fleet({
+            "c0": _view(2, 11, "straggler"),
+            "c1": _view(10, 11), "c2": _view(10, 11),
+            "c3": _view(10, 11)}), {})
+        knobs = sch.knobs_for("c0")
+        assert knobs and "intermediate" in knobs["codec"]
+        assert sch.staleness_bonus_for("c0") == 0
+        assert not sch.quorum_exempt("c0")
+        d = [d for d in sch.decisions if d["action"] == "demote"][0]
+        assert d["detail"]["attribution"] == "wire"
+
+    def test_demote_compute_slow_gets_staleness(self):
+        sch = Scheduler(_cfg())
+        sch.plan_round([_plan()], 1, _fleet({
+            "c0": _view(2, 2, "straggler"),
+            "c1": _view(10, 11), "c2": _view(10, 11),
+            "c3": _view(10, 11)}), {})
+        assert sch.staleness_bonus_for("c0") == 2
+        assert sch.quorum_exempt("c0")
+        assert sch.max_staleness_bonus == 2
+        d = [d for d in sch.decisions if d["action"] == "demote"][0]
+        assert d["detail"]["attribution"] == "compute"
+
+    def test_stale_attribution_from_version_lag(self):
+        sch = Scheduler(_cfg())
+        sch.plan_round([_plan()], 1, _fleet({
+            "c0": _view(9, 11, "straggler", lag=3),
+            "c1": _view(10, 11), "c2": _view(10, 11),
+            "c3": _view(10, 11)}), {})
+        d = [d for d in sch.decisions if d["action"] == "demote"][0]
+        assert d["detail"]["attribution"] == "stale"
+
+    def test_evict_after_ladder_and_recovery_reset(self):
+        sch = Scheduler(_cfg(evict_after=3))
+        slow = {"c0": _view(2, 2, "straggler"),
+                "c1": _view(10, 11), "c2": _view(10, 11),
+                "c3": _view(10, 11)}
+        assert not sch.plan_round([_plan()], 1, _fleet(slow), {}).evict
+        assert not sch.plan_round([_plan()], 2, _fleet(slow), {}).evict
+        # recovery resets the ladder
+        ok = dict(slow); ok["c0"] = _view(10, 11)
+        sch.plan_round([_plan()], 3, _fleet(ok), {})
+        assert not sch.plan_round([_plan()], 4, _fleet(slow), {}).evict
+        assert not sch.plan_round([_plan()], 5, _fleet(slow), {}).evict
+        out = sch.plan_round([_plan()], 6, _fleet(slow), {})
+        assert out.evict == {"c0"}
+        assert out.plans is not None
+        assert "c0" not in out.plans[0].stage1_clients
+        assert out.plans[0].label_counts.shape[0] == 3
+
+    def test_evict_skip_when_stage_would_empty(self):
+        sch = Scheduler(_cfg(evict_after=1))
+        plan = _plan(n=1)
+        slow = {"c0": _view(2, 2, "straggler"),
+                "x1": _view(10, 11), "x2": _view(10, 11)}
+        out = sch.plan_round([plan], 1, _fleet(slow), {})
+        assert not out.evict
+        assert any(d["action"] == "evict-skip" for d in sch.decisions)
+        # the skipped client is demoted instead
+        assert sch.knobs_for("c0") is not None
+
+    def test_promote_revokes_knobs_after_sustained_recovery(self):
+        sch = Scheduler(_cfg(evict=False, evict_after=2))
+        slow = {"c0": _view(2, 2, "straggler"),
+                "c1": _view(10, 11), "c2": _view(10, 11),
+                "c3": _view(10, 11)}
+        ok = dict(slow)
+        ok["c0"] = _view(10, 11)
+        sch.plan_round([_plan()], 1, _fleet(slow), {})
+        assert sch.quorum_exempt("c0")           # compute-slow demoted
+        # one healthy boundary: hysteresis keeps the demotion
+        sch.plan_round([_plan()], 2, _fleet(ok), {})
+        assert sch.knobs_for("c0") is not None
+        # second consecutive healthy boundary (== evict-after): promote
+        sch.plan_round([_plan()], 3, _fleet(ok), {})
+        assert sch.knobs_for("c0") is None
+        assert not sch.quorum_exempt("c0")
+        assert sch.staleness_bonus_for("c0") == 0
+        proms = [d for d in sch.decisions if d["action"] == "promote"]
+        assert len(proms) == 1 and proms[0]["client"] == "c0"
+        assert validate_journal(list(sch.decisions)) == []
+        # a relapse re-demotes from scratch
+        sch.plan_round([_plan()], 4, _fleet(slow), {})
+        assert sch.quorum_exempt("c0")
+
+    def test_evict_skip_not_journaled_as_evict(self):
+        sch = Scheduler(_cfg(evict_after=1))
+        plan = _plan(n=1)
+        slow = {"c0": _view(2, 2, "straggler"),
+                "x1": _view(10, 11), "x2": _view(10, 11)}
+        sch.plan_round([plan], 1, _fleet(slow), {})
+        # infeasible eviction: NO evict record, NO counter — only the
+        # evict-skip and the fallback demotion are on the journal
+        assert not any(d["action"] == "evict" for d in sch.decisions)
+        assert any(d["action"] == "evict-skip" for d in sch.decisions)
+
+    def test_evict_disabled(self):
+        sch = Scheduler(_cfg(evict=False, evict_after=1))
+        slow = {"c0": _view(2, 2, "straggler"),
+                "c1": _view(10, 11), "c2": _view(10, 11),
+                "c3": _view(10, 11)}
+        for r in range(1, 4):
+            assert not sch.plan_round([_plan()], r,
+                                      _fleet(slow), {}).evict
+
+
+class TestBarrierDrop:
+    def _armed(self, **kw):
+        sch = Scheduler(_cfg(barrier_grace_s=5.0, **kw))
+        healthy = {f"c{i}": _view(10, 11) for i in range(4)}
+        sch.plan_round([_plan()], 1, _fleet(healthy), {})
+        return sch
+
+    def test_drops_only_stragglers_past_grace(self):
+        sch = self._armed()
+        states = {"c0": "straggler", "c1": "healthy", "c2": "degraded"}
+        assert sch.barrier_drop({"c0", "c1", "c2"}, states,
+                                waited_s=1.0, round_idx=1) == set()
+        assert sch.barrier_drop({"c0", "c1", "c2"}, states,
+                                waited_s=6.0, round_idx=1) == {"c0"}
+        d = [d for d in sch.decisions if d["action"] == "drop"]
+        assert len(d) == 1 and d[0]["client"] == "c0"
+
+    def test_inert_before_first_acting_boundary(self):
+        sch = Scheduler(_cfg(barrier_grace_s=5.0))
+        assert sch.barrier_drop({"c0"}, {"c0": "straggler"},
+                                waited_s=60.0, round_idx=0) == set()
+
+    def test_grace_is_the_sole_control(self):
+        # evict: false forbids ELASTIC evictions but not mid-round
+        # drops — barrier-grace-s alone controls those (0 = never)
+        sch = self._armed(evict=False)
+        assert sch.barrier_drop({"c0"}, {"c0": "straggler"},
+                                waited_s=60.0, round_idx=1) == {"c0"}
+        sch2 = Scheduler(_cfg(barrier_grace_s=0.0))
+        sch2.plan_round([_plan()], 1, _fleet(
+            {f"c{i}": _view(10, 11) for i in range(4)}), {})
+        assert sch2.barrier_drop({"c0"}, {"c0": "straggler"},
+                                 waited_s=60.0, round_idx=1) == set()
+
+
+class TestDeterminism:
+    def _series(self):
+        """Three boundaries of fleet snapshots with one straggler."""
+        out = []
+        for r in range(1, 4):
+            views = {f"c{i}": _view(10 + i * 0.5, 11) for i in range(4)}
+            views["c0"] = _view(2, 2, "straggler")
+            out.append(_fleet(views))
+        return out
+
+    @staticmethod
+    def _canon(decisions):
+        return json.dumps(list(decisions), sort_keys=True,
+                          default=str)
+
+    def test_twin_runs_bit_identical(self):
+        runs = []
+        for _ in range(2):
+            sch = Scheduler(_cfg(evict_after=2))
+            plans = [_plan()]
+            for r, fleet in enumerate(self._series(), start=1):
+                out = sch.plan_round(plans, r, fleet, {})
+                if out.plans is not None:
+                    plans = out.plans
+            # drop the wall-clock field: the decide summary carries
+            # decision_ms, the only nondeterministic content
+            recs = [dict(d) for d in sch.decisions]
+            for d in recs:
+                d.get("detail", {}).pop("decision_ms", None)
+            runs.append(self._canon(recs))
+        assert runs[0] == runs[1]
+
+    def test_journal_validates(self):
+        sch = Scheduler(_cfg(evict_after=2))
+        plans = [_plan()]
+        for r, fleet in enumerate(self._series(), start=1):
+            out = sch.plan_round(plans, r, fleet, {})
+            if out.plans is not None:
+                plans = out.plans
+        assert validate_journal(list(sch.decisions)) == []
+
+    def test_validator_negatives(self):
+        assert validate_journal([{"action": "nope"}])
+        assert validate_journal([{"action": "evict", "round": 1,
+                                  "why": "x"}])  # missing client
+        assert validate_journal([{"action": "demote", "round": "r1",
+                                  "client": "c", "why": "x"}])
+        assert validate_journal([{"action": "replan", "round": 1,
+                                  "why": "x", "detail": {}}])
+        assert validate_journal([]) == []
+
+
+class TestOnlineClusterer:
+    def _feats(self, n, drift=0.0, seed=0):
+        rng = np.random.default_rng(seed)
+        out = {}
+        for i in range(n):
+            side = i % 2
+            base = np.array([1.0, 0.0] if side == 0 else [0.0, 1.0])
+            out[f"c{i:03d}"] = base + rng.normal(0, 0.05, 2) + drift
+        return out
+
+    def test_deterministic(self):
+        a = OnlineClusterer(2, seed=7)
+        b = OnlineClusterer(2, seed=7)
+        f = self._feats(20)
+        assert a.update(f, 1)[0] == b.update(f, 1)[0]
+
+    def test_separates_two_populations(self):
+        cl = OnlineClusterer(2, seed=0)
+        assign, _ = cl.update(self._feats(40), 1)
+        sides = {0: set(), 1: set()}
+        for cid, k in assign.items():
+            sides[int(cid[1:]) % 2].add(k)
+        assert sides[0] and sides[1] and not (sides[0] & sides[1])
+
+    def test_sticky_under_churn(self):
+        cl = OnlineClusterer(2, hysteresis=0.3, minibatch=8, seed=0)
+        f = self._feats(30)
+        base, _ = cl.update(f, 1)
+        # churn: drop a third of the fleet, add new clients — the
+        # survivors' assignments must not move
+        f2 = {k: v for k, v in list(f.items())[10:]}
+        f2.update({f"n{i}": v for i, v in
+                   enumerate(self._feats(6, seed=9).values())})
+        assign2, moved = cl.update(f2, 2)
+        survivors = set(f2) & set(base)
+        assert all(assign2[c] == base[c] for c in survivors)
+        assert not [m for m in moved if m in base]
+
+    def test_minibatch_bounds_fit_cost(self):
+        cl = OnlineClusterer(2, minibatch=16, seed=0)
+        t0 = time.perf_counter()
+        cl.update(self._feats(2000), 1)
+        first = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        cl.update(self._feats(2000), 2)
+        assert time.perf_counter() - t0 < max(first * 3, 0.5)
+
+
+class TestThroughputModel:
+    def test_scaled_exe_time(self):
+        from split_learning_tpu.planner.throughput import (
+            scaled_exe_time,
+        )
+        out = scaled_exe_time([0.01, 0.03], compute_rate=50.0)
+        assert abs(sum(out) - 0.02) < 1e-9
+        assert abs(out[1] / out[0] - 3.0) < 1e-6
+        assert scaled_exe_time([0.01, 0.03], None) == [0.01, 0.03]
+
+    def test_implied_bandwidth(self):
+        from split_learning_tpu.planner.throughput import (
+            implied_bandwidth,
+        )
+        # 10/s end-to-end, 20/s device: 0.05 s/sample of wire for 1e6 B
+        assert implied_bandwidth(1e6, 10.0, 20.0) == pytest.approx(2e7)
+        assert implied_bandwidth(1e6, 20.0, 20.0) == 0.0
+        assert implied_bandwidth(1e6, None, 20.0) == 0.0
+
+    def test_replan_moves_cut_toward_slow_group(self):
+        from split_learning_tpu.planner.throughput import replan_cuts
+        # 4 layers; group-2 devices 4x slower: the cut should move
+        # RIGHT (give group 1 more layers) vs the middle cut
+        exe1 = [[0.01] * 4] * 2
+        exe2 = [[0.04] * 4] * 2
+        size = [1.0] * 4
+        res = replan_cuts([exe1, exe2], [[0.0, 0.0]] * 2, size,
+                          current_cuts=[2], damping=0.1)
+        assert res["adopted"] and res["cuts"][0] > 2
+
+    def test_damping_blocks_marginal_improvements(self):
+        from split_learning_tpu.planner.throughput import replan_cuts
+        exe = [[0.01, 0.011, 0.01, 0.011]] * 2
+        size = [1.0] * 4
+        res = replan_cuts([exe, exe], [[0.0, 0.0]] * 2, size,
+                          current_cuts=[2], damping=0.5)
+        assert not res["adopted"] and res["cuts"] == [2]
+
+    def test_predict_round_wall(self):
+        from split_learning_tpu.planner.throughput import (
+            predict_round_wall,
+        )
+        exe = [[0.01] * 4]
+        wall = predict_round_wall([exe[0:1] * 1, exe[0:1] * 1][0:1]
+                                  * 2, [[0.0]] * 2, [2], [1.0] * 4,
+                                  samples=100)
+        assert np.isfinite(wall) and wall > 0
+
+
+class TestSchedulerReplan:
+    def _views_slow_head_side(self):
+        # stage-1 clients fast on device; measured rates imply no
+        # wire constraint — profile shape drives the search
+        return {f"c{i}": _view(95.0, 100.0) for i in range(4)}
+
+    def test_replan_adopted_and_journaled(self):
+        sch = Scheduler(_cfg(replan_damping=0.05, replan_cooldown=0))
+        # profile: layer 3 is heavy — the balanced cut is past it
+        prof = {"exe_time": [0.001, 0.001, 0.02, 0.02],
+                "size_data": [1e5] * 4, "network": 0.0}
+        profiles = {f"c{i}": prof for i in range(4)}
+        out = sch.plan_round([_plan(cuts=(3,))], 1,
+                             _fleet(self._views_slow_head_side()),
+                             profiles)
+        reps = [d for d in sch.decisions if d["action"] == "replan"]
+        if reps:   # adopted: plans updated + detail complete
+            assert out.plans is not None
+            assert out.plans[0].cuts == reps[0]["detail"]["cuts_to"]
+            assert validate_journal(reps) == []
+
+    def test_cooldown_blocks_consecutive_replans(self):
+        sch = Scheduler(_cfg(replan_damping=0.0, replan_cooldown=5))
+        prof = {"exe_time": [0.001, 0.001, 0.02, 0.02],
+                "size_data": [1e5] * 4, "network": 0.0}
+        profiles = {f"c{i}": prof for i in range(4)}
+        plans = [_plan(cuts=(3,))]
+        out1 = sch.plan_round(plans, 1,
+                              _fleet(self._views_slow_head_side()),
+                              profiles)
+        if out1.plans is not None:
+            plans = out1.plans
+        n1 = sum(1 for d in sch.decisions if d["action"] == "replan")
+        sch.plan_round(plans, 2,
+                       _fleet(self._views_slow_head_side()), profiles)
+        n2 = sum(1 for d in sch.decisions if d["action"] == "replan")
+        assert n2 == n1   # cooled down
+
+    def test_no_profiles_no_replan(self):
+        sch = Scheduler(_cfg(replan_damping=0.0, replan_cooldown=0))
+        out = sch.plan_round([_plan(cuts=(2,))], 1,
+                             _fleet(self._views_slow_head_side()), {})
+        assert not any(d["action"] == "replan" for d in sch.decisions)
+        assert out.plans is None
+
+
+class TestConfig:
+    def test_requires_heartbeats(self):
+        with pytest.raises(ConfigError):
+            from_dict({"scheduler": {"enabled": True},
+                       "observability": {"heartbeat_interval": 0}})
+
+    def test_bad_codec_spec_rejected(self):
+        with pytest.raises(ConfigError):
+            from_dict({"scheduler": {"wire_slow_codec": "bogus:zz"}})
+
+    def test_bounds(self):
+        for bad in ({"hysteresis": 1.5}, {"evict_after": 0},
+                    {"replan_damping": -0.1}, {"interval": 0},
+                    {"barrier_grace_s": -1.0}, {"minibatch": 0}):
+            with pytest.raises(ConfigError):
+                from_dict({"scheduler": bad})
+
+    def test_default_off(self):
+        assert from_dict({}).scheduler.enabled is False
+
+
+class TestClientKnobs:
+    def _client(self, tmp_path, codec=None):
+        from split_learning_tpu.runtime.bus import InProcTransport
+        from split_learning_tpu.runtime.client import ProtocolClient
+        cfg = from_dict({
+            "model": "KWT", "dataset": "SPEECHCOMMANDS",
+            "clients": [1, 1], "synthetic_size": 48,
+            "model_kwargs": TINY_KWT, "log_path": str(tmp_path),
+            "transport": ({"codec": codec} if codec else {}),
+            "checkpoint": {"directory": str(tmp_path / "ck"),
+                           "save": False},
+        })
+        return ProtocolClient(cfg, "kc_1_0", 1,
+                              transport=InProcTransport())
+
+    def test_codec_override_applied_and_reverted(self, tmp_path):
+        c = self._client(tmp_path)
+        assert "intermediate" not in c.codecs
+        c._apply_sched_knobs({"codec": {"intermediate": "int8:64"}})
+        assert "intermediate" in c.codecs
+        # idempotent: same grant rebuilds nothing
+        codecs = c.codecs
+        c._apply_sched_knobs({"codec": {"intermediate": "int8:64"}})
+        assert c.codecs is codecs
+        # revoke -> config codecs
+        c._apply_sched_knobs(None)
+        assert "intermediate" not in c.codecs
+
+    def test_override_merges_over_config(self, tmp_path):
+        c = self._client(tmp_path, codec={"gradient": "topk:0.1"})
+        c._apply_sched_knobs({"codec": {"intermediate": "int4:32"}})
+        assert "gradient" in c.codecs and "intermediate" in c.codecs
+
+    def test_bad_spec_rejected_not_fatal(self, tmp_path):
+        c = self._client(tmp_path)
+        c._apply_sched_knobs({"codec": {"intermediate": "bogus:x"}})
+        assert "intermediate" not in c.codecs
+        assert c.faults.snapshot().get("sched_knob_rejects") == 1
+
+
+class TestSC001:
+    def test_repo_clean(self):
+        from split_learning_tpu.analysis import sched_check
+        root = pathlib.Path(__file__).resolve().parents[1]
+        assert sched_check.run(root) == []
+
+    def test_negative_silent_decision_site(self):
+        from split_learning_tpu.analysis import sched_check
+        src = ("class S:\n"
+               "    def _act_evict(self, cid):\n"
+               "        self.evicted.add(cid)\n"
+               "    def _act_demote(self, cid):\n"
+               "        self.journal('demote', 1, client=cid)\n")
+        found = sched_check.check_source(src, "x.py")
+        assert len(found) == 1
+        assert found[0].code == "SC001"
+        assert found[0].where == "_act_evict"
+
+
+# --------------------------------------------------------------------------
+# e2e: synthetic fleet against the real server planes
+# --------------------------------------------------------------------------
+
+def _sim_cfg(tmp_path, n1, rounds, sched_over=None, **over):
+    base = {
+        "model": "KWT", "dataset": "SPEECHCOMMANDS",
+        "clients": [n1, 1], "global_rounds": rounds,
+        "synthetic_size": 48, "val_max_batches": 1,
+        "val_batch_size": 16, "model_kwargs": TINY_KWT,
+        "log_path": str(tmp_path / "run"),
+        "learning": {"batch_size": 4},
+        "topology": {"cut_layers": [2]},
+        "checkpoint": {"save": False, "validate": False,
+                       "directory": str(tmp_path / "ckpt")},
+        "observability": {"heartbeat_interval": 0.25,
+                          "liveness_timeout": 30.0},
+        "scheduler": {"enabled": True, "warmup_rounds": 1,
+                      "evict_after": 2, "barrier_grace_s": 0.5,
+                      **(sched_over or {})},
+    }
+    base.update(over)
+    return from_dict(base)
+
+
+def _run_sim(cfg, specs, heartbeat=0.25, timeout=120.0):
+    from split_learning_tpu.runtime.bus import InProcTransport
+    from split_learning_tpu.runtime.log import Logger
+    from split_learning_tpu.runtime.server import ProtocolServer
+    from split_learning_tpu.runtime.simfleet import SyntheticFleet
+
+    bus = InProcTransport()
+    server = ProtocolServer(cfg, transport=bus,
+                            logger=Logger.for_run(cfg, "server",
+                                                  console=False),
+                            client_timeout=timeout)
+    fleet = SyntheticFleet(bus, specs,
+                           heartbeat_interval=heartbeat).start()
+    try:
+        res = server.serve()
+    finally:
+        fleet.stop()
+    return res, server.ctx, fleet
+
+
+@pytest.mark.slow
+def test_simfleet_e2e_demote_evict_and_fleet_view(tmp_path):
+    """Heterogeneous synthetic fleet: the compute- and wire-stragglers
+    are attributed, demoted with the right knobs, then evicted; the
+    round completes every time; /fleet carries CLUSTER/SCHED."""
+    from split_learning_tpu.runtime.simfleet import hetero_fleet
+
+    cfg = _sim_cfg(tmp_path, 8, 3)
+    specs = hetero_fleet(8, 1, compute_speed=100.0, compute_slow=1,
+                         compute_slow_factor=10.0, wire_slow=1,
+                         samples=32, seed=0)
+    res, ctx, fleet = _run_sim(cfg, specs)
+    assert all(r.ok for r in res.history)
+    assert not fleet.errors
+    sch = ctx.scheduler
+    demotes = {d["client"]: d["detail"] for d in sch.decisions
+               if d["action"] == "demote"}
+    assert demotes["sim_1_00000"]["attribution"] == "compute"
+    assert demotes["sim_1_00001"]["attribution"] == "wire"
+    evicted = {d["client"] for d in sch.decisions
+               if d["action"] == "evict"}
+    assert {"sim_1_00000", "sim_1_00001"} <= evicted
+    assert validate_journal(list(sch.decisions)) == []
+    # the journaled kind=fleet record carries the scheduler view
+    topo = sch.topology()
+    assert topo["actions"]
+    assert "sim_1_00002" in topo["clusters"]
+    # final round excludes the evicted members but still aggregates
+    assert res.history[-1].num_samples == 6 * 32
+
+
+@pytest.mark.slow
+def test_simfleet_midround_eviction_bit_identical_to_oracle(tmp_path):
+    """Chaos-soak the mid-round drop: a round where the scheduler
+    barrier-drops a straggler must aggregate BIT-IDENTICAL to the
+    oracle FedAvg over exactly the members that folded (the streaming
+    fold's canonical order must survive the mid-round release)."""
+    from split_learning_tpu.ops import fedavg
+    from split_learning_tpu.runtime.simfleet import hetero_fleet
+
+    cfg = _sim_cfg(tmp_path, 4, 2,
+                   sched_over={"evict": True, "evict_after": 10,
+                               "barrier_grace_s": 0.4})
+    specs = hetero_fleet(4, 1, compute_speed=100.0, compute_slow=1,
+                         compute_slow_factor=30.0, samples=32, seed=0)
+    res, ctx, fleet = _run_sim(cfg, specs)
+    assert all(r.ok for r in res.history)
+    drops = [d for d in ctx.scheduler.decisions
+             if d["action"] == "drop"]
+    assert drops, "the straggler was never barrier-dropped"
+    assert {d["client"] for d in drops} == {"sim_1_00000"}
+    # oracle: the surviving sim clients echo their last START shard
+    # back (the post-round-0 fold), so the final round's stage-1
+    # aggregate must be BIT-IDENTICAL to a direct StreamingFold over
+    # exactly the surviving members' identical trees in canonical
+    # order — computed here through the same fold path the server
+    # uses, which is what the mid-round release must not perturb
+    from split_learning_tpu.runtime.aggregate import StreamingFold
+    from split_learning_tpu.runtime.protocol import Update
+    survivors = ["sim_1_00001", "sim_1_00002", "sim_1_00003"]
+    echo = fleet.clients["sim_1_00001"].params
+    oracle = StreamingFold({1: sorted(survivors)})
+    for cid in sorted(survivors):
+        oracle.add_update(Update(
+            client_id=cid, stage=1, cluster=0,
+            params=copy.deepcopy(echo), num_samples=32, round_idx=0))
+    expected = oracle.finish().params
+    names = set(expected)
+    assert names
+    final = {k: v for k, v in res.params.items() if k in names}
+    flat_f, flat_e = fedavg_flat(final), fedavg_flat(expected)
+    assert [k for k, _ in flat_f] == [k for k, _ in flat_e]
+    for (ka, a), (_, b) in zip(flat_f, flat_e):
+        assert np.asarray(a).tobytes() == np.asarray(b).tobytes(), ka
+
+
+def fedavg_flat(tree, prefix=""):
+    out = []
+    if isinstance(tree, dict):
+        for k in sorted(tree):
+            out += fedavg_flat(tree[k], prefix + "/" + str(k))
+    else:
+        out.append((prefix, tree))
+    return out
+
+
+@pytest.mark.slow
+def test_simfleet_churn_elastic_replan(tmp_path):
+    """Membership churn through the elastic path: a leaver goes
+    silent and is pruned; rounds keep completing."""
+    from split_learning_tpu.runtime.simfleet import hetero_fleet
+
+    cfg = _sim_cfg(tmp_path, 6, 4,
+                   topology={"cut_layers": [2], "elastic_join": True},
+                   sched_over={"evict": False,
+                               "barrier_grace_s": 0.5})
+    specs = hetero_fleet(6, 1, compute_speed=100.0, samples=32,
+                         leavers=1, leave_after_rounds=1, seed=0)
+    res, ctx, fleet = _run_sim(cfg, specs)
+    assert all(r.ok for r in res.history)
+    # the leaver contributed round 0 then went silent; later rounds
+    # complete without it (mid-round drop or elastic prune)
+    assert res.history[0].num_samples == 6 * 32
+    assert res.history[-1].num_samples >= 5 * 32
+
+
+def test_sim_specs_deterministic():
+    from split_learning_tpu.runtime.simfleet import hetero_fleet
+    a = hetero_fleet(10, 1, compute_slow=2, wire_slow=2, seed=3)
+    b = hetero_fleet(10, 1, compute_slow=2, wire_slow=2, seed=3)
+    assert [(s.cid, s.compute_speed, s.wire_bytes_per_s) for s in a] \
+        == [(s.cid, s.compute_speed, s.wire_bytes_per_s) for s in b]
+
+
+def test_scheduler_topology_view_shape():
+    sch = Scheduler(_cfg())
+    sch.plan_round([_plan()], 1, _fleet({
+        "c0": _view(2, 2, "straggler"),
+        "c1": _view(10, 11), "c2": _view(10, 11),
+        "c3": _view(10, 11)}), {})
+    topo = sch.topology()
+    assert set(topo) == {"clusters", "actions", "last_replan",
+                         "decisions"}
+    assert topo["actions"].get("c0", "").startswith("demote@r")
+    # sl_top renders the scheduler columns from this view
+    sys.path.insert(0, str(pathlib.Path(__file__).resolve()
+                           .parents[1] / "tools"))
+    import sl_top
+    fleet = {"counts": {"healthy": 3, "straggler": 1},
+             "clients": {c: {**_view(10, 11), "cluster": 0,
+                             "sched": topo["actions"].get(c)}
+                         for c in ("c0", "c1", "c2", "c3")},
+             "transitions": [], "scheduler": topo}
+    table = sl_top.render_fleet(fleet, color=False)
+    assert "CLUSTER" in table and "SCHED" in table
+    assert "demote@r1" in table

@@ -638,6 +638,151 @@ def overlap_cell(tmp: str, seed: int = 13) -> tuple[bool, str]:
                   f"({n_ovl} overlap ticks records, {wall:.0f}s)")
 
 
+def sched_cell(tmp: str, seed: int = 17) -> tuple[bool, str]:
+    """Closed-loop scheduler chaos cell (scheduler.enabled): a
+    heterogeneous 6-client round (synthetic-client substrate,
+    ``runtime/simfleet.py``, against the real server/telemetry/
+    aggregation planes) with ONE injected compute-straggler (device
+    rate 10x slow) and ONE wire-straggler (wire time ~6x compute),
+    with duplicate+reorder chaos on the rpc queue.  PASSes iff
+
+    * every round completes (the scheduler must never stall a round);
+    * BOTH stragglers are attributed correctly and demoted with their
+      knobs retuned: the compute-straggler gets the wider staleness
+      window + quorum exemption, the wire-straggler the heavier
+      intermediate codec (asserted from the decision journal's
+      attribution + knob details);
+    * the decisions journal validates (``validate_journal``: every
+      control action fully attributable — SC001's runtime twin);
+    * /fleet carries the scheduler view (cluster map + per-client
+      SCHED column source) and sl_top renders it.
+
+    Writes ``sched.json`` (decisions + final fleet snapshot) into the
+    cell dir for CI artifact upload."""
+    from split_learning_tpu.config import from_dict
+    from split_learning_tpu.runtime.log import Logger
+    from split_learning_tpu.runtime.scheduler import validate_journal
+    from split_learning_tpu.runtime.server import ProtocolServer
+    from split_learning_tpu.runtime.simfleet import (
+        SimClientSpec, SyntheticFleet,
+    )
+
+    sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent))
+    import sl_top
+
+    cell_dir = pathlib.Path(tmp) / "sched"
+    cell_dir.mkdir(parents=True, exist_ok=True)
+    n1, heads = 6, 1
+    cfg = from_dict({
+        "model": "KWT", "dataset": "SPEECHCOMMANDS",
+        "clients": [n1, heads], "global_rounds": 3,
+        "synthetic_size": 48, "val_max_batches": 1,
+        "val_batch_size": 16,
+        "model_kwargs": {"embed_dim": 16, "num_heads": 2,
+                         "mlp_dim": 32},
+        "log_path": str(cell_dir),
+        "learning": {"batch_size": 4},
+        "topology": {"cut_layers": [2]},
+        "checkpoint": {"save": False, "validate": False,
+                       "directory": str(cell_dir / "ckpt")},
+        "observability": {"heartbeat_interval": 0.25,
+                          "liveness_timeout": 30.0, "http_port": 0},
+        # evict-after high: this cell proves DEMOTION + attribution
+        # (eviction has its own coverage in tests/test_scheduler.py)
+        "scheduler": {"enabled": True, "warmup_rounds": 1,
+                      "evict_after": 10, "barrier_grace_s": 0.5},
+    })
+    # one compute-straggler, one wire-straggler, four healthy + a head
+    n_layers, speed, samples = 4, 100.0, 32
+    update_bytes = 64 << 10
+    specs = []
+    for i in range(n1):
+        sp, wire = speed, 0.0
+        if i == 0:
+            sp = speed / 10.0
+        elif i == 1:
+            wire = update_bytes / (6.0 * samples / speed)
+        specs.append(SimClientSpec(
+            cid=f"sim_1_{i:05d}", stage=1, compute_speed=sp,
+            wire_bytes_per_s=wire, samples=samples,
+            profile={"exe_time": [(1.0 / sp) / n_layers] * n_layers,
+                     "size_data": [float(update_bytes)] * n_layers,
+                     "speed": sp, "network": 0.0}))
+    specs.append(SimClientSpec(cid="sim_2_00000", stage=2,
+                               compute_speed=speed, samples=samples))
+    compute_slow, wire_slow = "sim_1_00000", "sim_1_00001"
+
+    bus = InProcTransport()
+    fc = FaultCounters()
+    # duplicate + reorder chaos on the rpc queue: the scheduler's
+    # inputs (heartbeats, update-piggybacked telemetry) must survive
+    # the staleness guard's rejections without misattributing anyone
+    chaos = ChaosConfig(enabled=True, seed=seed, duplicate=0.2,
+                        reorder=0.2, queues=("rpc_queue",))
+    fleet_bus = ChaosTransport(bus, chaos, name="simfleet", faults=fc)
+    server = ProtocolServer(cfg, transport=bus,
+                            logger=Logger.for_run(cfg, "server",
+                                                  console=False),
+                            client_timeout=120.0)
+    fleet = SyntheticFleet(fleet_bus, specs,
+                           heartbeat_interval=0.25,
+                           time_scale=1.0).start()
+    t0 = time.monotonic()
+    try:
+        res = server.serve()
+    finally:
+        fleet.stop()
+    wall = time.monotonic() - t0
+    ctx = server.ctx
+    decisions = list(ctx.scheduler.decisions)
+    fsnap = ctx.scheduler.annotate_fleet(ctx.fleet.snapshot())
+    topo = fsnap["scheduler"]
+    (cell_dir / "sched.json").write_text(json.dumps(
+        {"decisions": decisions, "fleet": fsnap, "wall_s": wall},
+        indent=2, default=str))
+    if not res.history or not all(r.ok for r in res.history):
+        return False, "round not ok"
+    if wall > 240:
+        return False, f"round stalled ({wall:.0f}s)"
+    errs = validate_journal(decisions)
+    if errs:
+        return False, f"journal invalid: {errs[0]}"
+    demotes = {d["client"]: d["detail"] for d in decisions
+               if d["action"] == "demote"}
+    if compute_slow not in demotes:
+        return False, f"{compute_slow} never demoted"
+    if wire_slow not in demotes:
+        return False, f"{wire_slow} never demoted"
+    det_c, det_w = demotes[compute_slow], demotes[wire_slow]
+    if det_c.get("attribution") != "compute" \
+            or "staleness_bonus" not in det_c.get("knobs", {}):
+        return False, (f"compute-straggler misattributed: {det_c}")
+    if det_w.get("attribution") != "wire" \
+            or "intermediate" not in det_w.get("knobs",
+                                               {}).get("codec", {}):
+        return False, f"wire-straggler misattributed: {det_w}"
+    table = sl_top.render_fleet(fsnap, color=False, source="sched")
+    (cell_dir / "sched_table.txt").write_text(table + "\n")
+    # the SCHED column shows each client's LAST action (a later mid-
+    # round drop may have overwritten demote@rN) — require both
+    # stragglers to carry one, and the demotions to render in the
+    # decisions tail
+    if not all("@r" in (topo["actions"].get(c) or "")
+               for c in (compute_slow, wire_slow)):
+        return False, "sl_top SCHED column missing the stragglers"
+    if "demote" not in table:
+        return False, "sl_top decisions tail missing the demotions"
+    healthy_demoted = [c for c in demotes
+                       if c not in (compute_slow, wire_slow)]
+    if healthy_demoted:
+        return False, f"healthy clients demoted: {healthy_demoted}"
+    return True, (f"both stragglers attributed+demoted, "
+                  f"{len(decisions)} journaled decisions, "
+                  f"{fc.snapshot().get('duplicates', 0)} dup "
+                  f"{fc.snapshot().get('reorders', 0)} reorder "
+                  f"injected [{wall:.0f}s]")
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(
         description="Sweep fault probabilities over seeds; print a "
@@ -680,6 +825,16 @@ def main(argv=None):
                          "one is SIGKILLed mid-round and the round "
                          "must complete via the counted direct-to-"
                          "root fallback drain")
+    ap.add_argument("--sched", dest="sched_mode",
+                    action="store_true",
+                    help="run ONLY the closed-loop scheduler cell: a "
+                         "heterogeneous 6-client synthetic round with "
+                         "one compute- and one wire-straggler under "
+                         "rpc dup+reorder chaos; both must be "
+                         "attributed correctly and demoted with their "
+                         "knobs retuned, the round must complete, and "
+                         "the kind=sched decisions journal must "
+                         "validate (writes sched.json)")
     ap.add_argument("--overlap", dest="overlap_mode",
                     action="store_true",
                     help="run ONLY the sync-overlap cell: a 3-client "
@@ -700,6 +855,20 @@ def main(argv=None):
         ok, note = tree_remote_cell(tmp)
         dt = time.monotonic() - t0
         print(f"tree-remote cell: {'PASS' if ok else 'FAIL'} ({note}) "
+              f"[{dt:.1f}s, artifacts in {tmp}]")
+        return 0 if ok else 1
+
+    if args.sched_mode:
+        if args.artifacts_dir:
+            tmp = args.artifacts_dir
+            pathlib.Path(tmp).mkdir(parents=True, exist_ok=True)
+        else:
+            import tempfile
+            tmp = tempfile.mkdtemp(prefix="chaos_sched_")
+        t0 = time.monotonic()
+        ok, note = sched_cell(tmp)
+        dt = time.monotonic() - t0
+        print(f"sched cell: {'PASS' if ok else 'FAIL'} ({note}) "
               f"[{dt:.1f}s, artifacts in {tmp}]")
         return 0 if ok else 1
 

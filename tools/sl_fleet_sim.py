@@ -1,0 +1,183 @@
+#!/usr/bin/env python
+"""``sl_fleet_sim`` — fleet-scale control-plane simulator / load
+generator.
+
+Registers 1k–10k heterogeneous synthetic clients
+(``runtime/simfleet.py``: configurable compute/wire speed
+distributions, membership churn, registration storms) against the REAL
+server, aggregation and telemetry planes over an in-proc transport,
+and runs full protocol rounds.  This is the closed-loop scheduler's
+proof rig — and the load generator for any control-plane scale
+question (how long does a 10k registration storm take? does the
+scheduler's decision pass stay flat per client?).
+
+    # 1k clients, 3 rounds, scheduler on, one compute- and one
+    # wire-straggler per 100
+    python tools/sl_fleet_sim.py --clients 1000 --rounds 3 --sched \
+        --compute-slow 10 --wire-slow 10
+
+    # paired scheduler-on/off comparison on the same fleet + seed
+    python tools/sl_fleet_sim.py --clients 64 --rounds 4 --paired
+
+Prints one JSON summary: per-round walls, fleet health counts,
+scheduler decisions, and the decision-pass cost.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import pathlib
+import sys
+import time
+
+# runnable from anywhere: the repo root precedes any installed copy
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parents[1]))
+
+
+def build_cfg(args, log_dir: str, sched: bool):
+    from split_learning_tpu.config import from_dict
+    return from_dict({
+        "model": "KWT", "dataset": "SPEECHCOMMANDS",
+        "clients": [args.clients, args.heads],
+        "global_rounds": args.rounds,
+        "synthetic_size": 48, "val_max_batches": 1,
+        "val_batch_size": 16,
+        "model_kwargs": {"embed_dim": 16, "num_heads": 2,
+                         "mlp_dim": 32},
+        "log_path": log_dir,
+        "learning": {"batch_size": 4},
+        "topology": {"cut_layers": [2],
+                     "elastic_join": bool(args.churn)},
+        "checkpoint": {"save": False, "validate": False,
+                       "directory": f"{log_dir}/ckpt"},
+        "observability": {
+            "heartbeat_interval": args.heartbeat_interval,
+            "liveness_timeout": max(30.0,
+                                    8 * args.heartbeat_interval),
+            "http_port": (0 if args.http else None)},
+        "scheduler": {"enabled": sched,
+                      "warmup_rounds": 1,
+                      "evict_after": args.evict_after,
+                      "barrier_grace_s": args.grace},
+    })
+
+
+def run_leg(args, sched: bool, log_dir: str) -> dict:
+    from split_learning_tpu.runtime.bus import InProcTransport
+    from split_learning_tpu.runtime.server import ProtocolServer
+    from split_learning_tpu.runtime.simfleet import (
+        SyntheticFleet, hetero_fleet,
+    )
+
+    cfg = build_cfg(args, log_dir, sched)
+    specs = hetero_fleet(
+        args.clients, args.heads,
+        compute_speed=args.compute_speed,
+        compute_slow=args.compute_slow,
+        compute_slow_factor=args.compute_slow_factor,
+        wire_slow=args.wire_slow, samples=args.samples,
+        joiners=args.churn, join_delay_s=args.join_delay,
+        leavers=args.churn, seed=args.seed)
+    from split_learning_tpu.runtime.log import Logger
+    bus = InProcTransport()
+    # console off: stdout is this tool's JSON summary
+    server = ProtocolServer(cfg, transport=bus,
+                            logger=Logger.for_run(cfg, "server",
+                                                  console=False),
+                            client_timeout=args.client_timeout)
+    t_reg = time.monotonic()
+    fleet = SyntheticFleet(
+        bus, specs, heartbeat_interval=args.heartbeat_interval,
+        time_scale=args.time_scale,
+        codec_gain=args.codec_gain).start()
+    t0 = time.monotonic()
+    try:
+        res = server.serve()
+    finally:
+        fleet.stop()
+    wall = time.monotonic() - t0
+    out = {
+        "sched": sched,
+        "clients": args.clients, "rounds": args.rounds,
+        "register_to_serve_s": round(t0 - t_reg, 3),
+        "total_wall_s": round(wall, 3),
+        "round_walls_s": [round(r.wall_s, 3) for r in res.history],
+        "rounds_ok": all(r.ok for r in res.history),
+        "sim_errors": fleet.errors[:5],
+    }
+    ctx = server.ctx
+    if ctx.fleet is not None:
+        out["fleet_counts"] = ctx.fleet.snapshot()["counts"]
+    if ctx.scheduler is not None:
+        sch = ctx.scheduler
+        out["decisions"] = [
+            {k: d[k] for k in ("action", "round", "client", "why")}
+            for d in sch.decisions if d["action"] != "decide"]
+        out["decision_ms"] = (
+            ctx.gauges.get("sched_decision_ms"))
+        out["decision_ms_per_client"] = (
+            round(out["decision_ms"] / max(args.clients, 1), 6)
+            if out["decision_ms"] is not None else None)
+    return out
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="Fleet-scale control-plane simulator (synthetic "
+                    "clients against the real server planes).")
+    ap.add_argument("--clients", type=int, default=100,
+                    help="stage-1 synthetic clients")
+    ap.add_argument("--heads", type=int, default=1)
+    ap.add_argument("--rounds", type=int, default=3)
+    ap.add_argument("--samples", type=int, default=32,
+                    help="samples per client per round")
+    ap.add_argument("--compute-speed", type=float, default=100.0)
+    ap.add_argument("--compute-slow", type=int, default=0,
+                    help="clients at compute-speed / slow-factor")
+    ap.add_argument("--compute-slow-factor", type=float, default=8.0)
+    ap.add_argument("--wire-slow", type=int, default=0,
+                    help="clients whose wire time ~= 6x compute")
+    ap.add_argument("--churn", type=int, default=0,
+                    help="late joiners AND early leavers (each)")
+    ap.add_argument("--join-delay", type=float, default=2.0)
+    ap.add_argument("--time-scale", type=float, default=1.0,
+                    help="multiplier on every simulated duration")
+    ap.add_argument("--heartbeat-interval", type=float, default=0.5)
+    ap.add_argument("--codec-gain", type=float, default=4.0,
+                    help="wire speedup a granted codec knob models")
+    ap.add_argument("--grace", type=float, default=0.5,
+                    help="scheduler.barrier-grace-s")
+    ap.add_argument("--evict-after", type=int, default=2)
+    ap.add_argument("--client-timeout", type=float, default=300.0)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--sched", action="store_true",
+                    help="enable the closed-loop scheduler")
+    ap.add_argument("--paired", action="store_true",
+                    help="run scheduler-off then scheduler-on on the "
+                         "same fleet and report the wall ratio")
+    ap.add_argument("--http", action="store_true",
+                    help="serve /metrics + /fleet during the run")
+    ap.add_argument("--log-dir", default=None)
+    args = ap.parse_args(argv)
+
+    import tempfile
+    log_dir = args.log_dir or tempfile.mkdtemp(prefix="sl_fleet_sim_")
+    if args.paired:
+        off = run_leg(args, sched=False, log_dir=f"{log_dir}/off")
+        on = run_leg(args, sched=True, log_dir=f"{log_dir}/on")
+        steady_off = off["round_walls_s"][-1]
+        steady_on = on["round_walls_s"][-1]
+        out = {"off": off, "on": on,
+               "sched_wall_ratio_vs_static":
+                   round(steady_on / steady_off, 4)
+                   if steady_off else None}
+    else:
+        out = run_leg(args, sched=args.sched, log_dir=log_dir)
+    json.dump(out, sys.stdout, indent=2)
+    print()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

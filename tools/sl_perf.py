@@ -78,6 +78,13 @@ STABLE_KEYS = {
     # hidden behind client compute
     "extra.update_bubble_ms": "down",
     "extra.update_overlap_ratio": "up",
+    # closed-loop scheduler (round-13): steady-state round wall with
+    # the scheduler on vs the static plan on the heterogeneous
+    # simulated fleet (<1 = the control loop pays for itself), and the
+    # scheduler's own decision-pass wall at 10k simulated clients (the
+    # control plane must never become the bottleneck)
+    "extra.sched_wall_ratio_vs_static": "down",
+    "extra.sched_decision_ms_10k": "down",
 }
 
 #: absolute pins, enforced on the NEWEST record regardless of trend: a
@@ -96,6 +103,14 @@ STABLE_KEY_CAPS = {
     # superlinear-aggregation regression cannot calcify)
     "extra.agg_root_ingress_mb_ratio": 0.35,
     "extra.agg_wall_per_client_ms_10k": 1.5,
+    # closed-loop scheduler acceptance pins (round-13): the scheduler
+    # must keep beating the static plan by >= 30% on the heterogeneous
+    # fleet cell, and one decision pass at 10k clients must stay
+    # bounded (measured ~490 ms = 0.05 ms/client, flat from 24 ->
+    # 10k; the pin is host headroom, not a target — against a ~30 s
+    # 10k-client round wall the pass is ~1.6%)
+    "extra.sched_wall_ratio_vs_static": 0.7,
+    "extra.sched_decision_ms_10k": 1000.0,
 }
 
 #: attribution components of a kind=perf record, in report order
@@ -148,7 +163,8 @@ for _k in ("protocol_samples_per_sec", "cold_round_wall_s",
            "agg_wall_per_client_ms_10k", "agg_root_ingress_mb_ratio",
            "async_samples_per_sec", "async_wall_ratio_vs_sync",
            "async_accuracy_delta", "update_bubble_ms",
-           "update_overlap_ratio"):
+           "update_overlap_ratio", "sched_wall_ratio_vs_static",
+           "sched_decision_ms_10k"):
     _path = ("extra.mfu." + _k
              if _k.startswith(("mfu_vs", "measured_matmul"))
              else "extra." + _k)

@@ -31,9 +31,9 @@ _STATE_COLOR = {"healthy": "\033[92m", "degraded": "\033[93m",
                 "straggler": "\033[95m", "lost": "\033[91m"}
 _RESET = "\033[0m"
 
-_COLUMNS = ("PARTICIPANT", "ROLE", "STATE", "ROUND", "VLAG", "SAMPLES",
-            "RATE/s", "SCORE", "MFU", "STEP p95 ms", "RTT p95 ms",
-            "WIRE MB", "AGE s")
+_COLUMNS = ("PARTICIPANT", "ROLE", "STATE", "CLUSTER", "SCHED",
+            "ROUND", "VLAG", "SAMPLES", "RATE/s", "SCORE", "MFU",
+            "STEP p95 ms", "RTT p95 ms", "WIRE MB", "AGE s")
 
 #: telemetry snapshot `kind` -> table role label; aggregator nodes
 #: (aggregation.remote) rate-columns read "-": their samples/s is
@@ -89,7 +89,12 @@ def render_fleet(fleet: dict, color: bool = True,
         agg = c.get("kind") == "agg_node"
         rows.append((
             cid, _ROLE.get(c.get("kind", "client"), c.get("kind")),
-            c.get("state", "?"), _fmt(c.get("round")),
+            c.get("state", "?"),
+            # closed-loop scheduler (scheduler.enabled): assigned
+            # online cluster + last scheduler action ("demote@r3");
+            # "-" with the scheduler off or for unclustered roles
+            _fmt(c.get("cluster")), _fmt(c.get("sched")),
+            _fmt(c.get("round")),
             # async version lag (bounded-staleness mode); "-" outside it
             _fmt(c.get("version_lag")),
             # aggregator rows: training columns are structurally empty
@@ -120,6 +125,21 @@ def render_fleet(fleet: dict, color: bool = True,
         for t in tail:
             lines.append(f"  {t.get('client')}: {t.get('from')} -> "
                          f"{t.get('to')} ({t.get('why')})")
+    sched = fleet.get("scheduler") or {}
+    dec = [d for d in sched.get("decisions", [])
+           if d.get("action") != "decide"][-5:]
+    if dec:
+        lines.append("")
+        lines.append("recent scheduler decisions:")
+        for d in dec:
+            who = d.get("client") or f"cluster {d.get('cluster')}"
+            lines.append(f"  r{d.get('round')}: {d.get('action')} "
+                         f"{who} ({d.get('why')})")
+    if sched.get("last_replan"):
+        rp = sched["last_replan"]
+        lines.append(f"last re-plan: r{rp.get('round')} cluster "
+                     f"{rp.get('cluster')} cuts {rp.get('cuts_from')}"
+                     f" -> {rp.get('cuts_to')}")
     return "\n".join(lines)
 
 
