@@ -1,4 +1,4 @@
-"""Counter/histogram/gauge name-registry conformance (CT001-CT003).
+"""Counter/histogram/gauge name-registry conformance (CT001-CT004).
 
 ``FaultCounters.inc``, ``HistogramSet.observe`` and ``GaugeSet.set``
 are string-keyed: a typo'd name does not fail — it silently mints a
@@ -17,6 +17,13 @@ from an iteration over declared names today); test files are excluded
 rule only fires on string-literal first arguments, so
 ``Event().set()`` (no args) and jax's ``.at[idx].set(v)`` (non-string)
 never match.
+
+CT004 extends the same contract to the digest roll-up plane
+(``runtime/sketch.py``): the counter/gauge vocabularies the digest
+path declares (``DIGEST_COUNTER_NAMES`` / ``DIGEST_GAUGE_NAMES``) must
+be SUBSETS of the trace.py registries — a digest counter outside
+``FAULT_COUNTER_NAMES`` would mint a key no exporter family ever
+renders, the exact silent-drop CT001 exists to prevent, one level up.
 """
 
 from __future__ import annotations
@@ -75,6 +82,37 @@ def scan_source(source: str, rel: str,
     return findings
 
 
+def check_digest_registries(
+        registries: dict[str, frozenset] | None = None,
+        digest_counters: frozenset | None = None,
+        digest_gauges: frozenset | None = None) -> list[Finding]:
+    """CT004: every name the digest plane declares must exist in the
+    matching trace.py registry (parameters exist for the negative
+    tests; production callers pass nothing)."""
+    regs = registries if registries is not None else _registries()
+    if digest_counters is None or digest_gauges is None:
+        from split_learning_tpu.runtime import sketch
+        if digest_counters is None:
+            digest_counters = sketch.DIGEST_COUNTER_NAMES
+        if digest_gauges is None:
+            digest_gauges = sketch.DIGEST_GAUGE_NAMES
+    rel = "split_learning_tpu/runtime/sketch.py"
+    findings: list[Finding] = []
+    for name in sorted(digest_counters - regs["FAULT_COUNTER_NAMES"]):
+        findings.append(Finding(
+            "CT004", rel, 1, "DIGEST_COUNTER_NAMES",
+            f"digest counter {name!r} is not declared in "
+            "runtime/trace.py FAULT_COUNTER_NAMES — its increments "
+            "would never reach sl_faults_total"))
+    for name in sorted(digest_gauges - regs["GAUGE_NAMES"]):
+        findings.append(Finding(
+            "CT004", rel, 1, "DIGEST_GAUGE_NAMES",
+            f"digest gauge {name!r} is not declared in "
+            "runtime/trace.py GAUGE_NAMES — its sets would never "
+            "render on /metrics"))
+    return findings
+
+
 def run(root: pathlib.Path) -> list[Finding]:
     regs = _registries()
     findings: list[Finding] = []
@@ -88,4 +126,5 @@ def run(root: pathlib.Path) -> list[Finding]:
         except OSError:
             continue
         findings += scan_source(source, rel, regs)
+    findings += check_digest_registries(regs)
     return findings

@@ -49,7 +49,7 @@ from split_learning_tpu.analysis.findings import Finding
 CONTROL_KINDS = ("Register", "Ready", "Notify", "Update",
                  "Start", "Syn", "Pause", "Stop", "Heartbeat",
                  "PartialAggregate", "AggHello", "AggAssign",
-                 "AggFlush")
+                 "AggFlush", "FleetDigest", "DigestRoute")
 DATA_KINDS = ("Activation", "Gradient", "EpochEnd")
 ALL_KINDS = CONTROL_KINDS + DATA_KINDS
 
@@ -61,6 +61,11 @@ QUEUE_FAMILIES = {
     # aggregator tree (aggregation.fan-in, runtime/aggregate.py):
     # clients of one L1 group -> that group's aggregator
     "aggregate": "aggregate_queue_*",
+    # hierarchical heartbeat roll-up (observability.digest-interval,
+    # runtime/sketch.py): clients routed to an aggregator node publish
+    # their HEARTBEATs here; the node folds them into FleetDigest
+    # frames for the server
+    "digest": "digest_queue_*",
 }
 
 #: legal (sender-role, queue-family, kind) triples.  The two
@@ -96,6 +101,14 @@ SEND_RULES = frozenset({
     ("server", "reply", "AggFlush"),
     ("aggregator", "aggregate", "PartialAggregate"),
     ("server", "aggregate", "PartialAggregate"),
+    # hierarchical heartbeat roll-up (observability.digest-interval):
+    # a routed client beats into its node's digest queue, the node
+    # publishes one merged FleetDigest per interval on rpc, and the
+    # server re-points a dead node's clients with DigestRoute frames
+    # on their reply queues
+    ("client", "digest", "Heartbeat"),
+    ("aggregator", "rpc", "FleetDigest"),
+    ("server", "reply", "DigestRoute"),
 })
 
 #: queue families each role may consume from.  The server's aggregate
@@ -110,6 +123,10 @@ RECV_RULES = frozenset({
     # remote aggregator node: AggAssign/AggFlush/Stop on its reply
     # queue (runtime/aggnode.py AggregatorNode.run)
     ("aggregator", "reply"),
+    # heartbeat roll-up: the node's DigestWorker drains its digest
+    # queue; the server drains a DEAD node's queue itself (the
+    # fallback — parked beats are liveness proof, not losses)
+    ("aggregator", "digest"), ("server", "digest"),
 })
 
 #: kinds legal on each DATA queue family (post-transport stream)
@@ -290,11 +307,23 @@ for _state, _transitions in SERVER_FSM.items():
     # AggHello is lifecycle-orthogonal too: a node process may start
     # (or reconnect-and-rehello) at any point of the server's round
     _transitions[("recv", "AggHello")] = _state
+    # FleetDigest frames arrive on the node's interval clock, whatever
+    # round phase the server is in; DigestRoute re-points (digest-node
+    # death fallback) happen the moment the death is noticed
+    _transitions[("recv", "FleetDigest")] = _state
+    _transitions[("send", "DigestRoute")] = _state
 for _state, _transitions in CLIENT_FSM.items():
     _transitions[("send", "Heartbeat")] = _state
+    # heartbeat re-route is lifecycle-orthogonal: the beat thread's
+    # target changes, the training lifecycle doesn't notice
+    _transitions[("recv", "DigestRoute")] = _state
 for _state, _transitions in AGGREGATOR_FSM.items():
-    # remote nodes heartbeat from a background thread, any state
+    # remote nodes heartbeat from a background thread, any state; the
+    # digest worker consumes routed clients' beats and publishes
+    # merged digests on its own interval clock the same way
     _transitions[("send", "Heartbeat")] = _state
+    _transitions[("recv", "Heartbeat")] = _state
+    _transitions[("send", "FleetDigest")] = _state
 
 FSM_BY_ROLE = {"server": SERVER_FSM, "client": CLIENT_FSM,
                "aggregator": AGGREGATOR_FSM}

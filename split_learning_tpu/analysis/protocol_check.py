@@ -44,7 +44,8 @@ from split_learning_tpu.analysis.model import (
 
 _QUEUE_CTORS = {"reply_queue": "reply", "intermediate_queue":
                 "intermediate", "gradient_queue": "gradient",
-                "aggregate_queue": "aggregate", "_ack_queue": "ack"}
+                "aggregate_queue": "aggregate", "_ack_queue": "ack",
+                "digest_queue": "digest"}
 _ANNOT_RE = re.compile(r"#\s*slcheck:\s*(.+?)\s*$")
 
 
@@ -312,6 +313,21 @@ def _sample_messages():
             bases={1: {"w": np.ones((4,), np.float32)}},
             chunk_bytes=1 << 20),
         "AggFlush": P.AggFlush(node_id="aggregator_node_0", gen=3),
+        "FleetDigest": P.FleetDigest(
+            node_id="aggregator_node_0", round_idx=1,
+            digest={"v": 1, "node": "aggregator_node_0", "t": 1.0,
+                    "seq": 2, "clients": 3,
+                    "states": {"healthy": 2, "straggler": 1},
+                    "counters": {"drops": 4}, "samples": 96,
+                    "rate": {"v": 1, "n": 3, "zero": 0,
+                             "total": 30.0, "b": {"13": 3}},
+                    "crate": {"v": 1, "n": 3, "zero": 0,
+                              "total": 33.0, "b": {"13": 3}},
+                    "stages": {}, "worst": [
+                        {"client": "c", "state": "straggler",
+                         "score": 0.3, "view": {"stage": 1}}],
+                    "transitions": []}),
+        "DigestRoute": P.DigestRoute(client_id="c", queue=None),
         "Activation": P.Activation(
             data_id="d0", data=np.ones((2, 3), np.float32),
             labels=np.zeros((2,), np.int64), trace=["c"], cluster=0),

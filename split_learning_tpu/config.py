@@ -542,7 +542,22 @@ class ObservabilityConfig:
     ``metrics.jsonl``, ``spans-*.jsonl``) under
     ``{journal-dir or log_path}/artifacts/runs/{run_id}/`` with compat
     symlinks at the old paths, so successive runs stop appending into
-    one shared metrics.jsonl."""
+    one shared metrics.jsonl.
+
+    Fleet-scale telemetry (``runtime/sketch.py``):
+    ``digest-interval`` > 0 turns on the hierarchical heartbeat
+    roll-up — clients' HEARTBEATs route to their aggregator node's
+    digest queue and the server ingests one merged ``FleetDigest`` per
+    node per interval (O(nodes), not O(clients)); the server keeps
+    exact per-client state only for a ``watchlist-size``-bounded set
+    (digest top-K / recent transitions / scheduler attention, with
+    promotion/demotion hysteresis).  ``max-client-series`` caps the
+    per-client ``sl_client_*`` cardinality on ``/metrics`` (watchlist
+    first; the rest live in the fleet-level quantile families) and is
+    the client count past which ``/fleet`` defaults to its summary
+    shape.  ``metrics-max-mb`` > 0 rotates ``metrics.jsonl`` at that
+    size (keeping ``metrics-keep`` rotated files) so long fleet runs
+    cannot grow it without bound."""
     enabled: bool = True
     sample_rate: float = 1.0
     journal_dir: str | None = None      # None -> the run's log_path
@@ -551,6 +566,11 @@ class ObservabilityConfig:
     liveness_timeout: float = 45.0      # silent seconds -> lost
     http_port: int | None = None        # /metrics + /fleet; 0 = ephemeral
     run_scoped: bool = True             # artifacts/runs/<run_id>/ layout
+    digest_interval: float = 0.0        # seconds; 0 = roll-up off
+    max_client_series: int = 256        # /metrics sl_client_* cap
+    watchlist_size: int = 64            # exact-state bound (digest mode)
+    metrics_max_mb: float = 0.0         # metrics.jsonl rotation; 0 = off
+    metrics_keep: int = 4               # rotated metrics.jsonl.N kept
 
     def validate(self):
         _check(0.0 <= self.sample_rate <= 1.0,
@@ -567,6 +587,20 @@ class ObservabilityConfig:
                or 0 <= int(self.http_port) <= 65535,
                f"observability.http-port must be in [0, 65535], "
                f"got {self.http_port!r}")
+        _check(self.digest_interval >= 0,
+               "observability.digest-interval must be >= 0")
+        _check(self.digest_interval == 0
+               or self.heartbeat_interval > 0,
+               "observability.digest-interval requires "
+               "heartbeat-interval > 0 (digests roll up heartbeats)")
+        _check(self.max_client_series >= 1,
+               "observability.max-client-series must be >= 1")
+        _check(self.watchlist_size >= 0,
+               "observability.watchlist-size must be >= 0")
+        _check(self.metrics_max_mb >= 0,
+               "observability.metrics-max-mb must be >= 0")
+        _check(self.metrics_keep >= 1,
+               "observability.metrics-keep must be >= 1")
 
 
 @dataclasses.dataclass(frozen=True)

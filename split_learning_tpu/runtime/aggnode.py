@@ -51,13 +51,101 @@ from split_learning_tpu.config import Config, from_yaml
 from split_learning_tpu.runtime import aggregate as agg_plane
 from split_learning_tpu.runtime.log import Logger
 from split_learning_tpu.runtime.protocol import (
-    AggAssign, AggFlush, AggHello, FrameAssembler, Heartbeat, Stop,
-    encode, reply_queue, RPC_QUEUE,
+    AggAssign, AggFlush, AggHello, FleetDigest, FrameAssembler,
+    Heartbeat, Stop, digest_queue, encode, reply_queue, RPC_QUEUE,
 )
 
 #: seconds an interior group keeps polling for its children's partials
 #: after the flush cascade released the level below it
 FLUSH_GRACE_S = 2.0
+
+
+class DigestWorker(threading.Thread):
+    """Hierarchical heartbeat roll-up (``observability.digest-interval``):
+    drains the node's :func:`digest_queue` — where the server routed
+    its assigned clients' HEARTBEAT frames via START ``extra.digest``
+    — into a node-local :class:`~split_learning_tpu.runtime.telemetry
+    .FleetMonitor` (the SAME state machine the server runs, so the
+    rolled-up per-state counts are exact vs a flat oracle), and
+    publishes one :class:`FleetDigest` frame per interval on the rpc
+    queue.  Root ingest is thereby O(nodes + top-K), not O(clients).
+
+    Owns its transport (``digest_bus``): a blocking control-loop get
+    and a zero-timeout fold sweep must never share a TCP socket with
+    this drain (the same ownership rule as the fold worker's)."""
+
+    #: heartbeat frames drained per sweep before the publish check
+    DRAIN_BATCH = 512
+
+    def __init__(self, node: "AggregatorNode", interval: float):
+        super().__init__(daemon=True, name=f"{node.node_id}-digest")
+        from split_learning_tpu.runtime.telemetry import FleetMonitor
+        self.node = node
+        self.interval = max(float(interval), 1e-3)
+        self.queue = digest_queue(node.node_id)
+        obs = node.cfg.observability
+        # the node-local monitor mirrors the server's thresholds so
+        # digest states are exactly what a flat FleetMonitor fed the
+        # same heartbeats would report
+        self.monitor = FleetMonitor(
+            interval=obs.heartbeat_interval,
+            liveness_timeout=obs.liveness_timeout,
+            log=None, faults=node.faults)
+        self._asm = FrameAssembler(faults=node.faults)
+        # NOT named _stop: threading.Thread's join() path calls an
+        # internal _stop() on 3.10 — shadowing it with an Event breaks
+        # every join of this thread
+        self._halt = threading.Event()
+        self._seq = 0
+
+    def stop(self) -> None:
+        self._halt.set()
+
+    def run(self) -> None:
+        next_pub = time.monotonic() + self.interval
+        while not self._halt.is_set():
+            drained = self._drain()
+            self.monitor.note_pump()
+            if time.monotonic() >= next_pub:
+                next_pub += self.interval
+                try:
+                    self.publish_digest()
+                except Exception as e:  # noqa: BLE001 — transport
+                    # gone: the server's node-death fallback re-points
+                    # the clients; this thread just winds down
+                    self.node.log.warning(f"digest publish failed: {e}")
+                    return
+            if not drained:
+                self._halt.wait(min(self.interval / 4, 0.05))
+
+    def _drain(self) -> bool:
+        drained = False
+        for _ in range(self.DRAIN_BATCH):
+            raw = self.node.digest_bus.get(self.queue, timeout=0.0)
+            if raw is None:
+                break
+            drained = True
+            try:
+                msg = self._asm.feed(raw)
+            except Exception:  # noqa: BLE001 — one corrupt heartbeat
+                self.node.faults.inc("corrupt_rejected")
+                continue
+            if isinstance(msg, Heartbeat):
+                self.monitor.note_heartbeat(msg.client_id,
+                                            msg.telemetry)
+        return drained
+
+    def publish_digest(self) -> None:
+        """Advance the local state machine and ship one digest (also
+        called once at teardown so the last interval isn't lost)."""
+        self.monitor.advance()
+        self._seq += 1
+        digest = self.monitor.build_digest(self.node.node_id,
+                                           self._seq)
+        self.node.bus.publish(RPC_QUEUE, encode(FleetDigest(
+            node_id=self.node.node_id, digest=digest)))
+        self.node.gauges.set("fleet_digest_clients",
+                             digest.get("clients", 0))
 
 
 class AssignmentWorker(threading.Thread):
@@ -191,11 +279,19 @@ class AggregatorNode:
     """
 
     def __init__(self, cfg: Config, node_id: str, transport=None,
-                 fold_transport=None, logger: Logger | None = None):
+                 fold_transport=None, digest_transport=None,
+                 logger: Logger | None = None):
         self.cfg = cfg
         self.node_id = node_id
         from split_learning_tpu.runtime.trace import FaultCounters
         self.faults = FaultCounters()
+        obs = getattr(cfg, "observability", None)
+        digest_interval = (obs.digest_interval
+                           if obs is not None else 0.0)
+        # close-at-teardown only covers stacks this node CREATED: an
+        # injected transport (tests, in-proc cells) is shared — the
+        # same ownership rule as L1Aggregator's owns_bus
+        self._owns_buses = transport is None
         if transport is None:
             from split_learning_tpu.runtime.chaos import (
                 make_runtime_transport,
@@ -205,9 +301,15 @@ class AggregatorNode:
             if fold_transport is None:
                 fold_transport = make_runtime_transport(
                     cfg, f"{node_id}.fold", faults=self.faults)
+            if digest_transport is None and digest_interval > 0:
+                digest_transport = make_runtime_transport(
+                    cfg, f"{node_id}.digest", faults=self.faults)
         self.bus = transport
         self.fold_bus = (fold_transport if fold_transport is not None
                          else transport)
+        self.digest_bus = (digest_transport
+                           if digest_transport is not None
+                           else transport)
         self.log = logger or Logger.for_run(cfg, node_id, console=False)
         self._asm = FrameAssembler(faults=self.faults)
         self._stop = threading.Event()
@@ -215,11 +317,15 @@ class AggregatorNode:
             GaugeSet, TelemetryEmitter,
         )
         self.gauges = GaugeSet()
-        obs = getattr(cfg, "observability", None)
         interval = obs.heartbeat_interval if obs is not None else 0.0
         self.emitter = TelemetryEmitter(
             node_id, self._beat, interval=interval, faults=self.faults,
             gauges=self.gauges, kind="agg_node")
+        # hierarchical heartbeat roll-up: one FleetDigest per
+        # observability.digest-interval over the clients whose
+        # heartbeats the server routed to this node's digest queue
+        self.digester = (DigestWorker(self, digest_interval)
+                         if digest_interval > 0 else None)
 
     def _beat(self, snapshot: dict) -> None:
         self.bus.publish(RPC_QUEUE, encode(Heartbeat(
@@ -233,6 +339,8 @@ class AggregatorNode:
             node_id=self.node_id)))
         self.log.sent("AGGHELLO")
         self.emitter.start()
+        if self.digester is not None:
+            self.digester.start()
         worker: AssignmentWorker | None = None
         try:
             while not self._stop.is_set():
@@ -288,13 +396,26 @@ class AggregatorNode:
             if worker is not None and worker.is_alive():
                 worker.flush.set()
                 worker.join(timeout=10.0)
-            self.emitter.stop()
-            for bus in {id(self.bus): self.bus,
-                        id(self.fold_bus): self.fold_bus}.values():
+            if self.digester is not None:
+                self.digester.stop()
+                self.digester.join(timeout=5.0)
                 try:
-                    bus.close()
-                except Exception:  # noqa: BLE001 — teardown best-effort
-                    pass
+                    # last interval's heartbeats must not vanish with
+                    # the node: one final digest before teardown
+                    self.digester.publish_digest()
+                except Exception:  # noqa: BLE001 — transport already
+                    pass           # gone; the server's fallback covers
+            self.emitter.stop()
+            if self._owns_buses:
+                for bus in {
+                        id(self.bus): self.bus,
+                        id(self.fold_bus): self.fold_bus,
+                        id(self.digest_bus): self.digest_bus}.values():
+                    try:
+                        bus.close()
+                    except Exception:  # noqa: BLE001 — teardown
+                        pass           # best-effort
+
             self.log.close()
 
 

@@ -53,10 +53,10 @@ from split_learning_tpu.runtime.log import Logger
 from split_learning_tpu.runtime.memo import bounded_setdefault
 from split_learning_tpu.runtime.codec import make_codecs, wire_raw_nbytes
 from split_learning_tpu.runtime.protocol import (
-    Activation, EpochEnd, FrameAssembler, Gradient, Heartbeat, Notify,
-    Pause, Ready, Register, SparseLeaf, Start, Stop, Syn, QuantLeaf,
-    Update, aggregate_queue, encode, encode_parts, gradient_queue,
-    intermediate_queue, reply_queue, RPC_QUEUE,
+    Activation, DigestRoute, EpochEnd, FrameAssembler, Gradient,
+    Heartbeat, Notify, Pause, Ready, Register, SparseLeaf, Start, Stop,
+    Syn, QuantLeaf, Update, aggregate_queue, encode, encode_parts,
+    gradient_queue, intermediate_queue, reply_queue, RPC_QUEUE,
 )
 from split_learning_tpu.runtime.spans import make_tracer, unpack_ctx
 from split_learning_tpu.runtime.validation import dataset_for_model
@@ -500,7 +500,12 @@ class ProtocolClient:
             interval=(obs.heartbeat_interval if obs is not None else 0),
             faults=self.faults, wire=self.wire, hists=self.hists,
             gauges=self.gauges,
-            samples_fn=lambda: self.num_samples)
+            samples_fn=lambda: self.num_samples, stage=stage)
+        # hierarchical heartbeat roll-up: where heartbeats publish —
+        # a digest queue (START extra.digest named this client's
+        # aggregator node) or None for direct rpc beats; a mid-round
+        # DigestRoute frame re-points it (digest-node death fallback)
+        self._hb_queue: str | None = None
         # compute performance-attribution plane (runtime/perf.py):
         # sampled step timing (device fence only every
         # perf.sample-every steps), compile/retrace accounting on the
@@ -764,10 +769,15 @@ class ProtocolClient:
     def _send_heartbeat(self, snapshot: dict) -> None:
         """Publish one HEARTBEAT (called by the emitter's background
         thread): liveness + the full telemetry snapshot, on the rpc
-        queue like every client->server frame.  Not logged — at one
+        queue — or on this client's assigned digest queue when the
+        server routed its beats through an aggregator node's roll-up
+        (``observability.digest-interval``).  Not logged — at one
         frame per interval per client the [>>>] markers would drown
         the protocol trace."""
-        self.bus.publish(RPC_QUEUE, encode(Heartbeat(
+        # allow-send: the target alternates between the rpc queue and
+        # this client's assigned digest queue — both legal for
+        # (client, Heartbeat) in the model, unresolvable statically
+        self.bus.publish(self._hb_queue or RPC_QUEUE, encode(Heartbeat(  # slcheck: allow-send
             client_id=self.client_id,
             round_idx=getattr(self, "round_idx", 0),
             telemetry=snapshot)))
@@ -838,6 +848,15 @@ class ProtocolClient:
                 self.log.info("[>>>] READY")
             elif isinstance(msg, Syn):
                 self._on_syn(msg)
+            elif isinstance(msg, DigestRoute):
+                # mid-round heartbeat re-route (digest-node death
+                # fallback): adopt the new target and beat once NOW so
+                # the server's liveness view never gaps
+                self._hb_queue = msg.queue
+                try:
+                    self.telemetry.beat_once()
+                except Exception:  # noqa: BLE001 — transport teardown
+                    pass           # races the re-route; next beat covers
             elif isinstance(msg, Stop):
                 self.log.info(f"[<<<] STOP {msg.reason}")
                 # drain the async sender before the process exits: a
@@ -876,6 +895,11 @@ class ProtocolClient:
         # scheduler-granted per-client knob retune (heavier wire codec
         # for a wire-slow straggler; runtime/scheduler.py)
         self._apply_sched_knobs(extra.get("sched"))
+        # hierarchical heartbeat roll-up: beats publish to this digest
+        # queue (an aggregator node folds them into FleetDigest
+        # frames); None = direct rpc heartbeats.  Re-read every START
+        # — the route can move with the node topology.
+        self._hb_queue = extra.get("digest")
         # server-issued per-invocation generation: stamps every message
         # this client sends so the server/peers can drop strays from an
         # invocation that was already abandoned (round_idx alone can't —

@@ -197,11 +197,20 @@ class Logger:
     def __init__(self, log_path: str | pathlib.Path = ".",
                  debug: bool = False, console: bool = True,
                  name: str = "split_learning_tpu",
-                 run_id: str | None = None, run_scoped: bool = False):
+                 run_id: str | None = None, run_scoped: bool = False,
+                 metrics_max_mb: float = 0.0, metrics_keep: int = 4):
         self.debug_mode = debug
         self.console = console
         self.participant = name
         self.run_id = run_id or RUN_ID
+        # metrics.jsonl size-based rotation
+        # (observability.metrics-max-mb): 0 disables; otherwise the
+        # active file rotates to metrics.jsonl.1..keep once it crosses
+        # the cap.  The ACTIVE path never changes, so the run-scoped
+        # compat symlink stays valid across rotations (the rename +
+        # reopen is atomic at the path level: os.replace).
+        self._metrics_max = int(float(metrics_max_mb) * (1 << 20))
+        self._metrics_keep = max(1, int(metrics_keep))
         root = pathlib.Path(log_path)
         root.mkdir(parents=True, exist_ok=True)
         # run-scoped layout: files land under artifacts/runs/<run_id>/
@@ -274,6 +283,32 @@ class Logger:
                 self._metrics_f = open(self._metrics_path, "a")
             self._metrics_f.write(line)
             self._metrics_f.flush()
+            if self._metrics_max and \
+                    self._metrics_f.tell() >= self._metrics_max:
+                self._rotate_metrics_locked()
+
+    def _rotate_metrics_locked(self) -> None:
+        """Shift metrics.jsonl -> .1 -> ... -> .keep (oldest dropped)
+        and reopen the active path.  Readers (``sl_top --journal``,
+        ``sl_perf``, the bench scavengers) glob ``metrics.jsonl*`` and
+        read oldest-first, so a rotated run reads exactly like an
+        unrotated one.  Best-effort: a failed rename must never kill
+        the writer mid-round."""
+        import os
+        try:
+            self._metrics_f.close()
+            p = self._metrics_path
+            oldest = p.with_name(f"{p.name}.{self._metrics_keep}")
+            if oldest.exists():
+                oldest.unlink()
+            for i in range(self._metrics_keep - 1, 0, -1):
+                src = p.with_name(f"{p.name}.{i}")
+                if src.exists():
+                    os.replace(src, p.with_name(f"{p.name}.{i + 1}"))
+            os.replace(p, p.with_name(f"{p.name}.1"))
+        except OSError:
+            pass
+        self._metrics_f = open(self._metrics_path, "a")
 
     @classmethod
     def for_run(cls, cfg, name: str, console: bool = False,
@@ -284,7 +319,11 @@ class Logger:
         obs = getattr(cfg, "observability", None)
         return cls(cfg.log_path, debug=cfg.debug, console=console,
                    name=name, run_id=run_id,
-                   run_scoped=bool(obs is not None and obs.run_scoped))
+                   run_scoped=bool(obs is not None and obs.run_scoped),
+                   metrics_max_mb=getattr(obs, "metrics_max_mb", 0.0)
+                   if obs is not None else 0.0,
+                   metrics_keep=getattr(obs, "metrics_keep", 4)
+                   if obs is not None else 4)
 
     def close(self) -> None:
         self._handler.close()

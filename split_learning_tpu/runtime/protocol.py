@@ -73,6 +73,15 @@ def aggregate_queue(cluster: int, group: int) -> str:
     return f"aggregate_queue_{cluster}_{group}"
 
 
+def digest_queue(node_id: str) -> str:
+    """Heartbeat roll-up queue (``observability.digest-interval``):
+    clients assigned to aggregator node ``node_id`` publish their
+    HEARTBEAT frames here instead of ``rpc_queue``; the node's digest
+    worker folds them into one :class:`FleetDigest` per interval, so
+    the server's rpc ingest is O(nodes), not O(clients)."""
+    return f"digest_queue_{node_id}"
+
+
 # --------------------------------------------------------------------------
 # control messages
 # --------------------------------------------------------------------------
@@ -306,6 +315,39 @@ class AggFlush:
 
 
 @dataclasses.dataclass
+class FleetDigest:
+    """aggregator node → server (rpc queue), every
+    ``observability.digest-interval`` seconds: one merged health
+    summary of the clients whose heartbeats the node consumes from its
+    :func:`digest_queue` — exact per-state counts and counter sums,
+    log-bucket rate/compute-rate quantile sketches, per-stage step
+    stats, the top-K worst stragglers with their last snapshots, and
+    the state transitions since the previous digest
+    (``runtime/sketch.py``).  ``digest['t']``/``digest['seq']`` are
+    the server's staleness guard, same contract as a Heartbeat's: a
+    duplicated or reordered digest is rejected-and-counted
+    (``stale_digests``), never double-folded.  A plain dict — the
+    restricted unpickler's vocabulary stays closed."""
+    node_id: str
+    round_idx: int = 0
+    digest: dict | None = None
+
+
+@dataclasses.dataclass
+class DigestRoute:
+    """server → one client (its reply queue): re-point the client's
+    heartbeat publishes.  ``queue`` names a :func:`digest_queue`
+    (roll up through that aggregator node) or is None (beat directly
+    on the rpc queue — the fallback when the client's digest node
+    died).  The initial route rides START ``extra['digest']`` so the
+    common path costs no extra frame; this message exists for the
+    MID-ROUND fallback, where waiting for the next START would leave
+    the client beating into a dead node's queue."""
+    client_id: str
+    queue: str | None = None
+
+
+@dataclasses.dataclass
 class Heartbeat:
     """client → server, on the rpc queue, from a background thread at
     ``observability.heartbeat-interval``: liveness + a full
@@ -424,7 +466,7 @@ class _TensorRef:
 
 CONTROL_TYPES = (Register, Ready, Notify, Update, Start, Syn, Pause,
                  Stop, Heartbeat, PartialAggregate, AggHello, AggAssign,
-                 AggFlush)
+                 AggFlush, FleetDigest, DigestRoute)
 DATA_TYPES = (Activation, Gradient, EpochEnd)
 #: messages whose ndarray payloads ride the zero-copy TENSOR framing
 #: (the high-volume data plane + the round's weight uploads — Update

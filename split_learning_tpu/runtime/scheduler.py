@@ -235,6 +235,7 @@ class Scheduler:
         self._evicted: set = set()
         self._last_replan_round: int | None = None
         self._last_decide_round: int | None = None
+        self._stage_stats: dict = {}   # telemetry "stages" block
         # first boundary pass that was past warmup: until it has
         # happened, the mid-round barrier policy stays inert — round 0
         # must never drop a client on seconds-old telemetry
@@ -305,7 +306,17 @@ class Scheduler:
         return feats
 
     @staticmethod
-    def _medians(views: dict) -> tuple[float | None, float | None]:
+    def _medians(views: dict, fleet: dict | None = None
+                 ) -> tuple[float | None, float | None]:
+        """Fleet rate / compute-rate medians.  Under the digest
+        roll-up the exact views are a BIASED slice (watchlist = the
+        worst clients), so the medians come from the merged digest's
+        quantile sketches instead — the whole fleet, within one
+        bucket width."""
+        dig = (fleet or {}).get("digest") or {}
+        q = dig.get("quantiles") or {}
+        if q.get("rate_p50") is not None:
+            return q.get("rate_p50"), q.get("crate_p50")
         rates = [v.get("samples_per_s") for v in views.values()
                  if v.get("samples_per_s") and v.get("state") != "lost"]
         crates = [v.get("compute_samples_per_s")
@@ -472,8 +483,13 @@ class Scheduler:
                 self._act_cluster_move(cid, prev.get(cid),
                                        assignment[cid], round_idx)
 
+        # per-stage measured stats (telemetry snapshot "stages":
+        # direct reporters + digest sketches) — what the cut
+        # re-planner uses instead of mirroring stage-1 profiles
+        self._stage_stats = fleet.get("stages") or {}
+
         # (b) straggler policy
-        med, cmed = self._medians(views)
+        med, cmed = self._medians(views, fleet)
         evict: set = set()
         evict_n: dict[str, int] = {}
         if acting:
@@ -624,10 +640,41 @@ class Scheduler:
                 bw = float(p.get("network") or 0.0)
             nets.append(bw)
         n_groups = plan.n_stages
-        # later stages are unprofiled at the server (the reference
-        # keeps only stage-1 size_data); mirror group 1, like the
-        # static planner does
-        return replan_cuts([exe] * n_groups, [nets] * n_groups,
+        # later stages: the profile never covered them (the reference
+        # keeps only stage-1 size_data), but the telemetry plane now
+        # MEASURES them — each stage's clients report compute rate and
+        # step wall on their heartbeats, rolled up per stage in the
+        # fleet snapshot's "stages" block.  Build each group from its
+        # members' measured rates (stage-median fallback for quiet
+        # members); a stage with no measurements at all mirrors
+        # group 1, the pre-digest behavior.
+        exe_groups, net_groups = [exe], [nets]
+        for k in range(2, n_groups + 1):
+            stage_crate = (self._stage_stats.get(str(k)) or {}).get(
+                "compute_samples_per_s_p50")
+            members_k = list(plan.clients[k - 1])
+            if len(members_k) > self.REPLAN_MEMBER_SAMPLE:
+                stride = len(members_k) / self.REPLAN_MEMBER_SAMPLE
+                members_k = [members_k[int(i * stride)]
+                             for i in range(self.REPLAN_MEMBER_SAMPLE)]
+            g_exe, g_nets, measured = [], [], False
+            for c in members_k:
+                v = views.get(c, {})
+                crate = v.get("compute_samples_per_s") or stage_crate
+                if crate:
+                    measured = True
+                g_exe.append(scaled_exe_time(base_exe, crate))
+                bw = implied_bandwidth(cur_cut_bytes,
+                                       v.get("samples_per_s"),
+                                       v.get("compute_samples_per_s"))
+                g_nets.append(bw or 0.0)
+            if measured and g_exe:
+                exe_groups.append(g_exe)
+                net_groups.append(g_nets)
+            else:
+                exe_groups.append(exe)
+                net_groups.append(nets)
+        return replan_cuts(exe_groups, net_groups,
                            size_data, plan.cuts,
                            damping=self.sch.replan_damping)
 
@@ -648,6 +695,14 @@ class Scheduler:
 
     def quorum_exempt(self, cid: str) -> bool:
         return cid in self._exempt
+
+    def attention(self) -> set:
+        """Clients under active scheduler control (knob-carrying,
+        exempted, or on the eviction ladder): what the server pins to
+        the FleetMonitor watchlist under the digest roll-up, so this
+        loop keeps an exact view of everyone it is acting on."""
+        return (set(self._knobs) | self._exempt
+                | set(self._ledger))
 
     def barrier_drop(self, missing: set, states: dict,
                      waited_s: float, round_idx: int) -> set:

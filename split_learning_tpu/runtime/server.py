@@ -30,6 +30,7 @@ import argparse
 import copy
 import dataclasses
 import time
+import zlib
 from typing import Any, Callable
 
 import numpy as np
@@ -46,9 +47,10 @@ from split_learning_tpu.runtime.plan import (
 )
 from split_learning_tpu.runtime import aggregate as agg_plane
 from split_learning_tpu.runtime.protocol import (
-    AggAssign, AggFlush, AggHello, FrameAssembler, Heartbeat, Notify,
-    PartialAggregate, Pause, Ready, Register, Start, Stop, Syn, Update,
-    encode, encode_parts, reply_queue, RPC_QUEUE,
+    AggAssign, AggFlush, AggHello, DigestRoute, FleetDigest,
+    FrameAssembler, Heartbeat, Notify, PartialAggregate, Pause, Ready,
+    Register, Start, Stop, Syn, Update, digest_queue, encode,
+    encode_parts, reply_queue, RPC_QUEUE,
 )
 from split_learning_tpu.runtime.spans import unpack_ctx
 from split_learning_tpu.runtime.telemetry import FleetMonitor, GaugeSet
@@ -116,7 +118,22 @@ class ProtocolContext(MeshContext):
             self.fleet = FleetMonitor(
                 interval=obs.heartbeat_interval,
                 liveness_timeout=obs.liveness_timeout,
-                log=self.log, gauges=self.gauges, faults=self.faults)
+                log=self.log, gauges=self.gauges, faults=self.faults,
+                watchlist_size=obs.watchlist_size)
+        # hierarchical heartbeat roll-up (observability.digest-interval
+        # > 0): clients are routed to an adopted aggregator node's
+        # digest queue via START extra.digest and the node's
+        # FleetDigest frames replace their individual heartbeats at
+        # this pump.  _digest_route maps client -> node; a dead node's
+        # clients are re-pointed to direct heartbeats (DigestRoute
+        # frames) and its queue drained here, counted, so the fallback
+        # can never mint a phantom `lost`.
+        self._digest_interval = (obs.digest_interval
+                                 if obs is not None else 0.0)
+        self._digest_route: dict[str, str] = {}
+        self._digest_dead: set = set()
+        self._dead_drains: dict[str, FrameAssembler] = {}
+        self._digest_check_t = 0.0
         self.client_timeout = client_timeout
         # registration/READY happen before any jit work on the client, so
         # they can run on a much shorter deadline than the training
@@ -241,6 +258,7 @@ class ProtocolContext(MeshContext):
         # can release the streaming fold's reorder window.
         self.scheduler = None
         self._sched_gone: set = set()
+        self._sched_watched: set = set()
         self._stage_of: dict = {}
         sch = getattr(cfg, "scheduler", None)
         if sch is not None and sch.enabled:
@@ -262,6 +280,11 @@ class ProtocolContext(MeshContext):
                 # `lost` states before the drain finishes — see
                 # FleetMonitor.note_pump
                 self.fleet.note_pump()
+            # drained pump = a quiet moment: the right time for the
+            # (throttled) digest-node death check, so a dead node's
+            # clients are re-pointed within _DIGEST_CHECK_S whatever
+            # phase the round is in
+            self._check_digest_nodes()
             return False
         t_wall = time.time()
         t0 = time.perf_counter()
@@ -297,7 +320,28 @@ class ProtocolContext(MeshContext):
             # so a duplicated/reordered beat can't resurrect a lost
             # client or extend its liveness.
             if self.fleet is not None:
-                self.fleet.note_heartbeat(msg.client_id, msg.telemetry)
+                self.fleet.note_heartbeat(
+                    msg.client_id, msg.telemetry,
+                    via=self._digest_route.get(msg.client_id))
+            return True
+        if isinstance(msg, FleetDigest):
+            # one aggregator node's rolled-up heartbeat summary
+            # (observability.digest-interval) — the O(nodes) ingest
+            # that replaces O(clients) individual beats.  note_digest
+            # applies the same (t, seq) staleness guard as heartbeats
+            # (duplicates counted stale_digests), and the frame itself
+            # proves the node's process is alive.  A digest from a
+            # node already failed over is stale BY DEFINITION: its
+            # clients were re-pointed to direct heartbeats, and
+            # re-installing the standing digest (a reordered frame
+            # published before the death) would double-count them
+            # forever — _check_digest_nodes never revisits dead nodes.
+            if self.fleet is not None:
+                if msg.node_id in self._digest_dead:
+                    self.faults.inc("stale_digests")
+                else:
+                    self.fleet.note_frame(msg.node_id)
+                    self.fleet.note_digest(msg.node_id, msg.digest)
             return True
         if self.fleet is not None:
             cid = getattr(msg, "client_id", None)
@@ -308,9 +352,12 @@ class ProtocolContext(MeshContext):
                 # consumed even when the Update itself is stale-gen,
                 # liveness is not round-fenced
                 if isinstance(msg, Update) and msg.telemetry:
-                    self.fleet.note_heartbeat(cid, msg.telemetry)
+                    self.fleet.note_heartbeat(
+                        cid, msg.telemetry,
+                        via=self._digest_route.get(cid))
                 else:
-                    self.fleet.note_frame(cid)
+                    self.fleet.note_frame(
+                        cid, via=self._digest_route.get(cid))
         if isinstance(msg, Register):
             if (self.cfg.topology.elastic_join
                     and not 1 <= msg.stage <= self.cfg.num_stages):
@@ -557,7 +604,9 @@ class ProtocolContext(MeshContext):
             if cid is None:
                 continue
             if self.fleet is not None and m.get("telemetry"):
-                self.fleet.note_heartbeat(cid, m["telemetry"])
+                self.fleet.note_heartbeat(
+                    cid, m["telemetry"],
+                    via=self._digest_route.get(cid))
             # num_samples=0: the group's stage-1 samples already rode
             # the partial's n_samples — a per-member recount would
             # double the round total
@@ -582,6 +631,110 @@ class ProtocolContext(MeshContext):
             return True
         return (self.fleet is not None
                 and self.fleet.state(node_id) == "lost")
+
+    # -- hierarchical heartbeat roll-up (observability.digest-interval) ------
+
+    #: wall-clock cadence of the digest-node death check (cheap: a
+    #: dict walk over the routed nodes, work only on a death)
+    _DIGEST_CHECK_S = 0.5
+
+    def _digest_route_for(self, cid: str) -> str | None:
+        """The digest queue this client's heartbeats should roll up
+        through (START ``extra.digest``), or None for direct rpc
+        beats.  Assignment is a stable hash over the live adopted
+        nodes, so successive STARTs keep a client on the same node
+        (its node-local state machine keeps its history)."""
+        if self._digest_interval <= 0 or self.fleet is None:
+            return None
+        nodes = [n for n in sorted(self._agg_nodes)
+                 if n not in self._digest_dead
+                 and not self._node_dead(n)]
+        if not nodes:
+            return None
+        nid = nodes[zlib.crc32(cid.encode()) % len(nodes)]
+        self._digest_route[cid] = nid
+        # any standing direct entry stops aging at this monitor — the
+        # node's state machine covers the client from here (its next
+        # beats land on the node's queue, not ours)
+        self.fleet.route_via(cid, nid)
+        return digest_queue(nid)
+
+    def _check_digest_nodes(self, now: float | None = None) -> None:
+        """Digest-node death fallback: a routed node whose process
+        exited (or whose own heartbeats went FleetMonitor-``lost``)
+        gets its digest queue drained HERE — the heartbeats parked
+        there are liveness proof, not losses — and each of its clients
+        is re-pointed to direct rpc beats with a counted
+        ``digest_fallbacks``.  The node's standing digest is dropped
+        from the fold (its clients now count via their own beats), so
+        the degradation can neither double-count nor mint a phantom
+        ``lost``."""
+        if not self._digest_route and not self._dead_drains:
+            return
+        now = time.monotonic() if now is None else now
+        if now - self._digest_check_t < self._DIGEST_CHECK_S:
+            return
+        self._digest_check_t = now
+        if self.fleet is not None:
+            # _node_dead reads the monitor's state: advance first so a
+            # silent node's `lost` is current at this check, not the
+            # last barrier's
+            self.fleet.advance()
+        # keep draining dead nodes' queues: a client mid-compile only
+        # reads its reply queue (the DigestRoute) at the next control
+        # point, so its beats keep landing on the dead queue for a
+        # while — every one of them is liveness proof this monitor
+        # must see, or the fallback itself would mint the phantom
+        # `lost` it exists to prevent.  Quiesces to one empty
+        # zero-timeout get per dead node per check.
+        for nid, asm in self._dead_drains.items():
+            self._drain_dead_queue(nid, asm)
+        routed: dict[str, list] = {}
+        for cid, nid in self._digest_route.items():
+            routed.setdefault(nid, []).append(cid)
+        for nid, cids in routed.items():
+            if nid in self._digest_dead or not self._node_dead(nid):
+                continue
+            self._digest_dead.add(nid)
+            asm = self._dead_drains[nid] = FrameAssembler(
+                faults=self.faults)
+            drained = self._drain_dead_queue(nid, asm)
+            if self.fleet is not None:
+                self.fleet.drop_digest(nid)
+            for cid in sorted(cids):
+                self.faults.inc("digest_fallbacks")
+                self._digest_route.pop(cid, None)
+                self.bus.publish(
+                    reply_queue(cid),
+                    encode(DigestRoute(client_id=cid, queue=None)))  # slcheck: wire=DigestRoute
+                if self.fleet is not None:
+                    # the client was beating into the dead node's
+                    # queue, not lying silent — a fresh liveness grace
+                    # covers the re-route gap
+                    self.fleet.note_frame(cid)
+            self.log.warning(
+                f"digest node {nid} is dead; re-pointed {len(cids)} "
+                f"client(s) to direct heartbeats ({drained} queued "
+                "beat(s) recovered)")
+
+    def _drain_dead_queue(self, nid: str, asm: FrameAssembler) -> int:
+        """Fold the heartbeats parked on a dead digest node's queue
+        straight into this monitor (via=None: the senders are falling
+        back to direct reporting)."""
+        drained = 0
+        q = digest_queue(nid)
+        while True:
+            raw = self.bus.get(q, timeout=0.0)
+            if raw is None:
+                return drained
+            try:
+                m = asm.feed(raw)
+            except Exception:  # noqa: BLE001 — one corrupt beat
+                self.faults.inc("corrupt_rejected")
+                continue
+            if isinstance(m, Heartbeat) and self.fleet is not None:
+                self.fleet.note_heartbeat(m.client_id, m.telemetry)
+                drained += 1
 
     def _spawn_l1_threads(self, plan, groups, narrowed: dict) -> None:
         """Thread-mode aggregators (the default): one L1Aggregator
@@ -813,7 +966,9 @@ class ProtocolContext(MeshContext):
         u.params = None
         u.batch_stats = None
         if self.fleet is not None and u.telemetry:
-            self.fleet.note_heartbeat(u.client_id, u.telemetry)
+            self.fleet.note_heartbeat(
+                u.client_id, u.telemetry,
+                via=self._digest_route.get(u.client_id))
         if fb["book_direct"]:
             self._updates.append(u)
         self.log.received(f"UPDATE {u.client_id} (fallback drain)")
@@ -851,7 +1006,9 @@ class ProtocolContext(MeshContext):
             if cid is None:
                 continue
             if self.fleet is not None and mm.get("telemetry"):
-                self.fleet.note_heartbeat(cid, mm["telemetry"])
+                self.fleet.note_heartbeat(
+                    cid, mm["telemetry"],
+                    via=self._digest_route.get(cid))
             if fb["book_direct"]:
                 self._updates.append(Update(
                     client_id=cid, stage=int(mm.get("stage", m.stage)),
@@ -1011,6 +1168,7 @@ class ProtocolContext(MeshContext):
             if (waiting is not None and self.fleet is not None
                     and now - t_checked >= self._WAIT_CHECK_S):
                 t_checked = now
+                self._check_digest_nodes(now)
                 lost = self.fleet.advance()
                 missing = set(waiting())
                 if missing and missing <= lost:
@@ -1183,6 +1341,7 @@ class ProtocolContext(MeshContext):
                 # stop scoring the pruned client (its zero rate would
                 # drag the fleet median down for the survivors)
                 self.fleet.forget(cid)
+            self._digest_route.pop(cid, None)
         self.log.info(f"elastic re-plan: joined={joined} "
                       f"pruned={pruned}", "cyan")
         self._planned_ids = live
@@ -1244,7 +1403,20 @@ class ProtocolContext(MeshContext):
                 self._delta_shadow.clear(cid)
             if self.fleet is not None:
                 self.fleet.forget(cid)
+            self._digest_route.pop(cid, None)
             self._planned_ids.discard(cid)
+        if self.fleet is not None:
+            # scheduler attention pins the watchlist: a knob-carrying
+            # (demoted/exempted) client keeps its exact server-side
+            # view even when it climbs out of the digests' top-K —
+            # the scheduler needs to SEE the recovery to revoke the
+            # knobs.  Pins released on promotion next boundary.
+            watched = self.scheduler.attention()
+            for cid in watched - self._sched_watched:
+                self.fleet.watch(cid)
+            for cid in self._sched_watched - watched:
+                self.fleet.watch(cid, pinned=False)
+            self._sched_watched = watched
         if out.plans is None:
             return None
         old_rng = self._client_ranges(plans)
@@ -1568,6 +1740,11 @@ class ProtocolContext(MeshContext):
                        "sched": (self.scheduler.knobs_for(cid)
                                  if self.scheduler is not None
                                  else None),
+                       # hierarchical heartbeat roll-up: the digest
+                       # queue this client's beats publish to (its
+                       # aggregator node folds them into FleetDigest
+                       # frames), None = direct rpc heartbeats
+                       "digest": self._digest_route_for(cid),
                        "gen": self._cur_gen}),
                 self.cfg.transport.chunk_mb << 20)
             for part in start_parts:
@@ -2083,15 +2260,35 @@ class ProtocolServer:
                     ctx.fleet.advance()
                 return render_prometheus(
                     fleet=ctx.fleet, faults=ctx.faults, wire=ctx.wire,
-                    hists=ctx.hists, gauges=ctx.gauges)
+                    hists=ctx.hists, gauges=ctx.gauges,
+                    max_client_series=obs.max_client_series)
 
-            def _fleet() -> dict:
+            def _fleet(query: dict | None = None) -> dict:
+                query = query or {}
                 if ctx.fleet is None:
                     snap = {"clients": {}, "counts": {},
                             "transitions": []}
                 else:
                     ctx.fleet.advance()
-                    snap = ctx.fleet.snapshot()
+                    # default shape: full detail (series included)
+                    # while the tracked population is small; summary
+                    # (no ring-buffer series) past the series cap.
+                    # ?full=1 forces the old shape, ?page=N /
+                    # ?client=id fetch per-client detail on demand.
+                    full = str(query.get("full", "")) \
+                        in ("1", "true", "yes")
+                    client = query.get("client")
+                    page = None
+                    try:
+                        if query.get("page") is not None:
+                            page = int(query["page"])
+                    except (TypeError, ValueError):
+                        page = None
+                    big = (ctx.fleet.tracked_clients()
+                           > obs.max_client_series)
+                    snap = ctx.fleet.snapshot(
+                        series=full or not big,
+                        page=page, client=client)
                 # aggregator-tree topology (aggregation.fan-in /
                 # levels / remote): which node serves which group, so
                 # straggler attribution can NAME a slow L1 instead of

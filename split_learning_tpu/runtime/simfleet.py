@@ -44,8 +44,8 @@ import time
 import numpy as np
 
 from split_learning_tpu.runtime.protocol import (
-    FrameAssembler, Heartbeat, Notify, Pause, Ready, Register, Start,
-    Stop, Syn, Update, encode, reply_queue, RPC_QUEUE,
+    DigestRoute, FrameAssembler, Heartbeat, Notify, Pause, Ready,
+    Register, Start, Stop, Syn, Update, encode, reply_queue, RPC_QUEUE,
 )
 
 
@@ -131,6 +131,7 @@ class _SimClient:
         self.paused = False          # PAUSE seen, UPDATE owed
         self.send_weights = True
         self.codec_gain = 1.0        # scheduler knob: wire divider
+        self.hb_queue = None         # digest roll-up heartbeat target
         self.seq = 0
         self.total_samples = 0
 
@@ -184,13 +185,21 @@ class SyntheticFleet:
         c.seq += 1
         return {
             "part": c.spec.cid, "t": time.time(), "seq": c.seq,
-            "kind": "client", "round": c.round_idx,
+            "kind": "client", "stage": c.spec.stage,
+            "round": c.round_idx,
             "samples": c.total_samples,
             "samples_per_s": round(rate, 3),
             "gauges": {"samples_per_s": round(rate, 3),
                        "compute_samples_per_s":
                            round(c.spec.compute_speed, 3)},
-            "counters": {}, "wire": {}, "latency": {}, "v": 1,
+            "counters": {}, "wire": {},
+            # honest per-stage step wall: the configured compute time
+            # per sample in ms — what the digest path's per-stage
+            # stats and the cut re-planner consume
+            "latency": {"step_device": {
+                "p95_ms": round(1e3 / max(c.spec.compute_speed,
+                                          1e-9), 4)}},
+            "v": 1,
         }
 
     # -- wire actions --------------------------------------------------------
@@ -202,7 +211,7 @@ class SyntheticFleet:
         c.registered = True
 
     def _beat(self, c: _SimClient) -> None:
-        self.bus.publish(RPC_QUEUE, encode(Heartbeat(
+        self.bus.publish(c.hb_queue or RPC_QUEUE, encode(Heartbeat(
             client_id=c.spec.cid, round_idx=c.round_idx,
             telemetry=self._telemetry(c))))
 
@@ -241,6 +250,7 @@ class SyntheticFleet:
             knobs = extra.get("sched") or {}
             c.codec_gain = (self.codec_gain
                             if knobs.get("codec") else 1.0)
+            c.hb_queue = extra.get("digest")
             self.bus.publish(RPC_QUEUE, encode(Ready(
                 client_id=c.spec.cid, round_idx=c.fence)))
         elif isinstance(msg, Syn):
@@ -255,6 +265,12 @@ class SyntheticFleet:
                 self._send_update(c)
             else:
                 self._at(c.finish_t, "update", c.spec.cid)
+        elif isinstance(msg, DigestRoute):
+            # digest-node death fallback: adopt the new heartbeat
+            # target and beat once immediately (a real client does the
+            # same) so the server's liveness view never gaps
+            c.hb_queue = msg.queue
+            self._beat(c)
         elif isinstance(msg, Stop):
             c.stopped = True
 

@@ -31,8 +31,22 @@ rate and lag measured continuously.  This module is that plane:
   :meth:`FleetMonitor.advance` so a ``lost`` client is dropped after
   ``observability.liveness-timeout`` seconds instead of stalling the
   round until the 600 s RPC deadline;
+* **hierarchical digest roll-up** (``observability.digest-interval``,
+  ``runtime/sketch.py``): aggregator nodes run this same
+  :class:`FleetMonitor` over their routed clients' heartbeats and
+  publish one mergeable ``FleetDigest`` per interval
+  (:meth:`FleetMonitor.build_digest`); the server folds them
+  (:meth:`FleetMonitor.note_digest`, seq-guarded like heartbeats) and
+  keeps exact per-client state only for a bounded **watchlist**
+  (digest top-K / recent transitions / scheduler pins, with
+  promotion/demotion hysteresis) — rpc ingest, monitor state and the
+  decision loop's input all go O(nodes + watchlist) instead of
+  O(clients);
 * :func:`render_prometheus` / :func:`lint_prometheus` — Prometheus
   text-format exposition (and a pure-python format linter for tests);
+  per-client series are bounded by ``observability.max-client-series``
+  (watchlist/worst first) with fleet-level quantile families from the
+  merged digest sketch;
 * :class:`TelemetryExporter` — a tiny stdlib HTTP thread serving
   ``/metrics`` (Prometheus text) and ``/fleet`` (JSON snapshot),
   polled by ``tools/sl_top.py`` for the live terminal view.
@@ -98,6 +112,11 @@ class TelemetrySnapshot:
     t: float                        # sender clock (epoch seconds)
     seq: int                        # per-emitter monotonic sequence
     kind: str = "client"            # participant role: client | agg_node
+    # pipeline stage this participant runs (1-based; None for
+    # non-training roles): what lets the digest path and the
+    # scheduler's cut re-planner aggregate MEASURED step times per
+    # stage instead of mirroring stage-1 profiles
+    stage: int | None = None
     round: int | None = None        # current round index (gauge)
     samples: int = 0                # cumulative samples this round
     samples_per_s: float = 0.0      # EWMA training throughput
@@ -145,12 +164,15 @@ class TelemetryEmitter:
                  interval: float, faults=None, wire=None, hists=None,
                  gauges: GaugeSet | None = None,
                  samples_fn: Callable[[], int] | None = None,
-                 kind: str = "client"):
+                 kind: str = "client", stage: int | None = None):
         self.participant = participant
         # participant role stamped on every snapshot: the FleetMonitor
         # rate-scores only kind="client" reporters (an idle aggregator
         # node's 0 samples/s is its normal state, not a straggler)
         self.kind = kind
+        # pipeline stage (mutable: a re-plan may move this client);
+        # stamped on every snapshot for per-stage fleet aggregation
+        self.stage = stage
         self.interval = float(interval)
         self._send = send
         self._faults = faults
@@ -214,6 +236,7 @@ class TelemetryEmitter:
         rnd = self.gauges.get("round")
         return TelemetrySnapshot(
             part=self.participant, t=now, seq=seq, kind=self.kind,
+            stage=self.stage,
             round=None if rnd is None else int(rnd),
             samples=self._total_samples(),
             samples_per_s=round(rate, 3),
@@ -281,6 +304,12 @@ _STATE_CODE = {s: i for i, s in enumerate(HEALTH_STATES)}
 class _ClientHealth:
     state: str = "healthy"
     kind: str = "client"            # client | agg_node (snapshot.kind)
+    stage: int | None = None        # pipeline stage (snapshot.stage)
+    # digest roll-up: the aggregator node whose FleetDigest sourced
+    # this entry (a WATCHLIST member — its liveness/state machine runs
+    # on that node, the server keeps the exact view), None for clients
+    # heartbeating directly at this monitor
+    via: str | None = None
     first_seen: float = 0.0
     last_seen: float = 0.0          # receiver clock, any FRESH frame
     last_t_send: float = 0.0        # sender clock of last fresh beat
@@ -330,10 +359,18 @@ class FleetMonitor:
     RECOVER_SCORE = 0.75     # rate at/above this x median -> healthy
     STALE_LAG = 2            # version lag at/above this -> straggler
     MAX_TRANSITIONS = 512    # bounded transition journal
+    #: watchlist demotion hysteresis: a digest-sourced client is
+    #: dropped back to sketch space only after this many consecutive
+    #: digests from its node stopped naming it AND it is healthy — a
+    #: client oscillating around the top-K boundary cannot flap in and
+    #: out of exact state
+    WATCH_DEMOTE_MISSES = 3
+    #: per-digest worst-straggler fan-in (K of the top-K heap)
+    DIGEST_TOP_K = 8
 
     def __init__(self, interval: float, liveness_timeout: float,
                  log=None, gauges: GaugeSet | None = None,
-                 faults=None):
+                 faults=None, watchlist_size: int = 64):
         self.interval = max(float(interval), 1e-3)
         self.liveness_timeout = float(liveness_timeout)
         self._log = log
@@ -342,6 +379,20 @@ class FleetMonitor:
         self._lock = threading.RLock()
         self._clients: dict[str, _ClientHealth] = {}
         self._last_pump: float | None = None
+        # hierarchical digest roll-up (runtime/sketch.py): latest
+        # FleetDigest per aggregator node (seq-guarded), the bounded
+        # watchlist's miss counters (promotion/demotion hysteresis)
+        # and the pinned set (scheduler attention — never demoted
+        # while pinned)
+        self.watchlist_size = int(watchlist_size)
+        self._digests: dict[str, dict] = {}
+        self._watch_miss: dict[str, int] = {}
+        self._pinned: set = set()
+        # monotonic transition sequence: stamps every journal record so
+        # build_digest can report exactly the transitions since the
+        # previous digest, duplicate-free across digest intervals
+        self._tx_seq = 0
+        self._digest_mark = 0
         # async staleness as a first-class fleet signal: the server's
         # current global version (note_version at each cut) vs the
         # version each client's last Update was seeded from — the lag
@@ -379,22 +430,38 @@ class FleetMonitor:
         with self._lock:
             self._last_pump = now
 
-    def note_frame(self, cid: str, now: float | None = None) -> None:
+    def note_frame(self, cid: str, now: float | None = None,
+                   via: str | None = None) -> None:
         """Any rpc frame from ``cid`` proves a live process — clients
-        whose config disables heartbeats still register liveness."""
+        whose config disables heartbeats still register liveness.
+        ``via`` (the server's digest routing table) marks the entry
+        digest-covered: a routed client's occasional control frames
+        (READY/NOTIFY) must not start an aging clock here that its
+        heartbeats — which go to the node — can never feed."""
         now = time.time() if now is None else now
         with self._lock:
             h = self._ensure(cid, now)
             h.last_seen = max(h.last_seen, now)
+            if via is not None:
+                h.via = via
+                return   # state machine runs on the digest node
             if h.state == "lost":
                 self._transition(cid, h, "degraded", "contact resumed",
                                  now)
 
     def note_heartbeat(self, cid: str, telemetry: dict | None,
-                       now: float | None = None) -> bool:
+                       now: float | None = None,
+                       via: str | None = None) -> bool:
         """Fold one heartbeat/piggybacked snapshot; False when it was
         stale (duplicate/reordered) and therefore ignored — a stale
-        beat must neither refresh liveness nor flap the state."""
+        beat must neither refresh liveness nor flap the state.
+
+        ``via`` names the digest node whose roll-up covers this
+        client (the server passes its routing table): the fresh data
+        folds, but the entry stays digest-covered — its liveness
+        clock keeps running on the node, not here, so a routed client
+        whose direct frames are merely occasional (round-end Update
+        piggybacks) can never age into a phantom ``lost``."""
         now = time.time() if now is None else now
         snap = TelemetrySnapshot.from_dict(telemetry or {})
         with self._lock:
@@ -418,6 +485,14 @@ class FleetMonitor:
             h.last_t_send = snap.t
             h.last_seen = max(h.last_seen, now)
             h.kind = snap.kind or "client"
+            if snap.stage is not None:
+                h.stage = int(snap.stage)
+            # an unrouted direct heartbeat outranks the digest view:
+            # the client is talking to THIS monitor again (digest-node
+            # fallback), so its liveness clock runs here from now on.
+            # A routed client's occasional direct frame (Update
+            # piggyback) keeps its digest coverage instead.
+            h.via = via
             h.rate = float(snap.samples_per_s)
             h.round = snap.round
             h.samples = int(snap.samples)
@@ -465,6 +540,278 @@ class FleetMonitor:
         scored (and stops dragging the fleet median down)."""
         with self._lock:
             self._clients.pop(cid, None)
+            self._watch_miss.pop(cid, None)
+            self._pinned.discard(cid)
+
+    # -- hierarchical digest roll-up (runtime/sketch.py) ---------------------
+
+    def route_via(self, cid: str, node_id: str | None) -> None:
+        """The server routed this client's heartbeats to a digest
+        node: any standing exact entry stops aging here (the node's
+        state machine covers it from now on).  No-op without an entry
+        — the digest alone will carry the client."""
+        if node_id is None:
+            return
+        with self._lock:
+            h = self._clients.get(cid)
+            if h is not None:
+                h.via = node_id
+
+    def watch(self, cid: str, pinned: bool = True) -> None:
+        """Pin a client to the watchlist (scheduler attention: a
+        demoted/knob-carrying client must keep its exact view even
+        when it climbs out of the digests' top-K).  ``pinned=False``
+        releases the pin; the normal demotion hysteresis then
+        applies."""
+        with self._lock:
+            if pinned:
+                self._pinned.add(cid)
+                self._watch_miss.pop(cid, None)
+            else:
+                self._pinned.discard(cid)
+
+    def note_digest(self, node_id: str, digest: dict | None,
+                    now: float | None = None) -> bool:
+        """Fold one aggregator node's FleetDigest: False when it was
+        stale (duplicate/reordered — same lexicographic (t, seq) guard
+        as heartbeats) or undecodable.  Fresh digests replace the
+        node's standing summary wholesale (each digest is a full
+        restatement, not an increment — redelivery can never
+        double-count), append the node's state transitions to the
+        shared journal, and run the watchlist promotion/demotion
+        hysteresis over the digest's top-K."""
+        from split_learning_tpu.runtime import sketch
+        now = time.time() if now is None else now
+        d = sketch.decode_digest(digest)
+        with self._lock:
+            if d is None:
+                if self._faults is not None:
+                    self._faults.inc("stale_digests")
+                return False
+            last = self._digests.get(node_id)
+            if last is not None and (d["t"], d["seq"]) \
+                    <= (last["t"], last["seq"]):
+                if self._faults is not None:
+                    self._faults.inc("stale_digests")
+                return False
+            self._digests[node_id] = d
+            for rec in d.get("transitions") or []:
+                if isinstance(rec, dict) and rec.get("client"):
+                    self.transitions.append(
+                        {**rec, "via": node_id})
+            # -- watchlist maintenance ---------------------------------------
+            mentioned: set = set()
+            for w in d.get("worst") or []:
+                cid = w.get("client")
+                if not cid:
+                    continue
+                mentioned.add(cid)
+                self._promote_from_view(cid, w, node_id, now)
+            for rec in d.get("transitions") or []:
+                cid = rec.get("client")
+                if cid:
+                    # a transition names the client but carries no
+                    # view; promotion happens on its next top-K
+                    # mention — resetting the miss counter here keeps
+                    # a transitioning client from demoting mid-event
+                    mentioned.add(cid)
+            for cid, h in list(self._clients.items()):
+                if h.via != node_id:
+                    continue
+                if cid in mentioned:
+                    self._watch_miss.pop(cid, None)
+                    continue
+                miss = self._watch_miss[cid] = \
+                    self._watch_miss.get(cid, 0) + 1
+                # demotion hysteresis, WATCH_DEMOTE_MISSES consecutive
+                # unmentioned digests.  build_digest ranks EVERY
+                # client into its worst heap, so a still-straggler/
+                # lost client keeps being mentioned — sustained
+                # absence means the client now ranks healthier than
+                # the node's top-K, and keeping the STALE severe copy
+                # would freeze a recovered client in straggler/lost
+                # (the scheduler would act on fiction, and the cap
+                # would preferentially retain exactly these).  Pinned
+                # entries stay (scheduler attention needs to SEE the
+                # recovery) but their state resets to healthy.
+                if miss >= self.WATCH_DEMOTE_MISSES:
+                    if cid in self._pinned:
+                        h.state = "healthy"
+                        h.score = None
+                        self._watch_miss.pop(cid, None)
+                    else:
+                        del self._clients[cid]
+                        self._watch_miss.pop(cid, None)
+            self._enforce_watchlist_cap()
+            self._set_digest_gauges()
+            return True
+
+    def _promote_from_view(self, cid: str, entry: dict, node_id: str,
+                           now: float) -> None:
+        """Seed/refresh a watchlist entry from a digest's top-K view
+        (the node runs the state machine; this is the server's exact
+        copy)."""
+        view = entry.get("view") or {}
+        h = self._ensure(cid, now)
+        h.via = node_id
+        h.state = entry.get("state", h.state)
+        if entry.get("score") is not None:
+            h.score = entry["score"]
+        h.kind = view.get("kind", h.kind) or "client"
+        if view.get("stage") is not None:
+            h.stage = int(view["stage"])
+        if view.get("samples_per_s") is not None:
+            h.rate = float(view["samples_per_s"])
+        if view.get("samples") is not None:
+            h.samples = int(view["samples"])
+        if view.get("round") is not None:
+            h.round = view["round"]
+        if view.get("counters"):
+            h.counters = dict(view["counters"])
+        if view.get("gauges"):
+            h.gauges = dict(view["gauges"])
+        if view.get("latency"):
+            h.latency = dict(view["latency"])
+        if view.get("age_s") is not None:
+            h.last_seen = max(h.last_seen, now - float(view["age_s"]))
+        else:
+            h.last_seen = max(h.last_seen, now)
+        self._watch_miss.pop(cid, None)
+
+    def _enforce_watchlist_cap(self) -> None:
+        """Hard bound: the least-severe unpinned digest-sourced
+        entries are dropped first (deterministic: severity, then id).
+        Pinned entries never count against others — they ARE the
+        scheduler's attention set."""
+        from split_learning_tpu.runtime import sketch
+        watch = [(cid, h) for cid, h in self._clients.items()
+                 if h.via is not None and cid not in self._pinned]
+        over = len(watch) - max(0, self.watchlist_size)
+        if over <= 0:
+            return
+        watch.sort(key=lambda kv: sketch._worst_key(
+            {"client": kv[0], "state": kv[1].state,
+             "score": kv[1].score}))
+        for cid, _ in watch[len(watch) - over:]:
+            del self._clients[cid]
+            self._watch_miss.pop(cid, None)
+
+    def _set_digest_gauges(self) -> None:
+        self.gauges.set("fleet_digest_nodes", len(self._digests))
+        self.gauges.set("fleet_digest_clients",
+                        sum(int(d.get("clients", 0))
+                            for d in self._digests.values()))
+        self.gauges.set("fleet_watchlist",
+                        sum(1 for h in self._clients.values()
+                            if h.via is not None))
+
+    def drop_digest(self, node_id: str,
+                    now: float | None = None) -> None:
+        """Digest-node fallback (server side): forget the node's
+        standing digest and convert its watchlist views to DIRECT
+        entries with a fresh liveness grace — their heartbeats were
+        parked on the dead node's queue, not missing, and they are
+        about to resume beating here."""
+        now = time.time() if now is None else now
+        with self._lock:
+            self._digests.pop(node_id, None)
+            for cid, h in self._clients.items():
+                if h.via == node_id:
+                    h.via = None
+                    h.last_seen = max(h.last_seen, now)
+                    self._watch_miss.pop(cid, None)
+            self._set_digest_gauges()
+
+    def digest_totals(self) -> dict | None:
+        """The merged cross-node digest (None when no node reported
+        yet): exact state counts / counter sums / samples over every
+        digest-covered client, sketch-merged quantiles, re-ranked
+        worst-K."""
+        from split_learning_tpu.runtime import sketch
+        with self._lock:
+            if not self._digests:
+                return None
+            return sketch.merge_digests(
+                [self._digests[n] for n in sorted(self._digests)],
+                k=self.DIGEST_TOP_K)
+
+    def build_digest(self, node_id: str, seq: int,
+                     now: float | None = None,
+                     k: int | None = None) -> dict:
+        """One digest of THIS monitor's clients — the node side of the
+        roll-up (``runtime/aggnode.py DigestWorker``).  Callers should
+        :meth:`advance` first so states are current.  Transitions are
+        reported exactly once across successive digests (the ``i``
+        cursor); per-client views ride only the top-K entries."""
+        from split_learning_tpu.runtime import sketch
+        now = time.time() if now is None else now
+        k = self.DIGEST_TOP_K if k is None else int(k)
+        with self._lock:
+            d = sketch.empty_digest()
+            d.update({"node": node_id, "t": round(now, 3),
+                      "seq": int(seq)})
+            rate, crate = sketch.ValueSketch(), sketch.ValueSketch()
+            worst = sketch.WorstK(k)
+            states: dict[str, int] = {}
+            counters: dict[str, int] = {}
+            stages: dict[str, dict] = {}
+            samples = 0
+            for cid, h in self._clients.items():
+                if h.kind != "client":
+                    continue   # nodes never digest other nodes
+                d["clients"] += 1
+                states[h.state] = states.get(h.state, 0) + 1
+                samples += int(h.samples)
+                for name, v in h.counters.items():
+                    if isinstance(v, (int, float)):
+                        counters[name] = counters.get(name, 0) + int(v)
+                rate.observe(h.rate)
+                cr = h.gauges.get("compute_samples_per_s")
+                crate.observe(cr)
+                step = (h.latency.get("step_device")
+                        or h.latency.get("step") or {})
+                if h.stage is not None:
+                    ent = stages.setdefault(str(h.stage), {
+                        "n": 0, "crate": sketch.ValueSketch(),
+                        "step_ms": sketch.ValueSketch()})
+                    ent["n"] += 1
+                    ent["crate"].observe(cr)
+                    ent["step_ms"].observe(step.get("p95_ms"))
+                worst.add(cid, h.state, h.score,
+                          view=self._digest_view(h, now))
+            d["states"] = states
+            d["counters"] = counters
+            d["samples"] = samples
+            d["rate"] = rate.as_dict()
+            d["crate"] = crate.as_dict()
+            d["stages"] = {
+                st: {"n": e["n"], "crate": e["crate"].as_dict(),
+                     "step_ms": e["step_ms"].as_dict()}
+                for st, e in sorted(stages.items())}
+            d["worst"] = worst.top()
+            d["transitions"] = [
+                t for t in self.transitions
+                if t.get("i", 0) > self._digest_mark]
+            if d["transitions"]:
+                self._digest_mark = max(t.get("i", 0)
+                                        for t in d["transitions"])
+            return d
+
+    @staticmethod
+    def _digest_view(h: _ClientHealth, now: float) -> dict:
+        """The compact per-client view riding a digest's top-K entry —
+        what the server needs to seed a watchlist state machine."""
+        step = (h.latency.get("step_device")
+                or h.latency.get("step") or {})
+        return {
+            "kind": h.kind, "stage": h.stage,
+            "samples_per_s": h.rate, "samples": h.samples,
+            "round": h.round, "age_s": round(max(0.0, now
+                                                 - h.last_seen), 3),
+            "counters": dict(h.counters),
+            "gauges": dict(h.gauges),
+            "latency": ({"step_device": dict(step)} if step else {}),
+        }
 
     # -- state machine -------------------------------------------------------
 
@@ -472,8 +819,9 @@ class FleetMonitor:
                     why: str, now: float) -> None:
         if h.state == to:
             return
+        self._tx_seq += 1
         rec = {"t": round(now, 3), "client": cid, "from": h.state,
-               "to": to, "why": why}
+               "to": to, "why": why, "i": self._tx_seq}
         h.state = to
         self.transitions.append(rec)
         if to == "lost" and self.on_lost is not None:
@@ -529,8 +877,40 @@ class FleetMonitor:
                       if h.gauges.get("compute_samples_per_s")
                       and h.state != "lost"]
             cmed = statistics.median(crates) if crates else None
+            if self._digests:
+                # digest mode: the exact population here is the
+                # watchlist + direct reporters — a biased slice (the
+                # worst clients).  The fleet median must come from the
+                # WHOLE fleet's sketches, or every watchlist member
+                # would score against its own cohort.
+                from split_learning_tpu.runtime import sketch
+                rsk, csk = sketch.ValueSketch(), sketch.ValueSketch()
+                for d in self._digests.values():
+                    rsk.merge(d.get("rate"))
+                    csk.merge(d.get("crate"))
+                for h in self._clients.values():
+                    if h.via is None and h.kind == "client" \
+                            and h.state != "lost":
+                        rsk.observe(h.rate)
+                        cr = h.gauges.get("compute_samples_per_s")
+                        csk.observe(cr)
+                med = rsk.quantile(50) or med
+                cmed = csk.quantile(50) or cmed
             lost = set()
             for cid, h in self._clients.items():
+                if h.via is not None:
+                    # watchlist entry: its liveness clock and state
+                    # machine run on the digest node — aging it here
+                    # against a clock nobody feeds would mint phantom
+                    # `lost` states.  Its score still updates (the
+                    # fleet median moved), and a node-reported `lost`
+                    # joins the droppable set.
+                    h.score = (round(h.rate / med, 4)
+                               if med and h.rate is not None
+                               and h.kind == "client" else h.score)
+                    if h.state == "lost":
+                        lost.add(cid)
+                    continue
                 age = now - h.last_seen
                 h.score = (round(h.rate / med, 4)
                            if med and h.rate is not None
@@ -580,15 +960,27 @@ class FleetMonitor:
                                          now)
                 if h.state == "lost":
                     lost.add(cid)
-            counts = collections.Counter(
-                h.state for h in self._clients.values())
-            self.gauges.set("fleet_size", len(self._clients))
+            counts = self._counts_locked()
+            self.gauges.set("fleet_size", sum(counts.values()))
             self.gauges.set("fleet_healthy", counts.get("healthy", 0))
             self.gauges.set("fleet_degraded", counts.get("degraded", 0))
             self.gauges.set("fleet_straggler",
                             counts.get("straggler", 0))
             self.gauges.set("fleet_lost", counts.get("lost", 0))
             return frozenset(lost)
+
+    def _counts_locked(self) -> collections.Counter:
+        """Per-state fleet counts, EXACT under the digest roll-up: the
+        digests' per-state counts (each node's exact state machine
+        over its clients) plus the direct reporters.  Watchlist
+        entries are VIEWS of digest-covered clients — counting them
+        here would double-count against their node's digest."""
+        counts = collections.Counter(
+            h.state for h in self._clients.values() if h.via is None)
+        for d in self._digests.values():
+            for s, n in (d.get("states") or {}).items():
+                counts[s] += int(n)
+        return counts
 
     # -- views ---------------------------------------------------------------
 
@@ -606,47 +998,124 @@ class FleetMonitor:
         with self._lock:
             return {c: h.state for c, h in self._clients.items()}
 
-    def snapshot(self, now: float | None = None) -> dict:
+    def tracked_clients(self) -> int:
+        """Exact per-client entries held (direct + watchlist) — the
+        count the exporter compares against max-client-series to pick
+        the /fleet default shape."""
+        with self._lock:
+            return len(self._clients)
+
+    def _view_of(self, cid: str, h: _ClientHealth, now: float,
+                 series: bool) -> dict:
+        rtt = (h.latency.get("frame_rtt") or {})
+        step = (h.latency.get("step_device")
+                or h.latency.get("step") or {})
+        out = {
+            "state": h.state,
+            "kind": h.kind,
+            "stage": h.stage,
+            # the digest node whose roll-up sourced this entry
+            # (watchlist member), None for direct reporters
+            "via": h.via,
+            "age_s": round(max(0.0, now - h.last_seen), 3),
+            "round": h.round,
+            "samples": h.samples,
+            "samples_per_s": h.rate,
+            "straggler_score": h.score,
+            # async staleness signal: versions behind the
+            # server's current cut (None outside async / before
+            # the first Update)
+            "version_lag": self._lag(h),
+            "rtt_p95_ms": rtt.get("p95_ms"),
+            "wire_bytes_out": h.wire.get("bytes_out_total"),
+            # perf-plane gauges (runtime/perf.py), ridden in on
+            # heartbeats; absent for clients predating the
+            # plane — consumers render "-"
+            "mfu": h.gauges.get("mfu"),
+            "step_p95_ms": step.get("p95_ms"),
+            "compute_samples_per_s":
+                h.gauges.get("compute_samples_per_s"),
+            "hbm_peak_bytes": h.gauges.get("hbm_peak_bytes"),
+            "counters": dict(h.counters),
+        }
+        if series:
+            out["series"] = [list(x) for x in h.series][-32:]
+        return out
+
+    def _stages_locked(self, totals: dict | None) -> dict:
+        """Per-stage measured stats (the kind=perf plane rolled up
+        fleet-wide): client count, compute-rate and step-wall p50/p95
+        from the direct reporters' latest snapshots merged with the
+        digests' per-stage sketches — what the scheduler's cut
+        re-planner reads instead of mirroring stage-1 profiles."""
+        from split_learning_tpu.runtime import sketch
+        stages: dict[str, dict] = {}
+        for h in self._clients.values():
+            if h.kind != "client" or h.stage is None \
+                    or h.via is not None:
+                continue
+            ent = stages.setdefault(str(h.stage), {
+                "n": 0, "crate": sketch.ValueSketch(),
+                "step_ms": sketch.ValueSketch()})
+            ent["n"] += 1
+            ent["crate"].observe(h.gauges.get("compute_samples_per_s"))
+            step = (h.latency.get("step_device")
+                    or h.latency.get("step") or {})
+            ent["step_ms"].observe(step.get("p95_ms"))
+        for st, sd in ((totals or {}).get("stages") or {}).items():
+            ent = stages.setdefault(str(st), {
+                "n": 0, "crate": sketch.ValueSketch(),
+                "step_ms": sketch.ValueSketch()})
+            ent["n"] += int(sd.get("n", 0))
+            ent["crate"].merge(sd.get("crate"))
+            ent["step_ms"].merge(sd.get("step_ms"))
+        out = {}
+        for st, ent in sorted(stages.items(), key=lambda kv: kv[0]):
+            crate, step_ms = ent["crate"], ent["step_ms"]
+            out[st] = {
+                "n": ent["n"],
+                "compute_samples_per_s_p50": crate.quantile(50),
+                "compute_samples_per_s_p95": crate.quantile(95),
+                "step_p95_ms_p50": step_ms.quantile(50),
+                "step_p95_ms_p95": step_ms.quantile(95),
+            }
+        return out
+
+    def snapshot(self, now: float | None = None, *,
+                 series: bool = True, page: int | None = None,
+                 per_page: int = 256,
+                 client: str | None = None) -> dict:
         """The ``/fleet`` JSON view (also the ``kind=fleet`` metrics
         record): per-client state/rate/score/age + the latest
         counter/wire snapshots each heartbeat flushed (so a client
         that crashes mid-round loses at most one interval of
-        counters), recent transitions, and state counts."""
+        counters), recent transitions, and state counts.
+
+        Under the digest roll-up the per-client block holds only the
+        EXACT population (direct reporters + the bounded watchlist);
+        everyone else is summarized in the ``digest`` block (exact
+        counts/counter sums, quantile sketches, per-node summary).
+        ``series=False`` drops the ring-buffer series (the summary
+        shape); ``page`` (0-based, ``per_page`` ids per page) pages
+        the per-client block; ``client`` restricts it to one id."""
+        from split_learning_tpu.runtime import sketch
         now = time.time() if now is None else now
         with self._lock:
-            clients = {}
-            for cid, h in sorted(self._clients.items()):
-                rtt = (h.latency.get("frame_rtt") or {})
-                step = (h.latency.get("step_device")
-                        or h.latency.get("step") or {})
-                clients[cid] = {
-                    "state": h.state,
-                    "kind": h.kind,
-                    "age_s": round(max(0.0, now - h.last_seen), 3),
-                    "round": h.round,
-                    "samples": h.samples,
-                    "samples_per_s": h.rate,
-                    "straggler_score": h.score,
-                    # async staleness signal: versions behind the
-                    # server's current cut (None outside async / before
-                    # the first Update)
-                    "version_lag": self._lag(h),
-                    "rtt_p95_ms": rtt.get("p95_ms"),
-                    "wire_bytes_out": h.wire.get("bytes_out_total"),
-                    # perf-plane gauges (runtime/perf.py), ridden in on
-                    # heartbeats; absent for clients predating the
-                    # plane — consumers render "-"
-                    "mfu": h.gauges.get("mfu"),
-                    "step_p95_ms": step.get("p95_ms"),
-                    "compute_samples_per_s":
-                        h.gauges.get("compute_samples_per_s"),
-                    "hbm_peak_bytes": h.gauges.get("hbm_peak_bytes"),
-                    "counters": dict(h.counters),
-                    "series": [list(x) for x in h.series][-32:],
-                }
-            counts = collections.Counter(
-                h.state for h in self._clients.values())
-            return {
+            ids = sorted(self._clients)
+            total_ids = len(ids)
+            if client is not None:
+                ids = [c for c in ids if c == client]
+            elif page is not None:
+                per_page = max(1, int(per_page))
+                ids = ids[page * per_page:(page + 1) * per_page]
+            clients = {cid: self._view_of(cid, self._clients[cid],
+                                          now, series)
+                       for cid in ids}
+            counts = self._counts_locked()
+            totals = (sketch.merge_digests(
+                [self._digests[n] for n in sorted(self._digests)],
+                k=self.DIGEST_TOP_K) if self._digests else None)
+            out = {
                 "t": round(now, 3),
                 "heartbeat_interval_s": self.interval,
                 "liveness_timeout_s": self.liveness_timeout,
@@ -654,6 +1123,36 @@ class FleetMonitor:
                 "clients": clients,
                 "transitions": list(self.transitions)[-64:],
             }
+            stages = self._stages_locked(totals)
+            if stages:
+                out["stages"] = stages
+            if page is not None or client is not None:
+                out["paging"] = {
+                    "page": page, "per_page": per_page,
+                    "tracked_clients": total_ids,
+                    "pages": -(-total_ids // max(1, per_page))}
+            if totals is not None:
+                # worst entries carry full views on the wire (watchlist
+                # seeding); the JSON summary only needs the ranking
+                out["digest"] = {
+                    "nodes": {
+                        nid: {"t": d.get("t"), "seq": d.get("seq"),
+                              "clients": d.get("clients"),
+                              "states": d.get("states")}
+                        for nid, d in sorted(self._digests.items())},
+                    "clients": totals.get("clients", 0),
+                    "states": totals.get("states"),
+                    "counters": totals.get("counters"),
+                    "samples": totals.get("samples"),
+                    "quantiles": sketch.digest_quantiles(totals),
+                    "worst": [{k: w.get(k) for k in
+                               ("client", "state", "score")}
+                              for w in totals.get("worst") or []],
+                }
+                out["watchlist"] = sorted(
+                    cid for cid, h in self._clients.items()
+                    if h.via is not None)
+            return out
 
 
 # --------------------------------------------------------------------------
@@ -693,12 +1192,35 @@ def _sample(name: str, labels: dict, value: Any) -> str:
     return f"{name} {value}"
 
 
+def _series_order_key(item: tuple) -> tuple:
+    """Cap ordering for per-client /metrics series, worst first:
+    watchlist members (digest-sourced exact views) before direct
+    reporters, then state severity, then straggler score, then id —
+    so a bounded scrape always shows the clients that need looking
+    at."""
+    cid, c = item
+    score = c.get("straggler_score")
+    return (0 if c.get("via") else 1,
+            -_STATE_CODE.get(c.get("state", "healthy"), 0),
+            score if score is not None else math.inf,
+            cid)
+
+
 def render_prometheus(fleet: FleetMonitor | None = None, faults=None,
                       wire=None, hists=None,
-                      gauges: GaugeSet | None = None) -> str:
+                      gauges: GaugeSet | None = None,
+                      max_client_series: int | None = None) -> str:
     """One ``/metrics`` page: process counters/gauges/latency digests
     plus the per-client fleet view.  Pure string building — safe to
-    call from the exporter's HTTP threads mid-round."""
+    call from the exporter's HTTP threads mid-round.
+
+    ``max_client_series`` bounds the per-client ``sl_client_*``
+    cardinality (``observability.max-client-series``): when the exact
+    population exceeds it, the watchlist/worst clients render first
+    (:func:`_series_order_key`) and the rest are summarized by the
+    fleet-level families (``sl_fleet_clients``,
+    ``sl_fleet_rate_quantile``) — a 100k-client scrape stays the size
+    of a 256-client one."""
     out: list[str] = []
 
     def family(name: str, kind: str, help_: str, samples: list):
@@ -766,14 +1288,57 @@ def render_prometheus(fleet: FleetMonitor | None = None, faults=None,
         family("sl_latency_observations_total", "counter",
                "Observations per latency histogram.", n_samples)
     if fleet is not None:
-        snap = fleet.snapshot()
+        snap = fleet.snapshot(series=False)
         by_state = [_sample("sl_fleet_clients", {"state": s}, n)
                     for s, n in sorted(snap["counts"].items())]
         family("sl_fleet_clients", "gauge",
-               "Clients per health state.", by_state)
+               "Clients per health state (exact under the digest "
+               "roll-up).", by_state)
+        dig = snap.get("digest")
+        if dig:
+            family("sl_fleet_digest_nodes", "gauge",
+                   "Aggregator nodes reporting FleetDigest roll-ups.",
+                   [_sample("sl_fleet_digest_nodes", {},
+                            len(dig.get("nodes") or {}))])
+            family("sl_fleet_digest_clients", "gauge",
+                   "Clients covered by digest roll-ups (exact state "
+                   "lives on their aggregator node).",
+                   [_sample("sl_fleet_digest_clients", {},
+                            dig.get("clients", 0))])
+            q_samples = []
+            for key, v in sorted((dig.get("quantiles")
+                                  or {}).items()):
+                field, _, q = key.rpartition("_p")
+                name = ("sl_fleet_rate_quantile"
+                        if field == "rate"
+                        else "sl_fleet_compute_rate_quantile")
+                if _finite(v):
+                    q_samples.append((name,
+                                      _sample(name,
+                                              {"quantile":
+                                               f"0.{q}"}, v)))
+            for name, help_ in (
+                    ("sl_fleet_rate_quantile",
+                     "Fleet-wide samples/s quantiles from the merged "
+                     "digest sketch (error <= one 2^0.25 bucket)."),
+                    ("sl_fleet_compute_rate_quantile",
+                     "Fleet-wide device-rate quantiles from the "
+                     "merged digest sketch.")):
+                family(name, "gauge", help_,
+                       [s for n, s in q_samples if n == name])
+        items = sorted(snap["clients"].items())
+        if max_client_series is not None \
+                and len(items) > max_client_series:
+            capped = sorted(items, key=_series_order_key)
+            items = sorted(capped[:max_client_series])
+        family("sl_fleet_client_series", "gauge",
+               "Per-client series rendered below (bounded by "
+               "observability.max-client-series; the rest live in "
+               "the fleet-level families).",
+               [_sample("sl_fleet_client_series", {}, len(items))])
         up, code, rate, score, age = [], [], [], [], []
         mfu, crate, vlag = [], [], []
-        for cid, c in sorted(snap["clients"].items()):
+        for cid, c in items:
             lbl = {"client": cid}
             up.append(_sample("sl_client_up", lbl,
                               0 if c["state"] == "lost" else 1))
@@ -942,11 +1507,19 @@ class TelemetryExporter:
         class _Handler(http.server.BaseHTTPRequestHandler):
             def do_GET(self):  # noqa: N802 — stdlib API
                 try:
-                    if self.path.split("?")[0] == "/metrics":
+                    path, _, query = self.path.partition("?")
+                    if path == "/metrics":
                         body = exporter._metrics_fn().encode()
                         ctype = "text/plain; version=0.0.4"
-                    elif self.path.split("?")[0] == "/fleet":
-                        body = json.dumps(exporter._fleet_fn()).encode()
+                    elif path == "/fleet":
+                        if exporter._fleet_wants_query:
+                            import urllib.parse
+                            q = {k: v[-1] for k, v in
+                                 urllib.parse.parse_qs(query).items()}
+                            snap = exporter._fleet_fn(q)
+                        else:
+                            snap = exporter._fleet_fn()
+                        body = json.dumps(snap).encode()
                         ctype = "application/json"
                     else:
                         self.send_error(404)
@@ -989,6 +1562,15 @@ class TelemetryExporter:
 
         self._metrics_fn = metrics_fn
         self._fleet_fn = fleet_fn
+        # a fleet_fn taking a parameter receives the query-string dict
+        # (?full=1 / ?page=N / ?client=id — the summary-mode knobs);
+        # zero-arg callables (tests, old callers) keep working
+        import inspect
+        try:
+            self._fleet_wants_query = bool(
+                inspect.signature(fleet_fn).parameters)
+        except (TypeError, ValueError):
+            self._fleet_wants_query = False
         self._profile_fn = profile_fn
         self._httpd = http.server.ThreadingHTTPServer((host, port),
                                                       _Handler)

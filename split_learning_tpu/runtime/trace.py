@@ -60,6 +60,12 @@ FAULT_COUNTER_NAMES = frozenset({
     # rejected as stale, and barrier waits cut short because every
     # missing client was health-state `lost`
     "heartbeat_errors", "stale_heartbeats", "fleet_lost_drops",
+    # hierarchical digest roll-up (runtime/sketch.py +
+    # observability.digest-interval): duplicate/reordered FleetDigest
+    # frames the server's seq guard rejected, and clients re-pointed
+    # to direct heartbeats because their digest node died (one inc per
+    # re-pointed client — the chaos cell's exact fallback count)
+    "stale_digests", "digest_fallbacks",
     # wire codecs (runtime/codec/): non-finite payloads crossing the
     # quantizer, top-k leaves too small to sparsify, and the delta
     # codec's fold/full-frame/version-gap outcomes
@@ -156,6 +162,11 @@ GAUGE_NAMES = frozenset({
     # the 10k-client bench key pins flat), and the live online-cluster
     # count
     "sched_decision_ms", "sched_clusters",
+    # hierarchical digest roll-up (runtime/sketch.py DIGEST_GAUGE_NAMES
+    # — CT004 holds that registry to this one): nodes currently
+    # reporting digests, clients covered by those digests, and the
+    # server watchlist's size (the bounded exact-state population)
+    "fleet_digest_nodes", "fleet_digest_clients", "fleet_watchlist",
 })
 
 

@@ -1,0 +1,867 @@
+"""Hierarchical fleet telemetry (runtime/sketch.py + the FleetMonitor
+digest fold + runtime/aggnode.py DigestWorker + bounded exporters).
+
+Covers: sketch merge order/duplicate invariance with the quantile
+error bound, digest-vs-flat-oracle exactness (states, counter sums,
+samples) under shuffled/duplicated digest delivery, watchlist
+promotion/demotion hysteresis (no flapping, pinning, the hard cap),
+digest-route liveness semantics (no phantom `lost` for routed
+clients; node-death fallback restores direct aging), the capped
+/metrics render at the cardinality boundary, /fleet summary/paging
+query params, metrics.jsonl rotation + its readers, the CT004
+registry rule, the protocol-model extensions, and the scheduler's
+digest-median / per-stage-measured-replan consumption.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import pathlib
+import random
+import statistics
+import sys
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from split_learning_tpu.runtime import sketch
+from split_learning_tpu.runtime.sketch import (
+    ValueSketch, WorstK, merge_digests,
+)
+from split_learning_tpu.runtime.telemetry import (
+    FleetMonitor, GaugeSet, TelemetryEmitter, TelemetryExporter,
+    TelemetrySnapshot, lint_prometheus, render_prometheus,
+)
+from split_learning_tpu.runtime.trace import (
+    FAULT_COUNTER_NAMES, GAUGE_NAMES, FaultCounters,
+)
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parents[1]
+                       / "tools"))
+import sl_top  # noqa: E402
+import sl_perf  # noqa: E402
+
+
+def beat(cid, rate, *, seq=1, t=100.0, stage=1, samples=32,
+         counters=None, crate=None, step_ms=None):
+    lat = {}
+    if step_ms is not None:
+        lat["step_device"] = {"p95_ms": step_ms}
+    return {"part": cid, "t": t, "seq": seq, "kind": "client",
+            "stage": stage, "round": 1, "samples": samples,
+            "samples_per_s": rate,
+            "gauges": ({"compute_samples_per_s": crate}
+                       if crate is not None else {}),
+            "counters": counters or {}, "wire": {}, "latency": lat,
+            "v": 1}
+
+
+# --------------------------------------------------------------------------
+# sketches
+# --------------------------------------------------------------------------
+
+class TestValueSketch:
+    def test_merge_is_order_and_partition_invariant(self):
+        rng = random.Random(7)
+        values = [rng.uniform(0.01, 5000.0) for _ in range(2000)]
+        whole = ValueSketch()
+        for v in values:
+            whole.observe(v)
+        for n_parts in (2, 5, 17):
+            parts = [ValueSketch() for _ in range(n_parts)]
+            for i, v in enumerate(values):
+                parts[i % n_parts].observe(v)
+            for order in (parts, list(reversed(parts))):
+                merged = ValueSketch()
+                for p in order:
+                    merged.merge(p.as_dict())   # wire-form merge
+                assert merged.as_dict() == whole.as_dict()
+
+    def test_quantile_error_within_bucket_width(self):
+        rng = random.Random(3)
+        values = [rng.lognormvariate(2.0, 1.5) for _ in range(5000)]
+        sk = ValueSketch()
+        for v in values:
+            sk.observe(v)
+        values.sort()
+        for q in (10, 50, 90, 99):
+            true = values[max(0, math.ceil(len(values) * q / 100) - 1)]
+            got = sk.quantile(q)
+            # representative value is the bucket's geometric mean, so
+            # the worst-case relative error is one bucket width
+            assert abs(got - true) / true <= 2 ** 0.25 - 1 + 1e-9
+
+    def test_zero_bin_ranks_below_positives(self):
+        sk = ValueSketch()
+        for v in (0.0, -3.0, float("nan"), 10.0, 10.0, 10.0):
+            sk.observe(v)
+        assert sk.zero == 3 and sk.n == 6
+        assert sk.quantile(25) == 0.0
+        assert sk.quantile(90) > 0.0
+
+    def test_from_dict_tolerates_garbage(self):
+        assert ValueSketch.from_dict(None) is None
+        assert ValueSketch.from_dict("nope") is None
+        assert ValueSketch.from_dict({"n": "x"}) is None
+        rt = ValueSketch()
+        rt.observe(3.0)
+        again = ValueSketch.from_dict(
+            json.loads(json.dumps(rt.as_dict())))
+        assert again.as_dict() == rt.as_dict()
+
+
+class TestWorstK:
+    def test_merge_truncate_deterministic(self):
+        a, b = WorstK(2), WorstK(2)
+        a.add("c1", "straggler", 0.2)
+        a.add("c2", "healthy", 0.9)
+        b.add("c3", "lost", None)
+        b.add("c1", "healthy", 0.8)   # duplicate id: worst entry wins
+        ab = WorstK(2).merge(a).merge(b).top()
+        ba = WorstK(2).merge(b).merge(a).top()
+        assert ab == ba
+        assert [e["client"] for e in ab] == ["c3", "c1"]
+        assert ab[1]["state"] == "straggler"
+
+    def test_severity_ties_break_on_id(self):
+        w = WorstK(3)
+        for cid in ("b", "a", "c"):
+            w.add(cid, "straggler", 0.5)
+        assert [e["client"] for e in w.top()] == ["a", "b", "c"]
+
+
+# --------------------------------------------------------------------------
+# digest fold exactness vs a flat oracle
+# --------------------------------------------------------------------------
+
+def _build_fleet(n=60, nodes=3, interval=1.0, liveness=30.0):
+    """n clients over `nodes` node monitors + one flat oracle, all fed
+    identical beats: a mixed fleet with injected stragglers."""
+    flat = FleetMonitor(interval, liveness)
+    node_mons = [FleetMonitor(interval, liveness) for _ in range(nodes)]
+    for i in range(n):
+        cid = f"c{i:03d}"
+        rate = 2.0 if i % 10 == 3 else 80.0 + (i % 11)
+        b = beat(cid, rate, counters={"drops": i % 4,
+                                      "redeliveries": 1},
+                 crate=rate * 1.1, step_ms=10.0 + i % 5,
+                 stage=1 + i % 2)
+        node_mons[i % nodes].note_heartbeat(cid, b, now=100.0)
+        flat.note_heartbeat(cid, b, now=100.0)
+    for m in node_mons + [flat]:
+        m.note_pump(100.1)
+        m.advance(100.1)
+    return flat, node_mons
+
+
+def _oracle_counts(flat, now=100.2):
+    snap = flat.snapshot(now, series=False)
+    counters: dict = {}
+    for c in snap["clients"].values():
+        for k, v in c["counters"].items():
+            counters[k] = counters.get(k, 0) + v
+    return ({s: n for s, n in snap["counts"].items() if n}, counters,
+            sum(c["samples"] for c in snap["clients"].values()))
+
+
+class TestDigestExactness:
+    def test_counts_counters_samples_exact_vs_oracle(self):
+        flat, node_mons = _build_fleet()
+        srv = FleetMonitor(1.0, 30.0, watchlist_size=8)
+        for k, m in enumerate(node_mons):
+            assert srv.note_digest(f"n{k}",
+                                   m.build_digest(f"n{k}", 1,
+                                                  now=100.2),
+                                   now=100.2)
+        states, counters, samples = _oracle_counts(flat)
+        totals = srv.digest_totals()
+        assert {s: n for s, n in totals["states"].items() if n} \
+            == states
+        assert totals["counters"] == counters
+        assert totals["samples"] == samples
+        assert totals["clients"] == 60
+        # server-side counts view agrees (no double count through the
+        # watchlist copies)
+        srv.note_pump(100.3)
+        srv.advance(100.3)
+        snap = srv.snapshot(100.3, series=False)
+        assert {s: n for s, n in snap["counts"].items() if n} == states
+
+    def test_chaos_on_digest_queue_folds_like_oracle(self):
+        """Duplicate + reorder the digest frames (two intervals' worth,
+        shuffled, every frame delivered twice): the (t, seq) guard must
+        fold to exactly the same counts as in-order single delivery."""
+        flat, node_mons = _build_fleet()
+        frames = []
+        for rep in (1, 2):
+            for k, m in enumerate(node_mons):
+                frames.append((f"n{k}", m.build_digest(
+                    f"n{k}", rep, now=100.2 + rep)))
+        fc = FaultCounters()
+        srv = FleetMonitor(1.0, 30.0, faults=fc, watchlist_size=8)
+        delivery = frames * 2
+        random.Random(11).shuffle(delivery)
+        accepted = sum(
+            1 for nid, d in delivery
+            if srv.note_digest(nid, json.loads(json.dumps(d)),
+                               now=103.0))
+        # exactly one frame per (node, seq) strictly-newer step folds;
+        # reordering means an older seq arriving after a newer one is
+        # stale too, so accepted <= 2 per node — but the FINAL state
+        # must equal the newest digest per node however it shuffled
+        assert accepted >= len(node_mons)
+        assert fc.snapshot()["stale_digests"] \
+            == len(delivery) - accepted
+        states, counters, samples = _oracle_counts(flat)
+        totals = srv.digest_totals()
+        assert {s: n for s, n in totals["states"].items() if n} \
+            == states
+        assert totals["counters"] == counters
+        assert totals["samples"] == samples
+
+    def test_sketch_median_tracks_true_median(self):
+        flat, node_mons = _build_fleet()
+        srv = FleetMonitor(1.0, 30.0)
+        for k, m in enumerate(node_mons):
+            srv.note_digest(f"n{k}", m.build_digest(f"n{k}", 1,
+                                                    now=100.2),
+                            now=100.2)
+        fsnap = flat.snapshot(100.2, series=False)
+        true_med = statistics.median(
+            c["samples_per_s"] for c in fsnap["clients"].values())
+        q = srv.snapshot(100.3)["digest"]["quantiles"]["rate_p50"]
+        assert abs(q - true_med) / true_med <= 2 ** 0.25 - 1
+
+    def test_transitions_reported_once_across_digests(self):
+        m = FleetMonitor(1.0, 5.0)
+        m.note_heartbeat("c1", beat("c1", 50.0), now=100.0)
+        m.note_pump(100.0)
+        m.advance(100.0)
+        m.note_pump(107.0)
+        m.advance(107.0)          # c1 -> lost
+        d1 = m.build_digest("n0", 1, now=107.0)
+        assert any(t["to"] == "lost" for t in d1["transitions"])
+        d2 = m.build_digest("n0", 2, now=108.0)
+        assert d2["transitions"] == []
+
+
+# --------------------------------------------------------------------------
+# watchlist hysteresis
+# --------------------------------------------------------------------------
+
+def _digest_with_worst(node, seq, t, worst, clients=10):
+    d = sketch.empty_digest()
+    d.update({"node": node, "seq": seq, "t": t, "clients": clients,
+              "states": {"healthy": clients}, "worst": worst})
+    return d
+
+
+class TestWatchlist:
+    def test_promotion_and_demotion_hysteresis(self):
+        srv = FleetMonitor(1.0, 30.0, watchlist_size=8)
+        worst = [{"client": "w1", "state": "straggler", "score": 0.2,
+                  "view": {"samples_per_s": 5.0, "samples": 8,
+                           "stage": 1}}]
+        srv.note_digest("n0", _digest_with_worst("n0", 1, 100.0,
+                                                 worst), now=100.0)
+        assert "w1" in srv.snapshot(100.1)["watchlist"]
+        # recovered: named healthy once — must NOT demote yet
+        healthy = [{"client": "w1", "state": "healthy", "score": 0.9,
+                    "view": {"samples_per_s": 80.0}}]
+        srv.note_digest("n0", _digest_with_worst("n0", 2, 101.0,
+                                                 healthy), now=101.0)
+        assert "w1" in srv.snapshot(101.1)["watchlist"]
+        # three consecutive digests without a mention while healthy:
+        # demoted to sketch space
+        for s in (3, 4, 5):
+            srv.note_digest("n0", _digest_with_worst("n0", s,
+                                                     100.0 + s, []),
+                            now=100.0 + s)
+        assert "w1" not in srv.snapshot(106.0)["watchlist"]
+
+    def test_mentioned_straggler_persists_unmentioned_demotes(self):
+        """build_digest ranks EVERY client into the worst heap, so a
+        still-bad client keeps being mentioned and persists; sustained
+        absence means it recovered past the top-K — the stale severe
+        copy must NOT be kept frozen (the scheduler would act on
+        fiction)."""
+        srv = FleetMonitor(1.0, 30.0, watchlist_size=8)
+        worst = [{"client": "w1", "state": "straggler", "score": 0.2,
+                  "view": {}}]
+        for s in range(1, 6):   # mentioned every digest: persists
+            srv.note_digest("n0", _digest_with_worst("n0", s,
+                                                     100.0 + s, worst),
+                            now=100.0 + s)
+            assert "w1" in srv.snapshot(100.0 + s)["watchlist"]
+        for s in range(6, 9):   # recovered out of the top-K
+            srv.note_digest("n0", _digest_with_worst("n0", s,
+                                                     100.0 + s, []),
+                            now=100.0 + s)
+        assert "w1" not in srv.snapshot(110.0)["watchlist"]
+
+    def test_pinned_stale_state_resets_instead_of_freezing(self):
+        """A pinned (scheduler-attention) entry survives misses but
+        its stale straggler state resets to healthy once the node
+        stops ranking it among the worst — the recovery the promote
+        ladder needs to see."""
+        srv = FleetMonitor(1.0, 30.0, watchlist_size=8)
+        worst = [{"client": "w1", "state": "straggler", "score": 0.2,
+                  "view": {}}]
+        srv.note_digest("n0", _digest_with_worst("n0", 1, 100.0,
+                                                 worst), now=100.0)
+        srv.watch("w1")
+        for s in range(2, 6):
+            srv.note_digest("n0", _digest_with_worst("n0", s,
+                                                     100.0 + s, []),
+                            now=100.0 + s)
+        assert "w1" in srv.snapshot(110.0)["watchlist"]
+        assert srv.state("w1") == "healthy"
+
+    def test_boundary_oscillation_cannot_flap(self):
+        """A client alternating in/out of the top-K keeps its exact
+        entry: misses never reach the demotion threshold."""
+        srv = FleetMonitor(1.0, 30.0, watchlist_size=8)
+        worst = [{"client": "w1", "state": "healthy", "score": 0.55,
+                  "view": {}}]
+        for s in range(1, 12):
+            mentioned = worst if s % 2 else []
+            srv.note_digest("n0", _digest_with_worst("n0", s,
+                                                     100.0 + s,
+                                                     mentioned),
+                            now=100.0 + s)
+            assert "w1" in srv.snapshot(100.0 + s)["watchlist"]
+
+    def test_pinned_survives_misses_until_released(self):
+        srv = FleetMonitor(1.0, 30.0, watchlist_size=8)
+        worst = [{"client": "w1", "state": "healthy", "score": 0.9,
+                  "view": {}}]
+        srv.note_digest("n0", _digest_with_worst("n0", 1, 100.0,
+                                                 worst), now=100.0)
+        srv.watch("w1")
+        for s in range(2, 9):
+            srv.note_digest("n0", _digest_with_worst("n0", s,
+                                                     100.0 + s, []),
+                            now=100.0 + s)
+        assert "w1" in srv.snapshot(110.0)["watchlist"]
+        srv.watch("w1", pinned=False)
+        for s in range(9, 13):
+            srv.note_digest("n0", _digest_with_worst("n0", s,
+                                                     100.0 + s, []),
+                            now=100.0 + s)
+        assert "w1" not in srv.snapshot(115.0)["watchlist"]
+
+    def test_hard_cap_drops_least_severe_unpinned(self):
+        srv = FleetMonitor(1.0, 30.0, watchlist_size=2)
+        worst = [
+            {"client": "bad", "state": "lost", "score": None,
+             "view": {}},
+            {"client": "slow", "state": "straggler", "score": 0.1,
+             "view": {}},
+            {"client": "fine", "state": "healthy", "score": 0.9,
+             "view": {}},
+        ]
+        srv.note_digest("n0", _digest_with_worst("n0", 1, 100.0,
+                                                 worst), now=100.0)
+        wl = srv.snapshot(100.1)["watchlist"]
+        assert wl == ["bad", "slow"]
+        assert srv.gauges.get("fleet_watchlist") == 2
+
+
+# --------------------------------------------------------------------------
+# digest-route liveness semantics (the phantom-lost regression)
+# --------------------------------------------------------------------------
+
+class TestRouteLiveness:
+    def test_routed_client_never_ages_into_lost(self):
+        srv = FleetMonitor(0.2, 2.0)
+        srv.note_heartbeat("c1", beat("c1", 80.0), now=100.0)
+        srv.route_via("c1", "n0")
+        # direct control frames keep arriving (READY/NOTIFY) but no
+        # direct beats — the digest node owns the liveness clock
+        for t in (101.0, 103.0, 106.0):
+            srv.note_frame("c1", now=t, via="n0")
+            srv.note_pump(t)
+            assert "c1" not in srv.advance(t)
+        assert srv.state("c1") == "healthy"
+
+    def test_update_piggyback_keeps_digest_coverage(self):
+        srv = FleetMonitor(0.2, 2.0)
+        srv.note_heartbeat("c1", beat("c1", 80.0), now=100.0)
+        srv.route_via("c1", "n0")
+        srv.note_heartbeat("c1", beat("c1", 80.0, seq=2, t=101.0),
+                           now=101.0, via="n0")
+        srv.note_pump(105.0)
+        assert "c1" not in srv.advance(105.0)
+
+    def test_drop_digest_restores_direct_aging(self):
+        srv = FleetMonitor(0.2, 2.0)
+        srv.note_heartbeat("c1", beat("c1", 80.0), now=100.0)
+        srv.route_via("c1", "n0")
+        d = _digest_with_worst("n0", 1, 100.0, [], clients=1)
+        srv.note_digest("n0", d, now=100.0)
+        srv.drop_digest("n0", now=106.0)
+        assert srv.digest_totals() is None
+        # fresh grace at fallback, then normal direct aging applies
+        srv.note_pump(106.1)
+        assert "c1" not in srv.advance(106.1)
+        srv.note_pump(109.0)
+        assert "c1" in srv.advance(109.0)   # 2.9s direct silence
+
+
+# --------------------------------------------------------------------------
+# bounded /metrics + /fleet shapes
+# --------------------------------------------------------------------------
+
+class TestBoundedExport:
+    def _monitor(self, n=10):
+        m = FleetMonitor(1.0, 30.0)
+        for i in range(n):
+            rate = 1.0 if i == 0 else 50.0 + i
+            m.note_heartbeat(f"c{i:02d}", beat(f"c{i:02d}", rate),
+                             now=100.0)
+        m.note_pump(100.1)
+        m.advance(100.1)
+        return m
+
+    @pytest.mark.parametrize("cap", [9, 10, 11])
+    def test_capped_render_lint_clean_at_boundary(self, cap):
+        m = self._monitor(10)
+        text = render_prometheus(fleet=m, max_client_series=cap)
+        assert lint_prometheus(text) == []
+        n_up = sum(1 for ln in text.splitlines()
+                   if ln.startswith("sl_client_up{"))
+        assert n_up == min(cap, 10)
+
+    def test_worst_clients_render_first_under_cap(self):
+        m = self._monitor(10)
+        text = render_prometheus(fleet=m, max_client_series=3)
+        rendered = {ln.split('"')[1] for ln in text.splitlines()
+                    if ln.startswith("sl_client_up{")}
+        assert "c00" in rendered   # the straggler survives the cap
+
+    def test_fleet_quantile_families_from_digest(self):
+        flat, node_mons = _build_fleet()
+        srv = FleetMonitor(1.0, 30.0, watchlist_size=4)
+        for k, mm in enumerate(node_mons):
+            srv.note_digest(f"n{k}", mm.build_digest(f"n{k}", 1,
+                                                     now=100.2),
+                            now=100.2)
+        text = render_prometheus(fleet=srv, max_client_series=4)
+        assert lint_prometheus(text) == []
+        assert "sl_fleet_rate_quantile{" in text
+        assert "sl_fleet_digest_clients 60" in text
+
+    def test_snapshot_series_paging_and_client_filter(self):
+        m = self._monitor(10)
+        full = m.snapshot(101.0)
+        assert "series" in next(iter(full["clients"].values()))
+        summary = m.snapshot(101.0, series=False)
+        assert "series" not in next(iter(summary["clients"].values()))
+        page1 = m.snapshot(101.0, page=1, per_page=4)
+        assert sorted(page1["clients"]) == ["c04", "c05", "c06", "c07"]
+        assert page1["paging"]["pages"] == 3
+        one = m.snapshot(101.0, client="c03")
+        assert list(one["clients"]) == ["c03"]
+        # counts stay FLEET-wide whatever slice the view takes
+        assert sum(page1["counts"].values()) == 10
+
+    def test_exporter_query_params(self):
+        m = self._monitor(6)
+
+        def fleet_fn(query=None):
+            q = query or {}
+            page = (int(q["page"]) if q.get("page") is not None
+                    else None)
+            return m.snapshot(series="full" in q, page=page,
+                              per_page=2, client=q.get("client"))
+
+        ex = TelemetryExporter(lambda: render_prometheus(fleet=m),
+                               fleet_fn).start()
+        try:
+            def get(path):
+                with urllib.request.urlopen(f"{ex.url}{path}",
+                                            timeout=5) as r:
+                    return json.loads(r.read().decode())
+            assert len(get("/fleet")["clients"]) == 6
+            assert "series" in get("/fleet?full=1")["clients"]["c00"]
+            assert "series" not in get("/fleet")["clients"]["c00"]
+            assert list(get("/fleet?page=1")["clients"]) \
+                == ["c02", "c03"]
+            assert list(get("/fleet?client=c04")["clients"]) == ["c04"]
+        finally:
+            ex.close()
+
+    def test_zero_arg_fleet_fn_still_served(self):
+        m = self._monitor(3)
+        ex = TelemetryExporter(lambda: "", lambda: m.snapshot()).start()
+        try:
+            with urllib.request.urlopen(f"{ex.url}/fleet",
+                                        timeout=5) as r:
+                assert len(json.loads(r.read())["clients"]) == 3
+        finally:
+            ex.close()
+
+
+# --------------------------------------------------------------------------
+# sl_top worst-K collapse
+# --------------------------------------------------------------------------
+
+class TestSlTop:
+    def test_collapses_to_worst_rows_above_top(self):
+        m = FleetMonitor(1.0, 30.0)
+        for i in range(20):
+            rate = 1.0 if i == 19 else 60.0 + i
+            m.note_heartbeat(f"c{i:02d}", beat(f"c{i:02d}", rate),
+                             now=100.0)
+        m.note_pump(100.1)
+        m.advance(100.1)
+        out = sl_top.render_fleet(m.snapshot(100.2), color=False,
+                                  top=5)
+        assert "showing worst 5 of 20" in out
+        body = out.splitlines()
+        assert sum(1 for ln in body if ln.startswith("c")) == 5
+        # the straggler leads the collapsed table
+        first_row = next(ln for ln in body if ln.startswith("c"))
+        assert first_row.startswith("c19")
+
+    def test_full_table_below_threshold(self):
+        m = FleetMonitor(1.0, 30.0)
+        for i in range(4):
+            m.note_heartbeat(f"c{i}", beat(f"c{i}", 50.0), now=100.0)
+        out = sl_top.render_fleet(m.snapshot(100.1), color=False,
+                                  top=48)
+        assert "showing worst" not in out
+        assert sum(1 for ln in out.splitlines()
+                   if ln.startswith("c")) == 4
+
+    def test_digest_summary_header(self):
+        flat, node_mons = _build_fleet()
+        srv = FleetMonitor(1.0, 30.0, watchlist_size=4)
+        for k, mm in enumerate(node_mons):
+            srv.note_digest(f"n{k}", mm.build_digest(f"n{k}", 1,
+                                                     now=100.2),
+                            now=100.2)
+        out = sl_top.render_fleet(srv.snapshot(100.3), color=False)
+        assert "digest: 60 clients across 3 node(s)" in out
+        assert "rate p50=" in out
+
+
+# --------------------------------------------------------------------------
+# metrics.jsonl rotation + readers
+# --------------------------------------------------------------------------
+
+class TestMetricsRotation:
+    def test_rotation_and_readers(self, tmp_path):
+        from split_learning_tpu.runtime.log import Logger
+        lg = Logger(tmp_path, console=False, name="server",
+                    metrics_max_mb=0.002, metrics_keep=3)
+        for i in range(200):
+            lg.metric(kind="perf", round=i, wall_s=1.0, compute_s=0.5,
+                      pad="x" * 64)
+        lg.metric(kind="fleet", fleet={"clients": {"c9": {
+            "state": "healthy"}}, "counts": {}, "transitions": []})
+        lg.close()
+        rotated = sorted(p.name for p in
+                         tmp_path.glob("metrics.jsonl.*"))
+        assert rotated and len(rotated) <= 3
+        # oldest-first ordering across rotated + active
+        files = sl_top.journal_files(tmp_path)
+        assert files[-1].name == "metrics.jsonl"
+        assert [f.name for f in files[:-1]] \
+            == sorted(rotated, reverse=True)
+        # readers see the full retained window (keep-N bounds total
+        # size, so the OLDEST records are dropped by design) across
+        # the rotation boundaries, newest record included
+        recs = sl_perf.load_perf_records(tmp_path)
+        assert len(recs) >= 20
+        assert recs[-1]["round"] == 199
+        rounds = [r["round"] for r in recs]
+        assert rounds == sorted(rounds)   # oldest-first stitching
+        fleet = sl_top.fleet_from_journal(tmp_path)
+        assert fleet is not None and "c9" in fleet["clients"]
+
+    def test_no_rotation_by_default(self, tmp_path):
+        from split_learning_tpu.runtime.log import Logger
+        lg = Logger(tmp_path, console=False, name="server")
+        for i in range(50):
+            lg.metric(kind="perf", round=i, pad="y" * 256)
+        lg.close()
+        assert list(tmp_path.glob("metrics.jsonl.*")) == []
+
+
+# --------------------------------------------------------------------------
+# static rules + protocol model
+# --------------------------------------------------------------------------
+
+class TestAnalysis:
+    def test_ct004_registries_conform(self):
+        from split_learning_tpu.analysis.counters import (
+            check_digest_registries,
+        )
+        assert check_digest_registries() == []
+        assert sketch.DIGEST_COUNTER_NAMES <= FAULT_COUNTER_NAMES
+        assert sketch.DIGEST_GAUGE_NAMES <= GAUGE_NAMES
+
+    def test_ct004_flags_undeclared_names(self):
+        from split_learning_tpu.analysis.counters import (
+            check_digest_registries,
+        )
+        findings = check_digest_registries(
+            digest_counters=frozenset({"not_a_counter"}),
+            digest_gauges=frozenset({"not_a_gauge"}))
+        assert {f.code for f in findings} == {"CT004"}
+        assert len(findings) == 2
+
+    def test_severity_table_matches_health_states(self):
+        from split_learning_tpu.runtime.telemetry import _STATE_CODE
+        assert sketch._SEVERITY == _STATE_CODE
+
+    def test_fsm_accepts_digest_choreography(self):
+        from split_learning_tpu.analysis.model import (
+            Event, validate_events,
+        )
+        events = [
+            Event("server", "recv", "Register", "server"),
+            Event("aggregator", "send", "AggHello", "tel_node_0"),
+            Event("server", "recv", "AggHello", "server"),
+            Event("server", "send", "Start", "server"),
+            Event("client", "recv", "Start", "c1"),
+            Event("client", "send", "Heartbeat", "c1"),
+            Event("aggregator", "recv", "Heartbeat", "tel_node_0"),
+            Event("aggregator", "send", "FleetDigest", "tel_node_0"),
+            Event("server", "recv", "FleetDigest", "server"),
+            Event("server", "send", "DigestRoute", "server"),
+            Event("client", "recv", "DigestRoute", "c1"),
+        ]
+        assert validate_events(events) == []
+
+    def test_digest_queue_family(self):
+        from split_learning_tpu.analysis.model import queue_family
+        assert queue_family("digest_queue_tel_node_0") == "digest"
+
+    def test_frames_roundtrip(self):
+        from split_learning_tpu.runtime import protocol as P
+        d = sketch.empty_digest()
+        d.update({"node": "n0", "seq": 3, "t": 9.0, "clients": 2,
+                  "states": {"healthy": 2}})
+        msg = P.decode(P.encode(P.FleetDigest(node_id="n0",
+                                              digest=d)))
+        assert isinstance(msg, P.FleetDigest) \
+            and msg.digest["clients"] == 2
+        rt = P.decode(P.encode(P.DigestRoute(client_id="c1",
+                                             queue=None)))
+        assert isinstance(rt, P.DigestRoute) and rt.queue is None
+
+
+# --------------------------------------------------------------------------
+# emitter stage + scheduler consumption
+# --------------------------------------------------------------------------
+
+class TestStagePlane:
+    def test_emitter_stamps_stage(self):
+        em = TelemetryEmitter("c1", lambda d: None, interval=0.0,
+                              gauges=GaugeSet(), stage=3)
+        snap = em.snapshot(now=10.0)
+        assert snap.stage == 3
+        assert TelemetrySnapshot.from_dict(snap.as_dict()).stage == 3
+
+    def test_snapshot_stages_block(self):
+        m = FleetMonitor(1.0, 30.0)
+        for i in range(8):
+            m.note_heartbeat(
+                f"c{i}", beat(f"c{i}", 50.0, stage=1 + i % 2,
+                              crate=100.0 * (1 + i % 2),
+                              step_ms=20.0 / (1 + i % 2)),
+                now=100.0)
+        st = m.snapshot(100.1)["stages"]
+        assert set(st) == {"1", "2"}
+        assert st["1"]["n"] == 4
+        assert st["2"]["compute_samples_per_s_p50"] \
+            > st["1"]["compute_samples_per_s_p50"]
+
+    def test_scheduler_medians_prefer_digest_quantiles(self):
+        from split_learning_tpu.runtime.scheduler import Scheduler
+        views = {"w1": {"state": "straggler", "kind": "client",
+                        "samples_per_s": 2.0,
+                        "compute_samples_per_s": 2.2}}
+        fleet = {"clients": views,
+                 "digest": {"quantiles": {"rate_p50": 100.0,
+                                          "crate_p50": 110.0}}}
+        med, cmed = Scheduler._medians(views, fleet)
+        assert med == 100.0 and cmed == 110.0
+        # without a digest the old view-median path is unchanged
+        med2, _ = Scheduler._medians(views, {"clients": views})
+        assert med2 == 2.0
+
+    def test_replan_uses_measured_per_stage_rates(self):
+        """A measured SLOW later stage must pull the predicted wall
+        below the mirrored-stage-1 assumption — the pre-digest model
+        literally could not see it."""
+        from split_learning_tpu.config import from_dict
+        from split_learning_tpu.runtime.plan import ClusterPlan
+        from split_learning_tpu.runtime.scheduler import Scheduler
+        import numpy as np
+        cfg = from_dict({
+            "scheduler": {"enabled": True, "warmup_rounds": 0,
+                          "replan_damping": 0.05,
+                          "replan_cooldown": 0},
+            "observability": {"heartbeat_interval": 1.0}})
+        sch = Scheduler(cfg)
+        plan = ClusterPlan(
+            cluster_id=0, cuts=[2],
+            clients=[["c0", "c1"], ["h0"]],
+            label_counts=np.eye(2, 4), rejected=[])
+        prof = {"exe_time": [0.01] * 4, "size_data": [1e4] * 4,
+                "network": 0.0}
+        views = {c: {"state": "healthy", "kind": "client",
+                     "samples_per_s": 95.0,
+                     "compute_samples_per_s": 100.0}
+                 for c in ("c0", "c1")}
+        # head measured 10x slower than stage 1
+        views["h0"] = {"state": "healthy", "kind": "client",
+                       "samples_per_s": 9.5,
+                       "compute_samples_per_s": 10.0}
+        sch._stage_stats = {}
+        mirrored = sch._replan_plan(plan, {k: v for k, v in
+                                           views.items()
+                                           if k != "h0"},
+                                    {c: prof for c in ("c0", "c1")})
+        measured = sch._replan_plan(plan, views,
+                                    {c: prof for c in ("c0", "c1")})
+        assert measured["incumbent_wall_s"] \
+            > mirrored["incumbent_wall_s"]
+        # the balanced cut shrinks the slow head's layer range
+        if measured["adopted"]:
+            assert measured["cuts"][0] >= 2
+
+
+# --------------------------------------------------------------------------
+# server-side node-death fallback
+# --------------------------------------------------------------------------
+
+class TestServerFallback:
+    def _ctx(self, tmp_path):
+        from split_learning_tpu.config import from_dict
+        from split_learning_tpu.runtime.bus import InProcTransport
+        from split_learning_tpu.runtime.server import ProtocolContext
+        cfg = from_dict({
+            "log_path": str(tmp_path),
+            "observability": {"heartbeat_interval": 0.2,
+                              "liveness_timeout": 2.0,
+                              "digest_interval": 0.3,
+                              "run_scoped": False}})
+        bus = InProcTransport()
+        ctx = ProtocolContext(cfg, bus, client_timeout=5.0)
+        return ctx, bus
+
+    def _kill_node(self, ctx, nid):
+        ctx._agg_nodes.setdefault(nid, {})["t"] = 1.0
+        ctx.fleet.note_frame(nid, now=time.time() - 100.0)
+        ctx.fleet.note_pump()
+        ctx.fleet.advance()           # nid ages into lost
+        assert ctx.fleet.state(nid) == "lost"
+
+    def test_late_digest_from_dead_node_is_rejected(self, tmp_path):
+        from split_learning_tpu.runtime import protocol as P
+        ctx, bus = self._ctx(tmp_path)
+        nid = "tel_node_0"
+        ctx._digest_route["c1"] = nid
+        self._kill_node(ctx, nid)
+        ctx._check_digest_nodes(now=1e9)
+        assert nid in ctx._digest_dead
+        assert ctx.faults.snapshot()["digest_fallbacks"] == 1
+        # a digest published before the death, delivered after the
+        # fallback (reorder): must NOT re-install the standing digest
+        d = sketch.empty_digest()
+        d.update({"node": nid, "seq": 1, "t": 5.0, "clients": 1,
+                  "states": {"healthy": 1}})
+        bus.publish(P.RPC_QUEUE, P.encode(P.FleetDigest(
+            node_id=nid, digest=d)))
+        assert ctx._pump_one(timeout=0.1)
+        assert ctx.fleet.digest_totals() is None
+        assert ctx.faults.snapshot()["stale_digests"] >= 1
+
+    def test_dead_queue_drained_across_checks(self, tmp_path):
+        """Beats parked AFTER the fallback's first drain (a client
+        mid-compile adopts the DigestRoute late) must still reach the
+        monitor — a live, actively-beating client can never age into
+        a phantom `lost`."""
+        from split_learning_tpu.runtime import protocol as P
+        ctx, bus = self._ctx(tmp_path)
+        nid = "tel_node_0"
+        ctx._digest_route["c1"] = nid
+        self._kill_node(ctx, nid)
+        ctx._check_digest_nodes(now=1e9)
+        # the re-routed client hasn't seen its DigestRoute yet and
+        # keeps beating into the dead queue
+        bus.publish(P.digest_queue(nid), P.encode(P.Heartbeat(
+            client_id="c1", telemetry=beat("c1", 80.0, seq=9,
+                                           t=time.time()))))
+        ctx._check_digest_nodes(now=1e9 + 1.0)
+        assert ctx.fleet.state("c1") == "healthy"
+        ctx.fleet.note_pump()
+        assert "c1" not in ctx.fleet.advance()
+
+
+# --------------------------------------------------------------------------
+# node digest worker end-to-end (in-proc)
+# --------------------------------------------------------------------------
+
+class TestDigestWorker:
+    def _cfg(self, tmp_path):
+        from split_learning_tpu.config import from_dict
+        # heartbeat-interval 1.0 >> digest-interval: the test sends
+        # one burst of beats, which must still read healthy (not
+        # missed-beat degraded) at the first digest publishes
+        return from_dict({
+            "log_path": str(tmp_path),
+            "observability": {"heartbeat_interval": 1.0,
+                              "liveness_timeout": 5.0,
+                              "digest_interval": 0.15,
+                              "run_scoped": False}})
+
+    def test_node_rolls_up_heartbeats_into_digests(self, tmp_path):
+        from split_learning_tpu.runtime.aggnode import AggregatorNode
+        from split_learning_tpu.runtime.bus import InProcTransport
+        from split_learning_tpu.runtime import protocol as P
+
+        bus = InProcTransport()
+        node = AggregatorNode(self._cfg(tmp_path), "tel_node_0",
+                              transport=bus, fold_transport=bus,
+                              digest_transport=bus)
+        th = threading.Thread(target=node.run, daemon=True)
+        th.start()
+        try:
+            q = P.digest_queue("tel_node_0")
+            for i in range(5):
+                bus.publish(q, P.encode(P.Heartbeat(
+                    client_id=f"c{i}",
+                    telemetry=beat(f"c{i}", 70.0 + i))))
+            asm = P.FrameAssembler()
+            digest = None
+            deadline = time.monotonic() + 10.0
+            while digest is None and time.monotonic() < deadline:
+                raw = bus.get(P.RPC_QUEUE, timeout=0.1)
+                if raw is None:
+                    continue
+                msg = asm.feed(raw)
+                if isinstance(msg, P.FleetDigest) \
+                        and (msg.digest or {}).get("clients") == 5:
+                    digest = msg.digest
+            assert digest is not None
+            assert digest["node"] == "tel_node_0"
+            assert digest["states"] == {"healthy": 5}
+            assert digest["samples"] == 5 * 32
+            srv = FleetMonitor(0.1, 5.0)
+            assert srv.note_digest("tel_node_0", digest)
+            assert srv.digest_totals()["clients"] == 5
+        finally:
+            bus.publish(P.reply_queue("tel_node_0"),
+                        P.encode(P.Stop(reason="test done")))
+            th.join(timeout=10)
+            assert not th.is_alive()
+            # injected shared bus must survive the node's teardown
+            bus.publish("still_open", b"x")

@@ -783,6 +783,178 @@ def sched_cell(tmp: str, seed: int = 17) -> tuple[bool, str]:
                   f"injected [{wall:.0f}s]")
 
 
+def fleet_scale_cell(tmp: str, seed: int = 23) -> tuple[bool, str]:
+    """Hierarchical digest roll-up chaos cell
+    (observability.digest-interval): a 24-client synthetic fleet whose
+    heartbeats route through TWO in-proc aggregator-node digest
+    workers, with duplicate+reorder chaos on the digest and rpc
+    queues, and ONE node stopped mid-run.  PASSes iff
+
+    * every round completes (the roll-up must never stall a round);
+    * the digest path actually carried the fleet: the server folded
+      FleetDigest frames (digest block in /fleet with exact state
+      counts covering the routed clients);
+    * the killed node's clients fall back to DIRECT heartbeats,
+      counted exactly (``digest_fallbacks`` == clients routed to it);
+    * NO client ever transitions to ``lost`` (the fallback drains the
+      dead node's parked beats — a phantom `lost` flap is the failure
+      mode this cell exists to catch);
+    * chaos was real: duplicated digest/heartbeat frames were
+      rejected by the (t, seq) staleness guards, never double-folded.
+
+    Writes ``fleet_digest.json`` (final snapshot + fallback counts)
+    into the cell dir for CI artifact upload."""
+    import threading
+
+    from split_learning_tpu.config import from_dict
+    from split_learning_tpu.runtime.aggnode import AggregatorNode
+    from split_learning_tpu.runtime.log import Logger
+    from split_learning_tpu.runtime.server import ProtocolServer
+    from split_learning_tpu.runtime.simfleet import (
+        SyntheticFleet, hetero_fleet,
+    )
+
+    sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent))
+    import sl_top
+
+    cell_dir = pathlib.Path(tmp) / "fleet_scale"
+    cell_dir.mkdir(parents=True, exist_ok=True)
+    n1, heads = 24, 1
+    cfg = from_dict({
+        "model": "KWT", "dataset": "SPEECHCOMMANDS",
+        "clients": [n1, heads], "global_rounds": 4,
+        "synthetic_size": 48, "val_max_batches": 1,
+        "val_batch_size": 16,
+        "model_kwargs": {"embed_dim": 16, "num_heads": 2,
+                         "mlp_dim": 32},
+        "log_path": str(cell_dir),
+        "learning": {"batch_size": 4},
+        "topology": {"cut_layers": [2]},
+        "checkpoint": {"save": False, "validate": False,
+                       "directory": str(cell_dir / "ckpt")},
+        "observability": {"heartbeat_interval": 0.2,
+                          "liveness_timeout": 2.0,
+                          "digest_interval": 0.3,
+                          "watchlist_size": 8,
+                          "max_client_series": 16,
+                          "http_port": 0},
+    })
+    bus = InProcTransport()
+    fc = FaultCounters()
+    # dup + reorder on the roll-up path: duplicated heartbeats must be
+    # rejected by the node monitors' staleness guard, duplicated
+    # FleetDigest frames by the server's — never double-folded
+    chaos = ChaosConfig(enabled=True, seed=seed, duplicate=0.2,
+                        reorder=0.2,
+                        queues=("digest_queue_*", "rpc_queue"))
+    fleet_bus = ChaosTransport(bus, chaos, name="simfleet", faults=fc)
+    # server FIRST: its startup queue purge would eat AggHello frames
+    # published before it exists (the spawned-subprocess ordering)
+    server = ProtocolServer(cfg, transport=bus,
+                            logger=Logger.for_run(cfg, "server",
+                                                  console=False),
+                            client_timeout=120.0)
+    # node publishes (FleetDigest frames included) ride the same
+    # dup/reorder chaos: a duplicated digest must be rejected by the
+    # server's (t, seq) guard, never double-folded
+    nodes = [AggregatorNode(
+        cfg, f"tel_node_{i}",
+        transport=ChaosTransport(bus, chaos, name=f"tel_node_{i}",
+                                 faults=fc),
+        fold_transport=bus, digest_transport=bus)
+        for i in range(2)]
+    node_threads = [threading.Thread(target=n.run, daemon=True)
+                    for n in nodes]
+    for t in node_threads:
+        t.start()
+    specs = hetero_fleet(n1, heads, compute_speed=100.0, samples=32,
+                         seed=seed)
+    fleet = SyntheticFleet(fleet_bus, specs, heartbeat_interval=0.2,
+                           time_scale=1.0).start()
+    ctx = server.ctx
+    state = {"route_before": {}, "killed": None}
+
+    def killer():
+        # let round 1 establish the routes, then stop one node that
+        # actually serves clients (its digest thread dies with it)
+        deadline = time.monotonic() + 30.0
+        while time.monotonic() < deadline:
+            routed = dict(ctx._digest_route)
+            if len(set(routed.values())) >= 2:
+                break
+            time.sleep(0.1)
+        time.sleep(1.0)
+        routed = dict(ctx._digest_route)
+        state["route_before"] = routed
+        victims = sorted(set(routed.values()))
+        if victims:
+            state["killed"] = victims[0]
+            for n in nodes:
+                if n.node_id == victims[0]:
+                    n.stop()
+
+    kt = threading.Thread(target=killer, daemon=True)
+    kt.start()
+    t0 = time.monotonic()
+    try:
+        res = server.serve()
+    finally:
+        fleet.stop()
+        for n in nodes:
+            n.stop()
+    wall = time.monotonic() - t0
+    snap = ctx.fleet.snapshot(series=False)
+    faults = ctx.faults.snapshot()
+    killed = state["killed"]
+    expected_fallbacks = sum(
+        1 for nid in state["route_before"].values() if nid == killed)
+    out = {
+        "wall_s": round(wall, 3), "killed_node": killed,
+        "route_before": state["route_before"],
+        "digest_fallbacks": faults.get("digest_fallbacks", 0),
+        "expected_fallbacks": expected_fallbacks,
+        "stale_digests": faults.get("stale_digests", 0),
+        "stale_heartbeats": sum(
+            n.faults.snapshot().get("stale_heartbeats", 0)
+            for n in nodes),
+        "fleet": snap,
+    }
+    (cell_dir / "fleet_digest.json").write_text(
+        json.dumps(out, indent=2, default=str))
+    table = sl_top.render_fleet(snap, color=False,
+                                source="fleet-scale", top=10)
+    (cell_dir / "fleet_digest_table.txt").write_text(table + "\n")
+    if not res.history or not all(r.ok for r in res.history):
+        return False, "round not ok"
+    if killed is None:
+        return False, "no digest routes established (roll-up inert)"
+    if not state["route_before"]:
+        return False, "no clients were routed through digest nodes"
+    if faults.get("digest_fallbacks", 0) != expected_fallbacks:
+        return False, (f"fallback count {faults.get('digest_fallbacks')}"
+                       f" != {expected_fallbacks} clients routed to "
+                       f"{killed}")
+    phantom = [t for t in snap.get("transitions", ())
+               if t.get("to") == "lost"
+               and str(t.get("client", "")).startswith("sim_")]
+    if phantom:
+        return False, f"phantom lost transition(s): {phantom[:3]}"
+    dig_nodes = (snap.get("digest") or {}).get("nodes") or {}
+    if not dig_nodes:
+        return False, "no FleetDigest ever folded at the server"
+    if killed in dig_nodes:
+        return False, f"dead node {killed} still in the digest fold"
+    if out["stale_heartbeats"] <= 0 or out["stale_digests"] <= 0:
+        return False, ("chaos injected nothing the guards rejected "
+                       f"(beats={out['stale_heartbeats']} "
+                       f"digests={out['stale_digests']})")
+    return True, (f"{len(state['route_before'])} routed, "
+                  f"{expected_fallbacks} fell back on {killed} death, "
+                  f"{out['stale_heartbeats']} dup beats + "
+                  f"{out['stale_digests']} dup digests rejected, "
+                  f"0 phantom lost [{wall:.0f}s]")
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(
         description="Sweep fault probabilities over seeds; print a "
@@ -835,6 +1007,15 @@ def main(argv=None):
                          "knobs retuned, the round must complete, and "
                          "the kind=sched decisions journal must "
                          "validate (writes sched.json)")
+    ap.add_argument("--fleet-scale", dest="fleet_scale",
+                    action="store_true",
+                    help="run ONLY the hierarchical digest roll-up "
+                         "cell: 24 synthetic clients' heartbeats roll "
+                         "up through 2 aggregator-node digest workers "
+                         "under dup+reorder chaos; one node is killed "
+                         "and its clients must fall back to direct "
+                         "heartbeats, counted, with no phantom lost "
+                         "flap (writes fleet_digest.json)")
     ap.add_argument("--overlap", dest="overlap_mode",
                     action="store_true",
                     help="run ONLY the sync-overlap cell: a 3-client "
@@ -855,6 +1036,20 @@ def main(argv=None):
         ok, note = tree_remote_cell(tmp)
         dt = time.monotonic() - t0
         print(f"tree-remote cell: {'PASS' if ok else 'FAIL'} ({note}) "
+              f"[{dt:.1f}s, artifacts in {tmp}]")
+        return 0 if ok else 1
+
+    if args.fleet_scale:
+        if args.artifacts_dir:
+            tmp = args.artifacts_dir
+            pathlib.Path(tmp).mkdir(parents=True, exist_ok=True)
+        else:
+            import tempfile
+            tmp = tempfile.mkdtemp(prefix="chaos_fleet_scale_")
+        t0 = time.monotonic()
+        ok, note = fleet_scale_cell(tmp)
+        dt = time.monotonic() - t0
+        print(f"fleet-scale cell: {'PASS' if ok else 'FAIL'} ({note}) "
               f"[{dt:.1f}s, artifacts in {tmp}]")
         return 0 if ok else 1
 

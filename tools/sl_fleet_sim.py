@@ -55,6 +55,12 @@ def build_cfg(args, log_dir: str, sched: bool):
             "heartbeat_interval": args.heartbeat_interval,
             "liveness_timeout": max(30.0,
                                     8 * args.heartbeat_interval),
+            # hierarchical roll-up (--digest N): clients' heartbeats
+            # route through N aggregator-node digest workers instead
+            # of landing individually on the server's rpc pump
+            "digest_interval": (args.digest_interval
+                                if args.digest else 0.0),
+            "watchlist_size": args.watchlist,
             "http_port": (0 if args.http else None)},
         "scheduler": {"enabled": sched,
                       "warmup_rounds": 1,
@@ -64,6 +70,8 @@ def build_cfg(args, log_dir: str, sched: bool):
 
 
 def run_leg(args, sched: bool, log_dir: str) -> dict:
+    import threading
+
     from split_learning_tpu.runtime.bus import InProcTransport
     from split_learning_tpu.runtime.server import ProtocolServer
     from split_learning_tpu.runtime.simfleet import (
@@ -86,6 +94,20 @@ def run_leg(args, sched: bool, log_dir: str) -> dict:
                             logger=Logger.for_run(cfg, "server",
                                                   console=False),
                             client_timeout=args.client_timeout)
+    # in-proc digest nodes (--digest N): the clients' heartbeats roll
+    # up through these instead of hitting the server's rpc pump
+    # individually — the 100k-tier telemetry path, driveable from
+    # this CLI
+    nodes, node_threads = [], []
+    if args.digest:
+        from split_learning_tpu.runtime.aggnode import AggregatorNode
+        for i in range(args.digest):
+            n = AggregatorNode(cfg, f"tel_node_{i}", transport=bus,
+                               fold_transport=bus, digest_transport=bus)
+            t = threading.Thread(target=n.run, daemon=True)
+            t.start()
+            nodes.append(n)
+            node_threads.append(t)
     t_reg = time.monotonic()
     fleet = SyntheticFleet(
         bus, specs, heartbeat_interval=args.heartbeat_interval,
@@ -96,6 +118,8 @@ def run_leg(args, sched: bool, log_dir: str) -> dict:
         res = server.serve()
     finally:
         fleet.stop()
+        for n in nodes:
+            n.stop()
     wall = time.monotonic() - t0
     out = {
         "sched": sched,
@@ -108,7 +132,16 @@ def run_leg(args, sched: bool, log_dir: str) -> dict:
     }
     ctx = server.ctx
     if ctx.fleet is not None:
-        out["fleet_counts"] = ctx.fleet.snapshot()["counts"]
+        snap = ctx.fleet.snapshot(series=False)
+        out["fleet_counts"] = snap["counts"]
+        if snap.get("digest"):
+            out["digest"] = {
+                "nodes": len(snap["digest"]["nodes"]),
+                "clients": snap["digest"]["clients"],
+                "quantiles": snap["digest"]["quantiles"],
+                "watchlist": len(snap.get("watchlist") or []),
+                "fallbacks": ctx.faults.snapshot().get(
+                    "digest_fallbacks", 0)}
     if ctx.scheduler is not None:
         sch = ctx.scheduler
         out["decisions"] = [
@@ -156,6 +189,14 @@ def main(argv=None) -> int:
     ap.add_argument("--paired", action="store_true",
                     help="run scheduler-off then scheduler-on on the "
                          "same fleet and report the wall ratio")
+    ap.add_argument("--digest", type=int, default=0, metavar="N",
+                    help="roll heartbeats up through N in-proc "
+                         "aggregator-node digest workers "
+                         "(observability.digest-interval) instead of "
+                         "one frame per client on the rpc pump")
+    ap.add_argument("--digest-interval", type=float, default=1.0)
+    ap.add_argument("--watchlist", type=int, default=64,
+                    help="observability.watchlist-size (digest mode)")
     ap.add_argument("--http", action="store_true",
                     help="serve /metrics + /fleet during the run")
     ap.add_argument("--log-dir", default=None)

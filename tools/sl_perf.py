@@ -85,6 +85,13 @@ STABLE_KEYS = {
     # control plane must never become the bottleneck)
     "extra.sched_wall_ratio_vs_static": "down",
     "extra.sched_decision_ms_10k": "down",
+    # hierarchical fleet telemetry (round-14): server-side digest
+    # ingest + decision-input build per interval at 100k synthetic
+    # clients, and one /metrics render under the series cap at 100k —
+    # both must stay flat as the fleet grows (the digest path is
+    # O(nodes + top-K), the render O(max-client-series))
+    "extra.fleet_digest_ingest_ms_100k": "down",
+    "extra.fleet_metrics_render_ms_100k": "down",
 }
 
 #: absolute pins, enforced on the NEWEST record regardless of trend: a
@@ -111,6 +118,16 @@ STABLE_KEY_CAPS = {
     # 10k-client round wall the pass is ~1.6%)
     "extra.sched_wall_ratio_vs_static": 0.7,
     "extra.sched_decision_ms_10k": 1000.0,
+    # hierarchical fleet telemetry acceptance pins (round-14): ONE
+    # interval's server-side digest ingest + decision-input build at
+    # 100k synthetic clients (measured ~4 ms on the r09 host: 24 node
+    # digests + advance + summary snapshot — O(nodes + watchlist),
+    # not O(clients)), and one capped /metrics render at 100k
+    # (~1.5 ms; the page is O(max-client-series)).  Caps are host
+    # headroom over the measurement so a superlinear regression —
+    # anything that re-introduces a per-client walk — cannot calcify.
+    "extra.fleet_digest_ingest_ms_100k": 50.0,
+    "extra.fleet_metrics_render_ms_100k": 20.0,
 }
 
 #: attribution components of a kind=perf record, in report order
@@ -164,7 +181,8 @@ for _k in ("protocol_samples_per_sec", "cold_round_wall_s",
            "async_samples_per_sec", "async_wall_ratio_vs_sync",
            "async_accuracy_delta", "update_bubble_ms",
            "update_overlap_ratio", "sched_wall_ratio_vs_static",
-           "sched_decision_ms_10k"):
+           "sched_decision_ms_10k", "fleet_digest_ingest_ms_100k",
+           "fleet_metrics_render_ms_100k"):
     _path = ("extra.mfu." + _k
              if _k.startswith(("mfu_vs", "measured_matmul"))
              else "extra." + _k)
@@ -295,22 +313,28 @@ def diff_bench(prev: dict, cur: dict,
 # kind=perf attribution report
 # --------------------------------------------------------------------------
 
+def metrics_files(path: str | pathlib.Path) -> list[pathlib.Path]:
+    """metrics.jsonl plus its size-rotated siblings
+    (``observability.metrics-max-mb`` → ``metrics.jsonl.N``), oldest
+    first, so a rotated run reads exactly like an unrotated one.
+    ONE implementation for all the stdlib tools: sl_top owns it."""
+    sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent))
+    from sl_top import journal_files
+    return journal_files(pathlib.Path(path))
+
+
 def load_perf_records(path: str | pathlib.Path) -> list[dict]:
     """All ``kind=perf`` records from a metrics.jsonl (or a run/log
-    directory holding one)."""
-    p = pathlib.Path(path)
-    if p.is_dir():
-        p = p / "metrics.jsonl"
-    if not p.exists():
-        return []
+    directory holding one), rotated files included."""
     out = []
-    for line in p.read_text().splitlines():
-        try:
-            rec = json.loads(line)
-        except json.JSONDecodeError:
-            continue
-        if isinstance(rec, dict) and rec.get("kind") == "perf":
-            out.append(rec)
+    for p in metrics_files(path):
+        for line in p.read_text().splitlines():
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if isinstance(rec, dict) and rec.get("kind") == "perf":
+                out.append(rec)
     return out
 
 

@@ -47,22 +47,36 @@ def fetch_fleet(url: str, timeout: float = 3.0) -> dict:
         return json.loads(resp.read().decode())
 
 
-def fleet_from_journal(path: pathlib.Path) -> dict | None:
-    """Latest ``kind=fleet`` record from a metrics.jsonl (or a run
-    directory holding one)."""
+def journal_files(path: pathlib.Path) -> list[pathlib.Path]:
+    """metrics.jsonl plus its size-rotated siblings
+    (``observability.metrics-max-mb``), oldest first — so scanning
+    them in order reads exactly like one unrotated file."""
     if path.is_dir():
         path = path / "metrics.jsonl"
-    if not path.exists():
-        return None
+    rotated = []
+    for p in path.parent.glob(path.name + ".*"):
+        suffix = p.name.rsplit(".", 1)[-1]
+        if suffix.isdigit():
+            rotated.append((int(suffix), p))
+    out = [p for _, p in sorted(rotated, reverse=True)]
+    if path.exists():
+        out.append(path)
+    return out
+
+
+def fleet_from_journal(path: pathlib.Path) -> dict | None:
+    """Latest ``kind=fleet`` record from a metrics.jsonl (or a run
+    directory holding one), rotated files included."""
     latest = None
-    for line in path.read_text().splitlines():
-        try:
-            rec = json.loads(line)
-        except json.JSONDecodeError:
-            continue
-        if rec.get("kind") == "fleet" and isinstance(
-                rec.get("fleet"), dict):
-            latest = rec["fleet"]
+    for p in journal_files(pathlib.Path(path)):
+        for line in p.read_text().splitlines():
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if rec.get("kind") == "fleet" and isinstance(
+                    rec.get("fleet"), dict):
+                latest = rec["fleet"]
     return latest
 
 
@@ -74,17 +88,55 @@ def _fmt(v, nd=1) -> str:
     return str(v)
 
 
+#: past this many per-client rows the table collapses to the worst-K
+#: view (a 10k-row terminal table is unreadable and slow) — override
+#: with --top/--all
+DEFAULT_TOP = 48
+
+_STATE_SEV = {"healthy": 0, "degraded": 1, "straggler": 2, "lost": 3}
+
+
+def _severity_key(item):
+    cid, c = item
+    score = c.get("straggler_score")
+    return (-_STATE_SEV.get(c.get("state", "healthy"), 0),
+            score if score is not None else float("inf"), cid)
+
+
 def render_fleet(fleet: dict, color: bool = True,
-                 source: str = "") -> str:
-    """The fleet table as one string (tested, and reused by --once)."""
+                 source: str = "", top: int | None = None) -> str:
+    """The fleet table as one string (tested, and reused by --once).
+
+    Above ``top`` clients (default :data:`DEFAULT_TOP`; None = all)
+    only the WORST rows render — ranked by health-state severity then
+    straggler score — under a summary header; with the digest roll-up
+    active the header also carries the fleet-wide quantiles and the
+    per-node digest summary."""
     counts = fleet.get("counts", {})
     clients = fleet.get("clients", {})
     head = ("fleet @ " + time.strftime(
         "%H:%M:%S", time.localtime(fleet.get("t", time.time())))
         + (f"  [{source}]" if source else "")
         + "  |  " + " ".join(f"{s}={n}" for s, n in counts.items()))
+    summary: list[str] = []
+    dig = fleet.get("digest") or {}
+    if dig:
+        q = dig.get("quantiles") or {}
+        summary.append(
+            f"digest: {dig.get('clients', 0)} clients across "
+            f"{len(dig.get('nodes') or {})} node(s)"
+            + (f"  rate p50={q.get('rate_p50')}/s "
+               f"p95={q.get('rate_p95')}/s" if q else "")
+            + (f"  watchlist={len(fleet.get('watchlist') or [])}"
+               if fleet.get("watchlist") is not None else ""))
+    shown = sorted(clients.items())
+    if top is not None and len(shown) > top:
+        shown = sorted(shown, key=_severity_key)[:top]
+        summary.append(
+            f"showing worst {len(shown)} of {len(clients)} tracked "
+            "rows (--all for every row; severity-ranked)")
     rows = [_COLUMNS]
-    for cid, c in sorted(clients.items()):
+    for cid, c in shown:
         wire_mb = (c.get("wire_bytes_out") or 0) / 1e6
         agg = c.get("kind") == "agg_node"
         rows.append((
@@ -109,7 +161,8 @@ def render_fleet(fleet: dict, color: bool = True,
         ))
     widths = [max(len(str(r[i])) for r in rows)
               for i in range(len(_COLUMNS))]
-    lines = [head, "-" * (sum(widths) + 2 * (len(widths) - 1))]
+    lines = [head, *summary,
+             "-" * (sum(widths) + 2 * (len(widths) - 1))]
     for ri, row in enumerate(rows):
         cells = [f"{str(v):<{w}}" for v, w in zip(row, widths)]
         line = "  ".join(cells)
@@ -157,7 +210,13 @@ def main(argv=None) -> int:
     ap.add_argument("--once", action="store_true",
                     help="render one snapshot and exit")
     ap.add_argument("--no-color", action="store_true")
+    ap.add_argument("--top", type=int, default=DEFAULT_TOP,
+                    help="past this many clients, show only the "
+                         "worst rows (severity-ranked); see --all")
+    ap.add_argument("--all", action="store_true",
+                    help="always render every per-client row")
     args = ap.parse_args(argv)
+    top = None if args.all else args.top
 
     def snap() -> tuple[dict | None, str, str]:
         if args.journal:
@@ -178,7 +237,7 @@ def main(argv=None) -> int:
             return 1
         if fleet is not None:
             last = render_fleet(fleet, color=not args.no_color,
-                                source=source)
+                                source=source, top=top)
             if args.once:
                 print(last)
                 return 0
