@@ -265,6 +265,17 @@ class Artifact:
                       "fleet_metrics_render_ms_100k"):
                 if k in fdig and fdig[k] is not None:
                     self.extra[k] = fdig[k]
+        # stable keys (round-15 broker-shard PR): the shard plane's
+        # ingest-throughput multiplier over the 1-shard baseline and
+        # the 4-vs-1-shard round-wall ratio on the 100k synthetic
+        # fleet — mirrored at fixed paths for sl_perf --diff
+        bsh = self.results.get("broker_shard")
+        if isinstance(bsh, dict):
+            for k in ("broker_shard_scaling",
+                      "broker_round_wall_ratio_100k",
+                      "broker_round_wall_per_client_ms_100k"):
+                if k in bsh and bsh[k] is not None:
+                    self.extra[k] = bsh[k]
         plan = (self.cfgs.get("tinyllama_tinystories_4stage") or {})
         if isinstance(plan, dict):
             per_dev = (plan.get("memory_plan") or {}).get("per_device_gb")
@@ -2291,6 +2302,434 @@ def _sec_fleet_digest(ctx: dict) -> dict:
     return out
 
 
+# --------------------------------------------------------------------------
+# broker_shard: sharded event-loop broker plane (round-15)
+# --------------------------------------------------------------------------
+
+#: ingest worker: pre-encodes `n` publish frames (the same wire bytes
+#: TcpTransport would send), partitions them by owning shard, and
+#: streams each shard's batch down a raw socket from its own thread —
+#: then fences every connection with a 1 ms GET (per-connection
+#: ordering: the fence reply lands only after every prior publish on
+#: that connection was PROCESSED by its shard).  Raw batched sockets
+#: keep the load generator's per-message cost ~1 µs, so the measured
+#: wall is the BROKER plane's ingest capacity, not the generator's
+#: Python overhead.
+_BROKER_PUB_WORKER = r"""
+import socket, struct, sys, threading, time
+from split_learning_tpu.runtime.bus import shard_for
+host, port, shards, w, n = (sys.argv[1], int(sys.argv[2]),
+                            int(sys.argv[3]), int(sys.argv[4]),
+                            int(sys.argv[5]))
+payload = b"x" * 256
+queues = [("bw_%d_%d" % (w, i)).encode() for i in range(32)]
+frame = [b"P" + struct.pack(">I", len(q)) + q
+         + struct.pack(">Q", len(payload)) + payload for q in queues]
+owner = [shard_for(q.decode(), shards) for q in queues]
+bufs = {s: bytearray() for s in range(shards)}
+for k in range(n):
+    i = k % 32
+    bufs[owner[i]] += frame[i]
+for s in range(shards):
+    fq = ("bfence_%d_%d" % (w, s)).encode()
+    bufs[s] += (b"G" + struct.pack(">I", len(fq)) + fq
+                + struct.pack(">Q", 8) + struct.pack(">Q", 1))
+socks = {s: socket.create_connection((host, port + s))
+         for s in range(shards)}
+print("READY", flush=True)
+sys.stdin.readline()       # parent releases every worker at once
+t0 = time.perf_counter()
+ts = [threading.Thread(target=socks[s].sendall, args=(bytes(bufs[s]),))
+      for s in range(shards)]
+for t in ts:
+    t.start()
+for t in ts:
+    t.join()
+for s, sock in socks.items():   # fence replies: ingest complete
+    sock.settimeout(300.0)
+    buf = b""
+    while len(buf) < 13:
+        chunk = sock.recv(13 - len(buf))
+        assert chunk, "EOF before fence reply"
+        buf += chunk
+print("WALL", time.perf_counter() - t0, flush=True)
+for sock in socks.values():
+    sock.close()
+"""
+
+#: shared raw-socket helpers for the fleet-round workers: the wire
+#: bytes are exactly TcpTransport's, but without its per-op Python
+#: layering (lock, counters, object dispatch) the generator costs
+#: ~10 µs per op — so the measured wall is broker-plane latency and
+#: throughput, not load-generator CPU
+_BROKER_RAW_HELPERS = r"""
+import socket, struct
+
+
+def _recv_exact(sock, n):
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        assert chunk, "EOF from broker"
+        buf += chunk
+    return buf
+
+
+def raw_get(sock, queue, ms):
+    sock.sendall(b"G" + struct.pack(">I", len(queue)) + queue
+                 + struct.pack(">Q", 8) + struct.pack(">Q", ms))
+    head = _recv_exact(sock, 13)
+    (plen,) = struct.unpack(">Q", head[5:13])
+    if plen == 0xFFFFFFFFFFFFFFFF:
+        return None
+    return _recv_exact(sock, plen)
+
+
+def raw_pub(sock, queue, payload):
+    sock.sendall(b"P" + struct.pack(">I", len(queue)) + queue
+                 + struct.pack(">Q", len(payload)) + payload)
+"""
+
+#: fleet-round client worker: each simulated client blocking-GETs its
+#: START from its reply queue (a parked continuation on the owning
+#: shard) and answers with one UPDATE into its spread group queue
+_BROKER_FLEET_WORKER = _BROKER_RAW_HELPERS + r"""
+import sys
+from split_learning_tpu.runtime.bus import shard_for
+host, port, shards = sys.argv[1], int(sys.argv[2]), int(sys.argv[3])
+start, n, groups = int(sys.argv[4]), int(sys.argv[5]), int(sys.argv[6])
+socks = {s: socket.create_connection((host, port + s))
+         for s in range(shards)}
+upd = b"u" * 1024
+print("READY", flush=True)
+done = 0
+for i in range(start, start + n):
+    q = ("bstart_%06d" % i).encode()
+    raw = raw_get(socks[shard_for(q.decode(), shards)], q, 300000)
+    assert raw is not None, "no START for client %d" % i
+    g = ("bupd_%03d" % (i % groups)).encode()
+    raw_pub(socks[shard_for(g.decode(), shards)], g, upd)
+    done += 1
+print("DONE", done, flush=True)
+"""
+
+#: fleet-round drain worker: plays the server's fan-in side for its
+#: slice of the group queues (a real process, so the drain parallelism
+#: scales with the shard plane instead of serializing on one GIL)
+_BROKER_DRAIN_WORKER = _BROKER_RAW_HELPERS + r"""
+import sys
+from split_learning_tpu.runtime.bus import shard_for
+host, port, shards = sys.argv[1], int(sys.argv[2]), int(sys.argv[3])
+t, stride, groups, n_clients = (int(sys.argv[4]), int(sys.argv[5]),
+                                int(sys.argv[6]), int(sys.argv[7]))
+socks = {s: socket.create_connection((host, port + s))
+         for s in range(shards)}
+print("READY", flush=True)
+count = 0
+for g in range(t, groups, stride):
+    q = ("bupd_%03d" % g).encode()
+    sock = socks[shard_for(q.decode(), shards)]
+    want = len(range(g, n_clients, groups))
+    while want:
+        raw = raw_get(sock, q, 300000)
+        assert raw is not None, "drain stalled on group %d" % g
+        want -= 1
+        count += 1
+print("DONE", count, flush=True)
+"""
+
+
+def _spawn_broker_plane(shards: int):
+    """(base_port, [Popen]) — real shard subprocesses, ports verified
+    listening before return."""
+    import socket as _socket
+
+    from split_learning_tpu.broker import spawn_shard
+    from split_learning_tpu.runtime.bus import find_port_block
+    for _ in range(5):
+        base = find_port_block(shards)
+        procs = [spawn_shard("127.0.0.1", base + i, shard_index=i,
+                             python_only=True)
+                 for i in range(shards)]
+        deadline = time.monotonic() + 120
+        up = 0
+        while up < shards and time.monotonic() < deadline:
+            up = 0
+            for i in range(shards):
+                try:
+                    _socket.create_connection(
+                        ("127.0.0.1", base + i), timeout=0.5).close()
+                    up += 1
+                except OSError:
+                    break
+            if up < shards:
+                if any(p.poll() is not None for p in procs):
+                    break   # a shard lost the port race: retry block
+                time.sleep(0.25)
+        if up == shards:
+            return base, procs
+        for p in procs:
+            p.kill()
+    raise RuntimeError("broker shard plane never came up")
+
+
+def _teardown_plane(procs) -> None:
+    for p in procs:
+        if p.poll() is None:
+            p.terminate()
+    for p in procs:
+        try:
+            p.wait(timeout=10)
+        except Exception:  # noqa: BLE001 — stuck child
+            p.kill()
+
+
+def _broker_ingest_leg(shards: int, workers: int,
+                       msgs_per_worker: int) -> float:
+    """Aggregate broker-plane ingest throughput (msgs/s) through
+    `shards` REAL shard processes from `workers` real worker
+    processes."""
+    import subprocess as sp
+    base, procs = _spawn_broker_plane(shards)
+    try:
+        ws = [sp.Popen(
+            [sys.executable, "-c", _BROKER_PUB_WORKER, "127.0.0.1",
+             str(base), str(shards), str(w), str(msgs_per_worker)],
+            stdin=sp.PIPE, stdout=sp.PIPE, stderr=sp.PIPE, text=True,
+            cwd=str(HERE), env={**os.environ, "JAX_PLATFORMS": "cpu"})
+            for w in range(workers)]
+        for w in ws:
+            assert w.stdout.readline().strip() == "READY"
+        for w in ws:        # release the herd together
+            w.stdin.write("go\n")
+            w.stdin.flush()
+        walls = []
+        for w in ws:
+            out, err = w.communicate(timeout=300)
+            assert w.returncode == 0, err[-1000:]
+            walls.append(float(out.split("WALL", 1)[1].split()[0]))
+        total = workers * msgs_per_worker
+        return total / max(walls)
+    finally:
+        _teardown_plane(procs)
+
+
+def _broker_fleet_round(base: int, shards: int, n_clients: int,
+                        client_procs: int = 24, drain_procs: int = 16,
+                        groups: int = 96) -> float:
+    """One synthetic fleet round through the shard plane: START
+    fan-out to n_clients reply queues (pre-encoded frames streamed
+    down raw per-shard sockets — the generator must not GIL-bound the
+    measurement), every client's blocking GET + UPDATE from client
+    worker PROCESSES, and the full fan-in drain from drain worker
+    PROCESSES.  Returns the round wall (s): fan-out start -> last
+    drain DONE; worker spawn/connect setup excluded."""
+    import socket as _socket
+    import struct as _struct
+    import subprocess as sp
+    import threading as th
+
+    from split_learning_tpu.runtime.bus import shard_for
+
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    per = -(-n_clients // client_procs)
+    ws = []
+    start = 0
+    while start < n_clients:
+        n = min(per, n_clients - start)
+        ws.append(sp.Popen(
+            [sys.executable, "-c", _BROKER_FLEET_WORKER, "127.0.0.1",
+             str(base), str(shards), str(start), str(n), str(groups)],
+            stdout=sp.PIPE, stderr=sp.PIPE, text=True, cwd=str(HERE),
+            env=env))
+        start += n
+    ds = [sp.Popen(
+        [sys.executable, "-c", _BROKER_DRAIN_WORKER, "127.0.0.1",
+         str(base), str(shards), str(t), str(drain_procs),
+         str(groups), str(n_clients)],
+        stdout=sp.PIPE, stderr=sp.PIPE, text=True, cwd=str(HERE),
+        env=env)
+        for t in range(drain_procs)]
+    for w in ws + ds:
+        assert w.stdout.readline().strip() == "READY"
+    # pre-encoded START fan-out, partitioned by owning shard
+    payload = b"s" * 256
+    bufs = {s: bytearray() for s in range(shards)}
+    for i in range(n_clients):
+        q = ("bstart_%06d" % i).encode()
+        bufs[shard_for(q.decode(), shards)] += (
+            b"P" + _struct.pack(">I", len(q)) + q
+            + _struct.pack(">Q", len(payload)) + payload)
+
+    def fanout(s: int, buf: bytes) -> None:
+        sock = _socket.create_connection(("127.0.0.1", base + s))
+        sock.sendall(buf)
+        sock.close()
+
+    t0 = time.perf_counter()
+    ts = [th.Thread(target=fanout, args=(s, bytes(b)), daemon=True)
+          for s, b in bufs.items()]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=300)
+    drained = 0
+    for d in ds:
+        line = d.stdout.readline().strip()
+        assert line.startswith("DONE"), line
+        drained += int(line.split()[1])
+    wall = time.perf_counter() - t0
+    assert drained == n_clients, f"drained {drained}/{n_clients}"
+    for w in ws + ds:
+        out, err = w.communicate(timeout=120)
+        assert w.returncode == 0, err[-1000:]
+    return wall
+
+
+def _broker_sim_leg(base: int, shards: int) -> dict:
+    """Real ProtocolServer rounds driven by the SHARD-AWARE synthetic
+    fleet (runtime/simfleet.py multi-driver mode) over the real shard
+    processes — the satellite fix's proof that sim-driven cells now
+    exercise the true multi-shard fan-out."""
+    import shutil
+
+    from split_learning_tpu.config import from_dict
+    from split_learning_tpu.runtime.bus import (
+        ShardedTcpTransport, collect_broker_stats,
+    )
+    from split_learning_tpu.runtime.log import Logger
+    from split_learning_tpu.runtime.server import ProtocolServer
+    from split_learning_tpu.runtime.simfleet import (
+        SyntheticFleet, hetero_fleet,
+    )
+
+    logdir = "/tmp/slt_bench_broker_sim"
+    shutil.rmtree(logdir, ignore_errors=True)
+    n1 = 200
+    cfg = from_dict({
+        "model": "KWT", "dataset": "SPEECHCOMMANDS",
+        "clients": [n1, 1], "global-rounds": 2,
+        "synthetic-size": 48, "val-max-batches": 1,
+        "val-batch-size": 16,
+        "model-kwargs": {"embed_dim": 16, "num_heads": 2,
+                         "mlp_dim": 32},
+        "log-path": logdir,
+        "learning": {"batch-size": 4},
+        "topology": {"cut-layers": [2]},
+        "transport": {"kind": "tcp", "host": "127.0.0.1",
+                      "port": base, "async_send": False},
+        "broker": {"shards": shards},
+        "checkpoint": {"save": False, "validate": False,
+                       "directory": f"{logdir}/ckpt"},
+        "observability": {"heartbeat-interval": 2.0,
+                          "liveness-timeout": 60.0},
+    })
+    server = ProtocolServer(
+        cfg, transport=ShardedTcpTransport("127.0.0.1", base, shards),
+        logger=Logger.for_run(cfg, "server", console=False),
+        client_timeout=300.0)
+    specs = hetero_fleet(n1, 1, compute_speed=100.0, samples=32,
+                         seed=0)
+    fleet = SyntheticFleet(
+        ShardedTcpTransport("127.0.0.1", base, shards), specs,
+        heartbeat_interval=2.0, time_scale=0.02, drivers=4,
+        bus_factory=lambda: ShardedTcpTransport("127.0.0.1", base,
+                                                shards)).start()
+    t0 = time.perf_counter()
+    try:
+        res = server.serve()
+    finally:
+        fleet.stop()
+    stats = collect_broker_stats("127.0.0.1", base, shards)
+    live = [s for s in stats if "error" not in s]
+    return {
+        "clients": n1, "shards": shards,
+        "wall_s": round(time.perf_counter() - t0, 3),
+        "round_walls_s": [round(r.wall_s, 3) for r in res.history],
+        "rounds_ok": all(r.ok for r in res.history),
+        "sim_errors": fleet.errors[:3],
+        "shards_up": len(live),
+        "per_shard_published": [s.get("published") for s in stats],
+        "all_shards_carried_traffic": all(
+            s.get("published", 0) > 0 for s in live),
+    }
+
+
+def _sec_broker_shard(ctx: dict) -> dict:
+    """Sharded event-loop broker plane (ROADMAP item 1's last 1M-tier
+    wall: "digest-plane sharding of the rpc broker itself").  Three
+    legs, all through REAL shard subprocesses:
+
+    1. **Ingest scaling** — worker processes publish 256 B frames
+       (fenced per connection) through 1 vs 4 shard processes; stable
+       key ``broker_shard_scaling`` = aggregate msgs/s at 4 shards /
+       1 shard, pinned >= 2.0 (the GIL-serialized single broker is
+       the baseline the shard plane must beat multiplicatively).
+    2. **Synthetic fleet round wall** — 10k and 100k clients: START
+       fan-out to per-client reply queues (parked continuations on
+       the owning shards), per-client blocking GET + UPDATE into
+       spread group queues, full drain.  Stable key
+       ``broker_round_wall_ratio_100k`` = 4-shard / 1-shard round
+       wall at 100k, pinned <= 0.7; flatness = per-client wall at
+       100k vs 10k on the 4-shard plane (<= 2x).
+    3. **Sim-fleet leg** — 200 shard-aware synthetic clients
+       (multi-driver SyntheticFleet) against the real ProtocolServer
+       over the 4-shard plane: rounds must complete and every shard
+       must carry traffic (the sim-fix satellite's proof).
+    """
+    out: dict = {}
+    workers = int(os.environ.get("SLT_BENCH_BROKER_WORKERS", 6))
+    msgs = int(os.environ.get("SLT_BENCH_BROKER_MSGS", 30_000))
+    n100k = int(os.environ.get("SLT_BENCH_BROKER_CLIENTS", 100_000))
+    n10k = max(1000, n100k // 10)
+
+    # -- leg 1: ingest throughput scaling ------------------------------------
+    thr1 = _broker_ingest_leg(1, workers, msgs)
+    thr4 = _broker_ingest_leg(4, workers, msgs)
+    out["ingest"] = {"workers": workers, "msgs_per_worker": msgs,
+                     "msgs_per_s_1shard": round(thr1, 1),
+                     "msgs_per_s_4shard": round(thr4, 1)}
+    out["broker_shard_scaling"] = round(thr4 / thr1, 3)
+    out["scaling_within_budget"] = out["broker_shard_scaling"] >= 2.0
+
+    # -- leg 2: fleet round wall at 10k / 100k -------------------------------
+    walls: dict = {}
+    for shards in (1, 4):
+        base, procs = _spawn_broker_plane(shards)
+        try:
+            walls[(shards, n10k)] = _broker_fleet_round(
+                base, shards, n10k)
+            walls[(shards, n100k)] = _broker_fleet_round(
+                base, shards, n100k)
+        finally:
+            _teardown_plane(procs)
+    out["round"] = {
+        f"{s}shard_{n}": round(w, 3)
+        for (s, n), w in sorted(walls.items())}
+    w1, w4 = walls[(1, n100k)], walls[(4, n100k)]
+    out["broker_round_wall_ratio_100k"] = round(w4 / w1, 4)
+    out["round_ratio_within_budget"] = w4 / w1 <= 0.7
+    per10 = walls[(4, n10k)] / n10k
+    per100 = w4 / n100k
+    out["broker_round_wall_per_client_ms_100k"] = round(per100 * 1e3,
+                                                        5)
+    out["round_wall_flat_ratio"] = round(per100 / per10, 3)
+    out["round_flat_within_budget"] = per100 / per10 <= 2.0
+
+    # -- leg 3: shard-aware synthetic fleet, real server ---------------------
+    base, procs = _spawn_broker_plane(4)
+    try:
+        out["sim"] = _broker_sim_leg(base, 4)
+    finally:
+        _teardown_plane(procs)
+    log(f"[bench] broker_shard: scaling={out['broker_shard_scaling']} "
+        f"round100k {w1:.2f}s -> {w4:.2f}s "
+        f"(ratio {out['broker_round_wall_ratio_100k']}) "
+        f"flat={out['round_wall_flat_ratio']} "
+        f"sim_ok={out['sim'].get('rounds_ok')}")
+    return out
+
+
 def _sec_test_ok(ctx: dict) -> dict:
     """Hidden test section: trivially succeeds (watchdog CI coverage)."""
     return {"ok": True}
@@ -2313,6 +2752,7 @@ SECTIONS = {
     "update_overlap": _sec_update_overlap,
     "sched_fleet": _sec_sched_fleet,
     "fleet_digest": _sec_fleet_digest,
+    "broker_shard": _sec_broker_shard,
     "resnet50_cifar100_3way_cut_3_6": _sec_resnet,
     "vit_s16_cifar10_cut_block6": _sec_vit,
     "tinyllama_tinystories_4stage": _sec_llama,
@@ -2337,6 +2777,7 @@ SECTION_PLAN = [
     ("update_overlap", 900),
     ("sched_fleet", 1200),
     ("fleet_digest", 600),
+    ("broker_shard", 1200),
     ("resnet50_cifar100_3way_cut_3_6", 900),
     ("vit_s16_cifar10_cut_block6", 1500),
     ("tinyllama_tinystories_4stage", 3000),

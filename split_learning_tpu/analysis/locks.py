@@ -37,6 +37,7 @@ LOCK_ORDER = (
     "prefetch",         # _Prefetcher._cond
     "reliable",         # ReliableTransport._lock
     "chaos",            # ChaosTransport._lock
+    "tcp.shards",       # ShardedTcpTransport._shard_lock (shard map)
     "tcp.io",           # TcpTransport._lock (socket serialization)
     "inproc",           # InProcTransport._lock/_cond (base bus)
     "transport.count",  # Transport._count_lock (leaf byte counters)

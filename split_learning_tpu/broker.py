@@ -2,22 +2,126 @@
 
 The reference requires an external RabbitMQ (Erlang) broker
 (``/root/reference/README.md:43-69``); this hosts the framework's own
-TCP broker instead.  Prefers the native C++ broker when it can be built
-(``split_learning_tpu/native``), falling back to the threaded Python one.
+TCP broker instead — a selectors event loop holding O(1) threads per
+shard however many connections attach (``runtime/bus.py Broker``).
+Prefers the native C++ broker when it can be built
+(``split_learning_tpu/native``), falling back to the event-loop Python
+one.
+
+``--shards N`` hosts the SHARDED broker plane (``broker.shards``):
+this process supervises N shard subprocesses on consecutive ports
+``--port .. --port+N-1``, each an independent single-threaded event
+loop.  Clients map every queue to its owning shard with the shared
+deterministic ``shard_for`` hash, so the plane's aggregate bandwidth
+scales with N.  The supervisor forwards SIGTERM/SIGINT and exits when
+told to; it deliberately does NOT auto-restart a dead shard — shard
+death is a first-class fault the transport layer (per-shard reconnect
+backoff + at-least-once redelivery) is paid to survive, and the chaos
+suite kills shards to prove it.  Restart one with the printed per-shard
+command line.
 """
 
 from __future__ import annotations
 
 import argparse
 import signal
+import subprocess
 import sys
 import time
+
+
+def spawn_shard(host: str, port: int, *, shard_index: int = 0,
+                max_frame_gb: float | None = None,
+                python_only: bool = False) -> subprocess.Popen:
+    """Spawn ONE broker shard subprocess bound to ``host:port``.
+    Shared by the ``--shards`` supervisor, the broker_shard bench cell
+    and the ``--broker-shard`` chaos cell (which SIGKILLs and respawns
+    shards through exactly this path)."""
+    cmd = [sys.executable, "-m", "split_learning_tpu.broker",
+           "--host", host, "--port", str(port),
+           "--shard-id", f"shard_{shard_index}@{host}:{port}"]
+    if max_frame_gb is not None:
+        cmd += ["--max-frame-gb", str(max_frame_gb)]
+    if python_only:
+        cmd.append("--python")
+    return subprocess.Popen(cmd)
+
+
+def _supervise(args) -> int:
+    """Host N shard subprocesses; a shard dying on its own is
+    reported once and remembered as a non-zero exit code, while the
+    surviving shards keep running (partial-plane operation) until an
+    operator signal — or the last shard's death — tears the plane
+    down.
+
+    Shards are always the PYTHON event-loop broker: the O(1)-thread
+    loop and the ``__broker__.stats`` self-telemetry frame are what
+    the sharded plane is made of — the native C++ broker speaks the
+    frame protocol but answers no stats, which reads as a dead shard
+    on every sl_top//fleet sweep."""
+    procs = [spawn_shard(args.host, args.port + i, shard_index=i,
+                         max_frame_gb=args.max_frame_gb,
+                         python_only=True)
+             for i in range(args.shards)]
+    for i in range(args.shards):
+        print(f"broker shard {i}/{args.shards} on "
+              f"{args.host}:{args.port + i}")
+    stop = {"sig": None}
+
+    def on_sig(signum, _frame):
+        stop["sig"] = signum
+
+    signal.signal(signal.SIGTERM, on_sig)
+    signal.signal(signal.SIGINT, on_sig)
+    rc = 0
+    dead: set = set()
+    try:
+        while stop["sig"] is None:
+            for i, p in enumerate(procs):
+                code = p.poll()
+                if code is not None and i not in dead:
+                    # reported ONCE per shard; the supervisor keeps
+                    # the surviving shards up (partial-plane operation
+                    # is the resilience story) and remembers the
+                    # non-zero exit for when it is torn down
+                    dead.add(i)
+                    print(f"broker shard {i} exited rc={code} "
+                          f"(restart: {sys.executable} -m "
+                          f"split_learning_tpu.broker --host "
+                          f"{args.host} --port {args.port + i})",
+                          file=sys.stderr)
+                    rc = 1
+            if len(dead) == args.shards:
+                print("all broker shards exited; stopping",
+                      file=sys.stderr)
+                break
+            time.sleep(0.5)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.terminate()
+        deadline = time.monotonic() + 5.0
+        for p in procs:
+            try:
+                p.wait(timeout=max(0.1, deadline - time.monotonic()))
+            except subprocess.TimeoutExpired:
+                p.kill()
+    return rc
 
 
 def main(argv=None):
     ap = argparse.ArgumentParser(description="Split-learning TCP broker.")
     ap.add_argument("--host", default="127.0.0.1")
     ap.add_argument("--port", type=int, default=5672)
+    ap.add_argument("--shards", type=int, default=1,
+                    help="host a sharded broker plane: N shard "
+                         "subprocesses on ports --port..--port+N-1 "
+                         "(broker.shards in config.yaml); every queue "
+                         "is owned by exactly one shard via the "
+                         "deterministic shard_for hash")
+    ap.add_argument("--shard-id", default=None,
+                    help="stats-frame identity of this shard "
+                         "(set by the --shards supervisor)")
     ap.add_argument("--python", action="store_true",
                     help="force the pure-Python broker")
     ap.add_argument("--max-frame-gb", type=float, default=None,
@@ -30,6 +134,9 @@ def main(argv=None):
                          "it on both sides or oversized publishes "
                          "die at the broker instead of the client")
     args = ap.parse_args(argv)
+
+    if args.shards > 1:
+        return _supervise(args)
 
     if args.max_frame_gb is not None:
         from split_learning_tpu.runtime import bus, protocol
@@ -56,8 +163,9 @@ def main(argv=None):
             print(f"native broker unavailable ({e}); using Python broker")
     if broker is None:
         from split_learning_tpu.runtime.bus import Broker
-        broker = Broker(args.host, args.port)
-        print(f"python broker on {args.host}:{broker.port}")
+        broker = Broker(args.host, args.port, shard_id=args.shard_id)
+        print(f"python broker on {args.host}:{broker.port} "
+              f"(event loop, 1 thread)", flush=True)
     # SIGTERM (kill, process managers) must tear the native child down
     # with us — a bare kill otherwise orphans it holding the port
     signal.signal(signal.SIGTERM, lambda *_: sys.exit(0))
@@ -71,4 +179,4 @@ def main(argv=None):
 
 
 if __name__ == "__main__":
-    main()
+    sys.exit(main() or 0)

@@ -462,6 +462,36 @@ class TransportConfig:
 
 
 @dataclasses.dataclass(frozen=True)
+class BrokerConfig:
+    """Broker-plane shape (``runtime/bus.py``).
+
+    ``shards`` > 1 splits the TCP broker into N independent shard
+    processes on consecutive ports (``transport.port`` ..
+    ``transport.port + shards - 1``).  Every participant maps each
+    queue to its owning shard with the same deterministic
+    ``shard_for`` hash (family-aware: a queue family's instances
+    round-robin across shards, one queue never spans two), so the
+    fleet's aggregate broker bandwidth scales with the shard count
+    instead of serializing through one process.  A dead shard stalls
+    only its own queues; per-shard reconnect backoff plus the
+    reliable layer's redelivery recover it across a restart.  1
+    (default) is the classic single broker — exactly the pre-sharding
+    deployment.  Ignored by ``transport.kind: inproc`` (no broker
+    process exists to shard)."""
+    shards: int = 1
+    #: seconds between the server's broker-plane stats sweeps (the
+    #: /fleet "brokers" block + broker_* gauges); 0 disables polling
+    stats_interval: float = 5.0
+
+    def validate(self):
+        _check(self.shards >= 1, "broker.shards must be >= 1")
+        _check(self.shards <= 256,
+               f"broker.shards must be <= 256, got {self.shards!r}")
+        _check(self.stats_interval >= 0,
+               "broker.stats-interval must be >= 0")
+
+
+@dataclasses.dataclass(frozen=True)
 class ChaosConfig:
     """Deterministic fault injection (``runtime/chaos.py``).
 
@@ -688,6 +718,13 @@ class SchedulerConfig:
     replan: bool = True
     replan_damping: float = 0.15
     replan_cooldown: int = 2
+    # aggregator fan-in retuning (aggregation.fan-in >= 2 only): at
+    # round boundaries the scheduler rescans the fan-in candidates
+    # against the MEASURED per-contribution fold wall the kind=agg_node
+    # heartbeats report, adopting a new tree width when the predicted
+    # critical-path fold wall improves by replan-damping (same cooldown
+    # as cut re-planning; journaled kind=sched action "retune")
+    retune_fanin: bool = True
     # mid-round barrier policy: a NOTIFY/UPDATE barrier may drop a
     # health-state-straggler client after waiting this many seconds
     # (0 disables mid-round drops; lost clients are always droppable
@@ -751,6 +788,7 @@ class Config:
     aggregation: AggregationConfig = AggregationConfig()
     checkpoint: CheckpointConfig = CheckpointConfig()
     transport: TransportConfig = TransportConfig()
+    broker: BrokerConfig = BrokerConfig()
     chaos: ChaosConfig = ChaosConfig()
     observability: ObservabilityConfig = ObservabilityConfig()
     perf: PerfConfig = PerfConfig()
@@ -773,8 +811,9 @@ class Config:
                f"compute-dtype must be bfloat16|float32, "
                f"got {self.compute_dtype!r}")
         for sub in (self.learning, self.distribution, self.topology,
-                    self.aggregation, self.transport, self.chaos,
-                    self.observability, self.perf, self.scheduler):
+                    self.aggregation, self.transport, self.broker,
+                    self.chaos, self.observability, self.perf,
+                    self.scheduler):
             sub.validate()
         if self.scheduler.enabled:
             # the scheduler's only senses are the fleet-telemetry
@@ -834,6 +873,7 @@ _SECTION_TYPES = {
     "aggregation": AggregationConfig,
     "checkpoint": CheckpointConfig,
     "transport": TransportConfig,
+    "broker": BrokerConfig,
     "chaos": ChaosConfig,
     "observability": ObservabilityConfig,
     "perf": PerfConfig,

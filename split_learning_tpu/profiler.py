@@ -215,7 +215,8 @@ def main(argv=None):
     if args.probe_network:
         from split_learning_tpu.runtime.bus import make_transport
         bus = make_transport(cfg.transport.kind, cfg.transport.host,
-                             cfg.transport.port)
+                             cfg.transport.port,
+                             shards=cfg.broker.shards)
         prof["network"] = profile_network(bus)
         bus.close()
     write_profile(args.output, prof)

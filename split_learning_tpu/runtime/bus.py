@@ -9,9 +9,20 @@ named-queue semantics live behind one small interface with two backends:
   training cell (server + N clients) runs in one process; this is the
   TPU-native default (the data plane then usually bypasses the bus
   entirely via the compiled mesh pipeline).
-* :class:`TcpTransport` + :class:`Broker` — a ~150-line length-prefixed
-  TCP broker giving true multi-process / multi-host parity with the
+* :class:`TcpTransport` + :class:`Broker` — a length-prefixed TCP
+  broker giving true multi-process / multi-host parity with the
   reference's deployment shape, without an external Erlang dependency.
+  The broker is a **selectors event loop**: one thread per shard
+  whatever the connection count, blocked GETs parked as timer-backed
+  continuations, buffered partial reads/writes with per-connection
+  send-queue backpressure caps.
+* :func:`shard_for` + :class:`ShardedTcpTransport` — the sharded
+  broker plane (``broker.shards``): N independent shard processes on
+  consecutive ports, a deterministic family-aware queue→shard map
+  shared by every participant, lazy per-shard connections with
+  per-shard reconnect/backoff isolation.  The fleet's aggregate broker
+  bandwidth scales with the number of shards instead of serializing
+  through one process's GIL.
 
 Blocking ``get`` uses real waits (condition variables / socket blocking),
 not the reference's sleep-polling.
@@ -45,6 +56,11 @@ from __future__ import annotations
 
 import collections
 import fnmatch
+import heapq
+import json
+import os
+import re
+import selectors
 import socket
 import struct
 import threading
@@ -206,12 +222,82 @@ def _recv_frame(sock: socket.socket) -> tuple[bytes, bytes, bytes]:
     return op, name, _recv_exact(sock, plen)
 
 
+#: control queue: a GET on this name returns the shard's stats frame
+#: (JSON) immediately instead of popping a message — broker
+#: self-telemetry without a new wire op, so every existing client
+#: (and ``nc``-grade tooling) can scrape a shard
+BROKER_STATS_QUEUE = "__broker__.stats"
+
+#: read chunk per readable event
+_RECV_CHUNK = 1 << 18
+
+
+class _ParkedGet:
+    """One blocked GET continuation, parked on the event loop (a
+    long-poll timer, not a blocked thread)."""
+
+    __slots__ = ("conn", "queue", "deadline", "done")
+
+    def __init__(self, conn: "_BrokerConn", queue: str,
+                 deadline: float | None):
+        self.conn = conn
+        self.queue = queue
+        self.deadline = deadline
+        self.done = False
+
+
+class _BrokerConn:
+    """Per-connection state: incremental frame parser + buffered
+    writer.  Never blocks the loop — partial reads accumulate in
+    ``rbuf``, partial writes drain from ``wbuf`` on writable events."""
+
+    __slots__ = ("sock", "rbuf", "wbuf", "woff", "wbytes", "paused",
+                 "parked", "closed")
+
+    def __init__(self, sock: socket.socket):
+        self.sock = sock
+        self.rbuf = bytearray()
+        self.wbuf: collections.deque = collections.deque()
+        self.woff = 0          # bytes of wbuf[0] already sent
+        self.wbytes = 0        # total bytes buffered
+        self.paused = False    # read interest dropped (backpressure)
+        self.parked: list[_ParkedGet] = []
+        self.closed = False
+
+
 class Broker:
-    """Threaded TCP message broker (one thread per connection)."""
+    """Event-loop TCP message broker: ONE ``selectors``-driven thread
+    per shard regardless of connection count (the thread-per-connection
+    ancestor cost two threads per client, which is what capped the
+    single broker process at a few thousand connections).
+
+    * blocked GETs are parked continuations with a deadline on the
+      loop's timer heap — a publish to the queue completes the oldest
+      parked GET directly, a deadline sends the timeout reply;
+    * reads and writes are non-blocking and buffered per connection; a
+      connection whose outbound buffer exceeds :data:`SEND_QUEUE_CAP`
+      stops being READ until it drains below the resume mark
+      (backpressure instead of unbounded broker-side buffering);
+    * the wire format, the ``MAX_FRAME_BYTES`` sanity cap and the
+      same-port rebind-after-restart semantics are bit-compatible with
+      the threaded broker, so :class:`TcpTransport`,
+      :class:`ReliableTransport` and the chaos stack compose unchanged;
+    * a GET on :data:`BROKER_STATS_QUEUE` answers immediately with the
+      shard's JSON stats frame (conns, queues, depth high-water, bytes
+      in/out, parked gets) — the self-telemetry ``sl_top`` renders as
+      ROLE=broker rows.
+    """
+
+    #: outbound bytes buffered for one connection before the loop stops
+    #: reading from it; resumes below the low-water mark.  Applies per
+    #: connection, so one slow consumer cannot balloon the broker RSS
+    #: while healthy peers stream on.
+    SEND_QUEUE_CAP = 64 << 20
+    SEND_QUEUE_RESUME = 8 << 20
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
-                 bind_timeout: float = 10.0):
-        self._store = InProcTransport()
+                 bind_timeout: float = 10.0,
+                 shard_id: str | None = None):
         # a RESTARTED broker re-binds the same port while the previous
         # incarnation's connections may still be draining (FIN_WAIT):
         # retry briefly instead of failing the recovery path
@@ -225,78 +311,340 @@ class Broker:
                     raise
                 time.sleep(0.1)
         self.host, self.port = self._sock.getsockname()[:2]
-        self._threads: list[threading.Thread] = []
-        self._conns: list[socket.socket] = []
+        self.shard_id = shard_id or f"broker@{self.host}:{self.port}"
+        self._sock.setblocking(False)
+        self._sel = selectors.DefaultSelector()
+        self._sel.register(self._sock, selectors.EVENT_READ, None)
+        # wake pipe: close() (any thread) writes one byte so the loop
+        # notices shutdown without waiting out its select timeout
+        self._wake_r, self._wake_w = socket.socketpair()
+        self._wake_r.setblocking(False)
+        self._sel.register(self._wake_r, selectors.EVENT_READ, "wake")
+        self._queues: dict[str, collections.deque] = {}
+        self._parked: dict[str, collections.deque] = {}
+        self._timers: list = []     # heap of (deadline, seq, _ParkedGet)
+        self._tseq = 0
+        self._conns: dict[int, _BrokerConn] = {}
+        self._t0 = time.monotonic()
+        self._stats = collections.Counter()
+        self._depth = 0             # total stored messages
+        self._depth_hwm = 0
         self._running = True
-        self._accept_thread = threading.Thread(target=self._accept_loop,
-                                               daemon=True)
-        self._accept_thread.start()
+        self._closed = threading.Event()
+        from split_learning_tpu.runtime.trace import default_histograms
+        self._hists = default_histograms
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True,
+            name=f"broker-{self.port}")
+        self._thread.start()
 
-    def _accept_loop(self):
-        while self._running:
-            try:
-                conn, _ = self._sock.accept()
-            except OSError:
-                return
-            t = threading.Thread(target=self._serve, args=(conn,),
-                                 daemon=True)
-            # register BEFORE start: _serve's cleanup removes these
-            # entries, and an immediately-closing connection would
-            # otherwise race the removal and leak the entry forever
-            self._threads.append(t)
-            self._conns.append(conn)
-            t.start()
+    # -- event loop ----------------------------------------------------------
 
-    def _serve(self, conn: socket.socket):
+    def _loop(self) -> None:
         try:
-            while True:
-                op, name, payload = _recv_frame(conn)
-                queue = name.decode()
-                if op == _OP_PUB:
-                    self._store.publish(queue, payload)
-                elif op == _OP_GET:
-                    (ms,) = struct.unpack(">Q", payload)
-                    timeout = None if ms == 0 else ms / 1000.0
-                    msg = self._store.get(queue, timeout)
-                    if msg is None:
-                        conn.sendall(_OP_REPLY + struct.pack(">I", 0)
-                                     + struct.pack(">Q", _TIMEOUT_SENTINEL))
+            while self._running:
+                timeout = 1.0
+                if self._timers:
+                    timeout = max(0.0, min(
+                        timeout, self._timers[0][0] - time.monotonic()))
+                for key, ready in self._sel.select(timeout):
+                    if key.data is None:
+                        self._accept()
+                    elif key.data == "wake":
+                        try:
+                            self._wake_r.recv(64)
+                        except OSError:
+                            pass
                     else:
-                        _send_frame(conn, _OP_REPLY, b"", msg)
-                elif op == _OP_PURGE:
-                    self._store.purge(None if not payload
-                                      else payload.decode().split(","))
-        except (QueueClosed, ConnectionError, OSError):
-            return   # broker shutdown or client gone: quiet exit
+                        # the READY mask, not key.events (the
+                        # registered interest): a read-ready wakeup
+                        # must not burn a send syscall and vice versa
+                        self._service(key.data, ready)
+                self._fire_timers()
         finally:
-            # release the fd and drop the bookkeeping entry: under
-            # reconnect churn (auto-reconnecting TcpTransports) a
-            # long-running broker would otherwise accumulate dead
-            # CLOSE_WAIT sockets until accept() hits EMFILE
-            try:
-                conn.close()
-            except OSError:
-                pass
-            try:
-                self._conns.remove(conn)
-            except ValueError:
-                pass
-            try:
-                self._threads.remove(threading.current_thread())
-            except ValueError:
-                pass
+            self._teardown()
 
-    def close(self):
-        self._running = False
-        self._store.close()
-        # shutdown() BEFORE close(), on the listener and on every
-        # accepted connection: a thread blocked in accept()/recv() holds
-        # a python-level io-ref that DEFERS the real fd close, so a bare
-        # close() leaves clients hanging (no EOF ever sent) and keeps
-        # the port busy — a same-port broker RESTART (the recovery path
-        # TcpTransport reconnects to) would then fail with EADDRINUSE
-        # indefinitely.  shutdown wakes the blocked threads so the fds
-        # actually release.
+    def _accept(self) -> None:
+        while True:
+            try:
+                sock, _ = self._sock.accept()
+            except (BlockingIOError, OSError):
+                return
+            sock.setblocking(False)
+            conn = _BrokerConn(sock)
+            self._conns[sock.fileno()] = conn
+            self._sel.register(sock, selectors.EVENT_READ, conn)
+
+    def _interest(self, conn: _BrokerConn) -> None:
+        events = 0
+        if not conn.paused:
+            events |= selectors.EVENT_READ
+        if conn.wbuf:
+            events |= selectors.EVENT_WRITE
+        try:
+            if events:
+                self._sel.modify(conn.sock, events, conn)
+            else:
+                # nothing to do for this conn right now: stay
+                # registered read-only so a peer close still surfaces
+                self._sel.modify(conn.sock, selectors.EVENT_READ, conn)
+                conn.paused = False
+        except (KeyError, ValueError, OSError):
+            pass
+
+    def _service(self, conn: _BrokerConn, events: int) -> None:
+        if conn.closed:
+            return
+        if events & selectors.EVENT_WRITE:
+            self._flush(conn)
+        if conn.closed or conn.paused:
+            return
+        if events & selectors.EVENT_READ:
+            try:
+                chunk = conn.sock.recv(_RECV_CHUNK)
+            except (BlockingIOError, InterruptedError):
+                return
+            except OSError:
+                self._drop(conn)
+                return
+            if not chunk:
+                self._drop(conn)
+                return
+            self._stats["bytes_in"] += len(chunk)
+            conn.rbuf += chunk
+            self._parse(conn)
+
+    def _parse(self, conn: _BrokerConn) -> None:
+        buf = conn.rbuf
+        off = 0
+        while not conn.closed:
+            if len(buf) - off < 5:
+                break
+            op = buf[off:off + 1]
+            (nlen,) = struct.unpack_from(">I", buf, off + 1)
+            if nlen > MAX_NAME_BYTES:
+                self._drop(conn)
+                return
+            if len(buf) - off < 5 + nlen + 8:
+                break
+            name = bytes(buf[off + 5:off + 5 + nlen])
+            (plen,) = struct.unpack_from(">Q", buf, off + 5 + nlen)
+            if plen > MAX_FRAME_BYTES:
+                # corrupt length prefix: fail the connection, never
+                # the multi-terabyte allocation
+                self._drop(conn)
+                return
+            if len(buf) - off < 13 + nlen + plen:
+                break
+            payload = bytes(buf[off + 13 + nlen:off + 13 + nlen + plen])
+            off += 13 + nlen + plen
+            self._handle(conn, op, name, payload)
+        if off:
+            del conn.rbuf[:off]
+
+    def _handle(self, conn: _BrokerConn, op: bytes, name: bytes,
+                payload: bytes) -> None:
+        try:
+            queue = name.decode()
+        except UnicodeDecodeError:
+            self._drop(conn)
+            return
+        if op == _OP_PUB:
+            self._stats["published"] += 1
+            self._publish(queue, payload)
+        elif op == _OP_GET:
+            if len(payload) != 8:
+                self._drop(conn)
+                return
+            (ms,) = struct.unpack(">Q", payload)
+            self._get(conn, queue, ms)
+        elif op == _OP_PURGE:
+            self._stats["purges"] += 1
+            self._purge(None if not payload
+                        else payload.decode().split(","))
+        else:
+            self._drop(conn)
+
+    # -- queue machinery -----------------------------------------------------
+
+    def _publish(self, queue: str, payload: bytes) -> None:
+        parked = self._parked.get(queue)
+        while parked:
+            pg = parked.popleft()
+            if not parked:
+                del self._parked[queue]
+            if pg.done or pg.conn.closed:
+                continue
+            pg.done = True
+            # a parked consumer waited zero broker-residency time;
+            # observing 0 keeps the queue_wait histogram's population
+            # covering EVERY delivery (the threaded broker's store
+            # observed enqueue->dequeue for all of them), so the
+            # percentiles don't bias toward the slow stored path
+            self._hists.observe("queue_wait", 0.0)
+            self._reply(pg.conn, payload)
+            return
+        q = self._queues.get(queue)
+        if q is None:
+            q = self._queues[queue] = collections.deque()
+        q.append((time.perf_counter(), payload))
+        self._depth += 1
+        if self._depth > self._depth_hwm:
+            self._depth_hwm = self._depth
+
+    def _get(self, conn: _BrokerConn, queue: str, ms: int) -> None:
+        if queue == BROKER_STATS_QUEUE:
+            self._reply(conn, json.dumps(self.stats()).encode())
+            return
+        q = self._queues.get(queue)
+        if q:
+            t_enq, payload = q.popleft()
+            if not q:
+                del self._queues[queue]
+            self._depth -= 1
+            # histogram has its own lock; observing here is the same
+            # broker-residency clock InProcTransport kept
+            self._hists.observe("queue_wait",
+                                time.perf_counter() - t_enq)
+            self._reply(conn, payload)
+            return
+        deadline = (None if ms == 0
+                    else time.monotonic() + ms / 1000.0)
+        pg = _ParkedGet(conn, queue, deadline)
+        self._parked.setdefault(queue, collections.deque()).append(pg)
+        conn.parked.append(pg)
+        if len(conn.parked) > 32:
+            # the list exists so _drop can cancel a dying connection's
+            # continuations; compact completed ones as we go or a
+            # long-poll loop grows it one entry per GET forever
+            conn.parked = [p for p in conn.parked if not p.done]
+        if deadline is not None:
+            self._tseq += 1
+            heapq.heappush(self._timers, (deadline, self._tseq, pg))
+
+    def _fire_timers(self) -> None:
+        now = time.monotonic()
+        while self._timers and self._timers[0][0] <= now:
+            _, _, pg = heapq.heappop(self._timers)
+            if pg.done or pg.conn.closed:
+                continue
+            pg.done = True
+            self._stats["get_timeouts"] += 1
+            self._enqueue(pg.conn, _OP_REPLY + struct.pack(">I", 0)
+                          + struct.pack(">Q", _TIMEOUT_SENTINEL))
+            # trim satisfied/expired heads so a poll-heavy queue's
+            # parked deque cannot grow with dead continuations
+            dq = self._parked.get(pg.queue)
+            while dq and (dq[0].done or dq[0].conn.closed):
+                dq.popleft()
+            if dq is not None and not dq:
+                self._parked.pop(pg.queue, None)
+
+    def _purge(self, queues: list[str] | None) -> None:
+        if queues is None:
+            self._queues.clear()
+            self._depth = 0
+        else:
+            for q in queues:
+                gone = self._queues.pop(q, None)
+                if gone:
+                    self._depth -= len(gone)
+
+    # -- buffered writes -----------------------------------------------------
+
+    def _reply(self, conn: _BrokerConn, payload: bytes) -> None:
+        self._stats["delivered"] += 1
+        self._enqueue(conn, _OP_REPLY + struct.pack(">I", 0)
+                      + struct.pack(">Q", len(payload)) + payload)
+
+    def _enqueue(self, conn: _BrokerConn, frame: bytes) -> None:
+        if conn.closed:
+            return
+        conn.wbuf.append(frame)
+        conn.wbytes += len(frame)
+        self._flush(conn)
+        if conn.closed:
+            return
+        if conn.wbytes > self.SEND_QUEUE_CAP and not conn.paused:
+            # backpressure: stop READING from a connection we cannot
+            # drain — its GETs/publishes wait in ITS kernel buffers,
+            # not in broker heap
+            conn.paused = True
+            self._stats["backpressure_pauses"] += 1
+        self._interest(conn)
+
+    def _flush(self, conn: _BrokerConn) -> None:
+        try:
+            while conn.wbuf:
+                head = conn.wbuf[0]
+                sent = conn.sock.send(
+                    memoryview(head)[conn.woff:])
+                if sent <= 0:
+                    break
+                self._stats["bytes_out"] += sent
+                conn.woff += sent
+                conn.wbytes -= sent
+                if conn.woff >= len(head):
+                    conn.wbuf.popleft()
+                    conn.woff = 0
+        except (BlockingIOError, InterruptedError):
+            pass
+        except OSError:
+            self._drop(conn)
+            return
+        if conn.paused and conn.wbytes < self.SEND_QUEUE_RESUME:
+            conn.paused = False
+        self._interest(conn)
+
+    def _drop(self, conn: _BrokerConn) -> None:
+        if conn.closed:
+            return
+        conn.closed = True
+        for pg in conn.parked:
+            pg.done = True
+        conn.parked.clear()
+        self._conns.pop(conn.sock.fileno(), None)
+        try:
+            self._sel.unregister(conn.sock)
+        except (KeyError, ValueError, OSError):
+            pass
+        try:
+            conn.sock.close()
+        except OSError:
+            pass
+
+    # -- stats + lifecycle ---------------------------------------------------
+
+    def stats(self) -> dict:
+        """The shard's self-telemetry frame (also served on
+        :data:`BROKER_STATS_QUEUE`).  Loop-thread state read without a
+        lock: every field is a single int/str read, at worst one event
+        stale — fine for telemetry."""
+        parked = sum(sum(1 for pg in d if not pg.done)
+                     for d in self._parked.values())
+        return {
+            "shard": self.shard_id, "host": self.host,
+            "port": self.port, "pid": os.getpid(),
+            "uptime_s": round(time.monotonic() - self._t0, 3),
+            "conns": len(self._conns),
+            "queues": len(self._queues),
+            "depth": self._depth, "depth_hwm": self._depth_hwm,
+            "parked_gets": parked,
+            "threads": 1,
+            "bytes_in": self._stats["bytes_in"],
+            "bytes_out": self._stats["bytes_out"],
+            "published": self._stats["published"],
+            "delivered": self._stats["delivered"],
+            "get_timeouts": self._stats["get_timeouts"],
+            "purges": self._stats["purges"],
+            "backpressure_pauses": self._stats["backpressure_pauses"],
+        }
+
+    def _teardown(self) -> None:
+        # shutdown() BEFORE close(), listener and every connection: a
+        # blocked client recv must see EOF, and the port must actually
+        # release so a same-port broker RESTART (the recovery path
+        # TcpTransport reconnects to) cannot hit EADDRINUSE forever
         try:
             self._sock.shutdown(socket.SHUT_RDWR)
         except OSError:
@@ -305,18 +653,208 @@ class Broker:
             self._sock.close()
         except OSError:
             pass
-        for conn in list(self._conns):
+        for conn in list(self._conns.values()):
             try:
-                conn.shutdown(socket.SHUT_RDWR)
+                conn.sock.shutdown(socket.SHUT_RDWR)
             except OSError:
                 pass
             try:
-                conn.close()
+                conn.sock.close()
             except OSError:
                 pass
-        self._accept_thread.join(timeout=5.0)
-        for t in list(self._threads):
-            t.join(timeout=5.0)
+        self._conns.clear()
+        try:
+            self._sel.close()
+        except OSError:
+            pass
+        for s in (self._wake_r, self._wake_w):
+            try:
+                s.close()
+            except OSError:
+                pass
+        self._closed.set()
+
+    def close(self):
+        self._running = False
+        try:
+            self._wake_w.send(b"x")
+        except OSError:
+            pass
+        if threading.current_thread() is not self._thread:
+            self._thread.join(timeout=10.0)
+        self._closed.wait(timeout=10.0)
+
+
+# --------------------------------------------------------------------------
+# queue sharding
+# --------------------------------------------------------------------------
+
+_DIGIT_RE = re.compile(r"\d+")
+
+
+def shard_for(queue: str, shards: int) -> int:
+    """Deterministic owner shard of ``queue`` among ``shards`` broker
+    endpoints (ports ``base .. base+shards-1``).
+
+    Stable across processes and restarts (crc32 + integer arithmetic,
+    never :func:`hash`), and FAMILY-AWARE: the digits are lifted out of
+    the name, the remaining family template is hashed once, and the
+    instance indices are mixed back in — so ``intermediate_queue_0_0``,
+    ``_0_1``, ``_0_2`` … round-robin across shards (consecutive indices
+    hit consecutive shards) while any single queue always maps to
+    exactly one shard.  Digit-free names (``rpc_queue``) hash on the
+    family alone."""
+    if shards <= 1:
+        return 0
+    family = _DIGIT_RE.sub("#", queue)
+    h = zlib.crc32(family.encode())
+    for d in _DIGIT_RE.findall(queue):
+        # 1000003: odd prime ≫ any realistic shard count, so mixed
+        # indices stay a bijection mod shards per digit group
+        h = h * 1000003 + int(d)
+    return h % shards
+
+
+class ShardedTcpTransport(Transport):
+    """Multi-endpoint :class:`TcpTransport`: one broker-shard plane.
+
+    Routes every publish/get to the queue's owning shard
+    (:func:`shard_for`), lazily opening one :class:`TcpTransport` per
+    shard on first touch.  Each per-shard connection keeps its own
+    socket, lock and reconnect/backoff state, so a dead shard stalls
+    only operations on ITS queues — traffic to the surviving shards
+    flows on, and the reliable layer above redelivers whatever the
+    dead shard lost once it rebinds.  ``purge(None)`` broadcasts to
+    every shard (the server's startup hygiene must sweep the whole
+    plane)."""
+
+    def __init__(self, host: str, port: int, shards: int,
+                 connect_timeout: float = 30.0,
+                 reconnect_timeout: float = 15.0, faults=None):
+        super().__init__()
+        self.host, self.port = host, int(port)
+        self.shards = int(shards)
+        self._connect_timeout = connect_timeout
+        self._reconnect_timeout = reconnect_timeout
+        self._faults = faults
+        self._closed = False
+        # guards the shard map only — connections are DIALED outside
+        # it (a shard mid-backoff must not stall a sibling's lazy open)
+        self._shard_lock = make_lock("tcp.shards")
+        self._transports: dict[int, TcpTransport] = {}
+
+    def shard_of(self, queue: str) -> int:
+        return shard_for(queue, self.shards)
+
+    def endpoint(self, shard: int) -> tuple[str, int]:
+        return self.host, self.port + shard
+
+    def _conn(self, shard: int) -> TcpTransport:
+        t = self._transports.get(shard)
+        if t is not None:
+            return t
+        if self._closed:
+            raise ConnectionError("transport closed")
+        host, port = self.endpoint(shard)
+        fresh = TcpTransport(host, port,
+                             connect_timeout=self._connect_timeout,
+                             reconnect_timeout=self._reconnect_timeout,
+                             faults=self._faults)
+        with self._shard_lock:
+            cur = self._transports.get(shard)
+            if cur is None and not self._closed:
+                self._transports[shard] = fresh
+                return fresh
+        fresh.close()   # lost the race (or closed under us)
+        if cur is None:
+            raise ConnectionError("transport closed")
+        return cur
+
+    def publish(self, queue: str, payload: bytes) -> None:
+        self._count(queue, payload)
+        self._conn(self.shard_of(queue)).publish(queue, payload)
+
+    def get(self, queue: str, timeout: float | None = None
+            ) -> bytes | None:
+        return self._conn(self.shard_of(queue)).get(queue, timeout)
+
+    def purge(self, queues: Iterable[str] | None = None) -> None:
+        if queues is None:
+            for shard in range(self.shards):
+                self._conn(shard).purge(None)
+            return
+        by_shard: dict[int, list] = {}
+        for q in queues:
+            by_shard.setdefault(self.shard_of(q), []).append(q)
+        for shard, qs in sorted(by_shard.items()):
+            self._conn(shard).purge(qs)
+
+    def close(self) -> None:
+        with self._shard_lock:
+            self._closed = True
+            conns = list(self._transports.values())
+            self._transports.clear()
+        for t in conns:
+            t.close()
+
+
+def find_port_block(shards: int, host: str = "127.0.0.1",
+                    lo: int = 20000, hi: int = 28000,
+                    attempts: int = 64) -> int:
+    """A base port with ``shards`` consecutive bindable ports — shard
+    endpoints live at ``base .. base+shards-1``, and picking the block
+    below the ephemeral range keeps client-socket collisions out of
+    the plane.  Probe-and-release is inherently racy; callers that
+    lose the race (bind failure at spawn) just call again."""
+    import random
+    rng = random.Random()
+    for _ in range(attempts):
+        base = rng.randrange(lo, hi)
+        socks = []
+        try:
+            for i in range(shards):
+                s = socket.socket()
+                s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+                s.bind((host, base + i))
+                socks.append(s)
+        except OSError:
+            continue
+        finally:
+            for s in socks:
+                s.close()
+        if len(socks) == shards:
+            return base
+    raise OSError(f"no free {shards}-port block in [{lo}, {hi})")
+
+
+def broker_stats(host: str, port: int, timeout: float = 2.0) -> dict:
+    """One shard's stats frame (see :data:`BROKER_STATS_QUEUE`)."""
+    t = TcpTransport(host, port, connect_timeout=timeout,
+                     reconnect_timeout=timeout)
+    try:
+        raw = t.get(BROKER_STATS_QUEUE, timeout=timeout)
+        if raw is None:
+            raise ConnectionError("stats request timed out")
+        return json.loads(raw.decode())
+    finally:
+        t.close()
+
+
+def collect_broker_stats(host: str, port: int, shards: int,
+                         timeout: float = 1.5) -> list[dict]:
+    """Stats from every shard of a broker plane; unreachable shards
+    yield ``{"shard_index": i, "port": p, "error": ...}`` rows instead
+    of failing the sweep (sl_top must render a PARTIALLY dead plane)."""
+    out = []
+    for i in range(max(1, int(shards))):
+        try:
+            s = broker_stats(host, port + i, timeout=timeout)
+            s["shard_index"] = i
+        except Exception as e:  # noqa: BLE001 — down/refused/timeout
+            s = {"shard_index": i, "port": port + i,
+                 "error": f"{type(e).__name__}: {e}"}
+        out.append(s)
+    return out
 
 
 class TcpTransport(Transport):
@@ -1145,9 +1683,15 @@ class _Prefetcher:
 
 
 def make_transport(kind: str, host: str = "127.0.0.1",
-                   port: int = 5672) -> Transport:
+                   port: int = 5672, shards: int = 1,
+                   faults=None) -> Transport:
     if kind == "inproc":
         return InProcTransport()
     if kind == "tcp":
-        return TcpTransport(host, port)
+        if shards > 1:
+            # broker.shards: every queue is owned by exactly one of
+            # the shard endpoints at ports port..port+shards-1
+            return ShardedTcpTransport(host, port, shards,
+                                       faults=faults)
+        return TcpTransport(host, port, faults=faults)
     raise ValueError(f"unknown transport kind {kind!r}")

@@ -272,10 +272,12 @@ def make_runtime_transport(cfg: Config, name: str,
     trace context inside them) untouched, apart from the corruption
     chaos is paid to inject."""
     tcp = cfg.transport.kind == "tcp"
+    shards = getattr(getattr(cfg, "broker", None), "shards", 1)
 
     def mk() -> Transport:
         return make_transport(cfg.transport.kind, cfg.transport.host,
-                              cfg.transport.port)
+                              cfg.transport.port, shards=shards,
+                              faults=faults)
 
     bus = mk()
     if cfg.chaos.enabled:

@@ -63,7 +63,12 @@ from split_learning_tpu.runtime.plan import (
 
 #: journal actions the validator admits (``validate_journal``)
 ACTIONS = ("decide", "evict", "evict-skip", "demote", "promote",
-           "replan", "drop", "cluster")
+           "replan", "drop", "cluster", "retune")
+
+#: aggregation.fan-in candidates the retune search scans (ROADMAP
+#: item 1, 1M tier): small enough to keep per-node fold walls bounded,
+#: large enough to keep the tree shallow on big fleets
+FANIN_CANDIDATES = (2, 3, 4, 6, 8, 12, 16, 24, 32, 48, 64)
 
 #: score threshold mirroring FleetMonitor.STRAGGLER_SCORE: a rate (or
 #: compute rate) below this fraction of the fleet median is slow
@@ -76,6 +81,7 @@ class SchedOutcome:
     round_idx: int
     evict: set                       # client ids to evict (elastic path)
     plans: list | None               # replacement plans, or None
+    fan_in: int | None = None        # retuned aggregation.fan-in
     decision_ms: float = 0.0
 
 
@@ -188,6 +194,11 @@ def validate_journal(records: Sequence[dict]) -> list[str]:
             det = rec.get("detail") or {}
             if "cuts_to" not in det or "cuts_from" not in det:
                 errs.append(f"record {i} (replan): missing cuts detail")
+        if act == "retune":
+            det = rec.get("detail") or {}
+            if "fan_in_to" not in det or "fan_in_from" not in det:
+                errs.append(f"record {i} (retune): missing fan-in "
+                            "detail")
     return errs
 
 
@@ -235,6 +246,12 @@ class Scheduler:
         self._evicted: set = set()
         self._last_replan_round: int | None = None
         self._last_decide_round: int | None = None
+        # aggregator fan-in retuning (ROADMAP item 1, 1M tier): the
+        # LIVE fan-in (the server mirrors adopted retunes into its
+        # aggregation view) and the cooldown anchor, damped exactly
+        # like cut re-planning
+        self._fan_in = int(getattr(cfg.aggregation, "fan_in", 0))
+        self._last_fanin_round: int | None = None
         self._stage_stats: dict = {}   # telemetry "stages" block
         # first boundary pass that was past warmup: until it has
         # happened, the mid-round barrier policy stays inert — round 0
@@ -431,6 +448,107 @@ class Scheduler:
                     "incumbent_wall_s": result["incumbent_wall_s"],
                     "improvement": result["improvement"]})
 
+    def _act_retune_fanin(self, old: int, new: int, round_idx: int,
+                          model: dict) -> None:
+        """Adopt a measured-fold-wall aggregator fan-in retune: the
+        next round's tree is planned with ``new`` members per group.
+        Damped like cut re-planning (adopted only when the predicted
+        critical-path fold wall improves by ``replan-damping``) and
+        cooled down on the same knob, so tree shape cannot flap."""
+        self._fan_in = int(new)
+        self._last_fanin_round = round_idx
+        if self.faults is not None:
+            self.faults.inc("sched_fanin_retunes")
+        self.journal(
+            "retune", round_idx,
+            why=(f"measured agg_node fold walls: fan-in {old} -> "
+                 f"{new} improves the predicted tree critical path "
+                 f"{model['improvement']:.0%} (>= damping "
+                 f"{self.sch.replan_damping:.0%})"),
+            detail={"fan_in_from": int(old), "fan_in_to": int(new),
+                    **model})
+
+    def _agg_node_fold_cost(self, fleet: dict
+                            ) -> tuple[float | None, int]:
+        """Measured per-contribution fold wall (seconds) from the
+        ``kind=agg_node`` heartbeat views' gauges, plus the reporting
+        node count.  None until at least one node reported a round's
+        fold numbers."""
+        fold_s = folded = 0.0
+        nodes = 0
+        for cid in sorted((fleet.get("clients") or {})):
+            v = fleet["clients"][cid]
+            if v.get("kind") != "agg_node" or v.get("state") == "lost":
+                continue
+            g = v.get("gauges") or {}
+            f, n = g.get("agg_node_fold_s"), g.get("agg_node_folded")
+            if not f or not n:
+                continue
+            fold_s += float(f)
+            folded += float(n)
+            nodes += 1
+        if folded <= 0:
+            return None, nodes
+        return fold_s / folded, nodes
+
+    @staticmethod
+    def _tree_wall(fan_in: int, n: int, per_fold_s: float,
+                   levels: int) -> float:
+        """Predicted critical-path fold wall of the tree plan_tree
+        actually builds over ``n`` leaves: depth is CAPPED at
+        ``aggregation.levels`` (narrower fan-in does not buy depth
+        past it), each level's node folds fan_in children
+        sequentially and the levels cascade, and the ROOT then folds
+        every top-level partial itself — ceil(n / fan_in^depth) of
+        them, the term that punishes a too-narrow tree at a shallow
+        levels cap instead of rewarding it."""
+        import math
+        f = max(fan_in, 2)
+        depth = max(1, min(int(levels), math.ceil(
+            math.log(max(n, 2)) / math.log(f))))
+        top = math.ceil(n / (f ** depth))
+        return (depth * f + top) * per_fold_s
+
+    def _retune_fanin(self, plans: list, round_idx: int,
+                      fleet: dict) -> int | None:
+        """Scan the candidate fan-ins against the measured per-fold
+        cost; adopt the argmin under damping + cooldown."""
+        cur = self._fan_in
+        if not self.sch.retune_fanin or cur < 2:
+            return None
+        cooled = (self._last_fanin_round is None
+                  or round_idx - self._last_fanin_round
+                  > self.sch.replan_cooldown)
+        if not cooled:
+            return None
+        per_fold, _nodes = self._agg_node_fold_cost(fleet)
+        if per_fold is None:
+            return None   # no measured agg_node round yet
+        n = sum(len(p.stage1_clients) for p in plans)
+        if n <= cur:
+            return None   # the tree is degenerate at this population
+        levels = int(getattr(self.cfg.aggregation, "levels", 1) or 1)
+        incumbent = self._tree_wall(cur, n, per_fold, levels)
+        best, best_wall = cur, incumbent
+        for f in FANIN_CANDIDATES:
+            if f >= n:
+                continue
+            w = self._tree_wall(f, n, per_fold, levels)
+            if w < best_wall:
+                best, best_wall = f, w
+        if best == cur:
+            return None
+        improvement = (incumbent - best_wall) / incumbent
+        if improvement < self.sch.replan_damping:
+            return None
+        self._act_retune_fanin(cur, best, round_idx, {
+            "fold_ms_per_contrib": round(per_fold * 1e3, 6),
+            "members": n,
+            "predicted_wall_s": round(best_wall, 6),
+            "incumbent_wall_s": round(incumbent, 6),
+            "improvement": round(improvement, 4)})
+        return best
+
     def _act_drop(self, cid: str, round_idx: int, state: str,
                   waited_s: float) -> None:
         """Mid-round barrier drop: the round stops waiting for a
@@ -569,6 +687,13 @@ class Scheduler:
                         changed = True
                     replanned.append(p)
                 new_plans = replanned
+
+        # (d) aggregator fan-in retuning from measured kind=agg_node
+        # fold walls (the other open 1M-tier control loop), damped and
+        # cooled like cut re-planning
+        if acting:
+            out.fan_in = self._retune_fanin(new_plans, round_idx,
+                                            fleet)
 
         out.plans = new_plans if changed else None
         out.decision_ms = round((time.perf_counter() - t0) * 1e3, 3)
@@ -757,6 +882,7 @@ class Scheduler:
                 "clusters": dict(self.clusterer.assignment),
                 "actions": dict(self.last_action),
                 "last_replan": self.last_replan,
+                "fan_in": self._fan_in,
                 "decisions": list(self.decisions)[-64:],
             }
 

@@ -29,6 +29,7 @@ from __future__ import annotations
 import argparse
 import copy
 import dataclasses
+import threading
 import time
 import zlib
 from typing import Any, Callable
@@ -1388,6 +1389,15 @@ class ProtocolContext(MeshContext):
                     for cid, r in self._registrations.items()}
         out = self.scheduler.plan_round(plans, round_idx, fleet,
                                         profiles)
+        if out.fan_in is not None and out.fan_in != self._agg.fan_in:
+            # adopted fan-in retune: the next train_cluster plans its
+            # tree at the new width (the journal already carries the
+            # kind=sched "retune" record; this is just the application)
+            import dataclasses as _dc
+            self._agg = _dc.replace(self._agg, fan_in=int(out.fan_in))
+            self.log.info(
+                f"scheduler: aggregation fan-in retuned to "
+                f"{out.fan_in}", "cyan")
         for cid in sorted(out.evict):
             # the elastic-drop path's teardown: STOP, drop the
             # registration (or the next elastic refresh would re-plan
@@ -2249,6 +2259,60 @@ class ProtocolServer:
         # after K steps; separate client processes can't — there the
         # round boundary closes it (see register_process_capture)
         register_process_capture(self.ctx.perf_capture)
+        # broker-plane self-telemetry (broker.shards): each shard's
+        # event loop serves a stats frame on its control queue; the
+        # server sweeps the plane at most every broker.stats-interval
+        # seconds, mirrors plane-wide sums into the broker_* gauges
+        # (so /metrics carries them) and hands the per-shard rows to
+        # /fleet, where sl_top renders them as ROLE=broker rows
+        self._broker_stats_cache: dict = {"t": 0.0, "stats": None,
+                                          "busy": False}
+
+        def _refresh_broker_stats() -> None:
+            from split_learning_tpu.runtime.bus import (
+                collect_broker_stats,
+            )
+            cache = self._broker_stats_cache
+            try:
+                stats = collect_broker_stats(
+                    cfg.transport.host, cfg.transport.port,
+                    cfg.broker.shards)
+                cache["stats"], cache["t"] = stats, time.monotonic()
+                live = [s for s in stats if "error" not in s]
+                g = self.ctx.gauges
+                g.set("broker_shards_up", len(live))
+                for gauge, key in (
+                        ("broker_conns", "conns"),
+                        ("broker_queues", "queues"),
+                        ("broker_depth", "depth"),
+                        ("broker_depth_hwm", "depth_hwm"),
+                        ("broker_parked_gets", "parked_gets"),
+                        ("broker_bytes_in", "bytes_in"),
+                        ("broker_bytes_out", "bytes_out")):
+                    g.set(gauge, sum(s.get(key, 0) for s in live))
+            finally:
+                cache["busy"] = False
+
+        def _broker_stats() -> list | None:
+            """Cached shard-stats rows; a stale cache triggers an
+            ASYNC refresh and serves the previous sweep — dialing the
+            shards inline would add their connect latency to every
+            /fleet scrape (observed as scraper-side timeouts while a
+            compile starves the exporter threads)."""
+            if (cfg.transport.kind != "tcp"
+                    or cfg.broker.stats_interval <= 0):
+                return None
+            cache = self._broker_stats_cache
+            now = time.monotonic()
+            if (now - cache["t"] >= cfg.broker.stats_interval
+                    and not cache["busy"]):
+                cache["busy"] = True
+                threading.Thread(target=_refresh_broker_stats,
+                                 daemon=True,
+                                 name="broker-stats").start()
+            return cache["stats"]
+
+        self._broker_stats = _broker_stats
         if obs is not None and obs.http_port is not None:
             from split_learning_tpu.runtime.telemetry import (
                 TelemetryExporter, render_prometheus,
@@ -2258,6 +2322,7 @@ class ProtocolServer:
             def _metrics() -> str:
                 if ctx.fleet is not None:
                     ctx.fleet.advance()
+                _broker_stats()   # refresh the broker_* gauges
                 return render_prometheus(
                     fleet=ctx.fleet, faults=ctx.faults, wire=ctx.wire,
                     hists=ctx.hists, gauges=ctx.gauges,
@@ -2302,6 +2367,12 @@ class ProtocolServer:
                 # evicted/demoted (sl_top renders both columns)
                 if ctx.scheduler is not None:
                     ctx.scheduler.annotate_fleet(snap)
+                # sharded broker plane: per-shard stats rows (sl_top
+                # ROLE=broker) — cached, so scrapes don't hammer the
+                # shards' control queues
+                brokers = _broker_stats()
+                if brokers is not None:
+                    snap["brokers"] = brokers
                 return snap
 
             self.exporter = TelemetryExporter(
@@ -2377,7 +2448,9 @@ def main(argv=None):
                     "parity).")
     ap.add_argument("--config", default="config.yaml")
     ap.add_argument("--broker", action="store_true",
-                    help="also host the TCP broker in this process")
+                    help="also host the TCP broker in this process "
+                         "(broker.shards > 1 hosts every shard of "
+                         "the plane on consecutive ports)")
     ap.add_argument("--client_timeout", type=float, default=600.0)
     ap.add_argument("--ready_timeout", type=float, default=None,
                     help="registration/READY barrier deadline "
@@ -2386,9 +2459,16 @@ def main(argv=None):
     cfg = from_yaml(args.config)
     from split_learning_tpu.platform import apply_compile_cache
     apply_compile_cache(cfg.compile_cache_dir)
-    broker = None
+    brokers = []
     if args.broker and cfg.transport.kind == "tcp":
-        broker = Broker(cfg.transport.host, cfg.transport.port)
+        # each shard is its own O(1)-thread event loop; hosting N of
+        # them in-process keeps the single-command dev deployment
+        # working with broker.shards > 1 (production runs them as
+        # separate processes: python -m split_learning_tpu.broker
+        # --shards N)
+        brokers = [Broker(cfg.transport.host, cfg.transport.port + i,
+                          shard_id=f"shard_{i}")
+                   for i in range(cfg.broker.shards)]
     try:
         server = ProtocolServer(cfg, client_timeout=args.client_timeout,
                                 ready_timeout=args.ready_timeout)
@@ -2399,7 +2479,7 @@ def main(argv=None):
             print(f"round {rec.round_idx}: ok={rec.ok} "
                   f"samples={rec.num_samples}{acc}")
     finally:
-        if broker is not None:
+        for broker in brokers:
             broker.close()
 
 

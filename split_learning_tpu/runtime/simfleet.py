@@ -43,6 +43,7 @@ import time
 
 import numpy as np
 
+from split_learning_tpu.runtime.bus import shard_for
 from split_learning_tpu.runtime.protocol import (
     DigestRoute, FrameAssembler, Heartbeat, Notify, Pause, Ready,
     Register, Start, Stop, Syn, Update, encode, reply_queue, RPC_QUEUE,
@@ -136,15 +137,39 @@ class _SimClient:
         self.total_samples = 0
 
 
+class _FleetDriver:
+    """One driver thread's slice of the fleet: its own transport
+    (so sim traffic fans out over real per-shard broker connections
+    instead of multiplexing every client through one socket), its own
+    event heap, its own poll sweep."""
+
+    def __init__(self, bus, owns_bus: bool):
+        self.bus = bus
+        self.owns_bus = owns_bus
+        self.clients: dict[str, _SimClient] = {}
+        self.events: list = []       # (t, seq, kind, cid)
+        self.eseq = 0
+        self.thread: threading.Thread | None = None
+
+
 class SyntheticFleet:
     """Event-driven synthetic fleet over a shared transport.
 
-    ``start()`` launches the driver thread; clients with
+    ``start()`` launches the driver thread(s); clients with
     ``join_delay_s == 0`` REGISTER immediately in one burst (the
     registration-storm shape), the rest on their timers.  ``stop()``
     (or a server STOP fan-out) winds it down.  ``time_scale``
     multiplies every simulated duration — 1.0 for wall-realistic
-    cells, small values to make a 10k-client round cheap."""
+    cells, small values to make a 10k-client round cheap.
+
+    **Sharded broker planes** (``broker.shards``): pass ``drivers > 1``
+    plus a ``bus_factory`` and the fleet partitions its clients across
+    that many driver threads, each owning a fresh factory-built
+    transport — clients land on the driver that owns their reply
+    queue's SHARD (``shard_for``), so the sim's publishes and polls
+    exercise the real multi-shard fan-out instead of funneling 10k
+    clients through one broker connection.  The default (one driver,
+    the shared ``bus``) is the classic in-proc shape, unchanged."""
 
     POLL_BATCH = 4        # frames consumed per client per sweep
     REREGISTER_S = 1.0    # REGISTER retry period until first START
@@ -153,17 +178,34 @@ class SyntheticFleet:
                  heartbeat_interval: float = 0.5,
                  time_scale: float = 1.0,
                  update_bytes: float = 64 << 10,
-                 codec_gain: float = 4.0):
+                 codec_gain: float = 4.0,
+                 drivers: int = 1, bus_factory=None):
         self.bus = bus
         self.heartbeat_interval = float(heartbeat_interval)
         self.time_scale = float(time_scale)
         self.update_bytes = float(update_bytes)
         self.codec_gain = float(codec_gain)
         self.clients = {s.cid: _SimClient(s) for s in specs}
-        self._events: list = []      # (t, seq, kind, cid)
-        self._eseq = 0
+        drivers = max(1, int(drivers))
+        # with a factory every driver owns a fresh transport (the
+        # per-shard fan-out); without one they share `bus` (the
+        # classic in-proc cell — drivers then only parallelize sweeps)
+        self._drivers = [
+            _FleetDriver(bus_factory(), owns_bus=True)
+            if bus_factory is not None else
+            _FleetDriver(bus, owns_bus=False)
+            for _ in range(drivers)]
+        shards = int(getattr(self._drivers[0].bus, "shards", 1) or 1)
+        for i, (cid, c) in enumerate(sorted(self.clients.items())):
+            if shards > 1:
+                # shard-affine placement: a driver polls queues that
+                # live on (mostly) one shard, so sweeps ride that
+                # shard's connection instead of ping-ponging
+                d = shard_for(reply_queue(cid), shards) % drivers
+            else:
+                d = i % drivers
+            self._drivers[d].clients[cid] = c
         self._stop = threading.Event()
-        self._thread: threading.Thread | None = None
         self.errors: list[str] = []
 
     # -- timing model --------------------------------------------------------
@@ -204,19 +246,19 @@ class SyntheticFleet:
 
     # -- wire actions --------------------------------------------------------
 
-    def _register(self, c: _SimClient) -> None:
-        self.bus.publish(RPC_QUEUE, encode(Register(
+    def _register(self, d: _FleetDriver, c: _SimClient) -> None:
+        d.bus.publish(RPC_QUEUE, encode(Register(
             client_id=c.spec.cid, stage=c.spec.stage,
             profile=c.spec.profile)))
         c.registered = True
 
-    def _beat(self, c: _SimClient) -> None:
-        self.bus.publish(c.hb_queue or RPC_QUEUE, encode(Heartbeat(
+    def _beat(self, d: _FleetDriver, c: _SimClient) -> None:
+        d.bus.publish(c.hb_queue or RPC_QUEUE, encode(Heartbeat(
             client_id=c.spec.cid, round_idx=c.round_idx,
             telemetry=self._telemetry(c))))
 
-    def _send_update(self, c: _SimClient) -> None:
-        self.bus.publish(RPC_QUEUE, encode(Update(
+    def _send_update(self, d: _FleetDriver, c: _SimClient) -> None:
+        d.bus.publish(RPC_QUEUE, encode(Update(
             client_id=c.spec.cid, stage=c.spec.stage,
             cluster=c.cluster,
             params=(c.params if c.send_weights else None),
@@ -232,11 +274,12 @@ class SyntheticFleet:
 
     # -- event plumbing ------------------------------------------------------
 
-    def _at(self, t: float, kind: str, cid: str) -> None:
-        self._eseq += 1
-        heapq.heappush(self._events, (t, self._eseq, kind, cid))
+    @staticmethod
+    def _at(d: _FleetDriver, t: float, kind: str, cid: str) -> None:
+        d.eseq += 1
+        heapq.heappush(d.events, (t, d.eseq, kind, cid))
 
-    def _handle(self, c: _SimClient, msg) -> None:
+    def _handle(self, d: _FleetDriver, c: _SimClient, msg) -> None:
         now = time.monotonic()
         if isinstance(msg, Start):
             extra = msg.extra or {}
@@ -251,90 +294,92 @@ class SyntheticFleet:
             c.codec_gain = (self.codec_gain
                             if knobs.get("codec") else 1.0)
             c.hb_queue = extra.get("digest")
-            self.bus.publish(RPC_QUEUE, encode(Ready(
+            d.bus.publish(RPC_QUEUE, encode(Ready(
                 client_id=c.spec.cid, round_idx=c.fence)))
         elif isinstance(msg, Syn):
             compute_t, wire_t = self._durations(c)
             c.finish_t = now + (compute_t + wire_t) * self.time_scale
             if c.spec.stage == 1:
-                self._at(c.finish_t, "notify", c.spec.cid)
+                self._at(d, c.finish_t, "notify", c.spec.cid)
         elif isinstance(msg, Pause):
             c.paused = True
             c.send_weights = bool(msg.send_weights)
             if now >= c.finish_t:
-                self._send_update(c)
+                self._send_update(d, c)
             else:
-                self._at(c.finish_t, "update", c.spec.cid)
+                self._at(d, c.finish_t, "update", c.spec.cid)
         elif isinstance(msg, DigestRoute):
             # digest-node death fallback: adopt the new heartbeat
             # target and beat once immediately (a real client does the
             # same) so the server's liveness view never gaps
             c.hb_queue = msg.queue
-            self._beat(c)
+            self._beat(d, c)
         elif isinstance(msg, Stop):
             c.stopped = True
 
-    def _fire(self, kind: str, c: _SimClient) -> None:
+    def _fire(self, d: _FleetDriver, kind: str, c: _SimClient) -> None:
         if c.stopped:
             return
         if kind == "join":
-            self._register(c)
+            self._register(d, c)
             if self.heartbeat_interval > 0:
-                self._at(time.monotonic() + self.heartbeat_interval,
+                self._at(d, time.monotonic() + self.heartbeat_interval,
                          "beat", c.spec.cid)
-            self._at(time.monotonic() + self.REREGISTER_S,
+            self._at(d, time.monotonic() + self.REREGISTER_S,
                      "reregister", c.spec.cid)
         elif kind == "reregister":
             # like a real client: REGISTER is re-sent until the first
             # START lands, so the server's startup queue purge (or a
             # dropped frame) cannot lose this client forever
             if not c.started:
-                self._register(c)
-                self._at(time.monotonic() + self.REREGISTER_S,
+                self._register(d, c)
+                self._at(d, time.monotonic() + self.REREGISTER_S,
                          "reregister", c.spec.cid)
         elif kind == "beat":
             if self.heartbeat_interval > 0:
-                self._beat(c)
-                self._at(time.monotonic() + self.heartbeat_interval,
+                self._beat(d, c)
+                self._at(d, time.monotonic() + self.heartbeat_interval,
                          "beat", c.spec.cid)
         elif kind == "notify":
-            self.bus.publish(RPC_QUEUE, encode(Notify(
+            d.bus.publish(RPC_QUEUE, encode(Notify(
                 client_id=c.spec.cid, cluster=c.cluster,
                 round_idx=c.fence)))
         elif kind == "update":
             if c.paused:
-                self._send_update(c)
+                self._send_update(d, c)
 
     # -- driver loop ---------------------------------------------------------
 
-    def _run(self) -> None:
+    def _run(self, d: _FleetDriver) -> None:
         now = time.monotonic()
-        for c in self.clients.values():
+        for c in d.clients.values():
             if c.spec.join_delay_s > 0:
-                self._at(now + c.spec.join_delay_s, "join",
+                self._at(d, now + c.spec.join_delay_s, "join",
                          c.spec.cid)
             else:
-                self._register(c)   # the registration-storm burst
+                self._register(d, c)   # the registration-storm burst
                 if self.heartbeat_interval > 0:
-                    self._at(now + self.heartbeat_interval, "beat",
+                    self._at(d, now + self.heartbeat_interval, "beat",
                              c.spec.cid)
-                self._at(now + self.REREGISTER_S, "reregister",
+                self._at(d, now + self.REREGISTER_S, "reregister",
                          c.spec.cid)
         while not self._stop.is_set():
             busy = False
             now = time.monotonic()
-            while self._events and self._events[0][0] <= now:
-                _, _, kind, cid = heapq.heappop(self._events)
-                self._fire(kind, self.clients[cid])
+            while d.events and d.events[0][0] <= now:
+                _, _, kind, cid = heapq.heappop(d.events)
+                self._fire(d, kind, d.clients[cid])
                 busy = True
             # InProcTransport fast path: peek queue lengths WITHOUT
             # taking the bus lock (a CPython len() read is atomic and
             # at worst one sweep stale).  A locked get() per client
             # per sweep is 10k lock acquisitions contending with the
             # server's fan-out publishes — the difference between an
-            # 82/s and a >1k/s START drain at 10k clients.
-            peek = getattr(self.bus, "_queues", None)
-            for c in self.clients.values():
+            # 82/s and a >1k/s START drain at 10k clients.  (Over a
+            # sharded TCP plane there is nothing to peek: each poll is
+            # a real zero-timeout GET routed to the owning shard.)
+            peek = getattr(d.bus, "_queues", None)
+            for c in d.clients.values():
                 if c.stopped or not c.registered:
                     continue
                 q = reply_queue(c.spec.cid)
@@ -342,7 +387,7 @@ class SyntheticFleet:
                     continue
                 for _ in range(self.POLL_BATCH):
                     try:
-                        raw = self.bus.get(q, timeout=0)
+                        raw = d.bus.get(q, timeout=0)
                     except Exception:  # noqa: BLE001 — bus closed:
                         return         # the deployment is over
                     if raw is None:
@@ -354,22 +399,30 @@ class SyntheticFleet:
                         self.errors.append(f"{c.spec.cid}: {e}")
                         continue
                     if msg is not None:
-                        self._handle(c, msg)
+                        self._handle(d, c, msg)
             if not busy:
                 # idle: sleep to the next event (bounded) instead of
                 # spinning the poll sweep
-                wake = (self._events[0][0] - time.monotonic()
-                        if self._events else 0.005)
+                wake = (d.events[0][0] - time.monotonic()
+                        if d.events else 0.005)
                 self._stop.wait(min(max(wake, 0.0005), 0.02))
 
     def start(self) -> "SyntheticFleet":
-        self._thread = threading.Thread(target=self._run, daemon=True,
-                                        name="simfleet-driver")
-        self._thread.start()
+        for i, d in enumerate(self._drivers):
+            d.thread = threading.Thread(
+                target=self._run, args=(d,), daemon=True,
+                name=f"simfleet-driver-{i}")
+            d.thread.start()
         return self
 
     def stop(self) -> None:
         self._stop.set()
-        if self._thread is not None:
-            self._thread.join(timeout=10.0)
-            self._thread = None
+        for d in self._drivers:
+            if d.thread is not None:
+                d.thread.join(timeout=10.0)
+                d.thread = None
+            if d.owns_bus:
+                try:
+                    d.bus.close()
+                except Exception:  # noqa: BLE001 — teardown
+                    pass

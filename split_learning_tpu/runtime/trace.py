@@ -107,6 +107,10 @@ FAULT_COUNTER_NAMES = frozenset({
     "sched_evictions", "sched_demotions", "sched_replans",
     "sched_barrier_drops", "sched_cluster_moves",
     "sched_knob_rejects",
+    # scheduler-driven aggregator fan-in retuning (ROADMAP item 1, 1M
+    # tier): adopted aggregation.fan-in changes driven by measured
+    # kind=agg_node fold walls
+    "sched_fanin_retunes",
 })
 
 #: Declared registry of latency-histogram names (same contract as
@@ -167,6 +171,14 @@ GAUGE_NAMES = frozenset({
     # reporting digests, clients covered by those digests, and the
     # server watchlist's size (the bounded exact-state population)
     "fleet_digest_nodes", "fleet_digest_clients", "fleet_watchlist",
+    # sharded broker plane (runtime/bus.py Broker stats frames, polled
+    # by the server's /fleet "brokers" sweep): shard processes
+    # answering their stats control queue, and the plane-wide sums of
+    # their connection counts, live queues, stored depth (+ high
+    # water), parked GET continuations and wire bytes
+    "broker_shards_up", "broker_conns", "broker_queues",
+    "broker_depth", "broker_depth_hwm", "broker_parked_gets",
+    "broker_bytes_in", "broker_bytes_out",
 })
 
 

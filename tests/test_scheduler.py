@@ -651,7 +651,7 @@ def test_scheduler_topology_view_shape():
         "c3": _view(10, 11)}), {})
     topo = sch.topology()
     assert set(topo) == {"clusters", "actions", "last_replan",
-                         "decisions"}
+                         "fan_in", "decisions"}
     assert topo["actions"].get("c0", "").startswith("demote@r")
     # sl_top renders the scheduler columns from this view
     sys.path.insert(0, str(pathlib.Path(__file__).resolve()
@@ -665,3 +665,94 @@ def test_scheduler_topology_view_shape():
     table = sl_top.render_fleet(fleet, color=False)
     assert "CLUSTER" in table and "SCHED" in table
     assert "demote@r1" in table
+
+
+# --------------------------------------------------------------------------
+# scheduler-driven aggregator fan-in retuning (kind=sched "retune")
+# --------------------------------------------------------------------------
+
+def _tree_cfg(fan_in=32, **sched):
+    base = {"enabled": True, "warmup_rounds": 1, "evict_after": 2}
+    base.update(sched)
+    return from_dict({"scheduler": base,
+                      "aggregation": {"fan_in": fan_in},
+                      "observability": {"heartbeat_interval": 1.0}})
+
+
+def _node_view(fold_s, folded, state="healthy"):
+    return {"state": state, "kind": "agg_node",
+            "gauges": {"agg_node_fold_s": fold_s,
+                       "agg_node_folded": folded}}
+
+
+class TestFanInRetune:
+    def test_retune_adopts_measured_optimum_and_journals(self):
+        sch = Scheduler(_tree_cfg(fan_in=32))
+        fleet = _fleet({"agg_0": _node_view(0.064, 64),
+                        "agg_1": _node_view(0.064, 64)})
+        out = sch.plan_round([_plan(n=200)], 1, fleet, {})
+        # per-fold 1 ms over 200 leaves: a 32-ary tree's critical path
+        # (2 levels x 32 folds) loses to a narrow tree by far more
+        # than the damping margin
+        assert out.fan_in is not None and out.fan_in < 32
+        recs = [d for d in sch.decisions if d["action"] == "retune"]
+        assert len(recs) == 1
+        det = recs[0]["detail"]
+        assert det["fan_in_from"] == 32
+        assert det["fan_in_to"] == out.fan_in
+        assert det["improvement"] >= sch.sch.replan_damping
+        assert validate_journal(list(sch.decisions)) == []
+
+    def test_retune_cooldown_then_reacts(self):
+        sch = Scheduler(_tree_cfg(fan_in=32, replan_cooldown=2))
+        fleet = _fleet({"agg_0": _node_view(0.064, 64)})
+        out1 = sch.plan_round([_plan(n=200)], 1, fleet, {})
+        assert out1.fan_in is not None
+        # cooling: rounds 2 and 3 must not retune again even though
+        # the (stale) measurement still says "narrower is better"
+        assert sch.plan_round([_plan(n=200)], 2, fleet, {}).fan_in \
+            is None
+        assert sch.plan_round([_plan(n=200)], 3, fleet, {}).fan_in \
+            is None
+
+    def test_retune_damping_keeps_near_optimal_width(self):
+        sch = Scheduler(_tree_cfg(fan_in=16))
+        # fan-in 16 is already the argmin of the levels-capped model
+        # (level cascade + root fold of the top partials) at this
+        # population: nothing beats it by the damping margin, so no
+        # decision fires
+        out = sch.plan_round(
+            [_plan(n=200)], 1,
+            _fleet({"agg_0": _node_view(0.01, 10)}), {})
+        assert out.fan_in is None
+        assert not [d for d in sch.decisions
+                    if d["action"] == "retune"]
+
+    def test_retune_respects_levels_cap_root_cost(self):
+        # at levels=1 a NARROW fan-in explodes the root's fold of the
+        # top-level partials (ceil(n/f) of them) — the model must
+        # widen, never adopt the depth-uncapped optimum (f ~ e)
+        sch = Scheduler(_tree_cfg(fan_in=32))
+        out = sch.plan_round(
+            [_plan(n=10_000)], 1,
+            _fleet({"agg_0": _node_view(0.064, 64)}), {})
+        assert out.fan_in is not None and out.fan_in > 32
+        det = [d for d in sch.decisions
+               if d["action"] == "retune"][0]["detail"]
+        assert det["fan_in_to"] == out.fan_in
+
+    def test_retune_needs_measurement_flag_and_tree(self):
+        # no agg_node views -> no retune
+        sch = Scheduler(_tree_cfg(fan_in=32))
+        assert sch.plan_round([_plan(n=200)], 1, _fleet({}),
+                              {}).fan_in is None
+        # flag off -> no retune
+        sch = Scheduler(_tree_cfg(fan_in=32, retune_fanin=False))
+        assert sch.plan_round(
+            [_plan(n=200)], 1,
+            _fleet({"agg_0": _node_view(0.064, 64)}), {}).fan_in is None
+        # flat tree (fan_in 0) -> no retune
+        sch = Scheduler(_cfg())
+        assert sch.plan_round(
+            [_plan(n=200)], 1,
+            _fleet({"agg_0": _node_view(0.064, 64)}), {}).fan_in is None

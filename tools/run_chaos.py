@@ -955,6 +955,213 @@ def fleet_scale_cell(tmp: str, seed: int = 23) -> tuple[bool, str]:
                   f"0 phantom lost [{wall:.0f}s]")
 
 
+def broker_shard_cell(tmp: str, seed: int = 29) -> tuple[bool, str]:
+    """Sharded broker plane chaos cell (broker.shards): a 3-client
+    deterministic round over TWO real broker shard processes with the
+    reliable layer on and drop+dup+reorder injected on the data-plane
+    queues — and the shard owning the forward data queue SIGKILLed
+    mid-round (its queued frames die with it), then respawned on the
+    same port.  PASSes iff
+
+    * the round completes without a barrier stall (per-shard reconnect
+      backoff + at-least-once redelivery absorb the restart; the
+      surviving shard's traffic never stalls);
+    * aggregation is BIT-IDENTICAL to a fault-free twin run over a
+      fresh 2-shard plane (chaos off, no kill) — the exactness bar
+      every chaos cell in this suite holds;
+    * fault counts are exact where exactness is provable: zero
+      ``lost``, zero ``gave_up`` (nothing may be silently dropped),
+      with ``reconnects`` >= 1 (the kill was real) and
+      ``redeliveries`` >= 1 (the at-least-once envelope repaired real
+      loss), all recorded in the artifact;
+    * the killed shard actually carried data-plane traffic before the
+      kill (a kill on an idle shard proves nothing).
+
+    Writes ``broker_shard.json`` (kill choreography, per-shard stats
+    frames, fault counters) into the cell dir for CI artifact upload.
+    """
+    import threading as _threading
+
+    import numpy as np
+
+    sys.path.insert(0, "tests")
+    from test_chaos import _round_cfg  # noqa: E402
+
+    from split_learning_tpu.broker import spawn_shard
+    from split_learning_tpu.runtime.bus import (
+        broker_stats, collect_broker_stats, find_port_block, shard_for,
+    )
+    from split_learning_tpu.runtime.chaos import make_runtime_transport
+    from split_learning_tpu.runtime.client import ProtocolClient
+    from split_learning_tpu.runtime.server import ProtocolServer
+
+    cell_dir = pathlib.Path(tmp) / "broker_shard"
+    cell_dir.mkdir(parents=True, exist_ok=True)
+    shards = 2
+
+    def spawn_plane():
+        base = find_port_block(shards)
+        procs = {i: spawn_shard("127.0.0.1", base + i, shard_index=i,
+                                python_only=True)
+                 for i in range(shards)}
+        deadline = time.monotonic() + 120
+        for i in range(shards):
+            while time.monotonic() < deadline:
+                try:
+                    broker_stats("127.0.0.1", base + i, timeout=1.0)
+                    break
+                except Exception:  # noqa: BLE001 — still booting
+                    time.sleep(0.25)
+        return base, procs
+
+    def run_round(tag, base, chaos_on):
+        over = dict(
+            global_rounds=3,   # enough round time for a MID-round kill
+            transport={"kind": "tcp", "host": "127.0.0.1",
+                       "port": base, "reliable": True,
+                       # reply_* upgraded too: a kill landing on the
+                       # START fan-out must be repaired by redelivery,
+                       # not by waiting out the ready barrier (the
+                       # README failure-model table's documented
+                       # upgrade for control frames)
+                       "reliable_queues": [
+                           "intermediate_queue*", "gradient_queue*",
+                           "rpc_queue", "aggregate_queue*",
+                           "reply_*"],
+                       "async_send": False},
+            broker={"shards": shards})
+        if chaos_on:
+            over["chaos"] = {"enabled": True, "seed": seed,
+                             "drop": 0.05, "duplicate": 0.1,
+                             "reorder": 0.1}
+        cfg = _round_cfg(pathlib_tmp, cell_dir / tag, **over)
+        fc = FaultCounters()
+        server = ProtocolServer(
+            cfg, transport=make_runtime_transport(cfg, "server",
+                                                  faults=fc),
+            client_timeout=300.0)
+        threads = []
+        for stage, count in enumerate(cfg.clients, start=1):
+            for i in range(count):
+                cid = f"client_{stage}_{i}"
+                client = ProtocolClient(
+                    cfg, cid, stage,
+                    transport=make_runtime_transport(cfg, cid,
+                                                     faults=fc))
+                th = _threading.Thread(target=client.run, daemon=True)
+                th.start()
+                threads.append(th)
+        t0 = time.monotonic()
+        res = server.serve()
+        wall = time.monotonic() - t0
+        for th in threads:
+            th.join(timeout=30)
+        return res, fc, wall
+
+    pathlib_tmp = pathlib.Path(tmp)
+    # fault-free twin on its own fresh plane
+    base_b, procs_b = spawn_plane()
+    try:
+        res_base, _, _ = run_round("twin", base_b, chaos_on=False)
+    finally:
+        for p in procs_b.values():
+            p.kill()
+    if not res_base.history or not res_base.history[0].ok:
+        return False, "fault-free twin round not ok"
+
+    # chaotic run: drop/dup/reorder + mid-round shard SIGKILL+respawn
+    base, procs = spawn_plane()
+    victim = shard_for("intermediate_queue_0_0", shards)
+    kill_info: dict = {}
+
+    def killer():
+        deadline = time.monotonic() + 200
+        # prefer killing while frames sit queued-but-unconsumed (their
+        # loss is what redelivery must repair); parked-GET delivery
+        # bypasses the store, so depth>=1 is intermittent — past the
+        # soft deadline a busy victim is killed regardless (the drop
+        # chaos keeps the redelivery assertion independent).  The
+        # trigger threshold is LOW and the poll tight: a warm-cache
+        # round is sub-second, and a kill that waits too long lands in
+        # the teardown instead of the round.
+        soft = time.monotonic() + 15
+        while time.monotonic() < deadline:
+            try:
+                s = broker_stats("127.0.0.1", base + victim,
+                                 timeout=1.0)
+            except Exception:  # noqa: BLE001 — booting / mid-kill
+                time.sleep(0.1)
+                continue
+            if s.get("published", 0) >= 4 and (
+                    s.get("depth", 0) >= 1
+                    or time.monotonic() >= soft
+                    or s.get("published", 0) >= 12):
+                procs[victim].kill()   # SIGKILL: queued frames die
+                procs[victim].wait()
+                kill_info["published_at_kill"] = s["published"]
+                kill_info["depth_at_kill"] = s["depth"]
+                kill_info["t_kill"] = time.monotonic()
+                time.sleep(1.0)        # real downtime window
+                procs[victim] = spawn_shard(
+                    "127.0.0.1", base + victim, shard_index=victim,
+                    python_only=True)
+                kill_info["downtime_s"] = round(
+                    time.monotonic() - kill_info["t_kill"], 3)
+                return
+            time.sleep(0.01)
+
+    kt = _threading.Thread(target=killer, daemon=True)
+    kt.start()
+    try:
+        res, fc, wall = run_round("chaos", base, chaos_on=True)
+        kt.join(timeout=10)
+        stats = collect_broker_stats("127.0.0.1", base, shards)
+    finally:
+        for p in procs.values():
+            p.kill()
+    snap = fc.snapshot()
+    out = {
+        "shards": shards, "base_port": base, "victim_shard": victim,
+        "kill": {k: v for k, v in kill_info.items() if k != "t_kill"},
+        "wall_s": round(wall, 3),
+        "faults": snap,
+        "shard_stats": stats,
+    }
+    (cell_dir / "broker_shard.json").write_text(
+        json.dumps(out, indent=2, default=str))
+    if not res.history or not all(r.ok for r in res.history):
+        return False, "round not ok"
+    if wall > 240:
+        return False, f"round stalled ({wall:.0f}s)"
+    if "published_at_kill" not in kill_info:
+        return False, "victim shard never qualified for the kill " \
+                      "(no mid-round traffic observed)"
+    if snap.get("reconnects", 0) < 1:
+        return False, f"no reconnects counted: {snap}"
+    if snap.get("redeliveries", 0) < 1:
+        return False, f"no redeliveries counted: {snap}"
+    if snap.get("lost", 0) != 0:
+        return False, f"phantom lost: {snap.get('lost')}"
+    if snap.get("gave_up", 0) != 0:
+        return False, f"redelivery gave up: {snap.get('gave_up')}"
+    if [r.num_samples for r in res.history] \
+            != [r.num_samples for r in res_base.history]:
+        return False, "sample count drifted"
+    import jax
+    la = jax.tree_util.tree_leaves(res_base.params)
+    lb = jax.tree_util.tree_leaves(res.params)
+    if len(la) != len(lb) or any(
+            np.asarray(a).tobytes() != np.asarray(b).tobytes()
+            for a, b in zip(la, lb)):
+        return False, "aggregation not bit-identical to the twin"
+    return True, (f"shard {victim} killed+respawned "
+                  f"(depth {kill_info.get('depth_at_kill')} at kill), "
+                  f"{snap.get('reconnects')} reconnects "
+                  f"{snap.get('redeliveries')} redeliveries "
+                  f"{snap.get('dedup_hits', 0)} dedups, 0 lost "
+                  f"[{wall:.0f}s]")
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(
         description="Sweep fault probabilities over seeds; print a "
@@ -1016,6 +1223,17 @@ def main(argv=None):
                          "and its clients must fall back to direct "
                          "heartbeats, counted, with no phantom lost "
                          "flap (writes fleet_digest.json)")
+    ap.add_argument("--broker-shard", dest="broker_shard",
+                    action="store_true",
+                    help="run ONLY the sharded broker plane cell: a "
+                         "3-client round over 2 real broker shard "
+                         "processes with drop+dup+reorder chaos; the "
+                         "data-plane shard is SIGKILLed mid-round and "
+                         "respawned, and the round must complete "
+                         "bit-identical to a fault-free twin with "
+                         "exact fault counts (reconnects/redeliveries "
+                         "counted, zero lost) — writes "
+                         "broker_shard.json")
     ap.add_argument("--overlap", dest="overlap_mode",
                     action="store_true",
                     help="run ONLY the sync-overlap cell: a 3-client "
@@ -1036,6 +1254,20 @@ def main(argv=None):
         ok, note = tree_remote_cell(tmp)
         dt = time.monotonic() - t0
         print(f"tree-remote cell: {'PASS' if ok else 'FAIL'} ({note}) "
+              f"[{dt:.1f}s, artifacts in {tmp}]")
+        return 0 if ok else 1
+
+    if args.broker_shard:
+        if args.artifacts_dir:
+            tmp = args.artifacts_dir
+            pathlib.Path(tmp).mkdir(parents=True, exist_ok=True)
+        else:
+            import tempfile
+            tmp = tempfile.mkdtemp(prefix="chaos_broker_shard_")
+        t0 = time.monotonic()
+        ok, note = broker_shard_cell(tmp)
+        dt = time.monotonic() - t0
+        print(f"broker-shard cell: {'PASS' if ok else 'FAIL'} ({note}) "
               f"[{dt:.1f}s, artifacts in {tmp}]")
         return 0 if ok else 1
 

@@ -72,7 +72,9 @@ def build_cfg(args, log_dir: str, sched: bool):
 def run_leg(args, sched: bool, log_dir: str) -> dict:
     import threading
 
-    from split_learning_tpu.runtime.bus import InProcTransport
+    from split_learning_tpu.runtime.bus import (
+        Broker, InProcTransport, ShardedTcpTransport, find_port_block,
+    )
     from split_learning_tpu.runtime.server import ProtocolServer
     from split_learning_tpu.runtime.simfleet import (
         SyntheticFleet, hetero_fleet,
@@ -88,7 +90,23 @@ def run_leg(args, sched: bool, log_dir: str) -> dict:
         joiners=args.churn, join_delay_s=args.join_delay,
         leavers=args.churn, seed=args.seed)
     from split_learning_tpu.runtime.log import Logger
-    bus = InProcTransport()
+    # --shards N: host N in-proc event-loop broker shards and drive
+    # the whole deployment over the REAL sharded TCP plane (the sim's
+    # multi-driver mode routes every queue to its owning shard);
+    # default stays the zero-wire in-proc transport
+    brokers = []
+    bus_factory = None
+    if args.shards:
+        base = find_port_block(args.shards)
+        brokers = [Broker("127.0.0.1", base + i,
+                          shard_id=f"shard_{i}")
+                   for i in range(args.shards)]
+
+        def bus_factory():
+            return ShardedTcpTransport("127.0.0.1", base, args.shards)
+        bus = bus_factory()
+    else:
+        bus = InProcTransport()
     # console off: stdout is this tool's JSON summary
     server = ProtocolServer(cfg, transport=bus,
                             logger=Logger.for_run(cfg, "server",
@@ -102,8 +120,13 @@ def run_leg(args, sched: bool, log_dir: str) -> dict:
     if args.digest:
         from split_learning_tpu.runtime.aggnode import AggregatorNode
         for i in range(args.digest):
-            n = AggregatorNode(cfg, f"tel_node_{i}", transport=bus,
-                               fold_transport=bus, digest_transport=bus)
+            # over the sharded plane each node owns fresh connections
+            # (a shared blocking get would serialize a shard socket)
+            mk = bus_factory if bus_factory is not None \
+                else (lambda: bus)
+            n = AggregatorNode(cfg, f"tel_node_{i}", transport=mk(),
+                               fold_transport=mk(),
+                               digest_transport=mk())
             t = threading.Thread(target=n.run, daemon=True)
             t.start()
             nodes.append(n)
@@ -112,7 +135,8 @@ def run_leg(args, sched: bool, log_dir: str) -> dict:
     fleet = SyntheticFleet(
         bus, specs, heartbeat_interval=args.heartbeat_interval,
         time_scale=args.time_scale,
-        codec_gain=args.codec_gain).start()
+        codec_gain=args.codec_gain,
+        drivers=args.drivers, bus_factory=bus_factory).start()
     t0 = time.monotonic()
     try:
         res = server.serve()
@@ -120,6 +144,8 @@ def run_leg(args, sched: bool, log_dir: str) -> dict:
         fleet.stop()
         for n in nodes:
             n.stop()
+        for b in brokers:
+            b.close()
     wall = time.monotonic() - t0
     out = {
         "sched": sched,
@@ -199,6 +225,14 @@ def main(argv=None) -> int:
                     help="observability.watchlist-size (digest mode)")
     ap.add_argument("--http", action="store_true",
                     help="serve /metrics + /fleet during the run")
+    ap.add_argument("--shards", type=int, default=0, metavar="N",
+                    help="host N in-proc broker shards and run the "
+                         "deployment over the real sharded TCP plane "
+                         "(broker.shards) instead of the in-proc bus")
+    ap.add_argument("--drivers", type=int, default=1,
+                    help="fleet driver threads; with --shards each "
+                         "owns its own per-shard connections "
+                         "(shard-affine client placement)")
     ap.add_argument("--log-dir", default=None)
     args = ap.parse_args(argv)
 

@@ -92,6 +92,12 @@ STABLE_KEYS = {
     # O(nodes + top-K), the render O(max-client-series))
     "extra.fleet_digest_ingest_ms_100k": "down",
     "extra.fleet_metrics_render_ms_100k": "down",
+    # sharded event-loop broker plane (round-15): aggregate ingest
+    # throughput multiplier of 4 shard processes over the 1-shard
+    # baseline (>1 = the plane scales past one GIL), and the 4-vs-1
+    # shard round-wall ratio on the 100k synthetic fleet round
+    "extra.broker_shard_scaling": "up",
+    "extra.broker_round_wall_ratio_100k": "down",
 }
 
 #: absolute pins, enforced on the NEWEST record regardless of trend: a
@@ -128,6 +134,14 @@ STABLE_KEY_CAPS = {
     # anything that re-introduces a per-client walk — cannot calcify.
     "extra.fleet_digest_ingest_ms_100k": 50.0,
     "extra.fleet_metrics_render_ms_100k": 20.0,
+    # sharded broker plane acceptance pins (round-15): 4 shard
+    # processes must keep ingesting >= 2x the single broker's
+    # aggregate rate, and the 100k-fleet round wall through 4 shards
+    # must stay <= 0.7x the 1-shard wall — a regression toward
+    # re-serializing the plane (a shared lock, a single-connection
+    # funnel) cannot calcify
+    "extra.broker_shard_scaling": 2.0,
+    "extra.broker_round_wall_ratio_100k": 0.7,
 }
 
 #: attribution components of a kind=perf record, in report order
@@ -182,7 +196,8 @@ for _k in ("protocol_samples_per_sec", "cold_round_wall_s",
            "async_accuracy_delta", "update_bubble_ms",
            "update_overlap_ratio", "sched_wall_ratio_vs_static",
            "sched_decision_ms_10k", "fleet_digest_ingest_ms_100k",
-           "fleet_metrics_render_ms_100k"):
+           "fleet_metrics_render_ms_100k", "broker_shard_scaling",
+           "broker_round_wall_ratio_100k"):
     _path = ("extra.mfu." + _k
              if _k.startswith(("mfu_vs", "measured_matmul"))
              else "extra." + _k)

@@ -28,7 +28,8 @@ import time
 import urllib.request
 
 _STATE_COLOR = {"healthy": "\033[92m", "degraded": "\033[93m",
-                "straggler": "\033[95m", "lost": "\033[91m"}
+                "straggler": "\033[95m", "lost": "\033[91m",
+                "down": "\033[91m"}
 _RESET = "\033[0m"
 
 _COLUMNS = ("PARTICIPANT", "ROLE", "STATE", "CLUSTER", "SCHED",
@@ -39,6 +40,26 @@ _COLUMNS = ("PARTICIPANT", "ROLE", "STATE", "CLUSTER", "SCHED",
 #: (aggregation.remote) rate-columns read "-": their samples/s is
 #: structurally 0, the AGG gauges carry their load instead
 _ROLE = {"client": "client", "agg_node": "agg"}
+
+
+def _broker_rows(brokers: list) -> list[tuple]:
+    """ROLE=broker table rows from the /fleet ``brokers`` block (one
+    per shard; ``broker.shards``).  Training columns are structurally
+    empty — a shard's load lives in the summary line and the WIRE/AGE
+    columns (bytes moved, uptime)."""
+    rows = []
+    for s in brokers:
+        dead = "error" in s
+        name = s.get("shard") or f"shard_{s.get('shard_index', '?')}" \
+            f"@{s.get('port', '?')}"
+        wire_mb = (s.get("bytes_in", 0) + s.get("bytes_out", 0)) / 1e6
+        rows.append((
+            name, "broker", "down" if dead else "up",
+            "-", "-", "-", "-",
+            "-" if dead else _fmt(s.get("depth")),       # queued msgs
+            "-", "-", "-", "-", "-",
+            f"{wire_mb:.2f}", "-" if dead else _fmt(s.get("uptime_s"))))
+    return rows
 
 
 def fetch_fleet(url: str, timeout: float = 3.0) -> dict:
@@ -129,6 +150,15 @@ def render_fleet(fleet: dict, color: bool = True,
                f"p95={q.get('rate_p95')}/s" if q else "")
             + (f"  watchlist={len(fleet.get('watchlist') or [])}"
                if fleet.get("watchlist") is not None else ""))
+    brokers = fleet.get("brokers") or []
+    if brokers:
+        live = [s for s in brokers if "error" not in s]
+        summary.append(
+            f"brokers: {len(live)}/{len(brokers)} shard(s) up, "
+            f"{sum(s.get('conns', 0) for s in live)} conns, "
+            f"{sum(s.get('parked_gets', 0) for s in live)} parked "
+            f"gets, depth hwm "
+            f"{max((s.get('depth_hwm', 0) for s in live), default=0)}")
     shown = sorted(clients.items())
     if top is not None and len(shown) > top:
         shown = sorted(shown, key=_severity_key)[:top]
@@ -136,6 +166,7 @@ def render_fleet(fleet: dict, color: bool = True,
             f"showing worst {len(shown)} of {len(clients)} tracked "
             "rows (--all for every row; severity-ranked)")
     rows = [_COLUMNS]
+    rows += _broker_rows(brokers)
     for cid, c in shown:
         wire_mb = (c.get("wire_bytes_out") or 0) / 1e6
         agg = c.get("kind") == "agg_node"
@@ -206,6 +237,10 @@ def main(argv=None) -> int:
     ap.add_argument("--journal", default=None, metavar="DIR",
                     help="instead of polling: read the latest "
                          "kind=fleet record from DIR/metrics.jsonl")
+    ap.add_argument("--broker", default=None, metavar="HOST:PORT[:N]",
+                    help="instead of a server: poll N broker shards' "
+                         "stats control queues directly (default "
+                         "N=1) and render the ROLE=broker rows")
     ap.add_argument("--interval", type=float, default=1.0)
     ap.add_argument("--once", action="store_true",
                     help="render one snapshot and exit")
@@ -219,6 +254,28 @@ def main(argv=None) -> int:
     top = None if args.all else args.top
 
     def snap() -> tuple[dict | None, str, str]:
+        if args.broker:
+            # not stdlib-only like the default path: the shard stats
+            # ride the repo's own broker wire protocol
+            try:
+                from split_learning_tpu.runtime.bus import (
+                    collect_broker_stats,
+                )
+            except ImportError:
+                sys.path.insert(0, str(pathlib.Path(
+                    __file__).resolve().parent.parent))
+                from split_learning_tpu.runtime.bus import (
+                    collect_broker_stats,
+                )
+            host, _, rest = args.broker.partition(":")
+            port, _, n = rest.partition(":")
+            try:
+                brokers = collect_broker_stats(host, int(port),
+                                               int(n or 1))
+            except Exception as e:  # noqa: BLE001 — plane down
+                return None, args.broker, str(e)
+            return ({"clients": {}, "counts": {}, "t": time.time(),
+                     "brokers": brokers}, args.broker, "")
         if args.journal:
             return (fleet_from_journal(pathlib.Path(args.journal)),
                     args.journal, "no kind=fleet record found")
