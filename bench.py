@@ -276,6 +276,15 @@ class Artifact:
                       "broker_round_wall_per_client_ms_100k"):
                 if k in bsh and bsh[k] is not None:
                     self.extra[k] = bsh[k]
+        # stable keys (round-16 MPMD stage-pipeline PR): the 3-host
+        # end-to-end rate and its ratio over the single-process twin —
+        # mirrored at fixed paths UP FRONT (the r01-r05 tails needed
+        # regex archaeology; these are machine-readable from day one)
+        mpm = self.results.get("mpmd_pipeline")
+        if isinstance(mpm, dict):
+            for k in ("mpmd_samples_per_sec", "mpmd_scaling_3host"):
+                if k in mpm and mpm[k] is not None:
+                    self.extra[k] = mpm[k]
         plan = (self.cfgs.get("tinyllama_tinystories_4stage") or {})
         if isinstance(plan, dict):
             per_dev = (plan.get("memory_plan") or {}).get("per_device_gb")
@@ -2730,6 +2739,216 @@ def _sec_broker_shard(ctx: dict) -> dict:
     return out
 
 
+def _mpmd_tree_equal(a, b) -> bool:
+    """Exact (bit-level) equality of two nested param trees."""
+    import numpy as _np
+    if isinstance(a, dict) or isinstance(b, dict):
+        return (isinstance(a, dict) and isinstance(b, dict)
+                and set(a) == set(b)
+                and all(_mpmd_tree_equal(a[k], b[k]) for k in a))
+    return _np.array_equal(_np.asarray(a), _np.asarray(b))
+
+
+def _mpmd_cell(tag: str, n_hosts: int, base_port: int, *,
+               rounds: int, control: int, num_samples: int,
+               kill: bool = False):
+    """One MPMD deployment over the live 2-shard broker plane:
+    stage-1 feeders as threads in this process; the three later
+    stages either as in-process threads (``n_hosts=0``, the
+    single-process twin) or spread over ``n_hosts`` server-spawned,
+    core-pinned StageHost subprocesses.  ``kill`` SIGKILLs the first
+    slot-owning host the moment the round attempt arms the stage
+    watch (mid-round by construction) and lets the counted
+    re-assignment finish the round.
+
+    Returns ``(wall_s, samples, result, ctx, killed)`` where
+    ``killed`` is ``(host_id, n_slots_moved)`` or ``None``."""
+    import shutil
+    import threading
+
+    from split_learning_tpu.config import from_dict
+    from split_learning_tpu.runtime.bus import ShardedTcpTransport
+    from split_learning_tpu.runtime.client import ProtocolClient
+    from split_learning_tpu.runtime.plan import pipeline_slots
+    from split_learning_tpu.runtime.server import ProtocolServer
+
+    logdir = f"/tmp/slt_bench_mpmd_{tag}"
+    shutil.rmtree(logdir, ignore_errors=True)
+    cfg = from_dict({
+        # the deterministic chaos-grade recipe (control_count=1 +
+        # strict SDA) generalized to FOUR stages: three later-stage
+        # slots so 1/2/3 stage hosts all change the process layout
+        "model": "KWT", "dataset": "SPEECHCOMMANDS",
+        "clients": [2, 1, 1, 1], "global_rounds": rounds,
+        "synthetic_size": max(48, 2 * num_samples),
+        "val_max_batches": 1, "val_batch_size": 16,
+        "compute_dtype": "float32",
+        # dropout OFF: a middle stage relays activations on receipt
+        # (arrival order), so its rng-draw-to-batch assignment is
+        # thread-scheduling noise — with >= 3 stages the bit-identity
+        # recipe additionally needs rng-insensitive forwards (the
+        # 2-stage chaos recipe never has a middle stage; the head's
+        # strict sorted SDA window is deterministic on its own)
+        "model_kwargs": {"embed_dim": 16, "num_heads": 2,
+                         "mlp_dim": 32, "dropout_rate": 0.0},
+        "log_path": logdir,
+        "learning": {"batch_size": 4, "control_count": control,
+                     "optimizer": "adamw", "learning_rate": 1e-3},
+        "distribution": {"num_samples": num_samples},
+        "topology": {"cut_layers": [2, 4, 6]},
+        "aggregation": {"strategy": "sda", "sda_size": 2,
+                        "sda_strict": True, "local_rounds": 1},
+        "transport": {"kind": "tcp", "host": "127.0.0.1",
+                      "port": base_port, "async_send": False},
+        "broker": {"shards": 2},
+        # every process (this one + spawned hosts) shares the bench's
+        # persistent compile cache, so only the first leg pays XLA
+        "compile_cache_dir": str(HERE / ".jax_cache"
+                                 / host_cache_tag()),
+        "pipeline": ({"remote": True, "hosts": n_hosts,
+                      "retries": 2, "pin_cpus": True}
+                     if n_hosts else {}),
+        "checkpoint": {"directory": f"{logdir}/ckpt", "save": False},
+        "observability": {"heartbeat_interval": 0.5},
+    })
+    mk_bus = lambda: ShardedTcpTransport("127.0.0.1", base_port, 2)  # noqa: E731
+    server = ProtocolServer(cfg, transport=mk_bus(),
+                            client_timeout=600.0)
+    ctx = server.ctx
+    threads = []
+    for i in range(cfg.clients[0]):
+        c = ProtocolClient(cfg, f"client_1_{i}", 1, transport=mk_bus())
+        t = threading.Thread(target=c.run, daemon=True)
+        t.start()
+        threads.append(t)
+    if not n_hosts:
+        # the twin runs the later stages as threads UNDER THE SLOT
+        # IDS, so the fold (seed = client-id hash) is bit-comparable
+        for slot in pipeline_slots(cfg):
+            c = ProtocolClient(cfg, slot["client_id"],
+                               int(slot["stage"]), transport=mk_bus())
+            t = threading.Thread(target=c.run, daemon=True)
+            t.start()
+            threads.append(t)
+    killed: list = []
+    if kill:
+        def killer():
+            deadline = time.monotonic() + 300
+            while time.monotonic() < deadline:
+                if ctx._stage_watch:
+                    hid = next(
+                        (h for h in sorted(ctx._stage_assignments)
+                         if ctx._stage_assignments[h]), None)
+                    if hid:
+                        n_slots = len(ctx._stage_assignments[hid])
+                        proc = (ctx._stage_hosts.get(hid)
+                                or {}).get("proc")
+                        if proc is not None:
+                            proc.kill()   # SIGKILL, mid-round
+                            killed.append((hid, n_slots))
+                            return
+                time.sleep(0.005)
+        threading.Thread(target=killer, daemon=True).start()
+    t0 = time.perf_counter()
+    result = server.serve()
+    wall = time.perf_counter() - t0
+    for t in threads:
+        t.join(timeout=60)
+    samples = sum(r.num_samples for r in result.history)
+    # steady rate over the ROUND walls: process spawn + adoption +
+    # registration are one-time costs the sweep must not charge
+    # against the pipeline (the twin pays none of them)
+    round_wall = sum(r.wall_s for r in result.history) or wall
+    return ((wall, round_wall), samples, result, ctx,
+            (killed[0] if killed else None))
+
+
+def _sec_mpmd_pipeline(ctx: dict) -> dict:
+    """Cross-host MPMD stage pipeline (ROADMAP item 2's data-plane
+    half): the pipeline's three later stages as standalone StageHost
+    processes over a REAL 2-shard TCP broker plane, adopted via
+    StageHello/StageAssign.  Two legs:
+
+    1. **Process-scaling sweep** — identical 4-stage round, later
+       stages packed onto 1 / 2 / 3 core-pinned stage-host processes
+       vs the single-process twin.  Stable keys:
+       ``mpmd_samples_per_sec`` (3-host end-to-end rate) and
+       ``mpmd_scaling_3host`` (3-host rate / twin rate, pinned >=
+       1.5 on a multi-core box — adding a host must buy real
+       throughput, not just move the GIL around).
+    2. **Host-kill chaos** — a slot-owning stage host is SIGKILLed
+       the instant the round attempt arms the stage watch; the round
+       must complete via the counted re-assignment with the fold
+       BIT-IDENTICAL to the fault-free twin and exact fallback
+       counts (1 death, one re-assign per moved slot).
+    """
+    rounds = int(os.environ.get("SLT_BENCH_MPMD_ROUNDS", 2))
+    num_samples = int(os.environ.get("SLT_BENCH_MPMD_SAMPLES", 32))
+    base, procs = _spawn_broker_plane(2)
+    out: dict = {"stages": 4, "shards": 2, "rounds": rounds,
+                 "cores": os.cpu_count() or 1}
+    try:
+        # warm the shared compile cache once (twin shape; the host
+        # legs' subprocesses reuse it via cfg.compile_cache_dir)
+        _mpmd_cell("warm", 0, base, rounds=1, control=1,
+                   num_samples=8)
+        sweep: dict = {}
+        twin_rate = None
+        for n in (0, 1, 2, 3):
+            (wall, round_wall), samples, _res, _ctx, _ = _mpmd_cell(
+                f"scale{n}", n, base, rounds=rounds, control=2,
+                num_samples=num_samples)
+            rate = samples / max(round_wall, 1e-9)
+            sweep[str(n)] = {"wall_s": round(wall, 2),
+                             "round_wall_s": round(round_wall, 2),
+                             "samples": samples,
+                             "samples_per_sec": round(rate, 3)}
+            if n == 0:
+                twin_rate = rate
+        r1, r2, r3 = (sweep[k]["samples_per_sec"]
+                      for k in ("1", "2", "3"))
+        out["sweep"] = sweep
+        out["mpmd_samples_per_sec"] = r3
+        out["mpmd_scaling_3host"] = round(
+            r3 / max(twin_rate, 1e-9), 3)
+        out["scaling_monotonic_1_2_3"] = r1 <= r2 <= r3
+        out["scaling_within_budget"] = out["mpmd_scaling_3host"] >= 1.5
+
+        # chaos leg: fault-free twin first (deterministic recipe:
+        # control_count=1, strict SDA), then the 2-host cell with the
+        # scripted SIGKILL — host 0 owns 2 of the 3 slots, so the
+        # exact expected counts are 1 death / 2 re-assigns
+        _w, _, twin, _, _ = _mpmd_cell("chaos_twin", 0, base,
+                                       rounds=1, control=1,
+                                       num_samples=8)
+        _w, _, res, cctx, killed = _mpmd_cell("chaos", 2, base,
+                                              rounds=1, control=1,
+                                              num_samples=8,
+                                              kill=True)
+        snap = cctx.faults.snapshot()
+        identical = _mpmd_tree_equal(twin.params, res.params)
+        out["chaos"] = {
+            "round_ok": bool(res.history and res.history[0].ok),
+            "killed_host": killed[0] if killed else None,
+            "slots_moved": killed[1] if killed else 0,
+            "stage_host_deaths": snap.get("stage_host_deaths", 0),
+            "stage_reassigns": snap.get("stage_reassigns", 0),
+            "bit_identical": identical,
+        }
+        out["chaos_within_budget"] = bool(
+            killed is not None and identical
+            and res.history and res.history[0].ok
+            and snap.get("stage_host_deaths") == 1
+            and snap.get("stage_reassigns") == killed[1])
+        log(f"[bench] mpmd_pipeline: rate(twin/1/2/3)="
+            f"{sweep['0']['samples_per_sec']}/{r1}/{r2}/{r3} "
+            f"scaling={out['mpmd_scaling_3host']} "
+            f"chaos_ok={out['chaos_within_budget']}")
+        return out
+    finally:
+        _teardown_plane(procs)
+
+
 def _sec_test_ok(ctx: dict) -> dict:
     """Hidden test section: trivially succeeds (watchdog CI coverage)."""
     return {"ok": True}
@@ -2753,6 +2972,7 @@ SECTIONS = {
     "sched_fleet": _sec_sched_fleet,
     "fleet_digest": _sec_fleet_digest,
     "broker_shard": _sec_broker_shard,
+    "mpmd_pipeline": _sec_mpmd_pipeline,
     "resnet50_cifar100_3way_cut_3_6": _sec_resnet,
     "vit_s16_cifar10_cut_block6": _sec_vit,
     "tinyllama_tinystories_4stage": _sec_llama,
@@ -2778,6 +2998,7 @@ SECTION_PLAN = [
     ("sched_fleet", 1200),
     ("fleet_digest", 600),
     ("broker_shard", 1200),
+    ("mpmd_pipeline", 1800),
     ("resnet50_cifar100_3way_cut_3_6", 900),
     ("vit_s16_cifar10_cut_block6", 1500),
     ("tinyllama_tinystories_4stage", 3000),

@@ -49,7 +49,8 @@ from split_learning_tpu.analysis.findings import Finding
 CONTROL_KINDS = ("Register", "Ready", "Notify", "Update",
                  "Start", "Syn", "Pause", "Stop", "Heartbeat",
                  "PartialAggregate", "AggHello", "AggAssign",
-                 "AggFlush", "FleetDigest", "DigestRoute")
+                 "AggFlush", "FleetDigest", "DigestRoute",
+                 "StageHello", "StageAssign")
 DATA_KINDS = ("Activation", "Gradient", "EpochEnd")
 ALL_KINDS = CONTROL_KINDS + DATA_KINDS
 
@@ -109,6 +110,16 @@ SEND_RULES = frozenset({
     ("client", "digest", "Heartbeat"),
     ("aggregator", "rpc", "FleetDigest"),
     ("server", "reply", "DigestRoute"),
+    # MPMD cross-host stage pipeline (pipeline.remote,
+    # runtime/stagehost.py): a standalone stage-host process announces
+    # itself for adoption and heartbeats like a client; the server
+    # assigns (and, on host death, RE-assigns mid-round) the
+    # later-stage client slots over the host's reply queue.  The
+    # host's INNER per-slot clients are ordinary clients — their
+    # traffic is covered by the client rows above.
+    ("stagehost", "rpc", "StageHello"),
+    ("stagehost", "rpc", "Heartbeat"),
+    ("server", "reply", "StageAssign"),
 })
 
 #: queue families each role may consume from.  The server's aggregate
@@ -127,6 +138,9 @@ RECV_RULES = frozenset({
     # queue; the server drains a DEAD node's queue itself (the
     # fallback — parked beats are liveness proof, not losses)
     ("aggregator", "digest"), ("server", "digest"),
+    # stage host: StageAssign/Stop on its reply queue
+    # (runtime/stagehost.py StageHost.run)
+    ("stagehost", "reply"),
 })
 
 #: kinds legal on each DATA queue family (post-transport stream)
@@ -250,6 +264,27 @@ AGGREGATOR_FSM: dict[str, dict[tuple[str, str], str]] = {
     },
 }
 
+#: the MPMD stage host (runtime/stagehost.py StageHost): hello until
+#: adopted, then a flat assignment loop — a StageAssign may arrive at
+#: any time (initial fan-out, or a MID-ROUND re-assignment absorbing a
+#: dead peer's slots), each spinning inner clients whose own protocol
+#: traffic is validated under the client FSM.
+STAGEHOST_FSM: dict[str, dict[tuple[str, str], str]] = {
+    "idle": {
+        ("send", "StageHello"): "idle",   # re-sent until adopted
+        ("recv", "StageAssign"): "assigned",
+        ("recv", "Stop"): "stopped",
+    },
+    "assigned": {
+        ("send", "StageHello"): "assigned",   # reconnect re-hello
+        ("recv", "StageAssign"): "assigned",  # re-assignment / top-up
+        ("recv", "Stop"): "stopped",
+    },
+    "stopped": {
+        ("recv", "Stop"): "stopped",
+    },
+}
+
 CLIENT_FSM: dict[str, dict[tuple[str, str], str]] = {
     "idle": {
         ("send", "Register"): "idle",    # re-REGISTER until STARTed
@@ -312,6 +347,11 @@ for _state, _transitions in SERVER_FSM.items():
     # death fallback) happen the moment the death is noticed
     _transitions[("recv", "FleetDigest")] = _state
     _transitions[("send", "DigestRoute")] = _state
+    # stage-host adoption/assignment is lifecycle-orthogonal the same
+    # way: a host may hello at any point, and a mid-round host death
+    # triggers an immediate re-assignment, whatever the round phase
+    _transitions[("recv", "StageHello")] = _state
+    _transitions[("send", "StageAssign")] = _state
 for _state, _transitions in CLIENT_FSM.items():
     _transitions[("send", "Heartbeat")] = _state
     # heartbeat re-route is lifecycle-orthogonal: the beat thread's
@@ -324,9 +364,13 @@ for _state, _transitions in AGGREGATOR_FSM.items():
     _transitions[("send", "Heartbeat")] = _state
     _transitions[("recv", "Heartbeat")] = _state
     _transitions[("send", "FleetDigest")] = _state
+for _state, _transitions in STAGEHOST_FSM.items():
+    # stage hosts heartbeat from a background thread like clients
+    _transitions[("send", "Heartbeat")] = _state
 
 FSM_BY_ROLE = {"server": SERVER_FSM, "client": CLIENT_FSM,
-               "aggregator": AGGREGATOR_FSM}
+               "aggregator": AGGREGATOR_FSM,
+               "stagehost": STAGEHOST_FSM}
 INITIAL_STATE = "idle"
 
 
@@ -396,6 +440,7 @@ def events_from_log(text: str) -> list[Event]:
         participant = m.group("name").rsplit(".", 1)[0]
         role = ("server" if participant == "server"
                 else "aggregator" if participant.startswith("aggregator_")
+                else "stagehost" if participant.startswith("stage_host")
                 else "client")
         events.append(Event(
             role=role,

@@ -328,6 +328,11 @@ def _sample_messages():
                          "score": 0.3, "view": {"stage": 1}}],
                     "transitions": []}),
         "DigestRoute": P.DigestRoute(client_id="c", queue=None),
+        "StageHello": P.StageHello(host_id="stage_host_0", capacity=2),
+        "StageAssign": P.StageAssign(
+            host_id="stage_host_0", gen=3, round_idx=1,
+            slots=[{"client_id": "pipeline_s2_0", "stage": 2,
+                    "cluster": 0}]),
         "Activation": P.Activation(
             data_id="d0", data=np.ones((2, 3), np.float32),
             labels=np.zeros((2,), np.int64), trace=["c"], cluster=0),
@@ -485,7 +490,7 @@ def _check_handlers(root: pathlib.Path) -> list[Finding]:
     must_handle = {"client": {"Start", "Syn", "Pause", "Stop"},
                    "server": {"Register", "Ready", "Notify", "Update",
                               "Heartbeat", "PartialAggregate",
-                              "AggHello"}}
+                              "AggHello", "StageHello"}}
     for role in ("client", "server"):
         rel = f"split_learning_tpu/runtime/{role}.py"
         tree = ast.parse((root / rel).read_text())

@@ -763,6 +763,48 @@ class SchedulerConfig:
 
 
 @dataclasses.dataclass(frozen=True)
+class PipelineConfig:
+    """Cross-host MPMD stage pipeline (``runtime/stagehost.py``).
+
+    ``remote: true`` moves the pipeline's LATER-stage clients (the
+    ``intermediate_queue_*`` consumers) out of the deployment's
+    process group into standalone stage-host processes adopted over
+    the broker via StageHello/StageAssign — stage-0 feeders stay
+    wherever the deployment put them (they own the data).  The
+    activation/gradient streams already ride broker queues as
+    TENSOR/SLTC frames, so transport, codecs, generation fences and
+    the async staleness plane compose unchanged; what changes is WHO
+    polls those queues."""
+    # Adopt stage hosts announced with StageHello and assign them the
+    # later-stage client slots.  False (default): in-process later
+    # stages, unchanged.
+    remote: bool = False
+    # With remote: the number of stage-host subprocesses the SERVER
+    # spawns at startup (tcp transport only).  0 = adopt externally
+    # started hosts (`python -m split_learning_tpu.stagehost`).
+    hosts: int = 0
+    # Per-round cap on counted slot re-assignments after a stage-host
+    # death (FleetMonitor `lost` or child-process exit).  Each retry
+    # re-assigns the dead host's slots to a survivor under the SAME
+    # client ids and re-runs the round attempt behind a bumped
+    # generation fence — the re-run fold is bit-identical to the
+    # fault-free twin.  Exhausting retries fails the round loudly.
+    retries: int = 2
+    # With hosts: pin each spawned stage host to its own CPU core
+    # (host i -> core (i+1) mod cpu_count; core 0 stays with the
+    # server + feeders).  The NUMA-naive placement proxy the MPMD
+    # bench cell uses so host processes don't migrate mid-measurement;
+    # ignored when there are fewer cores than processes.
+    pin_cpus: bool = False
+
+    def validate(self):
+        _check(self.hosts >= 0, "pipeline.hosts must be >= 0")
+        _check(not self.hosts or self.remote,
+               "pipeline.hosts requires pipeline.remote")
+        _check(self.retries >= 0, "pipeline.retries must be >= 0")
+
+
+@dataclasses.dataclass(frozen=True)
 class Config:
     model: str = "VGG16"
     dataset: str = "CIFAR10"
@@ -793,6 +835,7 @@ class Config:
     observability: ObservabilityConfig = ObservabilityConfig()
     perf: PerfConfig = PerfConfig()
     scheduler: SchedulerConfig = SchedulerConfig()
+    pipeline: PipelineConfig = PipelineConfig()
 
     @property
     def model_key(self) -> str:
@@ -813,7 +856,7 @@ class Config:
         for sub in (self.learning, self.distribution, self.topology,
                     self.aggregation, self.transport, self.broker,
                     self.chaos, self.observability, self.perf,
-                    self.scheduler):
+                    self.scheduler, self.pipeline):
             sub.validate()
         if self.scheduler.enabled:
             # the scheduler's only senses are the fleet-telemetry
@@ -855,6 +898,12 @@ class Config:
                    "subprocesses) requires transport.kind: tcp — "
                    "in-process deployments adopt AggregatorNode "
                    "threads instead")
+        if self.pipeline.hosts:
+            _check(self.transport.kind == "tcp",
+                   "pipeline.hosts (server-spawned stage-host "
+                   "subprocesses) requires transport.kind: tcp — "
+                   "in-process deployments adopt StageHost threads "
+                   "instead")
         if self.topology.mode == "manual":
             cuts = self.topology.cluster_cut_layers or (
                 self.topology.cut_layers,)
@@ -878,6 +927,7 @@ _SECTION_TYPES = {
     "observability": ObservabilityConfig,
     "perf": PerfConfig,
     "scheduler": SchedulerConfig,
+    "pipeline": PipelineConfig,
 }
 
 

@@ -1155,6 +1155,7 @@ class ProtocolClient:
                 rec = self.perf.end_round(samples=self.num_samples)
                 if rec:
                     self.log.metric(kind="perf", client=self.client_id,
+                                    stage=self.stage,
                                     round_idx=msg.round_idx,
                                     aborted=True, **rec)
                 self.tracer.flush()
@@ -1172,6 +1173,7 @@ class ProtocolClient:
             rec = self.perf.end_round(samples=self.num_samples)
             if rec:
                 self.log.metric(kind="perf", client=self.client_id,
+                                stage=self.stage,
                                 round_idx=msg.round_idx, **rec)
         # pipelined rounds: keep ticking locally while the server
         # aggregates/validates and the next START streams in — BEFORE
@@ -2069,6 +2071,7 @@ class ProtocolClient:
             inflight[act.data_id] = _Inflight(x=x, rng=rng,
                                               trace=list(act.trace),
                                               n=len(act.labels))
+            self.gauges.set("queue_depth", len(inflight))
             _start_host_copy(out)
             self._publish_parts(
                 out_q,
@@ -2229,6 +2232,8 @@ class ProtocolClient:
             # never widen past the middle-stage client count.  Gradient
             # routing below still uses trace[-1] (hop-by-hop return).
             pending.setdefault(act.trace[0], []).append(act)
+            self.gauges.set("queue_depth",
+                            sum(len(q) for q in pending.values()))
             n_live = len(live())
             if n_live > target:
                 target = min(max(1, self.sda_size), n_live)

@@ -66,6 +66,26 @@ class ClusterPlan:
         return [c for ids in self.clients for c in ids]
 
 
+def pipeline_slots(cfg: Config) -> list[dict]:
+    """Deterministic later-stage client slots for the cross-host MPMD
+    stage pipeline (``pipeline.remote``): every stage >= 2 client the
+    configured counts call for, as plain dicts a
+    :class:`~split_learning_tpu.runtime.protocol.StageAssign` can
+    carry.  Ids follow the deployment convention
+    ``client_{stage}_{index}`` so a single-process twin running the
+    same ids produces a BIT-IDENTICAL fold (the per-client ShardRunner
+    seed is a client-id hash), and so a slot re-assigned to a
+    surviving host after a death keeps its identity.  Stage-0 feeders
+    are not slots — they own the data and stay wherever the
+    deployment put them."""
+    slots: list[dict] = []
+    for s in range(2, cfg.num_stages + 1):
+        for i in range(cfg.clients[s - 1]):
+            slots.append({"client_id": f"client_{s}_{i}",
+                          "stage": s, "cluster": None})
+    return slots
+
+
 def _num_classes(cfg: Config) -> int:
     return DATASET_CLASSES.get(cfg.dataset, 10)
 

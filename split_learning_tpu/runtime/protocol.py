@@ -315,6 +315,37 @@ class AggFlush:
 
 
 @dataclasses.dataclass
+class StageHello:
+    """stage host → server (rpc queue): a standalone pipeline stage
+    host announcing itself for adoption (``pipeline.remote``,
+    ``runtime/stagehost.py``).  Re-sent until adopted (an assignment
+    arrives); liveness afterwards rides the host's HEARTBEAT frames
+    like any client's.  ``capacity`` is informational — how many
+    later-stage client slots the host is willing to run."""
+    host_id: str
+    capacity: int = 0
+
+
+@dataclasses.dataclass
+class StageAssign:
+    """server → one stage host (its reply queue): the later-stage
+    client slots the host must run.  ``slots`` is a list of plain
+    dicts ``{client_id, stage, cluster}`` — the host spins one inner
+    protocol client per slot, which REGISTERs under the assigned
+    ``client_id`` and then speaks the ordinary choreography (so the
+    whole transport/chaos/codec stack composes unchanged).  ``gen``
+    carries the server's invocation generation on MID-ROUND
+    re-assignment (stage-host death fallback): a re-assigned slot
+    reuses the dead host's ``client_id``, so the ShardRunner seed —
+    and therefore the fold — is bit-identical to the fault-free
+    round."""
+    host_id: str
+    gen: int = 0
+    round_idx: int = 0
+    slots: list | None = None
+
+
+@dataclasses.dataclass
 class FleetDigest:
     """aggregator node → server (rpc queue), every
     ``observability.digest-interval`` seconds: one merged health
@@ -466,7 +497,8 @@ class _TensorRef:
 
 CONTROL_TYPES = (Register, Ready, Notify, Update, Start, Syn, Pause,
                  Stop, Heartbeat, PartialAggregate, AggHello, AggAssign,
-                 AggFlush, FleetDigest, DigestRoute)
+                 AggFlush, FleetDigest, DigestRoute, StageHello,
+                 StageAssign)
 DATA_TYPES = (Activation, Gradient, EpochEnd)
 #: messages whose ndarray payloads ride the zero-copy TENSOR framing
 #: (the high-volume data plane + the round's weight uploads — Update

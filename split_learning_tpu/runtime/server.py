@@ -29,6 +29,7 @@ from __future__ import annotations
 import argparse
 import copy
 import dataclasses
+import os
 import threading
 import time
 import zlib
@@ -50,8 +51,8 @@ from split_learning_tpu.runtime import aggregate as agg_plane
 from split_learning_tpu.runtime.protocol import (
     AggAssign, AggFlush, AggHello, DigestRoute, FleetDigest,
     FrameAssembler, Heartbeat, Notify, PartialAggregate, Pause, Ready,
-    Register, Start, Stop, Syn, Update, digest_queue, encode,
-    encode_parts, reply_queue, RPC_QUEUE,
+    Register, StageAssign, StageHello, Start, Stop, Syn, Update,
+    digest_queue, encode, encode_parts, reply_queue, RPC_QUEUE,
 )
 from split_learning_tpu.runtime.spans import unpack_ctx
 from split_learning_tpu.runtime.telemetry import FleetMonitor, GaugeSet
@@ -59,6 +60,21 @@ from split_learning_tpu.runtime.telemetry import FleetMonitor, GaugeSet
 
 class RoundTimeout(RuntimeError):
     pass
+
+
+class _StageHostLost(RuntimeError):
+    """Raised from inside a round attempt's barriers when an assigned
+    stage host (pipeline.remote) died — its spawned process exited or
+    the FleetMonitor marked it ``lost``.  Caught by ``train_cluster``'s
+    retry wrapper: the dead host's slots are re-assigned to a survivor
+    under the SAME client ids and the attempt re-runs behind a bumped
+    generation fence (every barrier frame is gen-fenced, so the aborted
+    attempt's stragglers drop on arrival and the re-run's fold is
+    bit-identical to a fault-free round)."""
+
+    def __init__(self, host_id: str):
+        super().__init__(f"stage host {host_id} lost mid-round")
+        self.host_id = host_id
 
 
 class ProtocolContext(MeshContext):
@@ -182,6 +198,16 @@ class ProtocolContext(MeshContext):
         # groups assignment, nodes already declared dead this
         # invocation, and the full tree plan by group idx
         self._agg_nodes: dict = {}     # node_id -> {t, proc?}
+        # cross-host MPMD stage pipeline (pipeline.remote,
+        # runtime/stagehost.py): adopted stage-host registry (StageHello
+        # / spawned Popen handles) and the standing host -> later-stage
+        # client-slot assignment.  _stage_watch arms the barrier-side
+        # death check only INSIDE a train_cluster attempt — a host dying
+        # between rounds is handled by the next attempt's recovery, not
+        # by an exception out of an idle pump.
+        self._stage_hosts: dict = {}        # host_id -> {t, proc?, dead?}
+        self._stage_assignments: dict = {}  # host_id -> [slot dicts]
+        self._stage_watch = False
         self._l1_remote: dict = {}     # node_id -> [AggGroup]
         self._dead_nodes: set = set()
         self._tree_groups: dict = {}   # group idx -> AggGroup
@@ -444,6 +470,21 @@ class ProtocolContext(MeshContext):
             ent["t"] = time.time()
             if self.fleet is not None:
                 self.fleet.note_frame(msg.node_id)
+        elif isinstance(msg, StageHello):
+            # a standalone stage-host process offering itself for
+            # adoption (pipeline.remote); liveness afterwards rides its
+            # heartbeats through the FleetMonitor like a client's.  A
+            # host helloing again AFTER assignment (slow adoption ack,
+            # or a restarted process under the same id) gets its
+            # standing slots re-sent — the host side is idempotent.
+            ent = self._stage_hosts.setdefault(msg.host_id, {})
+            if "t" not in ent:
+                self.log.received(f"STAGEHELLO {msg.host_id}")
+            ent["t"] = time.time()
+            if self.fleet is not None:
+                self.fleet.note_frame(msg.host_id)
+            if self._stage_assignments.get(msg.host_id):
+                self._send_stage_assign(msg.host_id)
         return True
 
     def _admit_update(self, msg: Update) -> None:
@@ -632,6 +673,97 @@ class ProtocolContext(MeshContext):
             return True
         return (self.fleet is not None
                 and self.fleet.state(node_id) == "lost")
+
+    # -- cross-host MPMD stage pipeline (pipeline.remote) --------------------
+
+    def _host_dead(self, host_id: str) -> bool:
+        """Same liveness rule as :meth:`_node_dead`, for stage hosts:
+        the spawned child exited, OR the FleetMonitor aged the host's
+        heartbeats to ``lost`` (externally-started hosts have no Popen
+        handle — the telemetry plane is their only death signal)."""
+        ent = self._stage_hosts.get(host_id) or {}
+        if ent.get("dead"):
+            return True
+        proc = ent.get("proc")
+        if proc is not None and proc.poll() is not None:
+            return True
+        return (self.fleet is not None
+                and self.fleet.state(host_id) == "lost")
+
+    def _send_stage_assign(self, host_id: str) -> None:
+        self.bus.publish(reply_queue(host_id), encode(StageAssign(
+            host_id=host_id, gen=self._cur_gen,
+            round_idx=getattr(self, "_cur_round", 0),
+            slots=[dict(s) for s in
+                   self._stage_assignments.get(host_id, [])])))
+        self.log.sent(
+            f"STAGEASSIGN {host_id} "
+            f"slots={len(self._stage_assignments.get(host_id, []))}")
+
+    def assign_stage_slots(self) -> None:
+        """Deal the pipeline's later-stage client slots round-robin
+        across the adopted stage hosts and publish each host its
+        StageAssign.  Runs BEFORE the registration barrier: the slots'
+        inner clients ARE later-stage registrations, so the barrier
+        cannot complete until the hosts have spun them up."""
+        from split_learning_tpu.runtime.plan import pipeline_slots
+        slots = pipeline_slots(self.cfg)
+        hosts = [h for h in sorted(self._stage_hosts)
+                 if "t" in self._stage_hosts[h]
+                 and not self._host_dead(h)]
+        if not hosts:
+            self.log.warning(
+                "pipeline.remote: no stage host adopted — "
+                "later-stage slots unassigned")
+            return
+        self._stage_assignments = {h: [] for h in hosts}
+        for j, slot in enumerate(slots):
+            self._stage_assignments[hosts[j % len(hosts)]].append(slot)
+        for h in hosts:
+            self._send_stage_assign(h)
+
+    def _check_stage_hosts(self) -> None:
+        """Barrier-side death check (armed only inside a round
+        attempt): the first assigned host found dead aborts the attempt
+        via :class:`_StageHostLost` — the retry wrapper re-assigns and
+        re-runs rather than letting the barrier eat its full deadline
+        waiting for clients whose process is gone."""
+        for host_id in sorted(self._stage_assignments):
+            if self._stage_assignments[host_id] \
+                    and self._host_dead(host_id):
+                raise _StageHostLost(host_id)
+
+    def _recover_stage_host(self, host_id: str) -> None:
+        """Counted re-assignment after a stage-host death: the dead
+        host's slots move to the surviving hosts round-robin UNDER THE
+        SAME CLIENT IDS (the per-client ShardRunner seed is a client-id
+        hash, so the re-run round's fold stays bit-identical to the
+        fault-free twin), and each touched survivor gets a fresh
+        StageAssign.  One ``stage_host_deaths`` per death, one
+        ``stage_reassigns`` per moved slot — the chaos cell's exact
+        expected counts."""
+        self.faults.inc("stage_host_deaths")
+        ent = self._stage_hosts.setdefault(host_id, {})
+        ent["dead"] = True
+        dead_slots = self._stage_assignments.pop(host_id, [])
+        survivors = [h for h in sorted(self._stage_assignments)
+                     if not self._host_dead(h)]
+        if not survivors:
+            raise RoundTimeout(
+                f"stage host {host_id} died and no live stage host "
+                "remains to adopt its "
+                f"{len(dead_slots)} slot(s)")
+        touched = set()
+        for j, slot in enumerate(dead_slots):
+            tgt = survivors[j % len(survivors)]
+            self._stage_assignments[tgt].append(slot)
+            self.faults.inc("stage_reassigns")
+            touched.add(tgt)
+        self.log.warning(
+            f"stage host {host_id} lost: re-assigned "
+            f"{len(dead_slots)} slot(s) to {sorted(touched)}")
+        for tgt in sorted(touched):
+            self._send_stage_assign(tgt)
 
     # -- hierarchical heartbeat roll-up (observability.digest-interval) ------
 
@@ -1148,12 +1280,23 @@ class ProtocolContext(MeshContext):
                     if deadline is None else deadline)
         t_begin = time.monotonic()
         t_checked = 0.0
+        t_stage = 0.0
         while not pred():
             if poll is not None:
                 poll()   # e.g. L1 aggregator health -> fallback drain
                 if pred():
                     return True
             now = time.monotonic()
+            # stage-host death check (pipeline.remote, armed only
+            # inside a round attempt): raises _StageHostLost so the
+            # retry wrapper re-assigns and re-runs instead of this
+            # barrier eating its deadline on a dead host's clients
+            if (self._stage_watch
+                    and now - t_stage >= self._WAIT_CHECK_S):
+                t_stage = now
+                if self.fleet is not None:
+                    self.fleet.advance()
+                self._check_stage_hosts()
             remain = deadline - now
             if remain <= 0:
                 w = what() if callable(what) else what
@@ -1446,7 +1589,46 @@ class ProtocolContext(MeshContext):
 
     # -- the remote round ----------------------------------------------------
 
-    def train_cluster(self, plan: ClusterPlan, params, stats, *,
+    def train_cluster(self, plan: ClusterPlan, params, stats,
+                      **kw) -> list[Update]:
+        """One remote round for one cluster — see
+        :meth:`_train_cluster_once` for the choreography.
+
+        This wrapper adds the pipeline.remote death-retry loop: with
+        stage-host slots assigned, a host death mid-attempt surfaces
+        as :class:`_StageHostLost` from a barrier's pump; the wrapper
+        re-assigns the dead host's slots to survivors (same client
+        ids) and re-runs the attempt.  The re-run bumps the generation
+        fence, so every straggler frame from the aborted attempt drops
+        on arrival and the re-run fold is bit-identical to a
+        fault-free round — surviving clients mid-round receive the
+        fresh START, requeue-and-abort (``_redeliver_start``), and
+        rejoin.  ``pipeline.retries`` caps attempts; exhaustion fails
+        the round loudly."""
+        if not self._stage_assignments:
+            return self._train_cluster_once(plan, params, stats, **kw)
+        retries = int(getattr(self.cfg.pipeline, "retries", 0))
+        attempt = 0
+        while True:
+            self._stage_watch = True
+            try:
+                return self._train_cluster_once(plan, params, stats,
+                                                **kw)
+            except _StageHostLost as e:
+                attempt += 1
+                if attempt > retries:
+                    raise RoundTimeout(
+                        f"stage host {e.host_id} died and "
+                        f"pipeline.retries={retries} re-assignment "
+                        "attempt(s) are exhausted") from e
+                self.log.warning(
+                    f"round attempt aborted ({e}); re-assigning and "
+                    f"re-running (attempt {attempt}/{retries})")
+                self._recover_stage_host(e.host_id)
+            finally:
+                self._stage_watch = False
+
+    def _train_cluster_once(self, plan: ClusterPlan, params, stats, *,
                       round_idx: int = 0, epochs: int = 1,
                       client_subset: list | None = None,
                       per_client_params: dict | None = None,
@@ -2177,6 +2359,9 @@ class ProtocolContext(MeshContext):
         for nid in self._agg_nodes:
             self.bus.publish(reply_queue(nid),
                              encode(Stop(reason=reason)))
+        for hid in self._stage_hosts:
+            self.bus.publish(reply_queue(hid),
+                             encode(Stop(reason=reason)))
         # the STOP fan-out must actually leave this process before the
         # caller tears the broker down
         flush = getattr(self.bus, "flush", None)
@@ -2237,6 +2422,34 @@ class ProtocolServer:
             self.log.info(
                 f"spawned {cfg.aggregation.nodes} aggregator "
                 "node(s)", "cyan")
+        # pipeline.hosts: spawn the stage-host subprocesses this
+        # deployment wants (tcp only — validated at config load); the
+        # hosts connect to the broker, StageHello into the rpc pump,
+        # and are adopted + assigned before the registration barrier
+        # (their inner clients ARE the later-stage registrations)
+        self._spawned_hosts: list = []
+        if cfg.pipeline.remote and cfg.pipeline.hosts:
+            import pathlib
+
+            from split_learning_tpu.runtime.stagehost import (
+                spawn_stage_host, write_host_config,
+            )
+            cfg_path = pathlib.Path(
+                getattr(self.log, "output_dir", None)
+                or cfg.log_path) / "stagehost_config.json"
+            write_host_config(cfg, cfg_path)
+            ncpu = os.cpu_count() or 1
+            for i in range(cfg.pipeline.hosts):
+                hid = f"stage_host_{i}"
+                # pin_cpus: one core per host, core 0 left to the
+                # server + feeders — placement-stable measurement
+                cpu = ((i + 1) % ncpu
+                       if cfg.pipeline.pin_cpus and ncpu > 1 else None)
+                proc = spawn_stage_host(cfg_path, hid, cpu=cpu)
+                self.ctx._stage_hosts.setdefault(hid, {})["proc"] = proc
+                self._spawned_hosts.append(proc)
+            self.log.info(
+                f"spawned {cfg.pipeline.hosts} stage host(s)", "cyan")
         # real-time export (observability.http-port): /metrics serves
         # Prometheus text, /fleet the JSON health snapshot — what
         # tools/sl_top.py polls for the live terminal view.  Render
@@ -2387,6 +2600,32 @@ class ProtocolServer:
             ensure_initialized,
         )
         ensure_initialized()
+        if self.cfg.pipeline.remote:
+            # adopt stage hosts and deal the later-stage slots BEFORE
+            # the registration barrier: the slots' inner clients are
+            # the later-stage registrations the barrier counts, so no
+            # host = the barrier can never release.  Zero adopted
+            # hosts is therefore fatal, not a warning.
+            ctx = self.ctx
+            want = max(int(self.cfg.pipeline.hosts), 1)
+
+            def helloed() -> int:
+                return sum(1 for e in ctx._stage_hosts.values()
+                           if "t" in e)
+            ctx._pump_until(
+                lambda: helloed() >= want,
+                lambda: (f"stage host adoption "
+                         f"({helloed()}/{want} helloed)"),
+                deadline=time.monotonic() + 60.0)
+            if not helloed():
+                raise RoundTimeout(
+                    "pipeline.remote: no stage host announced itself "
+                    "within 60s — start hosts with `python -m "
+                    "split_learning_tpu.stagehost` or set "
+                    "pipeline.hosts")
+            self.log.info(
+                f"stage hosts adopted: {helloed()}/{want}", "cyan")
+            ctx.assign_stage_slots()
         regs = self.ctx.wait_for_registrations()
         if self.cfg.aggregation.remote:
             # adopt aggregator nodes before the first round: spawned
@@ -2426,7 +2665,7 @@ class ProtocolServer:
                 register_process_capture(None)
             if self.exporter is not None:
                 self.exporter.close()
-            for proc in self._spawned_nodes:
+            for proc in self._spawned_nodes + self._spawned_hosts:
                 # STOP already fanned out (stop_all); give each child
                 # a moment to exit cleanly, then make sure
                 try:

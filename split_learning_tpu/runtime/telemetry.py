@@ -1036,6 +1036,11 @@ class FleetMonitor:
             "compute_samples_per_s":
                 h.gauges.get("compute_samples_per_s"),
             "hbm_peak_bytes": h.gauges.get("hbm_peak_bytes"),
+            # MPMD stage pipeline (pipeline.remote): a later-stage
+            # client's ingest backlog and a stage host's slot count;
+            # absent for pre-plane participants — consumers render "-"
+            "queue_depth": h.gauges.get("queue_depth"),
+            "stage_slots": h.gauges.get("stage_slots"),
             "counters": dict(h.counters),
         }
         if series:

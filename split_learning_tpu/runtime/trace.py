@@ -111,6 +111,13 @@ FAULT_COUNTER_NAMES = frozenset({
     # tier): adopted aggregation.fan-in changes driven by measured
     # kind=agg_node fold walls
     "sched_fanin_retunes",
+    # MPMD cross-host stage pipeline (runtime/stagehost.py +
+    # pipeline.remote): stage hosts declared dead mid-round (child
+    # exit or FleetMonitor lost), and later-stage client slots moved
+    # to a surviving host (one inc per slot — the chaos cell's exact
+    # fallback count), after which the invocation re-runs under a
+    # fresh generation
+    "stage_host_deaths", "stage_reassigns",
 })
 
 #: Declared registry of latency-histogram names (same contract as
@@ -179,6 +186,13 @@ GAUGE_NAMES = frozenset({
     "broker_shards_up", "broker_conns", "broker_queues",
     "broker_depth", "broker_depth_hwm", "broker_parked_gets",
     "broker_bytes_in", "broker_bytes_out",
+    # MPMD stage pipeline (runtime/client.py later-stage hot loops +
+    # runtime/stagehost.py): a later-stage client's local ingest
+    # backlog (buffered SDA window batches at the head, awaiting-
+    # gradient in-flight entries at a middle stage), and the slot
+    # count a stage host is currently running — both ride heartbeats
+    # so sl_top can name a backed-up hop
+    "queue_depth", "stage_slots",
 })
 
 

@@ -1,0 +1,7 @@
+"""``python -m split_learning_tpu.stagehost`` — standalone stage-host
+entry (``pipeline.remote``, ``runtime/stagehost.py``)."""
+
+from split_learning_tpu.runtime.stagehost import main
+
+if __name__ == "__main__":
+    main()

@@ -266,6 +266,42 @@ class TestShardFor:
         assert shard_for("anything", 1) == 0
         assert shard_for("anything", 0) == 0
 
+    def test_deep_pipeline_data_plane_families(self):
+        """MPMD pipeline contract (pipeline.remote): a deep pipeline's
+        per-hop data-plane families — the REAL ctor-produced names, not
+        hand-written lookalikes — must spread across broker shards (a
+        3-stage pipeline's hops must not serialize behind one shard's
+        event loop), while each individual queue stays whole on its
+        owner and every process computes the same owner independently
+        (a stage host and the server route without coordination)."""
+        from split_learning_tpu.runtime.protocol import (
+            gradient_queue, intermediate_queue,
+        )
+        # consecutive stage hops of one cluster cover a 4-shard plane
+        hops = [intermediate_queue(s, 0) for s in range(1, 5)]
+        assert {shard_for(q, 4) for q in hops} == {0, 1, 2, 3}
+        # per-client gradient returns of one stage spread too
+        grads = [gradient_queue(2, f"client_2_{i}") for i in range(4)]
+        assert {shard_for(q, 4) for q in grads} == {0, 1, 2, 3}
+        # 2LS pair-indexed activation queues spread across pairs
+        pairs = [intermediate_queue(1, 0, pair=p) for p in range(4)]
+        assert {shard_for(q, 4) for q in pairs} == {0, 1, 2, 3}
+        # one queue is NEVER split across shards: repeated routing of
+        # the same name is a single owner
+        for q in hops + grads + pairs:
+            assert len({shard_for(q, 4) for _ in range(50)}) == 1
+        # cross-process determinism for the data-plane families
+        qs = hops + grads + pairs
+        local = {q: shard_for(q, 4) for q in qs}
+        code = ("import json, sys\n"
+                "from split_learning_tpu.runtime.bus import shard_for\n"
+                "qs = json.loads(sys.argv[1])\n"
+                "print(json.dumps({q: shard_for(q, 4) for q in qs}))\n")
+        out = subprocess.run(
+            [sys.executable, "-c", code, json.dumps(qs)],
+            capture_output=True, text=True, check=True)
+        assert json.loads(out.stdout) == local
+
 
 # --------------------------------------------------------------------------
 # ShardedTcpTransport: routing, isolation, redelivery

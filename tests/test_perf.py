@@ -768,6 +768,56 @@ class TestSlPerf:
         assert [t["mfu"] for t in report["mfu_trend"]] == [0.25, 0.31]
         out = sp.render_report(report)
         assert "COMPILE" in out and "0.25" in out
+        # no stage-stamped records -> no per-hop section
+        assert "hops" not in report
+        assert "per-hop" not in out
+
+    def test_attribution_merges_stage_records_per_hop(self, tmp_path):
+        """Stage-stamped kind=perf records — including the ones a
+        stage-host process's inner clients write — roll up into one
+        compute|wire|wait row per pipeline hop."""
+        sp = _sl_perf()
+        m = tmp_path / "metrics.jsonl"
+        recs = [
+            # hop 1: two first-stage clients in the server process
+            {"kind": "perf", "participant": "client_1_0", "round": 0,
+             "stage": 1, "wall_s": 10.0, "compute_s": 6.0,
+             "compile_s": 0.0, "dispatch_s": 1.0, "host_s": 0.5,
+             "wait_s": 2.5, "steps": 8, "samples": 64, "retraces": 0},
+            {"kind": "perf", "participant": "client_1_1", "round": 0,
+             "stage": 1, "wall_s": 9.0, "compute_s": 5.0,
+             "compile_s": 0.0, "dispatch_s": 0.5, "host_s": 0.5,
+             "wait_s": 3.0, "steps": 8, "samples": 64, "retraces": 0},
+            # hop 2: the slot a StageHost runs remotely
+            {"kind": "perf", "participant": "client_2_0", "round": 0,
+             "stage": 2, "wall_s": 10.0, "compute_s": 4.0,
+             "compile_s": 0.0, "dispatch_s": 2.0, "host_s": 1.0,
+             "wait_s": 3.0, "steps": 8, "samples": 128,
+             "retraces": 0},
+            # pre-stage-stamp record: contributes to rounds, not hops
+            {"kind": "perf", "participant": "legacy", "round": 0,
+             "wall_s": 1.0, "compute_s": 1.0, "compile_s": 0.0,
+             "dispatch_s": 0.0, "host_s": 0.0, "wait_s": 0.0,
+             "steps": 1, "retraces": 0},
+        ]
+        m.write_text("".join(json.dumps(r) + "\n" for r in recs))
+        report = sp.attribution_report(sp.load_perf_records(tmp_path))
+        assert len(report["rounds"]) == 4
+        hops = report["hops"]
+        assert sorted(hops) == ["1", "2"]
+        assert hops["1"]["n"] == 2
+        assert hops["1"]["wall_s"] == 19.0
+        assert hops["1"]["compute_s"] == 11.0
+        # wire = dispatch + host, summed across the hop's records
+        assert hops["1"]["wire_s"] == 2.5
+        assert hops["1"]["wait_s"] == 5.5
+        assert hops["1"]["samples"] == 128
+        assert hops["2"] == {"n": 1, "wall_s": 10.0,
+                             "compute_s": 4.0, "wire_s": 3.0,
+                             "wait_s": 3.0, "samples": 128}
+        out = sp.render_report(report)
+        assert "per-hop attribution (stage pipeline):" in out
+        assert "STAGE" in out and "WIRE" in out
 
 
 # --------------------------------------------------------------------------

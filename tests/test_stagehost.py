@@ -1,0 +1,412 @@
+"""Cross-host MPMD stage pipeline (``pipeline.remote``).
+
+Fast tier-1: the StageHello/StageAssign adoption choreography on an
+in-proc bus (re-sent hello, idempotent assignment, Stop teardown) and
+the deterministic later-stage slot plan.
+
+Slow soaks: a full round with the later stage on a StageHost aggregates
+BIT-IDENTICAL to the single-process twin (same client ids -> same
+per-client seeds), and a stage-host death mid-round completes via the
+counted slot re-assignment with the fold still bit-identical.
+"""
+
+import threading
+import time
+
+import pytest
+
+from split_learning_tpu.config import ConfigError, from_dict
+from split_learning_tpu.runtime.bus import InProcTransport
+from split_learning_tpu.runtime.protocol import (
+    StageAssign, StageHello, Stop, decode, encode, reply_queue,
+    RPC_QUEUE,
+)
+
+from test_chaos import _assert_trees_identical, _round_cfg, _run_cell
+
+
+# --------------------------------------------------------------------------
+# slot plan + config surface (fast)
+# --------------------------------------------------------------------------
+
+def test_pipeline_slots_deterministic():
+    from split_learning_tpu.runtime.plan import pipeline_slots
+    cfg = from_dict({"clients": [3, 2, 1],
+                     "topology": {"cut_layers": [2, 4]}})
+    slots = pipeline_slots(cfg)
+    # stage-0 feeders are NOT slots; later stages in (stage, index)
+    # order under the deployment's client_{stage}_{i} convention, so a
+    # single-process twin running the same ids folds bit-identically
+    assert [s["client_id"] for s in slots] == [
+        "client_2_0", "client_2_1", "client_3_0"]
+    assert [s["stage"] for s in slots] == [2, 2, 3]
+    assert pipeline_slots(cfg) == slots   # deterministic
+    assert pipeline_slots(from_dict({"clients": [4]})) == []
+
+
+def test_pipeline_config_validation():
+    cfg = from_dict({"pipeline": {"remote": True, "retries": 0}})
+    assert cfg.pipeline.remote and cfg.pipeline.retries == 0
+    with pytest.raises(ConfigError):
+        from_dict({"pipeline": {"hosts": 2}})   # hosts w/o remote
+    with pytest.raises(ConfigError):
+        # server-spawned hosts need a broker to meet the server at
+        from_dict({"pipeline": {"remote": True, "hosts": 2}})
+    tcp = from_dict({"pipeline": {"remote": True, "hosts": 2},
+                     "transport": {"kind": "tcp"}})
+    assert tcp.pipeline.hosts == 2
+
+
+# --------------------------------------------------------------------------
+# adoption choreography (fast, in-proc bus)
+# --------------------------------------------------------------------------
+
+class _StubClient:
+    """Stands in for ProtocolClient inside SlotWorker: blocks until
+    released, exposes the attribute surface the host reads."""
+
+    def __init__(self):
+        from split_learning_tpu.runtime.telemetry import GaugeSet
+        from split_learning_tpu.runtime.trace import HistogramSet
+        self.hists = HistogramSet()
+        self.gauges = GaugeSet()
+        self.num_samples = 0
+        self.release = threading.Event()
+
+    def run(self):
+        self.release.wait(timeout=30.0)
+
+
+def _drain_hellos(bus, timeout=5.0):
+    deadline = time.monotonic() + timeout
+    hellos = []
+    while time.monotonic() < deadline:
+        raw = bus.get(RPC_QUEUE, timeout=0.1)
+        if raw is None:
+            if hellos:
+                return hellos
+            continue
+        msg = decode(raw)
+        if isinstance(msg, StageHello):
+            hellos.append(msg)
+    return hellos
+
+
+class TestAdoption:
+    def _host(self, tmp_path, bus):
+        from split_learning_tpu.runtime.stagehost import StageHost
+        cfg = _round_cfg(tmp_path, tmp_path,
+                         pipeline={"remote": True},
+                         observability={"heartbeat_interval": 0.0})
+        made = []
+
+        def mk(slot):
+            c = _StubClient()
+            made.append((slot["client_id"], c))
+            return c
+
+        host = StageHost(cfg, "stage_host_0", transport=bus,
+                         make_client=mk)
+        return host, made
+
+    def test_hello_assign_idempotent_stop(self, tmp_path):
+        bus = InProcTransport()
+        host, made = self._host(tmp_path, bus)
+        t = threading.Thread(target=host.run, daemon=True)
+        t.start()
+        try:
+            # hello is re-sent until an assignment arrives
+            first = _drain_hellos(bus)
+            assert first and first[0].host_id == "stage_host_0"
+            assign = StageAssign(
+                host_id="stage_host_0", gen=1,
+                slots=[{"client_id": "client_2_0", "stage": 2,
+                        "cluster": None}])
+            bus.publish(reply_queue("stage_host_0"), encode(assign))
+            deadline = time.monotonic() + 5.0
+            while time.monotonic() < deadline and len(made) < 1:
+                time.sleep(0.02)
+            assert [cid for cid, _ in made] == ["client_2_0"]
+            assert host.adopted.is_set()
+            # an idempotent re-send (a mid-round recovery re-sends the
+            # survivor's whole standing list) must not respawn a live
+            # slot, and a NEW slot under the same assign must spawn
+            assign2 = StageAssign(
+                host_id="stage_host_0", gen=2,
+                slots=[{"client_id": "client_2_0", "stage": 2,
+                        "cluster": None},
+                       {"client_id": "client_2_1", "stage": 2,
+                        "cluster": None}])
+            bus.publish(reply_queue("stage_host_0"), encode(assign2))
+            deadline = time.monotonic() + 5.0
+            while time.monotonic() < deadline and len(made) < 2:
+                time.sleep(0.02)
+            assert [cid for cid, _ in made] == ["client_2_0",
+                                                "client_2_1"]
+            assert host.gauges.get("stage_slots") == 2
+        finally:
+            for _, c in made:
+                c.release.set()
+            bus.publish(reply_queue("stage_host_0"),
+                        encode(Stop(reason="test done")))
+            t.join(timeout=15.0)
+        assert not t.is_alive()
+
+    def test_hello_resent_until_adopted(self, tmp_path):
+        import split_learning_tpu.runtime.stagehost as shmod
+        bus = InProcTransport()
+        host, made = self._host(tmp_path, bus)
+        old = shmod.HELLO_RESEND_S
+        shmod.HELLO_RESEND_S = 0.1
+        t = threading.Thread(target=host.run, daemon=True)
+        t.start()
+        try:
+            time.sleep(0.6)
+            hellos = _drain_hellos(bus, timeout=2.0)
+            assert len(hellos) >= 2, "unadopted host must re-hello"
+        finally:
+            shmod.HELLO_RESEND_S = old
+            bus.publish(reply_queue("stage_host_0"),
+                        encode(Stop(reason="test done")))
+            t.join(timeout=15.0)
+        assert not t.is_alive()
+
+
+# --------------------------------------------------------------------------
+# observability: ROLE=stage rows in sl_top (fast)
+# --------------------------------------------------------------------------
+
+def test_sl_top_renders_stage_rows():
+    import importlib.util
+    import pathlib
+
+    from split_learning_tpu.runtime.telemetry import (
+        FleetMonitor, TelemetrySnapshot,
+    )
+    spec = importlib.util.spec_from_file_location(
+        "sl_top", pathlib.Path(__file__).parent.parent
+        / "tools" / "sl_top.py")
+    sl_top = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(sl_top)
+
+    fm = FleetMonitor(interval=1.0, liveness_timeout=10.0)
+    fm.note_heartbeat("c1", TelemetrySnapshot(
+        part="c1", t=100.0, seq=2, kind="client",
+        samples_per_s=5.0).as_dict(), now=100.0)
+    fm.note_heartbeat("stage_host_0", TelemetrySnapshot(
+        part="stage_host_0", t=100.0, seq=2, kind="stage_host",
+        stage=2, samples=32, samples_per_s=7.5,
+        gauges={"queue_depth": 3.0, "stage_slots": 2.0}).as_dict(),
+        now=100.0)
+    fm.advance(now=100.2)
+    snap = fm.snapshot(now=100.2)
+    # the fleet view carries the pipeline-plane fields through
+    ent = snap["clients"]["stage_host_0"]
+    assert ent["kind"] == "stage_host"
+    assert ent["queue_depth"] == 3.0 and ent["stage_slots"] == 2.0
+    # a stage host is never rate-scored a straggler (its rate is the
+    # sum of its slots, not a per-client series)
+    assert ent["straggler_score"] is None
+
+    out = sl_top.render_fleet(snap, color=False)
+    lines = out.splitlines()
+    row = next(ln for ln in lines if ln.startswith("stage_host_0"))
+    assert " stage " in row        # ROLE
+    assert " s2 " in row           # stage id in the CLUSTER column
+    assert " 3 " in row or " 3.0 " in row   # QDEPTH
+    # pre-plane participants (no queue_depth gauge) render "-"
+    client_row = next(ln for ln in lines if ln.startswith("c1"))
+    assert " client " in client_row
+
+
+# --------------------------------------------------------------------------
+# measured-rate cut balancing: stage-host-resident clients feed the
+# re-planner's per-stage stats (fast)
+# --------------------------------------------------------------------------
+
+def test_stage_host_clients_feed_cut_replanner(tmp_path):
+    """A slot promoted onto a StageHost keeps its OWN TelemetryEmitter
+    (kind=client, stage stamped), so its beats roll up into the fleet
+    snapshot's "stages" block exactly like an in-process client's — and
+    the scheduler's cut re-planner reads measured later-stage rates
+    from there, with no stage-host-specific plumbing."""
+    from split_learning_tpu.runtime.scheduler import Scheduler
+    from split_learning_tpu.runtime.telemetry import (
+        FleetMonitor, TelemetrySnapshot,
+    )
+
+    fm = FleetMonitor(interval=1.0, liveness_timeout=10.0)
+    fm.note_heartbeat("client_1_0", TelemetrySnapshot(
+        part="client_1_0", t=100.0, seq=2, kind="client", stage=1,
+        samples_per_s=9.0,
+        gauges={"compute_samples_per_s": 10.0}).as_dict(), now=100.0)
+    # the stage-2 slot beating FROM a stage-host process: same frame
+    # shape, only the emitting process differs
+    fm.note_heartbeat("client_2_0", TelemetrySnapshot(
+        part="client_2_0", t=100.0, seq=2, kind="client", stage=2,
+        samples_per_s=4.0,
+        gauges={"compute_samples_per_s": 4.0}).as_dict(), now=100.0)
+    # the host's own beat is kind=stage_host: it must NOT double-count
+    # into the per-stage client stats
+    fm.note_heartbeat("stage_host_0", TelemetrySnapshot(
+        part="stage_host_0", t=100.0, seq=2, kind="stage_host",
+        stage=2, samples_per_s=4.0,
+        gauges={"compute_samples_per_s": 4.0,
+                "stage_slots": 1.0}).as_dict(), now=100.0)
+    fm.advance(now=100.2)
+    fleet = fm.snapshot(now=100.2)
+    assert fleet["stages"]["2"]["n"] == 1
+    # sketch quantiles are bucketized: the stage-2 median must reflect
+    # the remote slot's 4.0, not stage 1's 10.0 (and not double-count
+    # the host beat)
+    p50 = fleet["stages"]["2"]["compute_samples_per_s_p50"]
+    assert 3.0 < p50 < 5.0, p50
+
+    cfg = _round_cfg(tmp_path, tmp_path)
+    sched = Scheduler(cfg)
+    sched.plan_round([], 0, fleet)
+    # the boundary pass latched the measured block the re-planner
+    # models later-stage groups from
+    assert sched._stage_stats == fleet["stages"]
+
+
+# --------------------------------------------------------------------------
+# full-round soaks (slow)
+# --------------------------------------------------------------------------
+
+class _FakeProc:
+    """A Popen stand-in wired into the server's stage-host registry so
+    an in-proc 'host death' is visible to ``_host_dead`` exactly the
+    way a SIGKILLed child is."""
+
+    def __init__(self):
+        self.dead = threading.Event()
+
+    def poll(self):
+        return 1 if self.dead.is_set() else None
+
+
+class _DyingBus:
+    """Kills an inner client like its host process died: after ``n``
+    publishes every bus op raises, and ``on_die`` flips the host's
+    fake Popen to exited."""
+
+    def __init__(self, inner, n, on_die):
+        self._inner = inner
+        self._n = n
+        self._on_die = on_die
+        self._dead = False
+
+    def _check(self):
+        if self._dead:
+            raise RuntimeError("stage host process is dead")
+
+    def publish(self, queue, data):
+        self._check()
+        self._n -= 1
+        if self._n <= 0:
+            self._dead = True
+            self._on_die()
+            raise RuntimeError("stage host process is dead")
+        return self._inner.publish(queue, data)
+
+    def get(self, queue, timeout=None):
+        self._check()
+        return self._inner.get(queue, timeout=timeout)
+
+    def __getattr__(self, attr):
+        return getattr(self._inner, attr)
+
+
+def _run_mpmd(cfg, n_hosts=1, die_publishes=None, server_timeout=300.0):
+    """One in-process MPMD deployment: stage-1 feeder threads + the
+    later stages on StageHost instances adopted over a shared bus.
+    ``die_publishes={host_id: n}`` scripts a host death after its
+    inner clients' n-th publish (fake Popen flips to exited)."""
+    from split_learning_tpu.runtime.client import ProtocolClient
+    from split_learning_tpu.runtime.server import ProtocolServer
+    from split_learning_tpu.runtime.stagehost import StageHost
+
+    bus = InProcTransport()
+    server = ProtocolServer(cfg, transport=bus,
+                            client_timeout=server_timeout)
+    ctx = server.ctx
+    procs: dict = {}
+    hosts = []
+    for h in range(n_hosts):
+        hid = f"stage_host_{h}"
+        procs[hid] = _FakeProc()
+        ctx._stage_hosts.setdefault(hid, {})["proc"] = procs[hid]
+
+        def mk(slot, hid=hid):
+            t = bus
+            if die_publishes and hid in die_publishes:
+                t = _DyingBus(bus, die_publishes[hid],
+                              procs[hid].dead.set)
+            return ProtocolClient(cfg, slot["client_id"],
+                                  int(slot["stage"]), transport=t,
+                                  cluster=slot.get("cluster"))
+
+        hosts.append(StageHost(cfg, hid, transport=bus, make_client=mk))
+    host_threads = [threading.Thread(target=host.run, daemon=True)
+                    for host in hosts]
+    for t in host_threads:
+        t.start()
+    feeders = []
+    for i in range(cfg.clients[0]):
+        cid = f"client_1_{i}"
+        client = ProtocolClient(cfg, cid, 1, transport=bus)
+        t = threading.Thread(target=client.run, daemon=True, name=cid)
+        t.start()
+        feeders.append((cid, t))
+    result = server.serve()
+    for cid, t in feeders:
+        t.join(timeout=30)
+        assert not t.is_alive(), f"feeder {cid} failed to stop"
+    for host, t in zip(hosts, host_threads):
+        t.join(timeout=30)
+        assert not t.is_alive(), f"{host.host_id} failed to stop"
+    return result, ctx
+
+
+@pytest.mark.slow
+def test_mpmd_round_bit_identical_to_single_process_twin(tmp_path):
+    """The tentpole contract: moving the later stage onto a StageHost
+    changes WHO runs the hot loop, not WHAT it computes — the fold is
+    bit-identical to the all-in-one-process twin because the slots
+    carry the twin's own client ids (seed = client-id hash)."""
+    twin = _run_cell(_round_cfg(tmp_path, tmp_path / "twin"))
+    cfg = _round_cfg(tmp_path, tmp_path / "mpmd",
+                     pipeline={"remote": True})
+    result, ctx = _run_mpmd(cfg, n_hosts=1)
+    assert result.history[0].ok
+    assert result.history[0].num_samples == twin.history[0].num_samples
+    _assert_trees_identical(twin.params, result.params)
+    assert not ctx.faults.snapshot().get("stage_host_deaths")
+
+
+@pytest.mark.slow
+def test_mpmd_host_death_reassigned_bit_identical(tmp_path):
+    """A stage host dying mid-round aborts the attempt, moves its slot
+    to the survivor UNDER THE SAME CLIENT ID, and the re-run behind the
+    bumped generation fence folds bit-identical to the fault-free twin
+    — with exactly one counted death and one counted re-assignment."""
+    twin = _run_cell(_round_cfg(tmp_path, tmp_path / "twin"))
+    cfg = _round_cfg(tmp_path, tmp_path / "mpmd",
+                     pipeline={"remote": True, "retries": 2})
+    # host 0 owns the single stage-2 slot (round-robin from sorted
+    # hosts); its 5th publish (REGISTER, READY, then mid-stream) kills
+    # it — mid-round, after the barrier committed to the assignment
+    result, ctx = _run_mpmd(cfg, n_hosts=2,
+                            die_publishes={"stage_host_0": 5})
+    assert result.history[0].ok
+    assert result.history[0].num_samples == twin.history[0].num_samples
+    _assert_trees_identical(twin.params, result.params)
+    snap = ctx.faults.snapshot()
+    assert snap.get("stage_host_deaths") == 1, snap
+    assert snap.get("stage_reassigns") == 1, snap
+    # the slot really moved: the survivor now owns it
+    assert [s["client_id"] for s in
+            ctx._stage_assignments.get("stage_host_1", [])] == [
+        "client_2_0"]
+    assert "stage_host_0" not in ctx._stage_assignments

@@ -1162,6 +1162,195 @@ def broker_shard_cell(tmp: str, seed: int = 29) -> tuple[bool, str]:
                   f"[{wall:.0f}s]")
 
 
+def mpmd_cell(tmp: str) -> tuple[bool, str]:
+    """Cross-host MPMD stage-pipeline chaos cell (pipeline.remote): a
+    3-stage deterministic round whose two later stages run on TWO
+    server-spawned StageHost subprocesses over a real 2-shard TCP
+    broker plane — and the stage host owning the stage-2 slot is
+    SIGKILLed the moment the round attempt arms the stage watch
+    (mid-round by construction).  PASSes iff
+
+    * the round completes via the counted slot re-assignment (the
+      dead host's slot moves to the survivor UNDER THE SAME client
+      id, the attempt re-runs behind a bumped generation fence);
+    * aggregation is BIT-IDENTICAL to a fault-free single-process
+      twin (same client ids -> same per-client seeds -> same fold);
+    * the fallback counts are exact: ``stage_host_deaths == 1`` and
+      ``stage_reassigns == 1`` (one slot moved), and the survivor
+      ends the round owning the victim's slot.
+
+    Writes ``mpmd.json`` (assignment choreography, kill timing, fault
+    counters) into the cell dir; the stage hosts' own log/metrics
+    sidecars land under the cell's log dirs for CI artifact upload.
+    """
+    import threading as _threading
+
+    import numpy as np
+
+    sys.path.insert(0, "tests")
+    from test_chaos import _round_cfg  # noqa: E402
+
+    from split_learning_tpu.broker import spawn_shard
+    from split_learning_tpu.runtime.bus import (
+        broker_stats, find_port_block, ShardedTcpTransport,
+    )
+    from split_learning_tpu.runtime.client import ProtocolClient
+    from split_learning_tpu.runtime.server import ProtocolServer
+
+    cell_dir = pathlib.Path(tmp) / "mpmd"
+    cell_dir.mkdir(parents=True, exist_ok=True)
+    shards = 2
+
+    def spawn_plane():
+        base = find_port_block(shards)
+        procs = [spawn_shard("127.0.0.1", base + i, shard_index=i,
+                             python_only=True)
+                 for i in range(shards)]
+        deadline = time.monotonic() + 120
+        for i in range(shards):
+            while time.monotonic() < deadline:
+                try:
+                    broker_stats("127.0.0.1", base + i, timeout=1.0)
+                    break
+                except Exception:  # noqa: BLE001 — still booting
+                    time.sleep(0.25)
+        return base, procs
+
+    def run_round(tag, base, n_hosts):
+        """(result, ctx, wall, killed) — stage-1 feeders as threads;
+        later stages in-process (n_hosts=0, the twin) or on spawned
+        stage hosts, with the scripted mid-round SIGKILL when hosts
+        are in play."""
+        over = dict(
+            clients=[2, 1, 1],
+            topology={"cut_layers": [2, 4]},
+            # dropout OFF: the middle stage relays activations on
+            # receipt (arrival order), so with >= 3 stages the
+            # bit-identity recipe additionally needs rng-insensitive
+            # forwards — the 2-stage recipe's strict-SDA head never
+            # exposed the middle relay's rng-to-batch assignment race
+            model_kwargs={"dropout_rate": 0.0},
+            transport={"kind": "tcp", "host": "127.0.0.1",
+                       "port": base, "async_send": False},
+            broker={"shards": shards})
+        if n_hosts:
+            over["pipeline"] = {"remote": True, "hosts": n_hosts,
+                                "retries": 2}
+        cfg = _round_cfg(pathlib.Path(tmp), cell_dir / tag, **over)
+        server = ProtocolServer(
+            cfg, transport=ShardedTcpTransport("127.0.0.1", base,
+                                               shards),
+            client_timeout=300.0)
+        ctx = server.ctx
+        threads = []
+        stages = range(1, 2) if n_hosts else range(1, 4)
+        for stage in stages:
+            for i in range(cfg.clients[stage - 1]):
+                cid = f"client_{stage}_{i}"
+                client = ProtocolClient(
+                    cfg, cid, stage,
+                    transport=ShardedTcpTransport("127.0.0.1", base,
+                                                  shards))
+                th = _threading.Thread(target=client.run, daemon=True)
+                th.start()
+                threads.append(th)
+        killed: list = []
+        if n_hosts:
+            def killer():
+                deadline = time.monotonic() + 200
+                while time.monotonic() < deadline:
+                    # the stage watch arms exactly while a round
+                    # attempt is in flight — a kill here is mid-round
+                    # by construction, after the barrier committed to
+                    # the standing assignment
+                    if ctx._stage_watch:
+                        hid = next(
+                            (h for h in sorted(ctx._stage_assignments)
+                             if ctx._stage_assignments[h]), None)
+                        if hid:
+                            slots = [
+                                s["client_id"] for s in
+                                ctx._stage_assignments[hid]]
+                            proc = (ctx._stage_hosts.get(hid)
+                                    or {}).get("proc")
+                            if proc is not None:
+                                proc.kill()   # SIGKILL
+                                killed.append(
+                                    {"host": hid, "slots": slots,
+                                     "t": round(time.monotonic(), 3)})
+                                return
+                    time.sleep(0.005)
+            kt = _threading.Thread(target=killer, daemon=True)
+            kt.start()
+        t0 = time.monotonic()
+        res = server.serve()
+        wall = time.monotonic() - t0
+        for th in threads:
+            th.join(timeout=30)
+        return res, ctx, wall, (killed[0] if killed else None)
+
+    # fault-free single-process twin on its own fresh plane
+    base_b, procs_b = spawn_plane()
+    try:
+        res_base, _, _, _ = run_round("twin", base_b, 0)
+    finally:
+        for p in procs_b:
+            p.kill()
+    if not res_base.history or not res_base.history[0].ok:
+        return False, "fault-free twin round not ok"
+
+    # MPMD run: 2 stage hosts, scripted mid-round SIGKILL
+    base, procs = spawn_plane()
+    try:
+        res, ctx2, wall, killed = run_round("chaos", base, 2)
+    finally:
+        for p in procs:
+            p.kill()
+    snap = ctx2.faults.snapshot()
+    out = {
+        "shards": shards, "base_port": base, "hosts": 2,
+        "wall_s": round(wall, 3),
+        "kill": killed,
+        "final_assignments": {
+            h: [s["client_id"] for s in sl]
+            for h, sl in ctx2._stage_assignments.items()},
+        "faults": snap,
+    }
+    (cell_dir / "mpmd.json").write_text(
+        json.dumps(out, indent=2, default=str))
+    if killed is None:
+        return False, "no stage host qualified for the kill"
+    if not res.history or not res.history[0].ok:
+        return False, "round not ok after stage-host kill"
+    if wall > 240:
+        return False, f"round stalled ({wall:.0f}s)"
+    if snap.get("stage_host_deaths") != 1:
+        return False, f"deaths != 1: {snap}"
+    if snap.get("stage_reassigns") != len(killed["slots"]):
+        return False, f"reassigns != {len(killed['slots'])}: {snap}"
+    moved = killed["slots"]
+    survivor_slots = [
+        cid for h, sl in ctx2._stage_assignments.items()
+        if h != killed["host"] for cid in
+        [s["client_id"] for s in sl]]
+    if not all(cid in survivor_slots for cid in moved):
+        return False, (f"moved slots {moved} not on a survivor: "
+                       f"{out['final_assignments']}")
+    if [r.num_samples for r in res.history] \
+            != [r.num_samples for r in res_base.history]:
+        return False, "sample count drifted"
+    import jax
+    la = jax.tree_util.tree_leaves(res_base.params)
+    lb = jax.tree_util.tree_leaves(res.params)
+    if len(la) != len(lb) or any(
+            np.asarray(a).tobytes() != np.asarray(b).tobytes()
+            for a, b in zip(la, lb)):
+        return False, "aggregation not bit-identical to the twin"
+    return True, (f"host {killed['host']} SIGKILLed mid-round, "
+                  f"slot(s) {moved} re-assigned, fold bit-identical "
+                  f"(1 death, {len(moved)} reassign) [{wall:.0f}s]")
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(
         description="Sweep fault probabilities over seeds; print a "
@@ -1234,6 +1423,15 @@ def main(argv=None):
                          "exact fault counts (reconnects/redeliveries "
                          "counted, zero lost) — writes "
                          "broker_shard.json")
+    ap.add_argument("--mpmd", dest="mpmd_mode", action="store_true",
+                    help="run ONLY the cross-host MPMD stage-pipeline "
+                         "cell: a 3-stage round with the later stages "
+                         "on 2 spawned StageHost subprocesses over a "
+                         "real 2-shard TCP broker; one stage host is "
+                         "SIGKILLed mid-round and the round must "
+                         "complete via the counted slot re-assignment, "
+                         "bit-identical to a fault-free single-process "
+                         "twin (writes mpmd.json)")
     ap.add_argument("--overlap", dest="overlap_mode",
                     action="store_true",
                     help="run ONLY the sync-overlap cell: a 3-client "
@@ -1254,6 +1452,20 @@ def main(argv=None):
         ok, note = tree_remote_cell(tmp)
         dt = time.monotonic() - t0
         print(f"tree-remote cell: {'PASS' if ok else 'FAIL'} ({note}) "
+              f"[{dt:.1f}s, artifacts in {tmp}]")
+        return 0 if ok else 1
+
+    if args.mpmd_mode:
+        if args.artifacts_dir:
+            tmp = args.artifacts_dir
+            pathlib.Path(tmp).mkdir(parents=True, exist_ok=True)
+        else:
+            import tempfile
+            tmp = tempfile.mkdtemp(prefix="chaos_mpmd_")
+        t0 = time.monotonic()
+        ok, note = mpmd_cell(tmp)
+        dt = time.monotonic() - t0
+        print(f"mpmd cell: {'PASS' if ok else 'FAIL'} ({note}) "
               f"[{dt:.1f}s, artifacts in {tmp}]")
         return 0 if ok else 1
 

@@ -98,6 +98,12 @@ STABLE_KEYS = {
     # shard round-wall ratio on the 100k synthetic fleet round
     "extra.broker_shard_scaling": "up",
     "extra.broker_round_wall_ratio_100k": "down",
+    # cross-host MPMD stage pipeline (round-16): end-to-end samples/s
+    # of the 3-stage-host cell over the single-process twin (>1 =
+    # spreading the hops across processes buys real throughput), and
+    # the 3-host cell's absolute rate
+    "extra.mpmd_scaling_3host": "up",
+    "extra.mpmd_samples_per_sec": "up",
 }
 
 #: absolute pins, enforced on the NEWEST record regardless of trend: a
@@ -142,6 +148,11 @@ STABLE_KEY_CAPS = {
     # funnel) cannot calcify
     "extra.broker_shard_scaling": 2.0,
     "extra.broker_round_wall_ratio_100k": 0.7,
+    # MPMD stage-pipeline acceptance pin (round-16): the 3-stage-host
+    # cell must keep >= 1.5x the single-process twin's samples/s — a
+    # regression toward re-serializing the hops (a shared lock, a
+    # single-process fallback) cannot calcify
+    "extra.mpmd_scaling_3host": 1.5,
 }
 
 #: attribution components of a kind=perf record, in report order
@@ -197,7 +208,8 @@ for _k in ("protocol_samples_per_sec", "cold_round_wall_s",
            "update_overlap_ratio", "sched_wall_ratio_vs_static",
            "sched_decision_ms_10k", "fleet_digest_ingest_ms_100k",
            "fleet_metrics_render_ms_100k", "broker_shard_scaling",
-           "broker_round_wall_ratio_100k"):
+           "broker_round_wall_ratio_100k", "mpmd_scaling_3host",
+           "mpmd_samples_per_sec"):
     _path = ("extra.mfu." + _k
              if _k.startswith(("mfu_vs", "measured_matmul"))
              else "extra." + _k)
@@ -365,11 +377,15 @@ def attribution_report(records: list[dict],
         row = {
             "participant": rec.get("participant") or rec.get("client"),
             "round": rec.get("round", rec.get("round_idx")),
+            # pipeline hop this record ran (clients stamp their stage
+            # since the MPMD plane; None for older records)
+            "stage": rec.get("stage"),
             "wall_s": wall,
             **{c: round(v, 4) for c, v in comps.items()},
             "attributed_frac": (round(sum(comps.values()) / wall, 4)
                                 if wall else None),
             "steps": rec.get("steps"),
+            "samples": rec.get("samples"),
             "retraces": rec.get("retraces"),
         }
         for opt in ("mfu", "tflops_per_sec", "hbm_peak_bytes",
@@ -382,6 +398,33 @@ def attribution_report(records: list[dict],
                               "participant": row["participant"],
                               "mfu": rec["mfu"]})
     report: dict = {"rounds": rows, "mfu_trend": mfu_trend}
+    # per-hop attribution (MPMD stage pipeline): every stage-stamped
+    # record — stage-host processes' inner clients included, their
+    # metrics.jsonl files merge into the same load — rolls up by hop,
+    # so compute|wire|wait is reported per STAGE, not just per client.
+    # wire = dispatch + host (frame encode/decode + dispatch around
+    # the hot loop); wait = barrier/queue waits incl. the inter-hop
+    # activation/gradient queues.  Records predating the stage stamp
+    # simply don't contribute.
+    hops: dict = {}
+    for row in rows:
+        st = row.get("stage")
+        if st is None:
+            continue
+        ent = hops.setdefault(str(st), {
+            "n": 0, "wall_s": 0.0, "compute_s": 0.0, "wire_s": 0.0,
+            "wait_s": 0.0, "samples": 0})
+        ent["n"] += 1
+        ent["wall_s"] += row["wall_s"]
+        ent["compute_s"] += row["compute_s"]
+        ent["wire_s"] += row["dispatch_s"] + row["host_s"]
+        ent["wait_s"] += row["wait_s"]
+        ent["samples"] += int(row.get("samples") or 0)
+    if hops:
+        report["hops"] = {
+            st: {k: (round(v, 4) if isinstance(v, float) else v)
+                 for k, v in ent.items()}
+            for st, ent in sorted(hops.items())}
     if bench:
         report["bench_history"] = [dict(b) for b in bench]
     return report
@@ -414,6 +457,23 @@ def render_report(report: dict) -> str:
                                    for v, w in zip(row, widths)))
     else:
         lines.append("no kind=perf records found")
+    hops = report.get("hops")
+    if hops:
+        lines.append("")
+        lines.append("per-hop attribution (stage pipeline):")
+        head = ("STAGE", "RECS", "WALL s", "COMPUTE", "WIRE", "WAIT",
+                "SAMPLES")
+        table = [head]
+        for st, ent in hops.items():
+            table.append((
+                st, str(ent["n"]), f"{ent['wall_s']:.2f}",
+                f"{ent['compute_s']:.2f}", f"{ent['wire_s']:.2f}",
+                f"{ent['wait_s']:.2f}", str(ent["samples"])))
+        widths = [max(len(row[i]) for row in table)
+                  for i in range(len(head))]
+        for row in table:
+            lines.append("  " + "  ".join(
+                f"{v:<{w}}" for v, w in zip(row, widths)))
     hist = report.get("bench_history")
     if hist:
         # stable-key trend across the given history (oldest..newest):

@@ -1,0 +1,29 @@
+#!/usr/bin/env python
+"""``sl_stagehost`` — standalone MPMD stage-host process
+(``pipeline.remote``).
+
+One later-stage host of the cross-host pipeline: connects to the
+(sharded) TCP broker with the full runtime transport stack
+(Reliable/Chaos/Async compose unchanged), announces itself with
+STAGEHELLO, heartbeats into the server's FleetMonitor, and runs the
+later-stage client slots each STAGEASSIGN hands it — activations and
+input-gradients ride the broker's ``intermediate_queue_*`` /
+``gradient_queue_*`` families as ordinary TENSOR/SLTC frames.  See
+``runtime/stagehost.py``.
+
+    python tools/sl_stagehost.py --config config.yaml \
+        --host-id stage_host_0
+
+The server spawns these itself when ``pipeline.hosts`` is set; start
+them by hand (or under a process manager, one per host) for a real
+multi-host deployment.
+"""
+
+import sys
+
+sys.path.insert(0, ".")  # run from the repo root
+
+from split_learning_tpu.runtime.stagehost import main  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -33,13 +33,16 @@ _STATE_COLOR = {"healthy": "\033[92m", "degraded": "\033[93m",
 _RESET = "\033[0m"
 
 _COLUMNS = ("PARTICIPANT", "ROLE", "STATE", "CLUSTER", "SCHED",
-            "ROUND", "VLAG", "SAMPLES", "RATE/s", "SCORE", "MFU",
-            "STEP p95 ms", "RTT p95 ms", "WIRE MB", "AGE s")
+            "ROUND", "VLAG", "SAMPLES", "RATE/s", "QDEPTH", "SCORE",
+            "MFU", "STEP p95 ms", "RTT p95 ms", "WIRE MB", "AGE s")
 
 #: telemetry snapshot `kind` -> table role label; aggregator nodes
 #: (aggregation.remote) rate-columns read "-": their samples/s is
-#: structurally 0, the AGG gauges carry their load instead
-_ROLE = {"client": "client", "agg_node": "agg"}
+#: structurally 0, the AGG gauges carry their load instead.  Stage
+#: hosts (pipeline.remote) DO rate: their samples/s is the sum of
+#: their slots' hot loops, their CLUSTER column carries the stage id
+#: and QDEPTH their summed ingest backlog.
+_ROLE = {"client": "client", "agg_node": "agg", "stage_host": "stage"}
 
 
 def _broker_rows(brokers: list) -> list[tuple]:
@@ -170,19 +173,28 @@ def render_fleet(fleet: dict, color: bool = True,
     for cid, c in shown:
         wire_mb = (c.get("wire_bytes_out") or 0) / 1e6
         agg = c.get("kind") == "agg_node"
+        stage_host = c.get("kind") == "stage_host"
+        # stage-host rows (pipeline.remote) show the stage their slots
+        # run where clients show their scheduler cluster
+        cluster_cell = (f"s{c['stage']}"
+                        if stage_host and c.get("stage") is not None
+                        else _fmt(c.get("cluster")))
         rows.append((
             cid, _ROLE.get(c.get("kind", "client"), c.get("kind")),
             c.get("state", "?"),
             # closed-loop scheduler (scheduler.enabled): assigned
             # online cluster + last scheduler action ("demote@r3");
             # "-" with the scheduler off or for unclustered roles
-            _fmt(c.get("cluster")), _fmt(c.get("sched")),
+            cluster_cell, _fmt(c.get("sched")),
             _fmt(c.get("round")),
             # async version lag (bounded-staleness mode); "-" outside it
             _fmt(c.get("version_lag")),
             # aggregator rows: training columns are structurally empty
             "-" if agg else _fmt(c.get("samples")),
             "-" if agg else _fmt(c.get("samples_per_s")),
+            # later-stage ingest backlog (pipeline plane); "-" for
+            # pre-plane participants whose beats never carried it
+            _fmt(c.get("queue_depth")),
             _fmt(c.get("straggler_score"), 2),
             # perf-plane gauges (runtime/perf.py); "-" for clients
             # predating the plane
