@@ -16,7 +16,12 @@ enforced only by runtime tests:
   ``SLCHECK_LOCKS=1``);
 * :mod:`~split_learning_tpu.analysis.codec_check` — the wire codecs:
   every codec counter registered, no host-side quantization in hot
-  loops, quantizer kernels actually staged on device.
+  loops, quantizer kernels actually staged on device;
+* :mod:`~split_learning_tpu.analysis.pallas_check` — the Pallas
+  kernel plane (PK001): every enableable kernel (fused quantize/
+  dequantize, fused stage_update, llama flash attention) traced with
+  the kernel on must show its ``pallas_call`` in the hot-path jaxpr —
+  kernels cannot silently fall back to XLA.
 
 CLI: ``python -m split_learning_tpu.analysis`` (wrapper:
 ``tools/slcheck.py``).  This package is import-light on purpose —

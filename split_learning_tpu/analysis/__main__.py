@@ -24,7 +24,7 @@ from split_learning_tpu.analysis.findings import (
 )
 
 ANALYZERS = ("protocol", "jaxpr", "concurrency", "counters", "codec",
-             "perf", "agg", "async", "sched")
+             "perf", "agg", "async", "sched", "pallas")
 
 
 def repo_root() -> pathlib.Path:
@@ -61,6 +61,9 @@ def run_analyzers(root: pathlib.Path, names=ANALYZERS,
     if "sched" in names:
         from split_learning_tpu.analysis import sched_check
         findings += sched_check.run(root)
+    if "pallas" in names:
+        from split_learning_tpu.analysis import pallas_check
+        findings += pallas_check.run(root, trace=trace)
     return findings
 
 

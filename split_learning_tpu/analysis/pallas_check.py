@@ -1,0 +1,186 @@
+"""Pallas lowering gate (PK001).
+
+A config-enabled Pallas kernel that silently falls back to an XLA op
+chain is the worst kind of perf regression: numerically identical,
+invisible to every correctness test, and the exact failure mode a
+refactor of the dispatch plumbing would produce.  This analyzer closes
+the gap the jaxpr audit leaves open — JX001-007 prove the hot path has
+no host round-trips, but nothing proved the kernels the config claims
+are on actually ARE the compiled path.
+
+PK001: for every kernel the plane can enable, trace the REAL hot-path
+entry point with that kernel enabled and require a ``pallas_call``
+primitive somewhere in the jaxpr (recursing through sub-jaxprs, so
+jit/custom-vjp wrapping doesn't hide it):
+
+* quantize — ``_quantize_dev`` (int8 and the int4 nibble-pack shape)
+  with a kernel block;
+* dequantize — ``_dequantize_dev`` mirror;
+* stage_update — a real :class:`MeshFoldBackend` built with
+  ``stage_update`` enabled, driven through ``stage_update`` exactly
+  like the JX007 jaxpr audit, then every cached fused program traced;
+* flash attention — the llama decoder path (``use_flash=True``): a
+  tiny TinyLlama forward traced end to end, proving the model-level
+  flag still routes through the Pallas kernel in the compiled step
+  (before this gate, nothing asserted that).
+
+:func:`check_lowering` is a pure jaxpr->findings helper so the
+negative test can prove the gate actually fires on a pallas-free
+program.  Requires tracing (jax): a ``--no-trace`` run skips this
+analyzer entirely.
+"""
+
+from __future__ import annotations
+
+import pathlib
+
+from split_learning_tpu.analysis.findings import Finding
+
+_REL_QUANT = "split_learning_tpu/runtime/codec/quant.py"
+_REL_AGG = "split_learning_tpu/runtime/aggregate.py"
+_REL_FLASH = "split_learning_tpu/ops/flash_attention.py"
+
+
+def contains_pallas_call(jaxpr) -> bool:
+    """True iff a ``pallas_call`` primitive appears anywhere in the
+    (closed) jaxpr, including nested sub-jaxprs."""
+    seen: set = set()
+
+    def walk(jx) -> bool:
+        if id(jx) in seen:
+            return False
+        seen.add(id(jx))
+        for eqn in jx.eqns:
+            if eqn.primitive.name == "pallas_call":
+                return True
+            for sub in eqn.params.values():
+                inner = getattr(sub, "jaxpr", None)
+                if inner is not None and walk(inner):
+                    return True
+                # pallas_call itself carries the kernel as a plain
+                # Jaxpr param; custom_vjp/jit carry ClosedJaxprs —
+                # both expose .jaxpr, lists carry several
+                if isinstance(sub, (list, tuple)):
+                    for s in sub:
+                        inner = getattr(s, "jaxpr", None)
+                        if inner is not None and walk(inner):
+                            return True
+        return False
+
+    return walk(jaxpr.jaxpr if hasattr(jaxpr, "jaxpr") else jaxpr)
+
+
+def check_lowering(jaxpr, rel: str, where: str) -> list[Finding]:
+    """PK001 on one traced program: the enabled kernel's
+    ``pallas_call`` must be present, or the config is lying about what
+    the hot path runs."""
+    if contains_pallas_call(jaxpr):
+        return []
+    return [Finding(
+        "PK001", rel, 0, where,
+        f"kernel {where!r} is enabled but no pallas_call primitive "
+        "appears in the traced hot-path jaxpr: the kernel silently "
+        "fell back to the XLA chain")]
+
+
+def _check_codec_kernels(block: int) -> list[Finding]:
+    import jax
+    import numpy as np
+
+    from split_learning_tpu.runtime.codec.quant import (
+        _dequantize_dev, _quantize_dev,
+    )
+
+    findings: list[Finding] = []
+    x = np.ones((33, 5), np.float32)
+    for bits, tile in ((8, 64), (4, 7)):
+        jaxpr = jax.make_jaxpr(
+            lambda a, b=bits, t=tile: _quantize_dev(
+                a, t, b, kernel_block=block))(x)
+        findings += check_lowering(jaxpr, _REL_QUANT,
+                                   f"quantize:int{bits}")
+    # mirror: well-formed tiled codes for both widths
+    for bits, tile, codes in ((8, 64, np.zeros((192,), np.int8)),
+                              (4, 7, np.zeros((84,), np.uint8))):
+        scale = np.ones((codes.shape[0] * (2 if bits == 4 else 1)
+                         // tile,), np.float32)
+        jaxpr = jax.make_jaxpr(
+            lambda q, s, b=bits, t=tile: _dequantize_dev(
+                q, s, t, b, 160, (160,), kernel_block=block))(
+            codes, scale)
+        findings += check_lowering(jaxpr, _REL_QUANT,
+                                   f"dequantize:int{bits}")
+    return findings
+
+
+def _check_stage_update_kernel(block: int) -> list[Finding]:
+    """Build a mesh backend with the stage-update kernel enabled,
+    drive one real stage_update (compiling + caching its fused
+    program), then trace each cached program and require the
+    pallas_call — the same trace shape as the JX007 jaxpr audit."""
+    import jax
+    import numpy as np
+
+    from split_learning_tpu.ops.kernels import KernelPlan
+    from split_learning_tpu.runtime.aggregate import (
+        MeshFoldBackend, _StageFold,
+    )
+
+    findings: list[Finding] = []
+    be = MeshFoldBackend(kernels=KernelPlan(stage_update=True,
+                                            block=block))
+    st = _StageFold(["c0"])
+    st.dtype = {"layer0/k": np.dtype(np.float32),
+                "layer0/step": np.dtype(np.int32)}
+    st.total_w = 2.0
+    st.acc = {"layer0/k": be.contrib(np.ones((8, 4), np.float32), 2.0),
+              "layer0/step": be.contrib(np.asarray(3, np.int32), 2.0)}
+    base_flat = {"layer0/k": np.ones((8, 4), np.float32)}
+    be.stage_fetch(be.stage_update(st, base_flat, {}, 0.9))
+    if not be._fused_cache:
+        return [Finding(
+            "PK001", _REL_AGG, 0, "stage_update",
+            "stage_update compiled no fused program to audit")]
+    for prog in be._fused_cache.values():
+        jaxpr = jax.make_jaxpr(
+            lambda acc, base, vel: prog(
+                acc, {}, base, vel, np.float32(2.0), np.float32(1.0),
+                np.float32(0.9)))(
+            {"layer0/k": np.ones((8, 4), np.float32),
+             "layer0/step": np.float32(6.0)},
+            dict(base_flat),
+            {"layer0/k": np.zeros((8, 4), np.float32)})
+        findings += check_lowering(jaxpr, _REL_AGG, "stage_update")
+    return findings
+
+
+def _check_flash_lowering() -> list[Finding]:
+    """The llama attention path: a tiny TinyLlama with
+    ``use_flash=True`` traced end to end must keep ``flash_attention``
+    as a pallas_call in the compiled step."""
+    import jax
+    import jax.numpy as jnp
+
+    from split_learning_tpu.models import build_model
+
+    m = build_model("TinyLlama_TINYSTORIES", use_flash=True,
+                    vocab_size=64, hidden_size=32, num_heads=4,
+                    num_kv_heads=2, intermediate_size=64, n_block=1)
+    x = jnp.zeros((1, 8), jnp.int32)
+    variables = jax.eval_shape(
+        lambda k: m.init(k, x, train=False), jax.random.key(0))
+    jaxpr = jax.make_jaxpr(
+        lambda p, xx: m.apply({"params": p}, xx, train=False))(
+        variables["params"], x)
+    return check_lowering(jaxpr, _REL_FLASH, "llama-flash-attention")
+
+
+def run(root: pathlib.Path, trace: bool = True) -> list[Finding]:
+    if not trace:
+        return []
+    from split_learning_tpu.config import KernelsConfig
+    block = KernelsConfig().block
+    findings = _check_codec_kernels(block)
+    findings += _check_stage_update_kernel(block)
+    findings += _check_flash_lowering()
+    return findings

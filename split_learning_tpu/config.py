@@ -805,6 +805,35 @@ class PipelineConfig:
 
 
 @dataclasses.dataclass(frozen=True)
+class KernelsConfig:
+    """Pallas hot-path kernel plane (``ops/kernels/``).
+
+    Per-kernel enables for the single-pass fused kernels that replace
+    the XLA op chains on the two data-plane hot blocks: the tiled
+    absmax quantize/dequantize codec path and the fused round-boundary
+    ``stage_update``.  Off (default) keeps the pre-kernel XLA chains —
+    byte-for-byte the old behavior.  When enabled, the same kernels
+    run under the Pallas interpreter off-TPU and lower natively on
+    TPU; the slcheck ``pallas`` analyzer (PK001) asserts an enabled
+    kernel's ``pallas_call`` is actually present in the traced
+    hot-path jaxpr."""
+    # fused quantize (absmax reduce + scale + round/clip + NaN
+    # sentinel + int4 nibble-pack in one VMEM pass) on the sender
+    quantize: bool = False
+    # the mirror fused dequantize on the receiver hot path
+    dequantize: bool = False
+    # fused FedAvg divide + FedAvgM momentum + wire-dtype cast inside
+    # the sharded round-boundary update (aggregation.sharded)
+    stage_update: bool = False
+    # grid block target (tiles per quantize instance / axis-0 rows per
+    # update instance); auto-shrunk to the largest exact divisor
+    block: int = 128
+
+    def validate(self):
+        _check(self.block >= 1, "kernels.block must be >= 1")
+
+
+@dataclasses.dataclass(frozen=True)
 class Config:
     model: str = "VGG16"
     dataset: str = "CIFAR10"
@@ -836,6 +865,7 @@ class Config:
     perf: PerfConfig = PerfConfig()
     scheduler: SchedulerConfig = SchedulerConfig()
     pipeline: PipelineConfig = PipelineConfig()
+    kernels: KernelsConfig = KernelsConfig()
 
     @property
     def model_key(self) -> str:
@@ -856,7 +886,7 @@ class Config:
         for sub in (self.learning, self.distribution, self.topology,
                     self.aggregation, self.transport, self.broker,
                     self.chaos, self.observability, self.perf,
-                    self.scheduler, self.pipeline):
+                    self.scheduler, self.pipeline, self.kernels):
             sub.validate()
         if self.scheduler.enabled:
             # the scheduler's only senses are the fleet-telemetry
@@ -928,6 +958,7 @@ _SECTION_TYPES = {
     "perf": PerfConfig,
     "scheduler": SchedulerConfig,
     "pipeline": PipelineConfig,
+    "kernels": KernelsConfig,
 }
 
 

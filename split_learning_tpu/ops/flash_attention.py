@@ -33,6 +33,10 @@ import jax.numpy as jnp
 import numpy as np
 from jax.experimental import pallas as pl
 
+from split_learning_tpu.ops.kernels.util import (
+    pick_block as _pick_block, resolve_interpret,
+)
+
 NEG_INF = -1e30
 
 
@@ -43,15 +47,6 @@ def _pick_precision(dtype):
     agree or gradients desync from the primal."""
     return (jax.lax.Precision.HIGHEST if dtype == jnp.float32
             else jax.lax.Precision.DEFAULT)
-
-
-def _pick_block(s: int, target: int = 128) -> int:
-    """Largest divisor of s that is <= target (TPU-friendly when s is a
-    multiple of 128; exact fallback for small/odd test shapes)."""
-    b = min(s, target)
-    while s % b:
-        b -= 1
-    return b
 
 
 def _dot(a, b, dims, precision):
@@ -276,8 +271,7 @@ def flash_attention(q, k, v, causal: bool = False,
     ``interpret=None`` runs the Pallas interpreter unless on real TPU.
     S must be divisible by the (auto-shrunk) block sizes.
     """
-    if interpret is None:
-        interpret = jax.default_backend() != "tpu"
+    interpret = resolve_interpret(interpret)
     b, s, h, d = q.shape
     block_q = _pick_block(s, block_q)
     block_k = _pick_block(s, block_k)

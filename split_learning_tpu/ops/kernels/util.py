@@ -1,0 +1,49 @@
+"""Shared helpers for the Pallas kernel plane.
+
+One copy of the two decisions every kernel call site makes (the flash
+attention fwd/bwd kernels made them privately before this package
+existed):
+
+* :func:`pick_block` — grid block sizing: the largest divisor of the
+  gridded extent that fits the requested target, so TPU-friendly shapes
+  get full 128-wide blocks and small/odd test shapes still divide
+  exactly;
+* :func:`resolve_interpret` — the ``interpret=None`` auto-select: the
+  Pallas interpreter off-TPU (CPU tests run the SAME kernel code), the
+  native Mosaic lowering on real TPU.
+"""
+
+from __future__ import annotations
+
+
+def pick_block(s: int, target: int = 128) -> int:
+    """Largest divisor of s that is <= target (TPU-friendly when s is a
+    multiple of 128; exact fallback for small/odd test shapes)."""
+    b = min(s, target)
+    while s % b:
+        b -= 1
+    return b
+
+
+def pick_pair_block(t: int, tile: int, target: int = 128) -> int:
+    """Largest divisor b of t with b <= target AND b * tile even — the
+    int4 packer consumes code PAIRS, so every grid instance must own an
+    even number of codes.  The quantizer's padding guarantees t * tile
+    is even, so a valid b always exists (b = 2 when tile is odd)."""
+    if t * tile % 2:
+        raise ValueError(
+            f"t*tile must be even for int4 packing, got {t}x{tile}")
+    b = min(t, target)
+    while t % b or (b * tile) % 2:
+        b -= 1
+    return b
+
+
+def resolve_interpret(interpret: bool | None) -> bool:
+    """``interpret=None`` runs the Pallas interpreter unless on real
+    TPU, so the same kernel code path serves CPU tests and compiles
+    natively on TPU."""
+    if interpret is None:
+        import jax
+        return jax.default_backend() != "tpu"
+    return interpret

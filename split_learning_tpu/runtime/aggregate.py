@@ -250,10 +250,15 @@ class MeshFoldBackend:
 
     name = "mesh"
 
-    def __init__(self, devices=None):
+    def __init__(self, devices=None, kernels=None):
         import jax
         import jax.numpy as jnp
         from jax.sharding import Mesh, NamedSharding, PartitionSpec
+        from split_learning_tpu.ops import kernels as kplane
+        # Pallas kernel plan for the fused stage update (kernels:
+        # config block; None = the process-wide plan), captured at
+        # construction so one backend's programs are self-consistent
+        self._kplan = kplane.as_plan(kernels)
         self._jax = jax
         devs = list(devices) if devices is not None else jax.devices()
         self.n_devices = len(devs)
@@ -301,11 +306,28 @@ class MeshFoldBackend:
             return prog
         jax = self._jax
         import jax.numpy as jnp
+        kplan = self._kplan
+        if kplan.stage_update:
+            from split_learning_tpu.ops.kernels import update as kupd
 
         def fused(acc, stat_acc, base, vel, tw, stat_tw, m):
             params, stats, nvel = {}, {}, {}
             for path in sorted(acc):
                 dt = dtypes[path]
+                if kplan.stage_update and kupd.kernel_ok(acc[path]):
+                    # single-pass Pallas finish (same op order as the
+                    # jnp chain below — mesh/host stay bit-identical)
+                    if path in mom_paths:
+                        p, nv = kupd.momentum_leaf(
+                            acc[path], base[path], vel[path], tw, m,
+                            dt, block=kplan.block)
+                        nvel[path] = nv
+                        params[path] = p
+                    else:
+                        params[path] = kupd.finalize_leaf(
+                            acc[path], tw, dt, rnd=_is_int_dtype(dt),
+                            block=kplan.block)
+                    continue
                 a32 = acc[path] / tw
                 if path in mom_paths:
                     nv = m * vel[path] + (base[path] - a32)
@@ -317,6 +339,12 @@ class MeshFoldBackend:
                     params[path] = a32.astype(dt)
             for path in sorted(stat_acc):
                 dt = stat_dtypes[path]
+                if kplan.stage_update and kupd.kernel_ok(
+                        stat_acc[path]):
+                    stats[path] = kupd.finalize_leaf(
+                        stat_acc[path], stat_tw, dt,
+                        rnd=_is_int_dtype(dt), block=kplan.block)
+                    continue
                 s32 = stat_acc[path] / stat_tw
                 stats[path] = (jnp.round(s32).astype(dt)
                                if _is_int_dtype(dt)
@@ -421,7 +449,7 @@ class MeshFoldBackend:
 
 def make_fold_backend(cfg) -> HostFoldBackend | MeshFoldBackend:
     if getattr(cfg.aggregation, "sharded", False):
-        return MeshFoldBackend()
+        return MeshFoldBackend(kernels=getattr(cfg, "kernels", None))
     return HostFoldBackend()
 
 

@@ -48,11 +48,19 @@ def make_codecs(cfg, faults=None) -> dict:
     ``cfg.transport.codec``.  Families without a spec are absent —
     callers fall back to the plain wire-dtype path."""
     specs = parse_codec_map(getattr(cfg.transport, "codec", None))
+    # a full config carries the Pallas kernel plan for this process —
+    # install it so the self-describing decode path (no config in
+    # scope) follows the same plan; partial shims (no `kernels`
+    # section) leave the installed plan alone
+    kcfg = getattr(cfg, "kernels", None)
+    if kcfg is not None:
+        from split_learning_tpu.ops import kernels as kplane
+        kplane.configure(kcfg)
     out: dict = {}
     for family, spec in specs.items():
         if spec.kind in ("int8", "int4"):
             from split_learning_tpu.runtime.codec.quant import QuantCodec
-            out[family] = QuantCodec(spec, faults=faults)
+            out[family] = QuantCodec(spec, faults=faults, kernels=kcfg)
         elif spec.kind == "topk":
             from split_learning_tpu.runtime.codec.sparse import TopKCodec
             out[family] = TopKCodec(spec, faults=faults)

@@ -62,12 +62,17 @@ def _qmax(bits: int) -> float:
     return 127.0 if bits == 8 else 7.0
 
 
-@functools.partial(jax.jit, static_argnames=("tile", "bits"))
-def _quantize_dev(x, tile: int, bits: int):
+@functools.partial(jax.jit, static_argnames=("tile", "bits",
+                                             "kernel_block"))
+def _quantize_dev(x, tile: int, bits: int, kernel_block: int = 0):
     """(codes, per-tile scales) for one float leaf, on device.
 
     Codes are the FLAT padded array: int8 for bits=8; for bits=4 two
-    two's-complement nibbles packed per uint8 byte (lo nibble first)."""
+    two's-complement nibbles packed per uint8 byte (lo nibble first).
+    ``kernel_block > 0`` routes the tiled math through the fused Pallas
+    kernel (``ops/kernels/quant.py``) — one VMEM-resident pass instead
+    of this chain of full-leaf HBM round-trips; the pad/reshape
+    prologue stays here either way."""
     qmax = _qmax(bits)
     flat = x.reshape(-1).astype(jnp.float32)
     n = flat.shape[0]
@@ -78,6 +83,9 @@ def _quantize_dev(x, tile: int, bits: int):
         pad += tile
     flat = jnp.pad(flat, (0, pad))
     tiles = flat.reshape(-1, tile)
+    if kernel_block:
+        from split_learning_tpu.ops.kernels.quant import quantize_tiles
+        return quantize_tiles(tiles, bits=bits, block=kernel_block)
     amax = jnp.max(jnp.abs(tiles), axis=1)
     scale = jnp.where(jnp.isfinite(amax),
                       jnp.where(amax > 0, amax / qmax, 1.0),
@@ -95,9 +103,20 @@ def _quantize_dev(x, tile: int, bits: int):
 
 
 @functools.partial(jax.jit, static_argnames=("tile", "bits", "n",
-                                             "shape"))
+                                             "shape", "kernel_block"))
 def _dequantize_dev(q, scale, tile: int, bits: int, n: int,
-                    shape: tuple):
+                    shape: tuple, kernel_block: int = 0):
+    # the fused mirror kernel applies only to well-formed tiled codes
+    # (exactly scale.count * tile codes — what OUR quantizers emit);
+    # anything ragged keeps the legacy XLA chain below
+    expect = scale.shape[0] * tile // (2 if bits == 4 else 1)
+    if kernel_block and q.shape[0] == expect:
+        from split_learning_tpu.ops.kernels.quant import (
+            dequantize_tiles,
+        )
+        out = dequantize_tiles(q, scale, tile=tile, bits=bits,
+                               block=kernel_block)
+        return out[:n].reshape(shape)
     if bits == 4:
         u = q.astype(jnp.uint8)
         lo, hi = u & 0xF, u >> 4
@@ -120,9 +139,13 @@ class QuantCodec:
     name = "quant"
     COUNTERS = ("quant_nonfinite",)
 
-    def __init__(self, spec: CodecSpec, faults=None):
+    def __init__(self, spec: CodecSpec, faults=None, kernels=None):
         self.bits = spec.bits
         self.tile = spec.tile
+        # explicit kernel plan wins; None defers to the process-wide
+        # plan at prepare time (ops/kernels.configure — installed by
+        # make_codecs from the loaded config)
+        self._kernels = kernels
         if faults is None:
             from split_learning_tpu.runtime.trace import (
                 default_fault_counters,
@@ -130,16 +153,24 @@ class QuantCodec:
             faults = default_fault_counters
         self.faults = faults
 
+    def _kernel_block(self) -> int:
+        from split_learning_tpu.ops import kernels as kplane
+        kp = kplane.as_plan(self._kernels)
+        return kp.block if kp.quantize else 0
+
     def prepare(self, tree, key: str = ""):
         """Device-side stage (training thread): float leaves become
         :class:`DevQuant` holders; int/bool leaves pass through."""
+        kb = self._kernel_block()
+
         def conv(leaf):
             ldt = getattr(leaf, "dtype", None)
             if (ldt is None or ldt == jax.dtypes.float0
                     or not jnp.issubdtype(ldt, jnp.floating)):
                 return leaf
             x = jnp.asarray(leaf)
-            q, scale = _quantize_dev(x, self.tile, self.bits)
+            q, scale = _quantize_dev(x, self.tile, self.bits,
+                                     kernel_block=kb)
             return DevQuant(q, scale, self.bits, self.tile, x.shape)
         return jax.tree_util.tree_map(
             conv, tree, is_leaf=lambda o: isinstance(o, DevQuant))
@@ -164,17 +195,24 @@ class QuantCodec:
             conv, prepared, is_leaf=lambda o: isinstance(o, DevQuant))
 
 
-def dequantize_leaf(leaf: QuantLeaf):
+def dequantize_leaf(leaf: QuantLeaf, kernels=None):
     """Wire QuantLeaf -> device float32 array (receiver hot path).
 
     Handles both generations: the legacy per-tensor scalar-scale form
     keeps its exact original computation (bit parity with the int8
-    wire-dtype path), the tiled form runs the jitted kernel."""
+    wire-dtype path), the tiled form runs the jitted kernel.  Decode is
+    self-describing (no sender config in scope), so the fused Pallas
+    mirror engages through the RECEIVER's kernel plan — the explicit
+    ``kernels`` argument, or the process-wide plan."""
     if leaf.tile == 0 and leaf.shape is None:
         return jnp.asarray(leaf.q, jnp.float32) * np.float32(leaf.scale)
+    from split_learning_tpu.ops import kernels as kplane
+    kp = kplane.as_plan(kernels)
+    kb = kp.block if kp.dequantize else 0
     n = int(np.prod(leaf.shape)) if leaf.shape else 1
     return _dequantize_dev(jnp.asarray(leaf.q), jnp.asarray(leaf.scale),
-                           leaf.tile, leaf.bits, n, tuple(leaf.shape))
+                           leaf.tile, leaf.bits, n, tuple(leaf.shape),
+                           kernel_block=kb)
 
 
 # -- numpy twins (once-per-round Update/delta path; host-side inputs) ------

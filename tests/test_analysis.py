@@ -834,3 +834,47 @@ def test_aggregator_log_lines_resolve_to_aggregator_role():
     events = M.events_from_log(text)
     assert [e.role for e in events] == ["aggregator", "aggregator"]
     assert M.validate_events(events) == []
+
+
+# --------------------------------------------------------------------------
+# pallas lowering gate (PK001)
+# --------------------------------------------------------------------------
+
+def test_pallas_analyzer_clean_on_repo():
+    # every enableable kernel traced with the kernel on must show its
+    # pallas_call in the hot-path jaxpr (acceptance criterion)
+    from split_learning_tpu.analysis import pallas_check
+    from split_learning_tpu.analysis.__main__ import repo_root
+    assert pallas_check.run(repo_root(), trace=True) == []
+
+
+def test_pallas_gate_fires_on_pallas_free_program():
+    import jax
+    import numpy as np
+
+    from split_learning_tpu.analysis import pallas_check
+    jaxpr = jax.make_jaxpr(lambda x: x + 1)(np.ones((4,), np.float32))
+    assert not pallas_check.contains_pallas_call(jaxpr)
+    fs = pallas_check.check_lowering(jaxpr, "some/file.py", "quantize:int8")
+    assert codes(fs) == {"PK001"}
+    assert fs[0].where == "quantize:int8"
+
+
+def test_pallas_gate_sees_call_through_jit_wrapping():
+    import jax
+    import numpy as np
+
+    from split_learning_tpu.analysis import pallas_check
+    from split_learning_tpu.ops.kernels.quant import quantize_tiles
+
+    tiles = np.ones((3, 64), np.float32)
+    jaxpr = jax.make_jaxpr(
+        jax.jit(lambda t: quantize_tiles(t, bits=8)))(tiles)
+    assert pallas_check.contains_pallas_call(jaxpr)
+    assert pallas_check.check_lowering(jaxpr, "x.py", "quantize:int8") == []
+
+
+def test_pallas_analyzer_skipped_without_trace():
+    from split_learning_tpu.analysis import pallas_check
+    from split_learning_tpu.analysis.__main__ import repo_root
+    assert pallas_check.run(repo_root(), trace=False) == []

@@ -1351,6 +1351,73 @@ def mpmd_cell(tmp: str) -> tuple[bool, str]:
                   f"(1 death, {len(moved)} reassign) [{wall:.0f}s]")
 
 
+def kernels_cell(tmp: str, seed: int = 19) -> tuple[bool, str]:
+    """Pallas kernel-plane chaos cell (kernels.*): a 3-client round
+    with the FULL wire compression stack AND every fused kernel
+    enabled (``kernels: {quantize, dequantize, stage_update}``, the
+    sharded mesh update backend underneath), under drop + duplicate +
+    delay injection with the reliable layer masking.  PASSes iff
+
+    * the round completes without a barrier stall;
+    * the aggregated params are BIT-IDENTICAL to a fault-free,
+      KERNELS-OFF twin on the same codec stack: the single-pass Pallas
+      kernels must be invisible to training semantics — same codes,
+      same scales, same fused update, down to the last bit — even
+      while chaos reorders the quantized wire around them (the live
+      twin of the PK001 lowering gate and tests/test_kernels.py);
+    * the kernel plan was actually installed for the run (the process
+      plan the self-describing decode path follows).
+    """
+    import numpy as np
+
+    sys.path.insert(0, "tests")
+    from test_chaos import _chaos, _round_cfg, _run_cell  # noqa: E402
+
+    from split_learning_tpu.ops import kernels as kplane
+
+    kernels_on = {"quantize": True, "dequantize": True,
+                  "stage_update": True}
+    common = dict(transport={"codec": dict(CODEC_STACK)},
+                  aggregation={"sharded": True})
+    chaos = _chaos(seed=seed, drop=0.10, duplicate=0.10, delay=0.15,
+                   delay_s=0.02)
+    # fault-free kernels-off twin first (the plan default is all-off)
+    cfg_b = _round_cfg(pathlib.Path(tmp),
+                       pathlib.Path(tmp) / "kernels_base", **common)
+    res_b = _run_cell(cfg_b)
+    fc = FaultCounters()
+    cfg_k = _round_cfg(pathlib.Path(tmp),
+                       pathlib.Path(tmp) / "kernels_chaos",
+                       kernels=kernels_on, **common)
+    t0 = time.monotonic()
+    res_k = _run_cell(cfg_k, chaos_cfg=chaos, reliable=True, faults=fc)
+    wall = time.monotonic() - t0
+    plan = kplane.plan()
+    if not (plan.quantize and plan.dequantize and plan.stage_update):
+        return False, f"kernel plan never installed: {plan}"
+    if not (res_k.history and res_k.history[0].ok
+            and res_b.history and res_b.history[0].ok):
+        return False, "round not ok"
+    if wall > 240:
+        return False, f"barrier stall ({wall:.0f}s)"
+    if res_k.history[0].num_samples != res_b.history[0].num_samples:
+        return False, "sample count drifted"
+    import jax
+    la = jax.tree_util.tree_leaves(res_b.params)
+    lb = jax.tree_util.tree_leaves(res_k.params)
+    if len(la) != len(lb) or any(
+            np.asarray(a).tobytes() != np.asarray(b).tobytes()
+            for a, b in zip(la, lb)):
+        return False, "kernels+chaos fold not bit-identical"
+    snap = fc.snapshot()
+    injected = sum(snap.get(k, 0) for k in ("drops", "duplicates",
+                                            "delays"))
+    if not injected:
+        return False, "chaos injected nothing"
+    return True, (f"bit-identical with all kernels on "
+                  f"({injected} faults injected, {wall:.0f}s)")
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(
         description="Sweep fault probabilities over seeds; print a "
@@ -1432,6 +1499,15 @@ def main(argv=None):
                          "complete via the counted slot re-assignment, "
                          "bit-identical to a fault-free single-process "
                          "twin (writes mpmd.json)")
+    ap.add_argument("--kernels", dest="kernels_mode",
+                    action="store_true",
+                    help="run ONLY the Pallas kernel-plane cell: a "
+                         "3-client round with the full codec stack and "
+                         "every fused kernel enabled (quantize/"
+                         "dequantize/stage_update over the sharded "
+                         "mesh backend) under drop+dup+delay must stay "
+                         "bit-identical to a fault-free kernels-off "
+                         "twin on the same stack")
     ap.add_argument("--overlap", dest="overlap_mode",
                     action="store_true",
                     help="run ONLY the sync-overlap cell: a 3-client "
@@ -1508,6 +1584,20 @@ def main(argv=None):
         ok, note = sched_cell(tmp)
         dt = time.monotonic() - t0
         print(f"sched cell: {'PASS' if ok else 'FAIL'} ({note}) "
+              f"[{dt:.1f}s, artifacts in {tmp}]")
+        return 0 if ok else 1
+
+    if args.kernels_mode:
+        if args.artifacts_dir:
+            tmp = args.artifacts_dir
+            pathlib.Path(tmp).mkdir(parents=True, exist_ok=True)
+        else:
+            import tempfile
+            tmp = tempfile.mkdtemp(prefix="chaos_kernels_")
+        t0 = time.monotonic()
+        ok, note = kernels_cell(tmp)
+        dt = time.monotonic() - t0
+        print(f"kernels cell: {'PASS' if ok else 'FAIL'} ({note}) "
               f"[{dt:.1f}s, artifacts in {tmp}]")
         return 0 if ok else 1
 

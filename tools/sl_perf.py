@@ -104,6 +104,13 @@ STABLE_KEYS = {
     # the 3-host cell's absolute rate
     "extra.mpmd_scaling_3host": "up",
     "extra.mpmd_samples_per_sec": "up",
+    # Pallas hot-path kernel plane (round-17): fused-kernel wall over
+    # the XLA-chain wall for the codec quantize and the round-boundary
+    # stage update (< 1 = the single-pass kernel wins).  Recorded only
+    # on real TPU runs — the CPU interpreter cell leaves them null,
+    # and the diff gate skips null keys
+    "extra.quant_kernel_wall_ratio": "down",
+    "extra.update_kernel_wall_ratio": "down",
 }
 
 #: absolute pins, enforced on the NEWEST record regardless of trend: a
@@ -209,7 +216,8 @@ for _k in ("protocol_samples_per_sec", "cold_round_wall_s",
            "sched_decision_ms_10k", "fleet_digest_ingest_ms_100k",
            "fleet_metrics_render_ms_100k", "broker_shard_scaling",
            "broker_round_wall_ratio_100k", "mpmd_scaling_3host",
-           "mpmd_samples_per_sec"):
+           "mpmd_samples_per_sec", "quant_kernel_wall_ratio",
+           "update_kernel_wall_ratio"):
     _path = ("extra.mfu." + _k
              if _k.startswith(("mfu_vs", "measured_matmul"))
              else "extra." + _k)
