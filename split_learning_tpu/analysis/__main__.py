@@ -24,7 +24,7 @@ from split_learning_tpu.analysis.findings import (
 )
 
 ANALYZERS = ("protocol", "jaxpr", "concurrency", "counters", "codec",
-             "perf", "agg", "async", "sched", "pallas")
+             "perf", "agg", "async", "sched", "pallas", "blackbox")
 
 
 def repo_root() -> pathlib.Path:
@@ -64,6 +64,9 @@ def run_analyzers(root: pathlib.Path, names=ANALYZERS,
     if "pallas" in names:
         from split_learning_tpu.analysis import pallas_check
         findings += pallas_check.run(root, trace=trace)
+    if "blackbox" in names:
+        from split_learning_tpu.analysis import blackbox_check
+        findings += blackbox_check.run(root)
     return findings
 
 

@@ -1,0 +1,166 @@
+"""``blackbox`` analyzer — flight-recorder coverage rules.
+
+**BB001**: every process entry point must arm the flight recorder.
+The whole value of ``runtime/blackbox.py`` is that *every* process in
+the fleet carries a ring and abnormal-exit handlers — the postmortem
+assembler names a victim from the survivors' dumps, so one uncovered
+process is a hole in the causal timeline.  Rule: each entry-point
+module in :data:`ENTRY_FILES` (the ``main()``s behind
+``python -m split_learning_tpu.run/server/client/aggregator/stagehost/
+broker``) must call ``blackbox.install`` / ``install_basic`` /
+``configure`` somewhere in the module, or carry an explicit
+``# slcheck: no-blackbox`` opt-out comment.
+
+**BB002**: no silent swallow-and-continue on the transport hot path.
+In :data:`HOT_FILES` an ``except``/``except Exception`` handler that
+neither re-raises nor leaves any evidence — a fault-counter ``inc``, a
+ring ``record``/``dump``, or at least a log ``warning``/``error`` — is
+a fault that happened and left no trace for the postmortem to find.
+Rule: such handlers must contain one of those calls (anywhere in the
+handler) or carry ``# slcheck: no-blackbox`` on the ``except`` line
+(reserved for teardown paths where the process is already unwinding).
+"""
+
+from __future__ import annotations
+
+import ast
+import pathlib
+
+from split_learning_tpu.analysis.findings import Finding
+
+#: modules whose main() is a fleet process entry point
+ENTRY_FILES = (
+    "split_learning_tpu/run.py",
+    "split_learning_tpu/runtime/server.py",
+    "split_learning_tpu/runtime/client.py",
+    "split_learning_tpu/runtime/aggnode.py",
+    "split_learning_tpu/runtime/stagehost.py",
+    "split_learning_tpu/broker.py",
+)
+
+#: transport hot-path files held to the no-silent-swallow rule
+HOT_FILES = (
+    "split_learning_tpu/runtime/bus.py",
+    "split_learning_tpu/runtime/chaos.py",
+)
+
+OPT_OUT = "slcheck: no-blackbox"
+
+#: call names that count as blackbox arming (BB001)
+_INSTALL_NAMES = ("install", "install_basic", "configure",
+                  "configure_basic")
+
+#: call attr/names that count as evidence from an except handler (BB002)
+_EVIDENCE_NAMES = ("inc", "record", "dump", "warning", "error",
+                   "exception")
+
+
+def _call_name(node: ast.Call) -> str | None:
+    f = node.func
+    if isinstance(f, ast.Attribute):
+        return f.attr
+    if isinstance(f, ast.Name):
+        return f.id
+    return None
+
+
+def _installs_blackbox(tree: ast.AST) -> bool:
+    for n in ast.walk(tree):
+        if isinstance(n, ast.Call) \
+                and _call_name(n) in _INSTALL_NAMES:
+            f = n.func
+            # require the blackbox module as the receiver so an
+            # unrelated .install() can't satisfy the rule
+            if isinstance(f, ast.Attribute) \
+                    and isinstance(f.value, ast.Name) \
+                    and f.value.id == "blackbox":
+                return True
+    return False
+
+
+def _leaves_evidence(handler: ast.ExceptHandler) -> bool:
+    for n in ast.walk(handler):
+        if isinstance(n, ast.Raise):
+            return True
+        if isinstance(n, ast.Call) \
+                and _call_name(n) in _EVIDENCE_NAMES:
+            return True
+    return False
+
+
+def _broad(handler: ast.ExceptHandler) -> bool:
+    t = handler.type
+    if t is None:
+        return True
+    names = []
+    if isinstance(t, ast.Name):
+        names = [t.id]
+    elif isinstance(t, ast.Tuple):
+        names = [e.id for e in t.elts if isinstance(e, ast.Name)]
+    return any(n in ("Exception", "BaseException") for n in names)
+
+
+def _opted_out(lines: list[str], lineno: int) -> bool:
+    # the opt-out comment may ride the except line or the line above
+    for ln in (lineno - 1, lineno - 2):
+        if 0 <= ln < len(lines) and OPT_OUT in lines[ln]:
+            return True
+    return False
+
+
+def check_entry(source: str, rel: str) -> list[Finding]:
+    if OPT_OUT in source:
+        return []
+    tree = ast.parse(source)
+    if _installs_blackbox(tree):
+        return []
+    line = 1
+    for n in ast.walk(tree):
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                and n.name == "main":
+            line = n.lineno
+            break
+    return [Finding(
+        code="BB001", path=rel, line=line, where="main",
+        message=("process entry point does not arm the flight "
+                 "recorder: call blackbox.install(cfg, participant) "
+                 "(or install_basic for config-less processes) so "
+                 "this process dumps blackbox-*.json on abnormal "
+                 "exit — or opt out with '# slcheck: no-blackbox'"))]
+
+
+def check_hot(source: str, rel: str) -> list[Finding]:
+    findings: list[Finding] = []
+    lines = source.splitlines()
+    tree = ast.parse(source)
+    for n in ast.walk(tree):
+        if not isinstance(n, ast.ExceptHandler):
+            continue
+        if not _broad(n):
+            continue
+        if _leaves_evidence(n):
+            continue
+        if _opted_out(lines, n.lineno):
+            continue
+        findings.append(Finding(
+            code="BB002", path=rel, line=n.lineno, where="except",
+            message=("broad except swallows a hot-path fault without "
+                     "evidence: record it (faults.inc / "
+                     "blackbox.record / log.warning) so the "
+                     "postmortem can see it — or annotate the except "
+                     "line with '# slcheck: no-blackbox' for teardown "
+                     "paths")))
+    return findings
+
+
+def run(root: pathlib.Path) -> list[Finding]:
+    findings: list[Finding] = []
+    for rel in ENTRY_FILES:
+        path = root / rel
+        if path.exists():
+            findings += check_entry(path.read_text(), rel)
+    for rel in HOT_FILES:
+        path = root / rel
+        if path.exists():
+            findings += check_hot(path.read_text(), rel)
+    return findings

@@ -50,7 +50,7 @@ CONTROL_KINDS = ("Register", "Ready", "Notify", "Update",
                  "Start", "Syn", "Pause", "Stop", "Heartbeat",
                  "PartialAggregate", "AggHello", "AggAssign",
                  "AggFlush", "FleetDigest", "DigestRoute",
-                 "StageHello", "StageAssign")
+                 "StageHello", "StageAssign", "BlackboxDump")
 DATA_KINDS = ("Activation", "Gradient", "EpochEnd")
 ALL_KINDS = CONTROL_KINDS + DATA_KINDS
 
@@ -120,6 +120,11 @@ SEND_RULES = frozenset({
     ("stagehost", "rpc", "StageHello"),
     ("stagehost", "rpc", "Heartbeat"),
     ("server", "reply", "StageAssign"),
+    # fleet flight recorder (runtime/blackbox.py): when any
+    # participant dies the server fans a BlackboxDump out to every
+    # SURVIVING participant's reply queue; each recipient flushes its
+    # local ring to disk — no reply frame, the dumps are the answer
+    ("server", "reply", "BlackboxDump"),
 })
 
 #: queue families each role may consume from.  The server's aggregate
@@ -352,11 +357,17 @@ for _state, _transitions in SERVER_FSM.items():
     # triggers an immediate re-assignment, whatever the round phase
     _transitions[("recv", "StageHello")] = _state
     _transitions[("send", "StageAssign")] = _state
+    # flight-recorder snapshots fan out the moment a death is noticed,
+    # whatever round phase the server is in (runtime/blackbox.py)
+    _transitions[("send", "BlackboxDump")] = _state
 for _state, _transitions in CLIENT_FSM.items():
     _transitions[("send", "Heartbeat")] = _state
     # heartbeat re-route is lifecycle-orthogonal: the beat thread's
     # target changes, the training lifecycle doesn't notice
     _transitions[("recv", "DigestRoute")] = _state
+    # a fleet-snapshot request flushes the local blackbox ring and
+    # nothing else — the training lifecycle doesn't notice
+    _transitions[("recv", "BlackboxDump")] = _state
 for _state, _transitions in AGGREGATOR_FSM.items():
     # remote nodes heartbeat from a background thread, any state; the
     # digest worker consumes routed clients' beats and publishes
@@ -364,9 +375,11 @@ for _state, _transitions in AGGREGATOR_FSM.items():
     _transitions[("send", "Heartbeat")] = _state
     _transitions[("recv", "Heartbeat")] = _state
     _transitions[("send", "FleetDigest")] = _state
+    _transitions[("recv", "BlackboxDump")] = _state
 for _state, _transitions in STAGEHOST_FSM.items():
     # stage hosts heartbeat from a background thread like clients
     _transitions[("send", "Heartbeat")] = _state
+    _transitions[("recv", "BlackboxDump")] = _state
 
 FSM_BY_ROLE = {"server": SERVER_FSM, "client": CLIENT_FSM,
                "aggregator": AGGREGATOR_FSM,

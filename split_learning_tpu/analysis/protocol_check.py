@@ -328,6 +328,8 @@ def _sample_messages():
                          "score": 0.3, "view": {"stage": 1}}],
                     "transitions": []}),
         "DigestRoute": P.DigestRoute(client_id="c", queue=None),
+        "BlackboxDump": P.BlackboxDump(
+            participant="c", reason="lost:client_2_1", t_req=1.0),
         "StageHello": P.StageHello(host_id="stage_host_0", capacity=2),
         "StageAssign": P.StageAssign(
             host_id="stage_host_0", gen=3, round_idx=1,

@@ -32,11 +32,14 @@ import time
 
 def spawn_shard(host: str, port: int, *, shard_index: int = 0,
                 max_frame_gb: float | None = None,
-                python_only: bool = False) -> subprocess.Popen:
+                python_only: bool = False,
+                journal_dir: str | None = None) -> subprocess.Popen:
     """Spawn ONE broker shard subprocess bound to ``host:port``.
     Shared by the ``--shards`` supervisor, the broker_shard bench cell
     and the ``--broker-shard`` chaos cell (which SIGKILLs and respawns
-    shards through exactly this path)."""
+    shards through exactly this path).  ``journal_dir`` turns on the
+    shard's span journal + flight-recorder dump directory (the same
+    artifacts directory the other participants write into)."""
     cmd = [sys.executable, "-m", "split_learning_tpu.broker",
            "--host", host, "--port", str(port),
            "--shard-id", f"shard_{shard_index}@{host}:{port}"]
@@ -44,7 +47,17 @@ def spawn_shard(host: str, port: int, *, shard_index: int = 0,
         cmd += ["--max-frame-gb", str(max_frame_gb)]
     if python_only:
         cmd.append("--python")
+    if journal_dir is not None:
+        cmd += ["--journal-dir", str(journal_dir)]
     return subprocess.Popen(cmd)
+
+
+def _participant_name(args) -> str:
+    """Filesystem-safe participant identity for this shard's span
+    journal + blackbox dump (``shard_0@h:p`` → ``broker-shard_0_h_p``)."""
+    raw = args.shard_id or f"shard@{args.host}:{args.port}"
+    safe = raw.replace("@", "_").replace(":", "_").replace("/", "_")
+    return safe if safe.startswith("broker") else f"broker-{safe}"
 
 
 def _supervise(args) -> int:
@@ -61,7 +74,8 @@ def _supervise(args) -> int:
     on every sl_top//fleet sweep."""
     procs = [spawn_shard(args.host, args.port + i, shard_index=i,
                          max_frame_gb=args.max_frame_gb,
-                         python_only=True)
+                         python_only=True,
+                         journal_dir=args.journal_dir)
              for i in range(args.shards)]
     for i in range(args.shards):
         print(f"broker shard {i}/{args.shards} on "
@@ -124,6 +138,13 @@ def main(argv=None):
                          "(set by the --shards supervisor)")
     ap.add_argument("--python", action="store_true",
                     help="force the pure-Python broker")
+    ap.add_argument("--journal-dir", default=None,
+                    help="artifacts directory: turns on this shard's "
+                         "span journal (spans-<shard>.jsonl broker.tick "
+                         "heartbeat spans) and flight-recorder dump "
+                         "directory (blackbox-<shard>.json on abnormal "
+                         "exit).  Implies --python — the native broker "
+                         "has neither plane")
     ap.add_argument("--max-frame-gb", type=float, default=None,
                     help="per-frame payload cap (default 8 GiB): a "
                          "corrupt length prefix fails the connection "
@@ -137,6 +158,11 @@ def main(argv=None):
 
     if args.shards > 1:
         return _supervise(args)
+
+    if args.journal_dir is not None and not args.python:
+        # only the Python event-loop broker carries the tracer +
+        # flight-recorder planes
+        args.python = True
 
     if args.max_frame_gb is not None:
         from split_learning_tpu.runtime import bus, protocol
@@ -163,12 +189,26 @@ def main(argv=None):
             print(f"native broker unavailable ({e}); using Python broker")
     if broker is None:
         from split_learning_tpu.runtime.bus import Broker
-        broker = Broker(args.host, args.port, shard_id=args.shard_id)
+        tracer = None
+        if args.journal_dir is not None:
+            from split_learning_tpu.runtime.spans import Tracer
+            tracer = Tracer(_participant_name(args),
+                            journal_dir=args.journal_dir)
+        broker = Broker(args.host, args.port, shard_id=args.shard_id,
+                        tracer=tracer)
         print(f"python broker on {args.host}:{broker.port} "
               f"(event loop, 1 thread)", flush=True)
     # SIGTERM (kill, process managers) must tear the native child down
     # with us — a bare kill otherwise orphans it holding the port
     signal.signal(signal.SIGTERM, lambda *_: sys.exit(0))
+    if args.journal_dir is not None:
+        # AFTER the clean-exit lambda: the flight recorder's SIGTERM
+        # handler dumps blackbox-<shard>.json then CHAINS to it, so a
+        # plain kill still tears the broker down via sys.exit(0)
+        from split_learning_tpu.runtime import blackbox
+        blackbox.install_basic(_participant_name(args),
+                               role="broker_shard",
+                               dump_dir=args.journal_dir)
     try:
         while True:
             time.sleep(3600)

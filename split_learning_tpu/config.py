@@ -544,6 +544,32 @@ class ChaosConfig:
 
 
 @dataclasses.dataclass(frozen=True)
+class BlackboxConfig:
+    """Per-process flight recorder (``runtime/blackbox.py``).
+
+    Every process entry point installs a bounded in-memory event ring
+    fed by the existing instrumentation seams (spans, transport
+    publish/consume metadata, chaos injections, fault-counter deltas,
+    scheduler decisions).  On abnormal exit (SIGTERM/SIGABRT,
+    uncaught exception, sticky ChaosCrash) — or on demand via the
+    server's ``BlackboxDump`` fan-out when any fleet member dies —
+    the ring flushes an atomic ``blackbox-{participant}.json`` dump
+    that ``tools/sl_postmortem.py`` assembles into a causal
+    root-cause report.  ``ring-events`` bounds the ring (oldest
+    events overwritten); ``dump-dir`` overrides where dumps land
+    (default: the run-scoped artifacts directory, next to
+    ``spans-*.jsonl`` and ``metrics.jsonl``)."""
+    enabled: bool = True
+    ring_events: int = 2048
+    dump_dir: str | None = None
+
+    def validate(self):
+        _check(self.ring_events >= 16,
+               f"observability.blackbox.ring-events must be >= 16, "
+               f"got {self.ring_events!r}")
+
+
+@dataclasses.dataclass(frozen=True)
 class ObservabilityConfig:
     """Distributed round tracing (``runtime/spans.py``).
 
@@ -601,8 +627,10 @@ class ObservabilityConfig:
     watchlist_size: int = 64            # exact-state bound (digest mode)
     metrics_max_mb: float = 0.0         # metrics.jsonl rotation; 0 = off
     metrics_keep: int = 4               # rotated metrics.jsonl.N kept
+    blackbox: BlackboxConfig = BlackboxConfig()  # flight recorder
 
     def validate(self):
+        self.blackbox.validate()
         _check(0.0 <= self.sample_rate <= 1.0,
                f"observability.sample-rate must be in [0, 1], "
                f"got {self.sample_rate!r}")
@@ -984,13 +1012,25 @@ def _coerce(v, annotation: str):
     return v
 
 
+#: dataclass-typed fields NESTED inside a section (annotation name ->
+#: class), so ``observability.blackbox: {...}`` builds a sub-config
+#: instead of freezing to a plain dict
+_NESTED_TYPES = {"BlackboxConfig": BlackboxConfig}
+
+
 def _build(cls, d: dict, path: str):
     fields = {f.name: f for f in dataclasses.fields(cls)}
     kwargs = {}
     for k, v in d.items():
         key = k.replace("-", "_")
         _check(key in fields, f"unknown config key {path}{k!r}")
-        kwargs[key] = _coerce(_freeze(v), str(fields[key].type))
+        ann = str(fields[key].type).replace(" ", "")
+        if ann in _NESTED_TYPES:
+            _check(isinstance(v, dict),
+                   f"section {path}{k!r} must be a mapping")
+            kwargs[key] = _build(_NESTED_TYPES[ann], v, f"{path}{k}.")
+        else:
+            kwargs[key] = _coerce(_freeze(v), ann)
     return cls(**kwargs)
 
 

@@ -68,6 +68,8 @@ def main(argv=None):
     cfg = from_yaml(args.config)
     from split_learning_tpu.platform import apply_compile_cache
     apply_compile_cache(cfg.compile_cache_dir)
+    from split_learning_tpu.runtime import blackbox
+    blackbox.install(cfg, "server", role="server")
     result = run_local(cfg)
     for rec in result.history:
         acc = (f" val_acc={rec.val_accuracy:.4f}"

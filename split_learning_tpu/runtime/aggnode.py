@@ -49,10 +49,12 @@ import time
 
 from split_learning_tpu.config import Config, from_yaml
 from split_learning_tpu.runtime import aggregate as agg_plane
+from split_learning_tpu.runtime import blackbox
 from split_learning_tpu.runtime.log import Logger
 from split_learning_tpu.runtime.protocol import (
-    AggAssign, AggFlush, AggHello, FleetDigest, FrameAssembler,
-    Heartbeat, Stop, digest_queue, encode, reply_queue, RPC_QUEUE,
+    AggAssign, AggFlush, AggHello, BlackboxDump, FleetDigest,
+    FrameAssembler, Heartbeat, Stop, digest_queue, encode, reply_queue,
+    RPC_QUEUE,
 )
 
 #: seconds an interior group keeps polling for its children's partials
@@ -138,6 +140,7 @@ class DigestWorker(threading.Thread):
     def publish_digest(self) -> None:
         """Advance the local state machine and ship one digest (also
         called once at teardown so the last interval isn't lost)."""
+        t0 = time.time()
         self.monitor.advance()
         self._seq += 1
         digest = self.monitor.build_digest(self.node.node_id,
@@ -146,6 +149,9 @@ class DigestWorker(threading.Thread):
             node_id=self.node.node_id, digest=digest)))
         self.node.gauges.set("fleet_digest_clients",
                              digest.get("clients", 0))
+        self.node.tracer.record(
+            "agg.digest", t0, time.time(), always=True, seq=self._seq,
+            clients=digest.get("clients", 0))
 
 
 class AssignmentWorker(threading.Thread):
@@ -183,9 +189,18 @@ class AssignmentWorker(threading.Thread):
 
     def run(self) -> None:
         t0 = time.perf_counter()
+        tw0 = time.time()
         try:
             self._fold_loop()
+            tw1 = time.time()
+            self.node.tracer.record(
+                "agg.fold", tw0, tw1, always=True, gen=self.gen,
+                round=self.round_idx, groups=len(self.workers))
             self._flush_cascade()
+            self.node.tracer.record(
+                "agg.flush", tw1, time.time(), always=True,
+                gen=self.gen, round=self.round_idx,
+                flushed=sum(1 for w in self.workers if w.flushed))
         except Exception as e:  # noqa: BLE001 — a dead transport mid-
             # round means the node is effectively dead for this gen;
             # the server's fallback drain recovers the groups
@@ -267,6 +282,9 @@ class AssignmentWorker(threading.Thread):
             folded=folded, ingress_bytes=ingress, egress_bytes=egress,
             fold_s=round(fold_s, 6),
             incomplete=sum(1 for w in self.workers if not w.complete))
+        # round boundary for this node: make the gen's spans durable
+        # now, not at whatever flush_every batch boundary comes next
+        node.tracer.flush()
 
 
 class AggregatorNode:
@@ -311,6 +329,13 @@ class AggregatorNode:
                            if digest_transport is not None
                            else transport)
         self.log = logger or Logger.for_run(cfg, node_id, console=False)
+        # span-plane membership: the node's fold/flush/digest phases
+        # journal into spans-{node_id}.jsonl so sl_trace merges the
+        # aggregator tier into the fleet timeline (the trace id is
+        # adopted per-assignment from AggAssign-carrying runs' config;
+        # absent that, the journal still merges by wall clock)
+        from split_learning_tpu.runtime.spans import make_tracer
+        self.tracer = make_tracer(cfg, node_id)
         self._asm = FrameAssembler(faults=self.faults)
         self._stop = threading.Event()
         from split_learning_tpu.runtime.telemetry import (
@@ -360,6 +385,12 @@ class AggregatorNode:
                 if isinstance(msg, Stop):
                     self.log.received(f"STOP ({msg.reason})")
                     break
+                if isinstance(msg, BlackboxDump):
+                    # server-initiated fleet snapshot: flush this
+                    # node's flight recorder alongside everyone else's
+                    blackbox.record("dump_request", reason=msg.reason)
+                    blackbox.dump(msg.reason or "fleet_snapshot")
+                    continue
                 if isinstance(msg, AggAssign):
                     self.log.received(
                         f"AGGASSIGN gen={msg.gen} "
@@ -406,6 +437,7 @@ class AggregatorNode:
                 except Exception:  # noqa: BLE001 — transport already
                     pass           # gone; the server's fallback covers
             self.emitter.stop()
+            self.tracer.close()
             if self._owns_buses:
                 for bus in {
                         id(self.bus): self.bus,
@@ -452,6 +484,7 @@ def main(argv=None):
     ap.add_argument("--node-id", default="aggregator_node_0")
     args = ap.parse_args(argv)
     cfg = from_yaml(args.config)
+    blackbox.install(cfg, args.node_id, role="agg_node")
     node = AggregatorNode(cfg, args.node_id)
     node.run()
 

@@ -1,0 +1,498 @@
+"""Per-process flight recorder: bounded event ring + crash dumps.
+
+Every chaos cell in this repo kills processes on purpose (SIGKILL'd
+aggregators, shard kills, stage-host kills), and a real fleet kills
+them by accident — yet the only evidence a death leaves is monotonic
+fault counters and whatever ``app.log`` lines got flushed.  This module
+is the missing bounded recent-history capture:
+
+* :class:`BlackboxRing` — a lock-cheap bounded ring
+  (``collections.deque(maxlen=ring_events)`` under one mutex) that the
+  existing instrumentation seams feed: span open/close
+  (``runtime/spans.py``), frame publish/consume metadata (``bus.py``
+  transports), scheduler decisions, fault-counter increments
+  (``runtime/trace.py``), chaos injections (``runtime/chaos.py``).
+  Recording is a dict build + deque append; a disabled ring costs one
+  attribute read.
+* :func:`install` — wires the process for *abnormal-exit* capture:
+  SIGTERM/SIGABRT handlers, a chained ``sys.excepthook``, and a
+  chained ``threading.excepthook`` all flush an atomic
+  ``blackbox-{participant}.json`` dump before the process unwinds.
+  Handlers chain to whatever was installed before (broker shards
+  already trap SIGTERM for a clean exit) and re-deliver the default
+  disposition otherwise, so exit codes stay honest.
+* :func:`dump` — atomic (tempfile + ``os.replace``) JSON snapshot:
+  header (participant, role, pid, reason, wall time, event seq) first,
+  then the ring events oldest-first, then a fault-counter snapshot.
+  Dumps also fire on demand: the protocol server fans out a
+  ``BlackboxDump`` control frame when any participant dies, so one
+  death snapshots the whole fleet's last N seconds.
+* :func:`load_dump` — scavenge-tolerant loader (same discipline as
+  ``sl_perf``'s BENCH loader): a torn or truncated dump — a process
+  killed mid-``os.replace`` predecessor, a copied partial file —
+  yields the header fields plus every event that parses, flagged
+  ``torn``, instead of raising out of the postmortem assembler.
+
+SIGKILL is uncatchable by design: the killed process writes nothing,
+and that absence is itself evidence — ``tools/sl_postmortem.py`` names
+the victim from the *surviving* fleet's dumps (the server records
+``participant_lost`` / ``child_exit`` events with the victim's role
+and round).
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import os
+import pathlib
+import re
+import signal
+import sys
+import tempfile
+import threading
+import time
+from typing import Any
+
+#: blackbox dump schema version (bump on breaking change)
+SCHEMA_VERSION = 1
+
+#: event kinds sl_postmortem treats as abnormal (ordered by severity
+#: only for tie-breaks at equal timestamps; the FIRST one on the
+#: merged timeline is the proximate cause)
+ABNORMAL_KINDS = ("signal", "exception", "chaos_crash",
+                  "participant_lost", "child_exit", "shard_dead")
+
+
+class BlackboxRing:
+    """Bounded in-memory event ring for one process.
+
+    ``record`` is the only hot-path entry point: one lock, one dict,
+    one deque append (the deque evicts the oldest event itself).
+    ``seq`` counts every event ever recorded, so a dump can report how
+    many were overwritten (``seq - len(events)``)."""
+
+    def __init__(self, maxlen: int = 2048, enabled: bool = True):
+        self.enabled = enabled
+        self.maxlen = int(maxlen)
+        self._lock = threading.Lock()
+        self._ring: collections.deque = collections.deque(
+            maxlen=self.maxlen)
+        self.seq = 0
+        self.participant = ""
+        self.role = ""
+        self.dump_dir: pathlib.Path | None = None
+        self.last_dump_t: float | None = None
+        self.last_dump_path: pathlib.Path | None = None
+
+    def record(self, kind: str, **attrs: Any) -> None:
+        if not self.enabled:
+            return
+        ev = {"t": time.time(), "kind": kind}
+        for k, v in attrs.items():
+            if v is not None:
+                ev[k] = v
+        with self._lock:
+            self.seq += 1
+            ev["seq"] = self.seq
+            self._ring.append(ev)
+
+    def depth(self) -> int:
+        with self._lock:
+            return len(self._ring)
+
+    def snapshot(self) -> tuple[list[dict], int]:
+        """(events oldest-first, total seq) — a consistent pair."""
+        with self._lock:
+            return list(self._ring), self.seq
+
+    def clear(self) -> None:
+        with self._lock:
+            self._ring.clear()
+
+
+#: the process-wide default ring every seam records into.  Starts
+#: disabled so library import costs nothing; :func:`configure` /
+#: :func:`install` turn it on from config at each entry point.
+_RING = BlackboxRing(enabled=False)
+_install_lock = threading.Lock()
+_installed = False
+
+
+def ring() -> BlackboxRing:
+    return _RING
+
+
+def record(kind: str, **attrs: Any) -> None:
+    """Record one event into the process ring (no-op when disabled)."""
+    if _RING.enabled:
+        _RING.record(kind, **attrs)
+
+
+def enabled() -> bool:
+    return _RING.enabled
+
+
+def depth() -> int:
+    return _RING.depth() if _RING.enabled else 0
+
+
+def last_dump_age() -> float | None:
+    t = _RING.last_dump_t
+    return None if t is None else max(0.0, time.time() - t)
+
+
+def configure(cfg, participant: str, role: str = "") -> BlackboxRing:
+    """Size + aim the process ring from ``cfg.observability.blackbox``.
+
+    ``cfg`` may be a full Config, an ObservabilityConfig-less stub, or
+    None (broker shards configure via :func:`configure_basic`) — the
+    recorder degrades to disabled, never raises, because it runs at
+    every process entry point including half-configured test rigs."""
+    obs = getattr(cfg, "observability", None)
+    bb = getattr(obs, "blackbox", None) if obs is not None else None
+    if bb is None or not getattr(bb, "enabled", False):
+        _RING.enabled = False
+        return _RING
+    dump_dir = getattr(bb, "dump_dir", None)
+    if dump_dir is None:
+        # land dumps next to the run's other artifacts (spans/metrics)
+        # so one directory holds everything sl_postmortem needs
+        journal = getattr(obs, "journal_dir", None) \
+            or getattr(cfg, "log_path", ".")
+        dump_dir = journal
+        if getattr(obs, "run_scoped", False):
+            try:
+                from split_learning_tpu.runtime.log import run_output_dir
+                dump_dir = run_output_dir(pathlib.Path(journal))
+            except Exception:
+                pass
+    return configure_basic(participant, role=role,
+                           dump_dir=dump_dir,
+                           ring_events=getattr(bb, "ring_events", 2048))
+
+
+def configure_basic(participant: str, role: str = "",
+                    dump_dir: str | pathlib.Path | None = None,
+                    ring_events: int = 2048) -> BlackboxRing:
+    """Config-less twin of :func:`configure` for processes that never
+    load a Config (broker shards get argv, not YAML)."""
+    if _RING.maxlen != int(ring_events):
+        _RING.maxlen = int(ring_events)
+        with _RING._lock:
+            _RING._ring = collections.deque(_RING._ring,
+                                            maxlen=_RING.maxlen)
+    _RING.participant = participant
+    _RING.role = role or _infer_role(participant)
+    _RING.dump_dir = (pathlib.Path(dump_dir) if dump_dir is not None
+                      else None)
+    _RING.enabled = True
+    return _RING
+
+
+def _infer_role(participant: str) -> str:
+    p = participant.lower()
+    if p.startswith("client"):
+        return "client"
+    if p.startswith(("agg", "node")):
+        return "agg_node"
+    if p.startswith(("host", "stage")):
+        return "stage_host"
+    if p.startswith("broker"):
+        return "broker_shard"
+    if p.startswith("server"):
+        return "server"
+    return participant or "?"
+
+
+# -- dumps ------------------------------------------------------------------
+
+def dump(reason: str, path: str | pathlib.Path | None = None,
+         extra: dict | None = None) -> pathlib.Path | None:
+    """Atomically write ``blackbox-{participant}.json``; returns the
+    path (None when the recorder is disabled or the write failed — a
+    dump must never take the process down with it)."""
+    if not _RING.enabled:
+        return None
+    events, seq = _RING.snapshot()
+    doc: dict[str, Any] = {
+        "v": SCHEMA_VERSION,
+        "participant": _RING.participant or "?",
+        "role": _RING.role or "?",
+        "pid": os.getpid(),
+        "reason": reason,
+        "t_dump": time.time(),
+        "seq": seq,
+        "dropped": max(0, seq - len(events)),
+    }
+    if extra:
+        doc.update(extra)
+    try:
+        from split_learning_tpu.runtime.trace import (
+            default_fault_counters,
+        )
+        doc["faults"] = dict(default_fault_counters.snapshot())
+    except Exception:
+        doc["faults"] = {}
+    # events LAST: a torn write still yields a parseable header for
+    # the scavenge loader
+    doc["events"] = events
+    if path is None:
+        d = _RING.dump_dir or pathlib.Path(".")
+        path = pathlib.Path(d) / f"blackbox-{_RING.participant or os.getpid()}.json"
+    path = pathlib.Path(path)
+    try:
+        path.parent.mkdir(parents=True, exist_ok=True)
+        fd, tmp = tempfile.mkstemp(prefix=path.name + ".",
+                                   dir=str(path.parent))
+        try:
+            with os.fdopen(fd, "w") as f:
+                json.dump(doc, f, default=_json_default)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, path)
+        except BaseException:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise
+    except Exception:
+        return None
+    _RING.last_dump_t = time.time()
+    _RING.last_dump_path = path
+    return path
+
+
+def dump_bytes(reason: str, extra: dict | None = None,
+               participant: str | None = None) -> bytes:
+    """The dump document serialized in-memory (no file): how broker
+    shards answer the ``__broker__.blackbox`` control queue — the
+    requester owns the dump directory, not the shard."""
+    events, seq = _RING.snapshot()
+    doc = {"v": SCHEMA_VERSION,
+           "participant": participant or _RING.participant or "?",
+           "role": _RING.role or (
+               "broker_shard" if participant else "?"),
+           "pid": os.getpid(), "reason": reason,
+           "t_dump": time.time(), "seq": seq,
+           "dropped": max(0, seq - len(events))}
+    if extra:
+        doc.update(extra)
+    doc["events"] = events
+    return json.dumps(doc, default=_json_default).encode()
+
+
+def write_dump_dict(doc: dict, dump_dir: str | pathlib.Path | None = None
+                    ) -> pathlib.Path | None:
+    """Atomically persist a dump document fetched from a REMOTE ring
+    (a broker shard's ``__broker__.blackbox`` reply) next to this
+    process's own dumps.  Same never-raise contract as :func:`dump`."""
+    name = str(doc.get("participant") or "remote")
+    name = re.sub(r"[^A-Za-z0-9_.@-]", "_", name)
+    d = dump_dir if dump_dir is not None \
+        else (_RING.dump_dir or pathlib.Path("."))
+    path = pathlib.Path(d) / f"blackbox-{name}.json"
+    try:
+        path.parent.mkdir(parents=True, exist_ok=True)
+        fd, tmp = tempfile.mkstemp(prefix=path.name + ".",
+                                   dir=str(path.parent))
+        try:
+            with os.fdopen(fd, "w") as f:
+                json.dump(doc, f, default=_json_default)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, path)
+        except BaseException:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise
+    except Exception:
+        return None
+    return path
+
+
+def _json_default(o):
+    try:
+        return str(o)
+    except Exception:
+        return "?"
+
+
+# -- abnormal-exit handlers -------------------------------------------------
+
+def install(cfg, participant: str, role: str = "") -> BlackboxRing:
+    """Configure the ring AND arm the abnormal-exit capture: signal
+    handlers (SIGTERM/SIGABRT), ``sys.excepthook`` and
+    ``threading.excepthook``, each chaining to the previously
+    installed one.  Idempotent; safe off the main thread (signal
+    handlers are then skipped — Python only allows them on main)."""
+    bb = configure(cfg, participant, role=role)
+    if bb.enabled:
+        _install_handlers()
+    return bb
+
+
+def install_basic(participant: str, role: str = "",
+                  dump_dir: str | pathlib.Path | None = None,
+                  ring_events: int = 2048) -> BlackboxRing:
+    """Config-less :func:`install` (broker shards)."""
+    bb = configure_basic(participant, role=role, dump_dir=dump_dir,
+                         ring_events=ring_events)
+    _install_handlers()
+    return bb
+
+
+def _install_handlers() -> None:
+    global _installed
+    with _install_lock:
+        if _installed:
+            return
+        _installed = True
+    prev_except = sys.excepthook
+
+    def _hook(tp, val, tb):
+        try:
+            record("exception", type=tp.__name__, msg=str(val)[:200])
+            dump(f"excepthook:{tp.__name__}")
+        except Exception:
+            pass
+        prev_except(tp, val, tb)
+
+    sys.excepthook = _hook
+
+    prev_thread = threading.excepthook
+
+    def _thook(args):
+        try:
+            if args.exc_type is not SystemExit:
+                record("exception", type=args.exc_type.__name__,
+                       msg=str(args.exc_value)[:200],
+                       thread=getattr(args.thread, "name", "?"))
+                dump(f"thread-excepthook:{args.exc_type.__name__}")
+        except Exception:
+            pass
+        prev_thread(args)
+
+    threading.excepthook = _thook
+
+    if threading.current_thread() is not threading.main_thread():
+        return
+    for signame in ("SIGTERM", "SIGABRT"):
+        signum = getattr(signal, signame, None)
+        if signum is None:
+            continue
+        try:
+            prev = signal.getsignal(signum)
+            signal.signal(signum, _make_signal_handler(signame, signum,
+                                                       prev))
+        except (OSError, ValueError, RuntimeError):
+            pass
+
+
+def _make_signal_handler(signame: str, signum: int, prev):
+    def _handler(sig, frame):
+        try:
+            record("signal", sig=signame)
+            dump(f"signal:{signame}")
+        except Exception:
+            pass
+        if callable(prev):
+            prev(sig, frame)
+            return
+        if prev is signal.SIG_IGN:
+            return
+        # default disposition: re-deliver so the exit status reports
+        # the real signal, not a python exception
+        try:
+            signal.signal(signum, signal.SIG_DFL)
+            os.kill(os.getpid(), signum)
+        except (OSError, ValueError):
+            sys.exit(128 + signum)
+    return _handler
+
+
+# -- scavenge-tolerant loader -----------------------------------------------
+
+_HDR_KEYS = ("v", "participant", "role", "pid", "reason", "t_dump",
+             "seq", "dropped")
+
+
+def load_dump(path: str | pathlib.Path) -> dict | None:
+    """Parse a blackbox dump, tolerating torn/truncated files.
+
+    Returns the full document when it parses; otherwise scavenges the
+    header fields by regex and every leading event object that still
+    parses (``torn: true`` marks the salvage).  Returns None only when
+    the file is unreadable or yields nothing at all."""
+    try:
+        text = pathlib.Path(path).read_text(errors="replace")
+    except OSError:
+        return None
+    if not text.strip():
+        return None
+    try:
+        doc = json.loads(text)
+        if isinstance(doc, dict):
+            doc.setdefault("events", [])
+            return doc
+    except ValueError:
+        pass
+    out: dict[str, Any] = {"torn": True}
+    for key in _HDR_KEYS:
+        m = re.search(r'"%s"\s*:\s*("(?:[^"\\]|\\.)*"|-?[0-9.eE+]+)'
+                      % re.escape(key), text)
+        if m:
+            try:
+                out[key] = json.loads(m.group(1))
+            except ValueError:
+                pass
+    events: list[dict] = []
+    i = text.find('"events"')
+    if i >= 0:
+        i = text.find("[", i)
+    if i >= 0:
+        dec = json.JSONDecoder()
+        j = i + 1
+        n = len(text)
+        while True:
+            while j < n and text[j] in ", \t\r\n":
+                j += 1
+            if j >= n or text[j] != "{":
+                break
+            try:
+                obj, j = dec.raw_decode(text, j)
+            except ValueError:
+                break
+            if isinstance(obj, dict):
+                events.append(obj)
+    out["events"] = events
+    if len(out) <= 2 and not events:
+        return None
+    return out
+
+
+def find_dumps(root: str | pathlib.Path) -> list[pathlib.Path]:
+    """Every ``blackbox-*.json`` under ``root`` (recursive, sorted)."""
+    root = pathlib.Path(root)
+    if root.is_file():
+        return [root]
+    return sorted(root.rglob("blackbox-*.json"))
+
+
+def _reset_for_tests() -> None:
+    """Test hook: forget installs/config so one process can exercise
+    several configurations (handlers stay chained — harmless)."""
+    global _installed
+    _RING.enabled = False
+    _RING.participant = ""
+    _RING.role = ""
+    _RING.dump_dir = None
+    _RING.last_dump_t = None
+    _RING.last_dump_path = None
+    _RING.seq = 0
+    _RING.clear()
+    with _install_lock:
+        _installed = False

@@ -70,10 +70,21 @@ import zlib
 from typing import Iterable
 
 from split_learning_tpu.analysis.locks import make_condition, make_lock
+from split_learning_tpu.runtime import blackbox
 
 
 class QueueClosed(Exception):
     pass
+
+
+def _bb_frame(ev: str, queue: str, nbytes: int) -> None:
+    """Flight-recorder feed (``runtime/blackbox.py``): one ring event
+    per frame actually touching the wire, recorded at the CONCRETE
+    transports (InProc/Tcp) so the wrapper layers never double-count.
+    Broker self-telemetry polls are skipped — a periodic stats sweep
+    must not flush real traffic out of the bounded ring."""
+    if blackbox.enabled() and not queue.startswith("__broker__."):
+        blackbox.record(ev, queue=queue, nbytes=nbytes)
 
 
 class Transport:
@@ -138,6 +149,7 @@ class InProcTransport(Transport):
 
     def publish(self, queue: str, payload: bytes) -> None:
         self._count(queue, payload)
+        _bb_frame("publish", queue, len(payload))
         with self._cond:
             if self._closed:
                 raise QueueClosed(queue)
@@ -155,6 +167,7 @@ class InProcTransport(Transport):
             t_enq, payload = self._queues[queue].popleft()
         # histogram has its own lock: observe OUTSIDE the bus condition
         self._hists.observe("queue_wait", time.perf_counter() - t_enq)
+        _bb_frame("consume", queue, len(payload))
         return payload
 
     def qsize(self, queue: str) -> int:
@@ -228,6 +241,14 @@ def _recv_frame(sock: socket.socket) -> tuple[bytes, bytes, bytes]:
 #: (and ``nc``-grade tooling) can scrape a shard
 BROKER_STATS_QUEUE = "__broker__.stats"
 
+#: control queue: a GET on this name returns the shard's flight-
+#: recorder dump (JSON: header + ring events + shard stats) instead of
+#: popping a message (``runtime/blackbox.py``).  The REQUESTER owns the
+#: dump directory — the server's fleet-snapshot sweep writes the reply
+#: to ``blackbox-broker-shard{i}.json`` next to the participants' own
+#: dumps, so broker shards need no filesystem coordination.
+BROKER_BLACKBOX_QUEUE = "__broker__.blackbox"
+
 #: read chunk per readable event
 _RECV_CHUNK = 1 << 18
 
@@ -297,7 +318,7 @@ class Broker:
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
                  bind_timeout: float = 10.0,
-                 shard_id: str | None = None):
+                 shard_id: str | None = None, tracer=None):
         # a RESTARTED broker re-binds the same port while the previous
         # incarnation's connections may still be draining (FIN_WAIT):
         # retry briefly instead of failing the recovery path
@@ -331,6 +352,13 @@ class Broker:
         self._depth_hwm = 0
         self._running = True
         self._closed = threading.Event()
+        # span plane (runtime/spans.py): shard loops journal coarse
+        # "broker.tick" spans (depth/conns attrs) plus one span per
+        # control-queue request, so tools/sl_trace.py merges the
+        # broker shards onto the same fleet timeline as every other
+        # participant.  None = no journal (in-process test brokers).
+        self._tracer = tracer
+        self._last_tick = time.time()
         from split_learning_tpu.runtime.trace import default_histograms
         self._hists = default_histograms
         self._thread = threading.Thread(
@@ -361,8 +389,31 @@ class Broker:
                         # must not burn a send syscall and vice versa
                         self._service(key.data, ready)
                 self._fire_timers()
+                self._tick_span()
         finally:
+            if self._tracer is not None:
+                try:
+                    self._tracer.close()
+                except Exception:  # slcheck: no-blackbox — teardown
+                    pass
             self._teardown()
+
+    def _tick_span(self) -> None:
+        """Coarse shard-health span every ~2 s: cheap enough for the
+        event loop, dense enough that a merged trace (and a blackbox
+        dump's span feed) shows the shard alive with its depth/conns
+        right up to the kill."""
+        if self._tracer is None:
+            return
+        now = time.time()
+        if now - self._last_tick < 2.0:
+            return
+        self._tracer.record("broker.tick", self._last_tick, now,
+                            always=True, depth=self._depth,
+                            conns=len(self._conns),
+                            queues=len(self._queues))
+        self._last_tick = now
+        self._tracer.flush()
 
     def _accept(self) -> None:
         while True:
@@ -495,6 +546,19 @@ class Broker:
     def _get(self, conn: _BrokerConn, queue: str, ms: int) -> None:
         if queue == BROKER_STATS_QUEUE:
             self._reply(conn, json.dumps(self.stats()).encode())
+            return
+        if queue == BROKER_BLACKBOX_QUEUE:
+            # on-demand flight-recorder dump: serialized in-memory and
+            # sent to the requester (who owns the dump directory);
+            # the shard's stats ride along as a ring-independent floor
+            # so even a blackbox-disabled shard answers usefully
+            if self._tracer is not None:
+                self._tracer.record("broker.blackbox", time.time(),
+                                    time.time(), always=True)
+            self._reply(conn, blackbox.dump_bytes(
+                "request", extra={"stats": self.stats()},
+                participant=blackbox.ring().participant
+                or self.shard_id))
             return
         q = self._queues.get(queue)
         if q:
@@ -840,6 +904,21 @@ def broker_stats(host: str, port: int, timeout: float = 2.0) -> dict:
         t.close()
 
 
+def broker_blackbox(host: str, port: int, timeout: float = 2.0) -> dict:
+    """One shard's flight-recorder dump (see
+    :data:`BROKER_BLACKBOX_QUEUE`); the caller writes it to its own
+    dump directory."""
+    t = TcpTransport(host, port, connect_timeout=timeout,
+                     reconnect_timeout=timeout)
+    try:
+        raw = t.get(BROKER_BLACKBOX_QUEUE, timeout=timeout)
+        if raw is None:
+            raise ConnectionError("blackbox request timed out")
+        return json.loads(raw.decode())
+    finally:
+        t.close()
+
+
 def collect_broker_stats(host: str, port: int, shards: int,
                          timeout: float = 1.5) -> list[dict]:
     """Stats from every shard of a broker plane; unreachable shards
@@ -853,6 +932,11 @@ def collect_broker_stats(host: str, port: int, shards: int,
         except Exception as e:  # noqa: BLE001 — down/refused/timeout
             s = {"shard_index": i, "port": port + i,
                  "error": f"{type(e).__name__}: {e}"}
+            # the REQUESTER's ring is where a dead shard leaves its
+            # trace (the shard itself can't): the postmortem reads
+            # shard_dead events from the surviving server's dump
+            blackbox.record("shard_dead", shard=i, port=port + i,
+                            err=type(e).__name__)
         out.append(s)
     return out
 
@@ -951,6 +1035,7 @@ class TcpTransport(Transport):
                 f"payload of {len(payload)} bytes exceeds the "
                 f"{MAX_FRAME_BYTES}-byte frame cap")
         self._count(queue, payload)
+        _bb_frame("publish", queue, len(payload))
         with self._lock:
             self._retry(lambda: _send_frame(self._sock, _OP_PUB,
                                             queue.encode(), payload))
@@ -969,7 +1054,10 @@ class TcpTransport(Transport):
         with self._lock:
             # a reconnect mid-get re-issues the request: the original
             # GET (and any reply in flight) died with the old socket
-            return self._retry(once)
+            payload = self._retry(once)
+        if payload is not None:
+            _bb_frame("consume", queue, len(payload))
+        return payload
 
     def purge(self, queues: Iterable[str] | None = None) -> None:
         payload = b"" if queues is None else ",".join(queues).encode()

@@ -55,6 +55,7 @@ from typing import Iterable
 
 from split_learning_tpu.analysis.locks import make_lock
 from split_learning_tpu.config import ChaosConfig, Config
+from split_learning_tpu.runtime import blackbox
 from split_learning_tpu.runtime.bus import (
     AsyncTransport, QueueClosed, ReliableTransport, Transport,
     make_transport,
@@ -111,6 +112,14 @@ class ChaosTransport(Transport):
             raise ChaosCrash(
                 f"scripted crash: {self.name or '?'} is dead")
 
+    def _record_crash(self, queue: str) -> None:
+        """Sticky ChaosCrash = this participant's process death: the
+        flight recorder dumps NOW, exactly like a signal handler would
+        — the unwinding 'process' gets no later chance."""
+        blackbox.record("chaos_crash", queue=queue,
+                        name=self.name or None)
+        blackbox.dump(f"chaos_crash:{self.name or '?'}")
+
     def _rng(self, queue: str) -> random.Random:
         r = self._rngs.get(queue)
         if r is None:
@@ -151,6 +160,7 @@ class ChaosTransport(Transport):
             if crash:
                 self.faults.inc("crashes")
                 self._crashed = True
+                self._record_crash(queue)
                 raise ChaosCrash(
                     f"scripted crash: {self.name or '?'} dies at "
                     f"publish to {queue}")
@@ -203,11 +213,23 @@ class ChaosTransport(Transport):
                     self._timers.append(t)
                     t.start()
                 emit = []
+        # flight-recorder feed: the fired faults with their queue —
+        # the per-name counter feed (FaultCounters.inc) has no queue
+        # context, and the postmortem wants "what was injected WHERE"
+        if blackbox.enabled():
+            fired = [n for n, f in (("drop", drop), ("dup", dup),
+                                    ("reorder", reorder),
+                                    ("corrupt", corrupt),
+                                    ("delay", delay)) if f]
+            if fired:
+                blackbox.record("chaos", queue=queue, faults=fired,
+                                name=self.name or None)
         for s in emit:
             self.inner.publish(queue, s)
         if crash:
             self.faults.inc("crashes")
             self._crashed = True
+            self._record_crash(queue)
             raise ChaosCrash(
                 f"scripted crash: {self.name or '?'} dies at publish "
                 f"to {queue}")

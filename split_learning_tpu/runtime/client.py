@@ -52,11 +52,13 @@ from split_learning_tpu.runtime.bus import Transport
 from split_learning_tpu.runtime.log import Logger
 from split_learning_tpu.runtime.memo import bounded_setdefault
 from split_learning_tpu.runtime.codec import make_codecs, wire_raw_nbytes
+from split_learning_tpu.runtime import blackbox
 from split_learning_tpu.runtime.protocol import (
-    Activation, DigestRoute, EpochEnd, FrameAssembler, Gradient,
-    Heartbeat, Notify, Pause, Ready, Register, SparseLeaf, Start, Stop,
-    Syn, QuantLeaf, Update, aggregate_queue, encode, encode_parts,
-    gradient_queue, intermediate_queue, reply_queue, RPC_QUEUE,
+    Activation, BlackboxDump, DigestRoute, EpochEnd, FrameAssembler,
+    Gradient, Heartbeat, Notify, Pause, Ready, Register, SparseLeaf,
+    Start, Stop, Syn, QuantLeaf, Update, aggregate_queue, encode,
+    encode_parts, gradient_queue, intermediate_queue, reply_queue,
+    RPC_QUEUE,
 )
 from split_learning_tpu.runtime.spans import make_tracer, unpack_ctx
 from split_learning_tpu.runtime.validation import dataset_for_model
@@ -621,6 +623,14 @@ class ProtocolClient:
                     queue=queue, kind=type(msg).__name__,
                     nbytes=len(raw), rtt_ms=round(rtt * 1e3, 3),
                     round=getattr(msg, "round_idx", None))
+        if isinstance(msg, BlackboxDump):
+            # fleet-snapshot request: absorbed HERE, in the one decode
+            # path every reply-queue consumer shares, so the dump fires
+            # whatever phase the client is in (idle pump, PAUSE wait,
+            # barrier) and no state machine sees an unexpected frame
+            blackbox.record("dump_request", reason=msg.reason)
+            blackbox.dump(msg.reason or "fleet_snapshot")
+            return None
         return msg
 
     def _publish_parts(self, queue: str, build, kind: str | None = None
@@ -2332,6 +2342,7 @@ def main(argv=None):
         with open(args.profile) as f:
             profile = json.load(f)
     client_id = args.client_id or f"client_{args.layer_id}_{uuid.uuid4().hex[:6]}"
+    blackbox.install(cfg, client_id, role="client")
     client = ProtocolClient(cfg, client_id, args.layer_id,
                             cluster=args.cluster, profile=profile)
     client.run()

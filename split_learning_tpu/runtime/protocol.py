@@ -379,6 +379,25 @@ class DigestRoute:
 
 
 @dataclasses.dataclass
+class BlackboxDump:
+    """server → one participant (its reply queue): flush your flight
+    recorder NOW (``runtime/blackbox.py``).  Fanned out to every live
+    client / aggregator node / stage host when the FleetMonitor marks
+    any participant ``lost`` or a child process exits, so one death
+    snapshots the whole fleet's last N seconds of ring events — the
+    inputs ``tools/sl_postmortem.py`` assembles into a causal
+    root-cause report.  Lifecycle-orthogonal (like Heartbeat): legal
+    in every protocol state, consumed by the participants' control
+    pumps without touching the round FSM.  ``reason`` names the
+    trigger (e.g. ``lost:client_2_1``); ``t_req`` is the server's send
+    clock, recorded into each dump so the assembler can align the
+    snapshot edge across processes."""
+    participant: str
+    reason: str = ""
+    t_req: float = 0.0
+
+
+@dataclasses.dataclass
 class Heartbeat:
     """client → server, on the rpc queue, from a background thread at
     ``observability.heartbeat-interval``: liveness + a full
@@ -498,7 +517,7 @@ class _TensorRef:
 CONTROL_TYPES = (Register, Ready, Notify, Update, Start, Syn, Pause,
                  Stop, Heartbeat, PartialAggregate, AggHello, AggAssign,
                  AggFlush, FleetDigest, DigestRoute, StageHello,
-                 StageAssign)
+                 StageAssign, BlackboxDump)
 DATA_TYPES = (Activation, Gradient, EpochEnd)
 #: messages whose ndarray payloads ride the zero-copy TENSOR framing
 #: (the high-volume data plane + the round's weight uploads — Update

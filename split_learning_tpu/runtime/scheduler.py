@@ -57,6 +57,7 @@ from typing import Any, Sequence
 
 import numpy as np
 
+from split_learning_tpu.runtime import blackbox
 from split_learning_tpu.runtime.plan import (
     ClusterPlan, prune_plan_members,
 )
@@ -273,6 +274,12 @@ class Scheduler:
             self.decisions.append(rec)
             if client is not None:
                 self.last_action[client] = f"{action}@r{round_idx}"
+        # flight-recorder feed: control-plane actions belong on the
+        # postmortem timeline next to the frames they caused
+        if blackbox.enabled():
+            blackbox.record("sched", action=action,
+                            round=int(round_idx), client=client,
+                            cluster=cluster, why=why or None)
         if self.log is not None:
             self.log.metric(kind="sched", **rec)
             if action not in ("decide",):

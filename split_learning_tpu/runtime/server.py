@@ -48,11 +48,12 @@ from split_learning_tpu.runtime.plan import (
     ClusterPlan, Registration, plan_clusters,
 )
 from split_learning_tpu.runtime import aggregate as agg_plane
+from split_learning_tpu.runtime import blackbox
 from split_learning_tpu.runtime.protocol import (
-    AggAssign, AggFlush, AggHello, DigestRoute, FleetDigest,
-    FrameAssembler, Heartbeat, Notify, PartialAggregate, Pause, Ready,
-    Register, StageAssign, StageHello, Start, Stop, Syn, Update,
-    digest_queue, encode, encode_parts, reply_queue, RPC_QUEUE,
+    AggAssign, AggFlush, AggHello, BlackboxDump, DigestRoute,
+    FleetDigest, FrameAssembler, Heartbeat, Notify, PartialAggregate,
+    Pause, Ready, Register, StageAssign, StageHello, Start, Stop, Syn,
+    Update, digest_queue, encode, encode_parts, reply_queue, RPC_QUEUE,
 )
 from split_learning_tpu.runtime.spans import unpack_ctx
 from split_learning_tpu.runtime.telemetry import FleetMonitor, GaugeSet
@@ -293,6 +294,12 @@ class ProtocolContext(MeshContext):
             self.scheduler = Scheduler(cfg, log=self.log,
                                        faults=self.faults,
                                        gauges=self.gauges)
+        # flight-recorder fleet snapshots (runtime/blackbox.py): one
+        # BlackboxDump fan-out per distinct dead participant, globally
+        # rate-limited so a death CASCADE yields one snapshot naming
+        # the first victim instead of a dump storm
+        self._bb_snapped: set = set()
+        self._bb_last_snap = 0.0
 
     # -- rpc pump ------------------------------------------------------------
 
@@ -602,6 +609,94 @@ class ProtocolContext(MeshContext):
             self._delta_shadow.clear(cid)
             self.gauges.set("agg_shadow_bytes",
                             self._delta_shadow.nbytes())
+        # the FleetMonitor tracks every heartbeating participant, not
+        # just clients — name the role the postmortem should report
+        role = ("agg_node" if cid in self._agg_nodes
+                else "stage_host" if cid in self._stage_hosts
+                else "client")
+        self._fleet_snapshot(cid, role, "participant_lost")
+
+    # -- flight-recorder fleet snapshot (runtime/blackbox.py) ----------------
+
+    #: minimum wall-clock gap between fleet snapshots: a cascade of
+    #: deaths (one kill tipping over its dependents) produces ONE
+    #: snapshot naming the first victim — the proximate cause the
+    #: postmortem wants — instead of a dump storm
+    BB_SNAPSHOT_MIN_S = 5.0
+
+    def _death_kind(self, victim: str, registry: dict) -> str:
+        """``child_exit`` when the victim is a subprocess this server
+        spawned and its Popen handle reports an exit code, else
+        ``participant_lost`` (heartbeats aged out — externally-started
+        process, or a SIGKILL that left no exit notification)."""
+        proc = (registry.get(victim) or {}).get("proc")
+        if proc is not None and proc.poll() is not None:
+            return "child_exit"
+        return "participant_lost"
+
+    def _fleet_snapshot(self, victim: str, role: str,
+                        kind: str) -> None:
+        """Record a participant death in the server's ring and trigger
+        the fleet-wide flight-recorder snapshot: dump the server's own
+        ring, fan a :class:`BlackboxDump` out to every surviving
+        participant's reply queue, and sweep the broker shards' rings
+        over their control queues — so the postmortem assembler finds
+        every process's last seconds in one artifacts directory even
+        though the victim itself (SIGKILL) wrote nothing."""
+        if not blackbox.enabled():
+            return
+        blackbox.record(kind, participant=victim, role=role,
+                        round=int(getattr(self, "_cur_round", 0)),
+                        gen=self._cur_gen)
+        now = time.monotonic()
+        if victim in self._bb_snapped \
+                or now - self._bb_last_snap < self.BB_SNAPSHOT_MIN_S:
+            return
+        self._bb_snapped.add(victim)
+        self._bb_last_snap = now
+        reason = f"{kind}:{victim}"
+        # own ring FIRST — it holds the death event this snapshot is
+        # named after, and a fan-out failure must not lose it
+        blackbox.dump(reason)
+        targets = (set(self._registrations) | set(self._agg_nodes)
+                   | set(self._stage_hosts))
+        targets.discard(victim)
+        for pid in sorted(targets):
+            if self.fleet is not None \
+                    and self.fleet.state(pid) == "lost":
+                continue   # its queue has no consumer; skip, don't park
+            try:
+                self.bus.publish(reply_queue(pid), encode(BlackboxDump(
+                    participant=pid, reason=reason,
+                    t_req=time.time())))  # slcheck: wire=BlackboxDump
+            except Exception:  # noqa: BLE001 — snapshot is best-effort
+                blackbox.record("error", where="bb_fanout",
+                                participant=pid)
+        self.log.warning(f"flight-recorder fleet snapshot: {reason} "
+                         f"({len(targets)} participants asked to dump)")
+        if self.cfg.transport.kind == "tcp":
+            # shard sweep dials TCP: off-pump so barrier latency stays
+            # flat while the shards answer
+            threading.Thread(target=self._sweep_broker_blackbox,
+                             args=(reason,), daemon=True,
+                             name="bb-sweep").start()
+
+    def _sweep_broker_blackbox(self, reason: str) -> None:
+        """Pull each broker shard's ring over ``__broker__.blackbox``
+        and persist it next to this server's own dumps (the shard
+        replies with bytes; the REQUESTER owns the dump directory)."""
+        from split_learning_tpu.runtime.bus import broker_blackbox
+        host, port = self.cfg.transport.host, self.cfg.transport.port
+        for i in range(self.cfg.broker.shards):
+            try:
+                d = broker_blackbox(host, port + i, timeout=2.0)
+            except Exception:  # noqa: BLE001 — dead/foreign shard
+                blackbox.record("error", where="bb_broker_sweep",
+                                shard=i)
+                continue
+            d.setdefault("snap_reason", reason)
+            d.setdefault("participant", f"broker-shard_{i}")
+            blackbox.write_dump_dict(d)
 
     def _fold_partial(self, msg: PartialAggregate,
                       nbytes: int = 0) -> None:
@@ -743,6 +838,9 @@ class ProtocolContext(MeshContext):
         ``stage_reassigns`` per moved slot — the chaos cell's exact
         expected counts."""
         self.faults.inc("stage_host_deaths")
+        self._fleet_snapshot(host_id, "stage_host",
+                             self._death_kind(host_id,
+                                              self._stage_hosts))
         ent = self._stage_hosts.setdefault(host_id, {})
         ent["dead"] = True
         dead_slots = self._stage_assignments.pop(host_id, [])
@@ -986,6 +1084,8 @@ class ProtocolContext(MeshContext):
                 continue
             self._dead_nodes.add(nid)
             self.faults.inc("agg_node_deaths")
+            self._fleet_snapshot(nid, "agg_node",
+                                 self._death_kind(nid, self._agg_nodes))
             self.log.warning(
                 f"aggregator node {nid} is dead (process exit or "
                 f"fleet-lost); draining its {len(glist)} group(s) "
@@ -2698,6 +2798,7 @@ def main(argv=None):
     cfg = from_yaml(args.config)
     from split_learning_tpu.platform import apply_compile_cache
     apply_compile_cache(cfg.compile_cache_dir)
+    blackbox.install(cfg, "server", role="server")
     brokers = []
     if args.broker and cfg.transport.kind == "tcp":
         # each shard is its own O(1)-thread event loop; hosting N of

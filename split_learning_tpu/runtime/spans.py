@@ -42,6 +42,8 @@ import time
 import uuid
 from typing import Any
 
+from split_learning_tpu.runtime import blackbox
+
 #: spans.jsonl record schema version (bump on breaking change)
 SCHEMA_VERSION = 1
 
@@ -289,6 +291,14 @@ class Tracer:
             if v is not None:
                 rec[k] = v
         self._journal.append(rec)
+        # flight-recorder feed: span close = "this phase just ran
+        # here" — the blackbox ring's primary what-was-it-doing signal
+        if blackbox.enabled():
+            blackbox.record("span", name=span.name,
+                            dur=rec["dur"],
+                            queue=span.attrs.get("queue"),
+                            nbytes=span.attrs.get("nbytes"),
+                            round=span.attrs.get("round"))
 
     def flush(self) -> None:
         if self._journal is not None:

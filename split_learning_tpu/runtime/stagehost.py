@@ -50,10 +50,11 @@ import threading
 import time
 
 from split_learning_tpu.config import Config, from_yaml
+from split_learning_tpu.runtime import blackbox
 from split_learning_tpu.runtime.log import Logger
 from split_learning_tpu.runtime.protocol import (
-    FrameAssembler, Heartbeat, StageAssign, StageHello, Stop, encode,
-    reply_queue, RPC_QUEUE,
+    BlackboxDump, FrameAssembler, Heartbeat, StageAssign, StageHello,
+    Stop, encode, reply_queue, RPC_QUEUE,
 )
 
 #: seconds between StageHello re-sends while not yet adopted (the
@@ -97,14 +98,21 @@ class SlotWorker(threading.Thread):
         self.client.hists = _TeeHists(self.client.hists, host.hists)
 
     def run(self) -> None:
+        t0 = time.time()
+        ok = True
         try:
             self.client.run()
         except Exception as e:  # noqa: BLE001 — a dead transport or a
             # fault unwinding the slot's hot loop means this slot is
             # done; the server's liveness plane (the inner client's
             # heartbeats died with it) and re-run machinery recover
+            ok = False
             self.host.log.warning(
                 f"slot {self.client_id} died: {e}")
+        self.host.tracer.record(
+            "stage.slot", t0, time.time(), always=True,
+            client=self.client_id, stage=int(self.slot.get("stage", 0)),
+            ok=ok)
 
 
 class StageHost:
@@ -133,6 +141,14 @@ class StageHost:
         self.bus = transport
         self._make_client = make_client or self._default_client
         self.log = logger or Logger.for_run(cfg, host_id, console=False)
+        # span-plane membership: adoption, each StageAssign apply and
+        # each slot's whole lifetime journal into
+        # spans-{host_id}.jsonl, so sl_trace's merged fleet timeline
+        # covers the stage tier (the inner clients keep their own
+        # journals — this is the HOST's view)
+        from split_learning_tpu.runtime.spans import make_tracer
+        self.tracer = make_tracer(cfg, host_id)
+        self._t_hello: float | None = None
         self._asm = FrameAssembler(faults=self.faults)
         # NOT named _stop: see aggnode.DigestWorker — threading
         # internals shadow that name on some interpreter versions
@@ -183,8 +199,13 @@ class StageHost:
 
     def _apply_assign(self, msg: StageAssign) -> None:
         slots = msg.slots or []
+        t0 = time.time()
         self.log.received(
             f"STAGEASSIGN gen={msg.gen} slots={len(slots)}")
+        if not self.adopted.is_set() and self._t_hello is not None:
+            # hello -> first assignment: the adoption handshake
+            self.tracer.record("stage.adopt", self._t_hello, t0,
+                               always=True, gen=msg.gen)
         self.adopted.set()
         for slot in slots:
             cid = slot["client_id"]
@@ -204,6 +225,10 @@ class StageHost:
             self.workers[cid] = worker
             worker.start()
         self._refresh_gauges()
+        self.tracer.record("stage.assign", t0, time.time(),
+                           always=True, gen=msg.gen, round=msg.round_idx,
+                           slots=len(slots))
+        self.tracer.flush()
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -236,6 +261,12 @@ class StageHost:
                 if isinstance(msg, Stop):
                     self.log.received(f"STOP ({msg.reason})")
                     break
+                if isinstance(msg, BlackboxDump):
+                    # server-initiated fleet snapshot: flush this
+                    # host's flight recorder alongside everyone else's
+                    blackbox.record("dump_request", reason=msg.reason)
+                    blackbox.dump(msg.reason or "fleet_snapshot")
+                    continue
                 if isinstance(msg, StageAssign):
                     self._apply_assign(msg)
         finally:
@@ -245,6 +276,7 @@ class StageHost:
             for w in self.workers.values():
                 w.join(timeout=10.0)
             self.emitter.stop()
+            self.tracer.close()
             if self._owns_bus:
                 try:
                     self.bus.close()
@@ -253,6 +285,8 @@ class StageHost:
             self.log.close()
 
     def _hello(self) -> None:
+        if self._t_hello is None:
+            self._t_hello = time.time()
         self.bus.publish(RPC_QUEUE, encode(StageHello(
             host_id=self.host_id, capacity=len(self.workers))))
         self.log.sent("STAGEHELLO")
@@ -304,6 +338,7 @@ def main(argv=None):
     cfg = from_yaml(args.config)
     from split_learning_tpu.platform import apply_compile_cache
     apply_compile_cache(cfg.compile_cache_dir)
+    blackbox.install(cfg, args.host_id, role="stage_host")
     host = StageHost(cfg, args.host_id)
     host.run()
 

@@ -230,6 +230,15 @@ class TelemetryEmitter:
         telemetry on Update frames, so sync rounds report for free."""
         now = time.time() if now is None else now
         rate = self._tick_rate(now)
+        # flight-recorder health rides every heartbeat: ring depth and
+        # dump recency reach /fleet (sl_top's BLACKBOX column) without
+        # a new frame kind.  -1 age = recorder on, never dumped.
+        from split_learning_tpu.runtime import blackbox
+        if blackbox.enabled():
+            self.gauges.set("blackbox_ring_depth", blackbox.depth())
+            age = blackbox.last_dump_age()
+            self.gauges.set("blackbox_last_dump_age_s",
+                            -1.0 if age is None else round(age, 1))
         with self._lock:
             self._seq += 1
             seq = self._seq
@@ -1041,6 +1050,13 @@ class FleetMonitor:
             # absent for pre-plane participants — consumers render "-"
             "queue_depth": h.gauges.get("queue_depth"),
             "stage_slots": h.gauges.get("stage_slots"),
+            # flight-recorder health (runtime/blackbox.py), ridden in
+            # on heartbeats: ring depth and seconds since the last
+            # dump (-1 = never dumped); absent when the recorder is
+            # off — consumers render "-"
+            "blackbox_ring_depth": h.gauges.get("blackbox_ring_depth"),
+            "blackbox_last_dump_age_s":
+                h.gauges.get("blackbox_last_dump_age_s"),
             "counters": dict(h.counters),
         }
         if series:

@@ -41,6 +41,8 @@ import time
 
 import jax
 
+from split_learning_tpu.runtime import blackbox
+
 #: Declared registry of every FaultCounters name the runtime may
 #: increment.  ``FaultCounters.inc`` with a string literal outside this
 #: set is a typo that would silently mint a new key (and a dashboard
@@ -193,6 +195,11 @@ GAUGE_NAMES = frozenset({
     # count a stage host is currently running — both ride heartbeats
     # so sl_top can name a backed-up hop
     "queue_depth", "stage_slots",
+    # flight recorder (runtime/blackbox.py): ring depth and seconds
+    # since the participant's last dump, ridden on heartbeats so
+    # /fleet and sl_top's BLACKBOX column can show per-participant
+    # capture state (-1 age = never dumped)
+    "blackbox_ring_depth", "blackbox_last_dump_age_s",
 })
 
 
@@ -208,6 +215,12 @@ class FaultCounters:
     def inc(self, name: str, n: int = 1) -> None:
         with self._lock:
             self._counts[name] += n
+        # flight-recorder feed (runtime/blackbox.py): every counter
+        # increment is a "something abnormal was absorbed" event — the
+        # per-process ring keeps the last N with timestamps, which is
+        # the ordering the monotonic totals erase
+        if blackbox.enabled():
+            blackbox.record("fault", name=name, n=n)
 
     def snapshot(self) -> dict:
         with self._lock:

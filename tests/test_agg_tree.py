@@ -573,7 +573,7 @@ def _fallback_stub(bus, groups, narrowed, cluster=0, gen=2):
                  "_step_fallback", "_children_draining",
                  "_member_clients", "_drain_fallback",
                  "_drain_fallback_update", "_drain_fallback_partial",
-                 "_flush_fallback"):
+                 "_flush_fallback", "_fleet_snapshot", "_death_kind"):
         setattr(s, name, getattr(ProtocolContext, name).__get__(s))
     return s
 

@@ -878,3 +878,112 @@ def test_pallas_analyzer_skipped_without_trace():
     from split_learning_tpu.analysis import pallas_check
     from split_learning_tpu.analysis.__main__ import repo_root
     assert pallas_check.run(repo_root(), trace=False) == []
+
+
+# --------------------------------------------------------------------------
+# blackbox analyzer (BB001-BB002) + BlackboxDump in the protocol model
+# --------------------------------------------------------------------------
+
+def test_bb001_uncovered_entry_point_flagged():
+    from split_learning_tpu.analysis import blackbox_check
+    src = ("import argparse\n"
+           "def main(argv=None):\n"
+           "    args = argparse.ArgumentParser().parse_args(argv)\n"
+           "    return 0\n")
+    fs = blackbox_check.check_entry(src, "runtime/fake.py")
+    assert codes(fs) == {"BB001"}
+    assert fs[0].line == 2  # anchored at def main
+    assert "flight" in fs[0].message
+
+
+def test_bb001_install_or_opt_out_passes():
+    from split_learning_tpu.analysis import blackbox_check
+    armed = ("from split_learning_tpu.runtime import blackbox\n"
+             "def main():\n"
+             "    blackbox.install_basic('p')\n")
+    assert blackbox_check.check_entry(armed, "x.py") == []
+    # an unrelated receiver's .install() must NOT satisfy the rule
+    imposter = "def main():\n    handlers.install('p')\n"
+    assert codes(blackbox_check.check_entry(imposter, "x.py")) == {"BB001"}
+    opted = "# slcheck: no-blackbox\ndef main():\n    pass\n"
+    assert blackbox_check.check_entry(opted, "x.py") == []
+
+
+def test_bb002_silent_swallow_flagged():
+    from split_learning_tpu.analysis import blackbox_check
+    src = ("def pump(self):\n"
+           "    try:\n"
+           "        self.sock.recv(4)\n"
+           "    except Exception:\n"
+           "        pass\n")
+    fs = blackbox_check.check_hot(src, "runtime/bus.py")
+    assert codes(fs) == {"BB002"}
+
+
+def test_bb002_evidence_or_opt_out_passes():
+    from split_learning_tpu.analysis import blackbox_check
+    evidenced = ("def pump(self):\n"
+                 "    try:\n"
+                 "        self.sock.recv(4)\n"
+                 "    except Exception:\n"
+                 "        self.faults.inc('recv_errors')\n")
+    assert blackbox_check.check_hot(evidenced, "x.py") == []
+    reraises = ("def pump(self):\n"
+                "    try:\n"
+                "        self.sock.recv(4)\n"
+                "    except Exception:\n"
+                "        raise\n")
+    assert blackbox_check.check_hot(reraises, "x.py") == []
+    opted = ("def close(self):\n"
+             "    try:\n"
+             "        self.sock.close()\n"
+             "    except Exception:  # slcheck: no-blackbox\n"
+             "        pass\n")
+    assert blackbox_check.check_hot(opted, "x.py") == []
+    narrow = ("def pump(self):\n"
+              "    try:\n"
+              "        self.sock.recv(4)\n"
+              "    except OSError:\n"
+              "        pass\n")
+    assert blackbox_check.check_hot(narrow, "x.py") == []
+
+
+def test_bb_registered_and_repo_clean():
+    from split_learning_tpu.analysis import blackbox_check
+    from split_learning_tpu.analysis.__main__ import ANALYZERS, repo_root
+    assert "blackbox" in ANALYZERS
+    assert blackbox_check.run(repo_root()) == []
+
+
+def test_blackbox_dump_legal_in_every_fsm_state():
+    # fleet snapshots fire the moment a death is noticed, whatever
+    # round phase any participant is in — lifecycle-orthogonal like
+    # Heartbeat, so every state needs the self-loop or chaos-run
+    # traces through the validator would flag the fan-out
+    from split_learning_tpu.analysis.model import (
+        AGGREGATOR_FSM, CLIENT_FSM, SERVER_FSM, STAGEHOST_FSM,
+        Event, validate_events,
+    )
+    for state, trans in SERVER_FSM.items():
+        assert trans[("send", "BlackboxDump")] == state
+    for fsm in (CLIENT_FSM, AGGREGATOR_FSM, STAGEHOST_FSM):
+        for state, trans in fsm.items():
+            assert trans[("recv", "BlackboxDump")] == state
+    events = [Event("client", "send", "Register", "c1"),
+              Event("client", "recv", "BlackboxDump", "c1"),
+              Event("client", "recv", "Start", "c1"),
+              Event("client", "recv", "BlackboxDump", "c1"),
+              Event("server", "send", "BlackboxDump", "server")]
+    assert validate_events(events) == []
+
+
+def test_blackbox_dump_in_send_rules_and_samples():
+    from split_learning_tpu.analysis import protocol_check as P
+    from split_learning_tpu.analysis.model import CONTROL_KINDS, SEND_RULES
+    assert "BlackboxDump" in CONTROL_KINDS
+    assert ("server", "reply", "BlackboxDump") in SEND_RULES
+    # the PC004 wire-conformance sample must round-trip
+    from split_learning_tpu.runtime.protocol import decode, encode
+    sample = P._sample_messages()["BlackboxDump"]
+    msg = decode(encode(sample))
+    assert msg == sample

@@ -1,0 +1,394 @@
+"""Flight-recorder plane: the blackbox ring, crash dumps, the
+``BlackboxDump`` control frame, and the ``sl_postmortem`` assembler.
+
+The chaos-oracle tests at the bottom are the acceptance proof: for
+each supported failure mode (stage-host kill, aggregator-node kill,
+broker-shard kill) a synthetic-but-real fleet of dumps — written by
+the actual ``runtime/blackbox.py`` machinery — must yield a verdict
+naming the correct dead participant, its role, and the first abnormal
+event in the correct round; the fault-free twin must come back clean.
+"""
+
+import importlib.util
+import json
+import pathlib
+import signal
+import subprocess
+import sys
+import textwrap
+import threading
+
+import pytest
+
+from split_learning_tpu.runtime import blackbox
+from split_learning_tpu.runtime.protocol import (
+    BlackboxDump, decode, encode,
+)
+
+ROOT = pathlib.Path(__file__).resolve().parents[1]
+
+_spec = importlib.util.spec_from_file_location(
+    "sl_postmortem", ROOT / "tools" / "sl_postmortem.py")
+sl_postmortem = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(sl_postmortem)
+
+
+@pytest.fixture(autouse=True)
+def _fresh_ring():
+    blackbox._reset_for_tests()
+    yield
+    blackbox._reset_for_tests()
+
+
+# --------------------------------------------------------------------------
+# the ring
+# --------------------------------------------------------------------------
+
+class TestRing:
+    def test_disabled_ring_records_nothing(self):
+        blackbox.record("span", name="x")
+        assert blackbox.depth() == 0
+        assert not blackbox.enabled()
+        assert blackbox.dump("why") is None
+
+    def test_bounded_and_seq_counts_evictions(self):
+        blackbox.configure_basic("p", ring_events=16)
+        for i in range(50):
+            blackbox.record("span", i=i)
+        events, seq = blackbox.ring().snapshot()
+        assert len(events) == 16
+        assert seq == 50
+        # oldest evicted: the survivors are the LAST 16
+        assert [e["i"] for e in events] == list(range(34, 50))
+
+    def test_concurrent_writers_never_lose_the_bound(self):
+        blackbox.configure_basic("p", ring_events=128)
+        n_threads, per = 8, 500
+
+        def work(k):
+            for i in range(per):
+                blackbox.record("span", thread=k, i=i)
+
+        ts = [threading.Thread(target=work, args=(k,))
+              for k in range(n_threads)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        events, seq = blackbox.ring().snapshot()
+        assert seq == n_threads * per
+        assert len(events) == 128
+        # seq stamps are unique and strictly increasing in ring order
+        seqs = [e["seq"] for e in events]
+        assert seqs == sorted(seqs) and len(set(seqs)) == len(seqs)
+
+    def test_none_attrs_dropped(self):
+        blackbox.configure_basic("p")
+        blackbox.record("publish", queue="q", nbytes=None)
+        (ev,), _ = blackbox.ring().snapshot()
+        assert "nbytes" not in ev and ev["queue"] == "q"
+
+
+# --------------------------------------------------------------------------
+# dumps: atomic write, scavenge loader, remote persist
+# --------------------------------------------------------------------------
+
+class TestDumps:
+    def test_dump_load_round_trip(self, tmp_path):
+        blackbox.configure_basic("srv", role="server",
+                                 dump_dir=tmp_path, ring_events=8)
+        for i in range(12):
+            blackbox.record("span", i=i)
+        path = blackbox.dump("unit-test")
+        assert path is not None and path.name == "blackbox-srv.json"
+        doc = blackbox.load_dump(path)
+        assert doc["participant"] == "srv"
+        assert doc["role"] == "server"
+        assert doc["reason"] == "unit-test"
+        assert doc["seq"] == 12 and doc["dropped"] == 4
+        assert len(doc["events"]) == 8
+        assert not doc.get("torn")
+        assert blackbox.last_dump_age() is not None
+
+    def test_torn_dump_scavenged(self, tmp_path):
+        blackbox.configure_basic("agg-1", role="agg_node",
+                                 dump_dir=tmp_path)
+        for i in range(6):
+            blackbox.record("span", i=i)
+        blackbox.record("exception", type="Boom")
+        path = blackbox.dump("crash")
+        text = path.read_text()
+        # tear the file mid-events: a process killed mid-write (the
+        # header rides FIRST by design so it survives any tear)
+        cut = text.index('"kind": "exception"')
+        path.write_text(text[:cut - 2])
+        doc = blackbox.load_dump(path)
+        assert doc is not None and doc["torn"]
+        assert doc["participant"] == "agg-1"
+        assert doc["reason"] == "crash"
+        # every event BEFORE the tear was salvaged
+        assert [e["i"] for e in doc["events"]] == list(range(6))
+
+    def test_garbage_file_yields_none(self, tmp_path):
+        p = tmp_path / "blackbox-x.json"
+        p.write_text("not json at all")
+        assert blackbox.load_dump(p) is None
+        assert blackbox.load_dump(tmp_path / "absent.json") is None
+
+    def test_write_dump_dict_sanitizes_participant(self, tmp_path):
+        path = blackbox.write_dump_dict(
+            {"participant": "shard@127.0.0.1:9100/x", "events": []},
+            dump_dir=tmp_path)
+        assert path.name == "blackbox-shard@127.0.0.1_9100_x.json"
+        assert json.loads(path.read_text())["events"] == []
+
+    def test_find_dumps_recurses(self, tmp_path):
+        (tmp_path / "a").mkdir()
+        (tmp_path / "a" / "blackbox-one.json").write_text("{}")
+        (tmp_path / "blackbox-two.json").write_text("{}")
+        (tmp_path / "metrics.jsonl").write_text("")
+        names = [p.name for p in blackbox.find_dumps(tmp_path)]
+        assert sorted(names) == ["blackbox-one.json", "blackbox-two.json"]
+
+
+# --------------------------------------------------------------------------
+# abnormal-exit capture in a REAL subprocess
+# --------------------------------------------------------------------------
+
+class TestAbnormalExit:
+    def test_sigterm_dumps_then_dies_with_the_signal(self, tmp_path):
+        # a real process: install_basic, then spin until SIGTERM'd.
+        # The handler must flush the dump AND re-deliver the default
+        # disposition so the exit status stays honest (-SIGTERM).
+        child = subprocess.Popen(
+            [sys.executable, "-c", textwrap.dedent(f"""
+                import sys, time
+                sys.path.insert(0, {str(ROOT)!r})
+                from split_learning_tpu.runtime import blackbox
+                blackbox.install_basic("victim", role="client",
+                                       dump_dir={str(tmp_path)!r})
+                blackbox.record("span", name="train", round=2)
+                print("armed", flush=True)
+                time.sleep(30)
+            """)],
+            stdout=subprocess.PIPE, cwd=str(tmp_path))
+        assert child.stdout.readline().strip() == b"armed"
+        child.send_signal(signal.SIGTERM)
+        rc = child.wait(timeout=10)
+        assert rc == -signal.SIGTERM
+        doc = blackbox.load_dump(tmp_path / "blackbox-victim.json")
+        assert doc["reason"] == "signal:SIGTERM"
+        kinds = [e["kind"] for e in doc["events"]]
+        assert kinds == ["span", "signal"]
+        assert doc["events"][1]["sig"] == "SIGTERM"
+
+    def test_unhandled_exception_dumps(self, tmp_path):
+        child = subprocess.run(
+            [sys.executable, "-c", textwrap.dedent(f"""
+                import sys
+                sys.path.insert(0, {str(ROOT)!r})
+                from split_learning_tpu.runtime import blackbox
+                blackbox.install_basic("crasher", dump_dir={str(tmp_path)!r})
+                raise RuntimeError("deliberate")
+            """)],
+            capture_output=True, cwd=str(tmp_path))
+        assert child.returncode == 1
+        assert b"deliberate" in child.stderr  # chained to the real hook
+        doc = blackbox.load_dump(tmp_path / "blackbox-crasher.json")
+        assert doc["reason"] == "excepthook:RuntimeError"
+        assert doc["events"][-1]["kind"] == "exception"
+        assert doc["events"][-1]["type"] == "RuntimeError"
+
+
+# --------------------------------------------------------------------------
+# the BlackboxDump control frame
+# --------------------------------------------------------------------------
+
+class TestFrame:
+    def test_round_trip(self):
+        msg = BlackboxDump(participant="client_0",
+                           reason="lost:host-1", t_req=123.5)
+        out = decode(encode(msg))
+        assert isinstance(out, BlackboxDump)
+        assert out.participant == "client_0"
+        assert out.reason == "lost:host-1"
+        assert out.t_req == 123.5
+
+    def test_dump_on_request_matches_client_absorb_path(self, tmp_path):
+        # what every participant's control pump does on receipt
+        blackbox.configure_basic("client_0", dump_dir=tmp_path)
+        msg = decode(encode(BlackboxDump(participant="client_0",
+                                         reason="lost:host-1")))
+        blackbox.record("dump_request", reason=msg.reason)
+        blackbox.dump(msg.reason or "fleet_snapshot")
+        doc = blackbox.load_dump(tmp_path / "blackbox-client_0.json")
+        assert doc["reason"] == "lost:host-1"
+        assert doc["events"][-1]["kind"] == "dump_request"
+
+
+# --------------------------------------------------------------------------
+# sl_postmortem: clock alignment + causal verdicts (chaos oracle)
+# --------------------------------------------------------------------------
+
+def _write_ring(tmp_path, participant, role, events, reason="snapshot"):
+    """Write one participant's dump through the REAL recorder: same
+    configure/record/dump machinery the fleet uses, with controlled
+    event timestamps patched in post-record."""
+    blackbox._reset_for_tests()
+    blackbox.configure_basic(participant, role=role, dump_dir=tmp_path)
+    for ev in events:
+        attrs = {k: v for k, v in ev.items() if k not in ("kind", "t")}
+        blackbox.record(ev["kind"], **attrs)
+    path = blackbox.dump(reason)
+    doc = json.loads(path.read_text())
+    for rec, ev in zip(doc["events"], events):
+        rec["t"] = ev["t"]
+    path.write_text(json.dumps(doc))
+    blackbox._reset_for_tests()
+    return path
+
+
+def _healthy(t0, rounds=3):
+    """A participant minding its own business: spans + consumed frames."""
+    out = []
+    for r in range(rounds):
+        out.append({"kind": "consume", "t": t0 + r, "queue": "q.start"})
+        out.append({"kind": "span", "t": t0 + r + 0.5, "name": "train",
+                    "round": r})
+    return out
+
+
+class TestPostmortem:
+    T0 = 1000.0
+
+    def test_clock_offsets_from_bidirectional_edges(self, tmp_path):
+        # client's clock runs 0.5s AHEAD of the server's; one edge per
+        # direction lets the latency cancel out exactly
+        spans = [
+            {"span": "s1", "part": "server", "name": "publish",
+             "ts": self.T0},
+            {"span": "r1", "part": "client_0", "name": "consume",
+             "parent": "s1", "ts": self.T0, "rtt_ms": 510.0},
+            {"span": "c1", "part": "client_0", "name": "publish",
+             "ts": self.T0},
+            {"span": "r2", "part": "server", "name": "consume",
+             "parent": "c1", "ts": self.T0, "rtt_ms": -490.0},
+        ]
+        off = sl_postmortem.estimate_offsets(spans)
+        assert off["server"] == 0.0
+        assert off["client_0"] == pytest.approx(-0.5)
+
+    def _server_events(self, abnormal, rnd=3):
+        t = self.T0
+        evs = [
+            {"kind": "span", "t": t + 0.2, "name": "ready_wait",
+             "round": rnd},
+            {"kind": "publish", "t": t + 0.3, "queue": "stage.host-0"},
+        ]
+        ab = dict(abnormal)
+        ab.setdefault("t", t + 1.0)
+        evs.append(ab)
+        return evs
+
+    def _fleet(self, tmp_path, abnormal, rnd=3):
+        _write_ring(tmp_path, "server", "server",
+                    self._server_events(abnormal, rnd),
+                    reason=f"{abnormal['kind']}:x")
+        _write_ring(tmp_path, "client_0", "client",
+                    _healthy(self.T0 - 3))
+        (tmp_path / "metrics.jsonl").write_text(json.dumps(
+            {"kind": "round", "round_idx": rnd - 1}) + "\n")
+        return sl_postmortem.assemble(tmp_path)
+
+    def test_verdict_stage_host_kill(self, tmp_path):
+        doc = self._fleet(tmp_path, {
+            "kind": "participant_lost", "participant": "host-0",
+            "role": "stage_host", "round": 3})
+        v = doc["verdict"]
+        assert v["abnormal"]
+        assert v["victim"] == "host-0"
+        assert v["role"] == "stage_host"
+        assert v["round"] == 3
+        assert v["cause"]["kind"] == "participant_lost"
+        assert v["reported_by"] == "server"
+        # ready_wait closed, then the death: the server is stalled in
+        # the NEXT barrier of the round
+        assert v["stalled_barrier"]["barrier"] == "notify_wait"
+        # the frame published to the dead host was never consumed
+        assert any(f["queue"] == "stage.host-0"
+                   for f in v["in_flight"])
+        assert doc["last_completed_round"] == 2
+        report = sl_postmortem.render(doc)
+        assert "host-0" in report and "stage_host" in report
+
+    def test_verdict_agg_node_kill(self, tmp_path):
+        doc = self._fleet(tmp_path, {
+            "kind": "child_exit", "participant": "node-1",
+            "role": "agg_node", "round": 5}, rnd=5)
+        v = doc["verdict"]
+        assert (v["victim"], v["role"]) == ("node-1", "agg_node")
+        assert v["round"] == 5
+        assert v["cause"]["kind"] == "child_exit"
+
+    def test_verdict_broker_shard_kill(self, tmp_path):
+        doc = self._fleet(tmp_path, {"kind": "shard_dead", "shard": 1,
+                                     "port": 9101})
+        v = doc["verdict"]
+        assert v["victim"] == "broker-shard_1"
+        assert v["role"] == "broker_shard"
+        assert v["cause"]["kind"] == "shard_dead"
+
+    def test_fault_free_twin_is_clean(self, tmp_path):
+        _write_ring(tmp_path, "server", "server",
+                    _healthy(self.T0))
+        _write_ring(tmp_path, "client_0", "client",
+                    _healthy(self.T0))
+        doc = sl_postmortem.assemble(tmp_path)
+        assert doc["verdict"] == {
+            "abnormal": False, "summary": "no abnormal termination"}
+        assert "CLEAN" in sl_postmortem.render(doc)
+
+    def test_first_abnormal_event_wins_across_processes(self, tmp_path):
+        # a crash on the stage host PRECEDES the server noticing the
+        # loss — the postmortem must name the crash, not the symptom
+        _write_ring(tmp_path, "server", "server", self._server_events(
+            {"kind": "participant_lost", "participant": "host-0",
+             "role": "stage_host", "round": 3, "t": self.T0 + 2.0}))
+        _write_ring(tmp_path, "host-0", "stage_host", [
+            {"kind": "span", "t": self.T0 + 0.1, "name": "stage.slot"},
+            {"kind": "chaos_crash", "t": self.T0 + 0.4,
+             "queue": "stage.host-0"},
+        ], reason="chaos")
+        doc = sl_postmortem.assemble(tmp_path)
+        v = doc["verdict"]
+        assert v["cause"]["kind"] == "chaos_crash"
+        assert v["victim"] == "host-0"
+        assert v["role"] == "stage_host"
+        # the later participant_lost shows up in the cascade
+        kinds = [e["kind"] for e in v["abnormal_events"]]
+        assert kinds == ["chaos_crash", "participant_lost"]
+
+    def test_torn_survivor_still_contributes(self, tmp_path):
+        doc_path = _write_ring(tmp_path, "server", "server",
+                               self._server_events(
+                                   {"kind": "participant_lost",
+                                    "participant": "host-0",
+                                    "role": "stage_host", "round": 1}))
+        # tear the OTHER dump; the verdict must survive the salvage
+        p2 = _write_ring(tmp_path, "client_0", "client",
+                         _healthy(self.T0 - 2))
+        text = p2.read_text()
+        p2.write_text(text[:len(text) // 2])
+        doc = sl_postmortem.assemble(tmp_path)
+        assert doc["verdict"]["victim"] == "host-0"
+        assert any(d["torn"] for d in doc["dumps"])
+        assert doc_path.exists()
+
+    def test_cli_writes_json_and_renders(self, tmp_path, capsys):
+        _write_ring(tmp_path, "server", "server",
+                    _healthy(self.T0))
+        out = tmp_path / "postmortem.json"
+        rc = sl_postmortem.main([str(tmp_path), "-o", str(out)])
+        assert rc == 0
+        assert "CLEAN" in capsys.readouterr().out
+        assert not json.loads(out.read_text())["verdict"]["abnormal"]

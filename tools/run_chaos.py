@@ -1351,6 +1351,185 @@ def mpmd_cell(tmp: str) -> tuple[bool, str]:
                   f"(1 death, {len(moved)} reassign) [{wall:.0f}s]")
 
 
+def postmortem_cell(tmp: str) -> tuple[bool, str]:
+    """Flight-recorder / postmortem chaos cell (runtime/blackbox.py +
+    tools/sl_postmortem.py): the mpmd choreography — a 3-stage round
+    with the later stages on 2 server-spawned StageHost subprocesses
+    over a real 2-shard TCP broker plane — with the blackbox recorder
+    armed in EVERY process, and the stage host owning a slot SIGKILLed
+    mid-round.  SIGKILL is the oracle: the victim writes nothing, so
+    the verdict can only come from the surviving fleet's dumps (the
+    server's ring records the death with role + round, the fan-out
+    snapshots the survivors, the broker sweep pulls the shard rings).
+    PASSes iff
+
+    * the round still completes via the counted slot re-assignment;
+    * ``sl_postmortem`` over the cell's dumps names the KILLED host as
+      the victim, role ``stage_host``, first abnormal event
+      ``child_exit``/``participant_lost`` in the round that was in
+      flight, reported by the server;
+    * a fault-free twin of the same round, same recorder armed, yields
+      the clean "no abnormal termination" verdict.
+
+    Writes ``postmortem.json`` + ``postmortem_twin.json`` and the raw
+    ``blackbox-*.json`` dumps into the cell dir for CI upload.
+    """
+    import threading as _threading
+
+    sys.path.insert(0, "tests")
+    sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent))
+    import sl_postmortem  # noqa: E402
+    from test_chaos import _round_cfg  # noqa: E402
+
+    from split_learning_tpu.broker import spawn_shard
+    from split_learning_tpu.runtime import blackbox
+    from split_learning_tpu.runtime.bus import (
+        broker_stats, find_port_block, ShardedTcpTransport,
+    )
+    from split_learning_tpu.runtime.client import ProtocolClient
+    from split_learning_tpu.runtime.server import ProtocolServer
+
+    cell_dir = pathlib.Path(tmp) / "postmortem"
+    cell_dir.mkdir(parents=True, exist_ok=True)
+    shards = 2
+
+    def spawn_plane():
+        base = find_port_block(shards)
+        procs = [spawn_shard("127.0.0.1", base + i, shard_index=i,
+                             python_only=True)
+                 for i in range(shards)]
+        deadline = time.monotonic() + 120
+        for i in range(shards):
+            while time.monotonic() < deadline:
+                try:
+                    broker_stats("127.0.0.1", base + i, timeout=1.0)
+                    break
+                except Exception:  # noqa: BLE001 — still booting
+                    time.sleep(0.25)
+        return base, procs
+
+    def run_round(tag, base, n_hosts):
+        dump_dir = cell_dir / tag
+        dump_dir.mkdir(parents=True, exist_ok=True)
+        over = dict(
+            clients=[2, 1, 1],
+            topology={"cut_layers": [2, 4]},
+            model_kwargs={"dropout_rate": 0.0},
+            transport={"kind": "tcp", "host": "127.0.0.1",
+                       "port": base, "async_send": False},
+            broker={"shards": shards},
+            observability={"blackbox": {
+                "enabled": True, "dump_dir": str(dump_dir)}})
+        if n_hosts:
+            over["pipeline"] = {"remote": True, "hosts": n_hosts,
+                                "retries": 2}
+        cfg = _round_cfg(pathlib.Path(tmp), dump_dir / "logs", **over)
+        # arm THIS process's recorder as the server role (spawned
+        # stage hosts arm themselves from the same config in main())
+        blackbox._reset_for_tests()
+        blackbox.configure(cfg, "server", role="server")
+        server = ProtocolServer(
+            cfg, transport=ShardedTcpTransport("127.0.0.1", base,
+                                               shards),
+            client_timeout=300.0)
+        ctx = server.ctx
+        threads = []
+        stages = range(1, 2) if n_hosts else range(1, 4)
+        for stage in stages:
+            for i in range(cfg.clients[stage - 1]):
+                cid = f"client_{stage}_{i}"
+                client = ProtocolClient(
+                    cfg, cid, stage,
+                    transport=ShardedTcpTransport("127.0.0.1", base,
+                                                  shards))
+                th = _threading.Thread(target=client.run, daemon=True)
+                th.start()
+                threads.append(th)
+        killed: list = []
+        if n_hosts:
+            def killer():
+                deadline = time.monotonic() + 200
+                while time.monotonic() < deadline:
+                    if ctx._stage_watch:
+                        hid = next(
+                            (h for h in sorted(ctx._stage_assignments)
+                             if ctx._stage_assignments[h]), None)
+                        if hid:
+                            proc = (ctx._stage_hosts.get(hid)
+                                    or {}).get("proc")
+                            if proc is not None:
+                                rnd = getattr(ctx, "_cur_round", 0)
+                                proc.kill()   # SIGKILL: writes NOTHING
+                                killed.append({"host": hid,
+                                               "round": rnd})
+                                return
+                    time.sleep(0.005)
+            kt = _threading.Thread(target=killer, daemon=True)
+            kt.start()
+        res = server.serve()
+        for th in threads:
+            th.join(timeout=30)
+        # give the fire-and-forget broker blackbox sweep a beat to
+        # land its shard dumps before the assembler scans the dir
+        time.sleep(1.5)
+        blackbox.dump("cell_end")
+        return res, (killed[0] if killed else None), dump_dir
+
+    # fault-free twin: same recorder armed, nothing dies -> the
+    # assembler must come back CLEAN (the no-false-positive half)
+    base_b, procs_b = spawn_plane()
+    try:
+        res_twin, _, twin_dir = run_round("twin", base_b, 0)
+    finally:
+        for p in procs_b:
+            p.kill()
+    if not res_twin.history or not res_twin.history[0].ok:
+        return False, "fault-free twin round not ok"
+    doc_twin = sl_postmortem.assemble(twin_dir)
+    (cell_dir / "postmortem_twin.json").write_text(
+        json.dumps(doc_twin, indent=2, default=str))
+    if doc_twin["verdict"]["abnormal"]:
+        return False, (f"twin verdict not clean: "
+                       f"{doc_twin['verdict']}")
+
+    # chaos run: 2 stage hosts, one SIGKILLed mid-round
+    base, procs = spawn_plane()
+    try:
+        res, killed, chaos_dir = run_round("chaos", base, 2)
+    finally:
+        for p in procs:
+            p.kill()
+    if killed is None:
+        return False, "no stage host qualified for the kill"
+    if not res.history or not res.history[0].ok:
+        return False, "round not ok after stage-host kill"
+    doc = sl_postmortem.assemble(chaos_dir)
+    (cell_dir / "postmortem.json").write_text(
+        json.dumps(doc, indent=2, default=str))
+    v = doc["verdict"]
+    if not v["abnormal"]:
+        return False, "kill not detected: verdict came back clean"
+    if v["victim"] != killed["host"]:
+        return False, (f"victim {v['victim']} != killed "
+                       f"{killed['host']}")
+    if v["role"] != "stage_host":
+        return False, f"role {v['role']} != stage_host"
+    if v["cause"]["kind"] not in ("child_exit", "participant_lost"):
+        return False, f"cause {v['cause']['kind']} unexpected"
+    if v["round"] != killed["round"]:
+        return False, (f"round {v['round']} != in-flight "
+                       f"{killed['round']}")
+    if v["reported_by"] != "server":
+        return False, f"reported by {v['reported_by']}, not server"
+    if len(doc["dumps"]) < 2:
+        return False, f"only {len(doc['dumps'])} dump(s) collected"
+    print(sl_postmortem.render(doc))
+    return True, (f"{killed['host']} SIGKILLed mid-round; verdict "
+                  f"names it ({v['role']}, {v['cause']['kind']}, "
+                  f"round {v['round']}) from {len(doc['dumps'])} "
+                  f"survivor dumps; twin clean")
+
+
 def kernels_cell(tmp: str, seed: int = 19) -> tuple[bool, str]:
     """Pallas kernel-plane chaos cell (kernels.*): a 3-client round
     with the FULL wire compression stack AND every fused kernel
@@ -1499,6 +1678,16 @@ def main(argv=None):
                          "complete via the counted slot re-assignment, "
                          "bit-identical to a fault-free single-process "
                          "twin (writes mpmd.json)")
+    ap.add_argument("--postmortem", dest="postmortem_mode",
+                    action="store_true",
+                    help="run ONLY the flight-recorder cell: the mpmd "
+                         "choreography with the blackbox recorder "
+                         "armed fleet-wide; a stage host is SIGKILLed "
+                         "mid-round and sl_postmortem over the "
+                         "surviving dumps must name the killed host, "
+                         "its role and the in-flight round, while a "
+                         "fault-free twin's report comes back clean "
+                         "(writes postmortem.json + blackbox-*.json)")
     ap.add_argument("--kernels", dest="kernels_mode",
                     action="store_true",
                     help="run ONLY the Pallas kernel-plane cell: a "
@@ -1542,6 +1731,20 @@ def main(argv=None):
         ok, note = mpmd_cell(tmp)
         dt = time.monotonic() - t0
         print(f"mpmd cell: {'PASS' if ok else 'FAIL'} ({note}) "
+              f"[{dt:.1f}s, artifacts in {tmp}]")
+        return 0 if ok else 1
+
+    if args.postmortem_mode:
+        if args.artifacts_dir:
+            tmp = args.artifacts_dir
+            pathlib.Path(tmp).mkdir(parents=True, exist_ok=True)
+        else:
+            import tempfile
+            tmp = tempfile.mkdtemp(prefix="chaos_postmortem_")
+        t0 = time.monotonic()
+        ok, note = postmortem_cell(tmp)
+        dt = time.monotonic() - t0
+        print(f"postmortem cell: {'PASS' if ok else 'FAIL'} ({note}) "
               f"[{dt:.1f}s, artifacts in {tmp}]")
         return 0 if ok else 1
 

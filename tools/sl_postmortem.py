@@ -1,0 +1,403 @@
+#!/usr/bin/env python
+"""Assemble a fleet's flight-recorder dumps into a causal postmortem.
+
+Every process in a deployment carries a blackbox ring
+(``runtime/blackbox.py``) and dumps ``blackbox-{participant}.json`` on
+abnormal exit or on the server's fleet-snapshot fan-out.  This tool
+reads every dump under a run's artifacts directory (plus the span
+journals and rotated ``metrics.jsonl`` when present), aligns the
+per-process clocks on the wire's ``t_send`` edges, merges the rings
+into one fleet timeline, and names the **proximate cause**: the first
+abnormal event — a caught signal, an unhandled exception, a sticky
+ChaosCrash, a ``participant_lost``/``child_exit`` the server recorded,
+a dead broker shard — with its owner, the victim's role, the round it
+died in, the frames in flight at that moment and the barrier the
+server was stalled in.
+
+A SIGKILL'd victim writes nothing; its death is named from the
+*survivors'* evidence (the server's ``participant_lost``/``child_exit``
+events carry the victim, role and round).  Torn or truncated dumps are
+scavenge-parsed, never fatal.  A fault-free run yields a clean
+"no abnormal termination" report — the chaos suite's fault-free twin
+asserts exactly that.
+
+    python tools/sl_postmortem.py <artifacts-dir>               # report
+    python tools/sl_postmortem.py <artifacts-dir> -o postmortem.json
+    python tools/sl_postmortem.py <artifacts-dir> --format json
+"""
+
+from __future__ import annotations
+
+import argparse
+import collections
+import json
+import pathlib
+import sys
+
+_REPO = pathlib.Path(__file__).resolve().parents[1]
+if str(_REPO) not in sys.path:
+    sys.path.insert(0, str(_REPO))
+
+from split_learning_tpu.runtime.blackbox import (  # noqa: E402
+    ABNORMAL_KINDS, find_dumps, load_dump,
+)
+
+#: the server's barrier wait spans in round order — a death mid-round
+#: stalls the first of these the server never closed afterwards
+BARRIER_ORDER = ("ready_wait", "notify_wait", "update_wait")
+
+#: events this close (s) before the cause count as "in flight" when
+#: their publish was never consumed
+IN_FLIGHT_WINDOW_S = 30.0
+
+
+# -- loading ----------------------------------------------------------------
+
+def load_fleet(root: pathlib.Path) -> list[dict]:
+    """Every parseable dump under ``root`` (scavenged ones flagged
+    ``torn``); unreadable files are skipped, never fatal."""
+    out = []
+    for path in find_dumps(root):
+        doc = load_dump(path)
+        if doc is None:
+            continue
+        doc["_path"] = str(path)
+        out.append(doc)
+    return out
+
+
+def load_spans(root: pathlib.Path) -> list[dict]:
+    recs = []
+    root = pathlib.Path(root)
+    for path in sorted(set(root.rglob("spans-*.jsonl"))):
+        try:
+            text = path.read_text(errors="replace")
+        except OSError:
+            continue
+        for line in text.splitlines():
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue
+            if isinstance(rec, dict):
+                recs.append(rec)
+    return recs
+
+
+def load_rounds(root: pathlib.Path) -> list[dict]:
+    """kind=round records from metrics.jsonl + its rotated siblings
+    (oldest first), for naming the last completed round."""
+    out = []
+    root = pathlib.Path(root)
+    paths = []
+    for p in root.rglob("metrics.jsonl*"):
+        suffix = p.name.rsplit(".", 1)[-1]
+        if p.name.endswith(".jsonl") or suffix.isdigit():
+            paths.append(p)
+    for p in sorted(set(paths)):
+        try:
+            text = p.read_text(errors="replace")
+        except OSError:
+            continue
+        for line in text.splitlines():
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue
+            if isinstance(rec, dict) and rec.get("kind") == "round":
+                out.append(rec)
+    return out
+
+
+# -- clock alignment --------------------------------------------------------
+
+def estimate_offsets(spans: list[dict],
+                     reference: str = "server") -> dict[str, float]:
+    """Per-participant clock offset (seconds to ADD to that clock to
+    land on the reference's), from the wire's ``t_send`` edges.
+
+    Every *consume* span carries ``rtt_ms`` = receiver wall clock
+    minus the sender-stamped SLT2 ctx ``t_send``, and its ``parent``
+    is the sender's publish span — so each edge measures
+    ``latency + (C_receiver - C_sender)``.  With traffic in BOTH
+    directions between two processes the latency cancels:
+    ``C_r - C_s = (min d_sr - min d_rs) / 2``.  Offsets propagate
+    breadth-first from the reference; unreached participants get 0
+    (same host, same clock — the common case)."""
+    owner: dict[str, str] = {}
+    for r in spans:
+        sid = r.get("span")
+        if sid:
+            owner[sid] = r.get("part", "?")
+    pair_min: dict[tuple, float] = {}
+    for r in spans:
+        if r.get("name") != "consume" or r.get("rtt_ms") is None:
+            continue
+        sender = owner.get(r.get("parent") or "")
+        receiver = r.get("part")
+        if not sender or not receiver or sender == receiver:
+            continue
+        d = float(r["rtt_ms"]) / 1e3
+        key = (sender, receiver)
+        pair_min[key] = min(pair_min.get(key, d), d)
+    # C_r - C_s per bidirectional pair
+    skew: dict[tuple, float] = {}
+    for (s, r), d_sr in pair_min.items():
+        d_rs = pair_min.get((r, s))
+        if d_rs is not None:
+            skew[(s, r)] = (d_sr - d_rs) / 2.0
+    offsets = {reference: 0.0}
+    frontier = [reference]
+    while frontier:
+        nxt = []
+        for a in frontier:
+            for (s, r), sk in skew.items():
+                if s == a and r not in offsets:
+                    # C_r = C_s + sk -> shift r by offset(s) - sk
+                    offsets[r] = offsets[s] - sk
+                    nxt.append(r)
+                elif r == a and s not in offsets:
+                    offsets[s] = offsets[r] + sk
+                    nxt.append(s)
+        frontier = nxt
+    return offsets
+
+
+# -- timeline ---------------------------------------------------------------
+
+#: tie-break severity at equal timestamps: earlier in ABNORMAL_KINDS
+#: wins (a caught signal beats the lost-transition it caused)
+_SEVERITY = {k: i for i, k in enumerate(ABNORMAL_KINDS)}
+
+
+def build_timeline(dumps: list[dict],
+                   offsets: dict[str, float]) -> list[dict]:
+    """All rings merged, clock-aligned, oldest first.  Each event is
+    annotated with its ``owner`` (the process whose ring recorded it)
+    and the owner's ``role``."""
+    events = []
+    for doc in dumps:
+        own = str(doc.get("participant", "?"))
+        role = str(doc.get("role", "?"))
+        off = offsets.get(own, 0.0)
+        for ev in doc.get("events", []):
+            if not isinstance(ev, dict) or "t" not in ev:
+                continue
+            e = dict(ev)
+            e["owner"] = own
+            e["owner_role"] = role
+            e["t_aligned"] = float(ev["t"]) + off
+            events.append(e)
+    events.sort(key=lambda e: (e["t_aligned"],
+                               _SEVERITY.get(e.get("kind"), 99)))
+    return events
+
+
+def find_cause(timeline: list[dict]) -> dict | None:
+    """The FIRST abnormal event on the aligned fleet timeline — the
+    proximate cause every later abnormality cascades from."""
+    for ev in timeline:
+        if ev.get("kind") in ABNORMAL_KINDS:
+            return ev
+    return None
+
+
+def _victim_of(cause: dict) -> tuple[str, str]:
+    """(victim participant, victim role).  Server-recorded deaths name
+    the victim in the event; a signal/exception/chaos_crash IS the
+    recording process's own death."""
+    kind = cause.get("kind")
+    if kind in ("participant_lost", "child_exit"):
+        return (str(cause.get("participant", "?")),
+                str(cause.get("role", "?")))
+    if kind == "shard_dead":
+        return (f"broker-shard_{cause.get('shard', '?')}",
+                "broker_shard")
+    return (str(cause.get("owner", "?")),
+            str(cause.get("owner_role", "?")))
+
+
+def in_flight_frames(timeline: list[dict], t_cause: float) -> list[dict]:
+    """Queues with publishes in the window before the cause that no
+    ring ever consumed — the frames the victim took down with it."""
+    pub: dict = collections.defaultdict(int)
+    con: dict = collections.defaultdict(int)
+    last_pub: dict = {}
+    for ev in timeline:
+        if ev["t_aligned"] > t_cause:
+            break
+        q = ev.get("queue")
+        if not q:
+            continue
+        if ev.get("kind") == "publish":
+            if ev["t_aligned"] >= t_cause - IN_FLIGHT_WINDOW_S:
+                pub[q] += 1
+                last_pub[q] = ev
+        elif ev.get("kind") == "consume":
+            con[q] += 1
+    out = []
+    for q, n in sorted(pub.items()):
+        missing = n - con.get(q, 0)
+        if missing > 0:
+            out.append({"queue": q, "frames": missing,
+                        "last_publisher": last_pub[q].get("owner"),
+                        "t_last": round(last_pub[q]["t_aligned"], 3)})
+    return out
+
+
+def stalled_barrier(timeline: list[dict],
+                    cause: dict) -> dict | None:
+    """The server barrier in progress at the cause: the last barrier
+    span the server CLOSED before the death tells us which one it was
+    stalled in after it (barriers close in a fixed round order)."""
+    last = None
+    for ev in timeline:
+        if ev["t_aligned"] > cause["t_aligned"]:
+            # a barrier that closed AFTER the cause within the same
+            # round means the round survived; keep the last pre-cause
+            # view regardless — the snapshot freezes at the cause
+            break
+        if ev.get("kind") == "span" and ev.get("owner_role") == "server" \
+                and ev.get("name") in BARRIER_ORDER:
+            last = ev
+    if last is None:
+        # death before any barrier closed: the first barrier is it
+        return {"barrier": BARRIER_ORDER[0], "round": cause.get("round")}
+    idx = BARRIER_ORDER.index(last["name"])
+    if idx + 1 < len(BARRIER_ORDER):
+        return {"barrier": BARRIER_ORDER[idx + 1],
+                "round": last.get("round")}
+    return {"barrier": BARRIER_ORDER[0],
+            "round": (last.get("round") or 0) + 1}
+
+
+# -- assembly ---------------------------------------------------------------
+
+def assemble(root: str | pathlib.Path) -> dict:
+    """The full postmortem document for one artifacts directory."""
+    root = pathlib.Path(root)
+    dumps = load_fleet(root)
+    spans = load_spans(root)
+    rounds = load_rounds(root)
+    offsets = estimate_offsets(spans)
+    timeline = build_timeline(dumps, offsets)
+    cause = find_cause(timeline)
+    doc: dict = {
+        "root": str(root),
+        "dumps": [{
+            "participant": d.get("participant"),
+            "role": d.get("role"),
+            "reason": d.get("reason"),
+            "pid": d.get("pid"),
+            "t_dump": d.get("t_dump"),
+            "events": len(d.get("events", [])),
+            "dropped": d.get("dropped", 0),
+            "torn": bool(d.get("torn")),
+            "path": d.get("_path"),
+        } for d in sorted(dumps,
+                          key=lambda d: str(d.get("participant")))],
+        "clock_offsets": {k: round(v, 6)
+                          for k, v in sorted(offsets.items())},
+        "events": len(timeline),
+        "last_completed_round": (rounds[-1].get("round_idx")
+                                 if rounds else None),
+    }
+    if cause is None:
+        doc["verdict"] = {"abnormal": False,
+                          "summary": "no abnormal termination"}
+        return doc
+    victim, role = _victim_of(cause)
+    rnd = cause.get("round")
+    if rnd is None and rounds:
+        rnd = (rounds[-1].get("round_idx") or 0) + 1
+    barrier = stalled_barrier(timeline, cause)
+    tail = [e for e in timeline
+            if e.get("kind") in ABNORMAL_KINDS][:8]
+    doc["verdict"] = {
+        "abnormal": True,
+        "victim": victim,
+        "role": role,
+        "round": rnd,
+        "cause": {k: v for k, v in cause.items()
+                  if not k.startswith("_")},
+        "reported_by": cause.get("owner"),
+        "stalled_barrier": barrier,
+        "in_flight": in_flight_frames(timeline, cause["t_aligned"]),
+        "abnormal_events": [
+            {"t": round(e["t_aligned"], 3), "kind": e.get("kind"),
+             "owner": e.get("owner"),
+             "participant": e.get("participant"),
+             "sig": e.get("sig"), "round": e.get("round")}
+            for e in tail],
+        "summary": (f"{victim} ({role}) died"
+                    + (f" in round {rnd}" if rnd is not None else "")
+                    + f": first abnormal event {cause.get('kind')}"
+                    f" reported by {cause.get('owner')}"),
+    }
+    return doc
+
+
+def render(doc: dict) -> str:
+    lines = [f"postmortem: {doc['root']}",
+             f"  dumps: {len(doc['dumps'])}  "
+             f"events: {doc['events']}  "
+             f"last completed round: {doc['last_completed_round']}"]
+    for d in doc["dumps"]:
+        torn = "  [TORN]" if d["torn"] else ""
+        lines.append(
+            f"    {d['participant']} ({d['role']}) reason="
+            f"{d['reason']} events={d['events']}"
+            f" dropped={d['dropped']}{torn}")
+    v = doc["verdict"]
+    lines.append("")
+    if not v["abnormal"]:
+        lines.append("verdict: CLEAN — no abnormal termination")
+        return "\n".join(lines)
+    lines.append(f"verdict: {v['summary']}")
+    c = v["cause"]
+    lines.append(f"  cause: kind={c.get('kind')} t={c.get('t')} "
+                 f"owner={v['reported_by']}")
+    if v.get("stalled_barrier"):
+        b = v["stalled_barrier"]
+        lines.append(f"  stalled barrier: {b.get('barrier')} "
+                     f"(round {b.get('round')})")
+    for f in v.get("in_flight", []):
+        lines.append(f"  in flight: {f['frames']} frame(s) on "
+                     f"{f['queue']} (last publisher "
+                     f"{f['last_publisher']})")
+    if len(v.get("abnormal_events", [])) > 1:
+        lines.append("  cascade:")
+        for e in v["abnormal_events"]:
+            who = e.get("participant") or e.get("sig") or ""
+            lines.append(f"    t={e['t']} {e['kind']} "
+                         f"[{e['owner']}] {who}")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="Assemble blackbox dumps into a causal "
+                    "cross-process postmortem report.")
+    ap.add_argument("root", help="artifacts directory holding "
+                                 "blackbox-*.json (searched "
+                                 "recursively)")
+    ap.add_argument("-o", "--out", default=None,
+                    help="also write the postmortem JSON here")
+    ap.add_argument("--format", choices=("human", "json"),
+                    default="human")
+    args = ap.parse_args(argv)
+    doc = assemble(args.root)
+    if args.out:
+        pathlib.Path(args.out).write_text(
+            json.dumps(doc, indent=2, default=str))
+    if args.format == "json":
+        print(json.dumps(doc, indent=2, default=str))
+    else:
+        print(render(doc))
+    # exit 0 either way: reporting an abnormal death is this tool
+    # WORKING, not failing — rigs assert on the verdict contents
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -34,7 +34,22 @@ _RESET = "\033[0m"
 
 _COLUMNS = ("PARTICIPANT", "ROLE", "STATE", "CLUSTER", "SCHED",
             "ROUND", "VLAG", "SAMPLES", "RATE/s", "QDEPTH", "SCORE",
-            "MFU", "STEP p95 ms", "RTT p95 ms", "WIRE MB", "AGE s")
+            "MFU", "STEP p95 ms", "RTT p95 ms", "WIRE MB", "BLACKBOX",
+            "AGE s")
+
+
+def _blackbox_cell(c: dict) -> str:
+    """Flight-recorder health: ``<ring depth>/<last-dump age>`` from
+    the ``blackbox_*`` gauges heartbeats carry (``runtime/blackbox``).
+    "-" for participants without a recorder; age "never" until the
+    first dump."""
+    depth = c.get("blackbox_ring_depth")
+    if depth is None:
+        return "-"
+    age = c.get("blackbox_last_dump_age_s")
+    if age is None or age < 0:
+        return f"{int(depth)}/never"
+    return f"{int(depth)}/{age:.0f}s"
 
 #: telemetry snapshot `kind` -> table role label; aggregator nodes
 #: (aggregation.remote) rate-columns read "-": their samples/s is
@@ -61,7 +76,8 @@ def _broker_rows(brokers: list) -> list[tuple]:
             "-", "-", "-", "-",
             "-" if dead else _fmt(s.get("depth")),       # queued msgs
             "-", "-", "-", "-", "-",
-            f"{wire_mb:.2f}", "-" if dead else _fmt(s.get("uptime_s"))))
+            f"{wire_mb:.2f}", "-",
+            "-" if dead else _fmt(s.get("uptime_s"))))
     return rows
 
 
@@ -200,7 +216,7 @@ def render_fleet(fleet: dict, color: bool = True,
             # predating the plane
             _fmt(c.get("mfu"), 4), _fmt(c.get("step_p95_ms"), 2),
             _fmt(c.get("rtt_p95_ms"), 2),
-            f"{wire_mb:.2f}", _fmt(c.get("age_s")),
+            f"{wire_mb:.2f}", _blackbox_cell(c), _fmt(c.get("age_s")),
         ))
     widths = [max(len(str(r[i])) for r in rows)
               for i in range(len(_COLUMNS))]
